@@ -1,0 +1,24 @@
+"""code2vec_tpu — a TPU-native (JAX/XLA/Pallas) code2vec framework.
+
+A from-scratch reimplementation of the capabilities of sonoisa/code2vec
+(reference at /root/reference), designed TPU-first:
+
+- Flax model compiled under XLA (``code2vec_tpu.models``)
+- jit/pjit train step over a ``jax.sharding.Mesh`` (``code2vec_tpu.parallel``)
+- vectorized host-side input pipeline (``code2vec_tpu.data``)
+- exact artifact-format compatibility with the reference's text interchange
+  files (``code2vec_tpu.formats``) so existing corpora keep working
+- a native C++ path-context extractor (``extractor/``) replacing the
+  reference's Scala/JVM notebook pipeline
+
+Reference layer map: SURVEY.md §1; component inventory: SURVEY.md §2.
+"""
+
+__version__ = "0.1.0"
+
+PAD_INDEX = 0
+PAD_NAME = "<PAD/>"
+QUESTION_TOKEN_NAME = "@question"
+# The terminal vocab injects "@question" at index 1 and shifts all file
+# indices > 0 up by one (reference: model/dataset_reader.py:11-12,29-41).
+QUESTION_TOKEN_INDEX = 1

@@ -1,0 +1,3 @@
+"""Host-side data layer: vocab, corpus reader, TPU-shaped input pipeline."""
+
+from code2vec_tpu.data.vocab import Vocab

@@ -1,0 +1,73 @@
+"""Vocabulary: name<->index maps with per-index frequency and subtokens.
+
+Semantics mirror the reference Vocab (model/dataset.py:52-92) including its
+quirks, which downstream code depends on:
+
+- ``add`` ignores names already present (first index wins).
+- ``freq`` counts *appends per index*, and because duplicate names are
+  ignored, every label's frequency ends up exactly 1 in the reference —
+  making the 1/freq class weights de-facto uniform (SURVEY.md §2.2). We keep
+  the same default but additionally track true occurrence counts in
+  ``occurrences`` so real frequency weighting is available as an opt-in.
+"""
+
+from __future__ import annotations
+
+from code2vec_tpu.text import normalize_and_subtokenize
+
+
+class Vocab:
+    __slots__ = ("stoi", "itos", "itosubtokens", "freq", "occurrences")
+
+    def __init__(self) -> None:
+        self.stoi: dict[str, int] = {}
+        self.itos: dict[int, str] = {}
+        self.itosubtokens: dict[int, tuple[str, ...]] = {}
+        self.freq: dict[int, int] = {}
+        self.occurrences: dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self.stoi)
+
+    def add(
+        self,
+        name: str,
+        index: int | None = None,
+        subtokens: tuple[str, ...] | None = None,
+    ) -> int:
+        """Insert ``name`` if unseen; return its index either way.
+
+        Mirrors Vocab.append (reference: model/dataset.py:64-74): explicit
+        ``index`` wins, otherwise the next dense slot; freq increments only
+        on first sight of the name. ``occurrences`` increments on every call.
+        """
+        existing = self.stoi.get(name)
+        if existing is not None:
+            self.occurrences[existing] = self.occurrences.get(existing, 0) + 1
+            return existing
+        if index is None:
+            index = len(self.stoi)
+        self.stoi[name] = index
+        self.itos[index] = name
+        if subtokens is not None:
+            self.itosubtokens[index] = tuple(subtokens)
+        self.freq[index] = self.freq.get(index, 0) + 1
+        self.occurrences[index] = self.occurrences.get(index, 0) + 1
+        return index
+
+    def add_label(self, raw_name: str) -> int:
+        """Normalize+subtokenize a raw label and insert it (the label-vocab
+        path of the reference corpus loader, model/dataset_reader.py:94-102)."""
+        normalized_lower, subtokens = normalize_and_subtokenize(raw_name)
+        return self.add(normalized_lower, subtokens=subtokens)
+
+    def freq_list(self) -> list[int]:
+        """Dense frequency list indexed 0..len-1 (reference:
+        model/dataset.py:76-81). Raises KeyError on index gaps, like the
+        reference would."""
+        return [self.freq[i] for i in range(len(self.stoi))]
+
+    def occurrence_list(self) -> list[int]:
+        """True occurrence counts (framework extension for real class
+        weighting; the reference's freq is de-facto uniform, SURVEY §2.2)."""
+        return [self.occurrences.get(i, 0) for i in range(len(self.stoi))]

@@ -1,0 +1,31 @@
+"""Text artifact formats — the L1 interchange contract (SURVEY.md §2.4).
+
+These five formats are the only coupling between the extraction half and the
+training half, in the reference and here:
+
+- ``corpus.txt``        blank-line-separated method records
+- ``*_idxs.txt``        vocab files, index 0 = ``<PAD/>``
+- ``params.txt``        extraction stats, ``key:value`` lines
+- ``code.vec``          exported code vectors
+- test-result TSV       per-example prediction dump
+"""
+
+from code2vec_tpu.formats.vocab_io import (
+    read_vocab,
+    write_vocab,
+    write_vocab_from_names,
+)
+from code2vec_tpu.formats.corpus_io import (
+    CorpusRecord,
+    iter_corpus_records,
+    read_corpus,
+    write_corpus,
+    write_corpus_record,
+)
+from code2vec_tpu.formats.params_io import read_params, write_params
+from code2vec_tpu.formats.vectors_io import (
+    read_code_vectors,
+    write_code_vectors_header,
+    append_code_vectors,
+    write_test_results,
+)

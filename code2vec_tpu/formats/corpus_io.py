@@ -1,0 +1,112 @@
+"""``corpus.txt`` streaming parser and writer.
+
+Record format (SURVEY.md §2.4; written by the reference extractor at
+create_path_contexts.ipynb cell11, parsed at model/dataset_reader.py:72-128)::
+
+    #<int id>
+    label:<original method name>
+    class:<source file path>
+    paths:
+    <startIdx>\\t<pathIdx>\\t<endIdx>      (one per path-context)
+    vars:
+    <originalName>\\t<aliasName>           (e.g. counter\\t@var_0)
+
+Records are separated by blank lines. A ``doc:`` line is recognized and its
+value discarded, matching the reference's behavior
+(model/dataset_reader.py:109-110).
+
+This layer is *raw*: terminal indices are emitted exactly as they appear in
+the file. The ``@question`` +1 shift is applied by the dataset reader
+(code2vec_tpu.data.reader), keeping file round-trips byte-faithful.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import IO, Iterator
+
+
+@dataclass
+class CorpusRecord:
+    """One method's worth of corpus data, indices raw as-on-disk."""
+
+    id: int | None = None
+    label: str | None = None
+    source: str | None = None
+    doc: str | None = None
+    path_contexts: list[tuple[int, int, int]] = field(default_factory=list)
+    aliases: list[tuple[str, str]] = field(default_factory=list)  # (original, alias)
+
+
+_MODE_HEADER, _MODE_PATHS, _MODE_VARS = 0, 1, 2
+
+
+def iter_corpus_records(path: str | os.PathLike) -> Iterator[CorpusRecord]:
+    """Stream records from a corpus file with a small line state machine
+    (same three parse modes as the reference, model/dataset_reader.py:72-128)."""
+    record: CorpusRecord | None = None
+    mode = _MODE_HEADER
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip(" \r\n\t")
+            if line == "":
+                if record is not None:
+                    yield record
+                    record = None
+                continue
+            if record is None:
+                record = CorpusRecord()
+                mode = _MODE_HEADER
+            if line.startswith("#"):
+                record.id = int(line[1:])
+            elif line.startswith("label:"):
+                record.label = line[6:]
+            elif line.startswith("class:"):
+                record.source = line[6:]
+            elif line.startswith("doc:"):
+                record.doc = line[4:]
+            elif line.startswith("paths:"):
+                mode = _MODE_PATHS
+            elif line.startswith("vars:"):
+                mode = _MODE_VARS
+            elif mode == _MODE_PATHS:
+                # Index the first three fields, tolerating extra trailing
+                # columns like the reference parser does
+                # (model/dataset_reader.py:112-115).
+                fields = line.split("\t")
+                record.path_contexts.append(
+                    (int(fields[0]), int(fields[1]), int(fields[2]))
+                )
+            elif mode == _MODE_VARS:
+                fields = line.split("\t")
+                record.aliases.append((fields[0], fields[1]))
+    if record is not None:
+        yield record
+
+
+def read_corpus(path: str | os.PathLike) -> list[CorpusRecord]:
+    return list(iter_corpus_records(path))
+
+
+def write_corpus_record(f: IO[str], record: CorpusRecord) -> None:
+    """Write one record followed by the blank separator line."""
+    f.write(f"#{record.id}\n")
+    f.write(f"label:{record.label}\n")
+    if record.source is not None:
+        f.write(f"class:{record.source}\n")
+    if record.doc is not None:
+        f.write(f"doc:{record.doc}\n")
+    f.write("paths:\n")
+    for start, p, end in record.path_contexts:
+        f.write(f"{start}\t{p}\t{end}\n")
+    f.write("vars:\n")
+    for original, alias in record.aliases:
+        f.write(f"{original}\t{alias}\n")
+    f.write("\n")
+
+
+def write_corpus(path: str | os.PathLike, records: list[CorpusRecord]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            write_corpus_record(f, record)

@@ -1,0 +1,66 @@
+"""``code.vec`` and test-result TSV formats.
+
+``code.vec`` (SURVEY.md §2.4): line 1 is ``<count>\\t<dim>``, then one
+``label\\t<space-separated floats>`` row per example (reference:
+main.py:226-230,414-416; read back by visualize_code_vec.py:8-23).
+
+Test-result TSV: ``id\\tcorrect?\\texpected\\tpredicted\\tprob``
+(reference: main.py:418-420).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import IO, Iterable, Sequence
+
+import numpy as np
+
+
+def write_code_vectors_header(path: str | os.PathLike, count: int, dim: int) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{count}\t{dim}\n")
+
+
+def append_code_vectors(
+    path: str | os.PathLike,
+    labels: Sequence[str],
+    vectors: np.ndarray,
+) -> None:
+    """Append label+vector rows (reference row format: main.py:416)."""
+    with open(path, "a", encoding="utf-8") as f:
+        for label, vec in zip(labels, vectors):
+            f.write(label + "\t" + " ".join(str(float(e)) for e in vec) + "\n")
+
+
+def read_code_vectors(path: str | os.PathLike) -> tuple[list[str], np.ndarray]:
+    """Parse code.vec back into (labels, [n, dim] float array)
+    (reference reader: visualize_code_vec.py:8-21)."""
+    labels: list[str] = []
+    rows: list[list[float]] = []
+    with open(path, encoding="utf-8") as f:
+        header = f.readline().strip().split("\t")
+        count, dim = int(header[0]), int(header[1])
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            label, values = line.split("\t")
+            labels.append(label)
+            rows.append([float(v) for v in values.split(" ")])
+    # The header count can disagree with the row count (the reference
+    # re-appends rows per best epoch); tolerate it like the reference
+    # visualizer, which never checks.
+    del count
+    arr = np.asarray(rows, dtype=np.float32) if rows else np.zeros((0, dim), np.float32)
+    return labels, arr
+
+
+def write_test_results(
+    f: IO[str],
+    ids: Iterable[int],
+    expected: Iterable[str],
+    predicted: Iterable[str],
+    probs: Iterable[float],
+) -> None:
+    for i, exp, pred, prob in zip(ids, expected, predicted, probs):
+        f.write(f"{i}\t{exp == pred}\t{exp}\t{pred}\t{prob}\n")

@@ -1,0 +1,60 @@
+"""Vocab file (``terminal_idxs.txt`` / ``path_idxs.txt``) reader/writer.
+
+Format: ``<index>\\t<name>`` per line; index 0 is the ``<PAD/>`` sentinel and
+blank names are tolerated (SURVEY.md §2.4).
+
+The reader supports *extra-token injection*: extras occupy indices 1..k and
+every file index > 0 is shifted up by k. The terminal vocab is always read
+with ``extra_tokens=["@question"]`` so ``@question`` sits at index 1 —
+which is also why raw corpus start/end terminal indices must be shifted by
++1 when parsed (reference: model/dataset_reader.py:18-41,113-115).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Sequence
+
+from code2vec_tpu.data.vocab import Vocab
+
+
+def read_vocab(path: str | os.PathLike, extra_tokens: Sequence[str] = ()) -> Vocab:
+    """Read a vocab file, injecting ``extra_tokens`` at indices 1..k and
+    shifting file indices > 0 up by k (reference: model/dataset_reader.py:22-41)."""
+    vocab = Vocab()
+    extra_size = len(extra_tokens)
+    for offset, name in enumerate(extra_tokens):
+        vocab.add(name, index=1 + offset)
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip(" \r\n")
+            if not line:
+                continue
+            fields = line.split("\t")
+            index = int(fields[0])
+            if index > 0:
+                index += extra_size
+            name = fields[1] if len(fields) > 1 else ""
+            vocab.add(name, index=index)
+    return vocab
+
+
+def write_vocab(path: str | os.PathLike, entries: Iterable[tuple[int, str]]) -> None:
+    """Write ``index\\tname`` lines. Callers are responsible for emitting the
+    ``0\\t<PAD/>`` sentinel first (the extractor does,
+    reference: create_path_contexts.ipynb cell11)."""
+    with open(path, "w", encoding="utf-8") as f:
+        for index, name in entries:
+            f.write(f"{index}\t{name}\n")
+
+
+def write_vocab_from_names(
+    path: str | os.PathLike, names: Iterable[str], pad_name: str = "<PAD/>"
+) -> None:
+    """Write a vocab file with the PAD sentinel at 0 and names at 1..n."""
+    def rows():
+        yield 0, pad_name
+        for i, name in enumerate(names, start=1):
+            yield i, name
+
+    write_vocab(path, rows())

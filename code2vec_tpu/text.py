@@ -1,0 +1,53 @@
+"""Method-name normalization and subtokenization — the kernel of truth.
+
+These rules define label identity for training and the subtoken metrics, so
+they must match the reference exactly (reference: model/dataset.py:55-56,86-92).
+Golden-tested in tests/test_text.py.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+
+# Characters stripped from method/variable names before subtokenization
+# (reference: model/dataset.py:55). "get_value_2" -> "getvalue" after
+# normalize+lower.
+_REDUNDANT_SYMBOL_CHARS = re.compile(r"[_0-9]+")
+
+# camelCase splitter (reference: model/dataset.py:56). Used with re.split so
+# the capture groups become the emitted tokens; None/'' entries are dropped.
+# "toString" -> ["to", "String"]; "HTMLParser" -> (degenerate but pinned
+# behavior, see tests).
+_METHOD_SUBTOKEN_SEPARATOR = re.compile(r"([a-z]+)([A-Z][a-z]+)|([A-Z][a-z]+)")
+
+
+def normalize_method_name(name: str) -> str:
+    """Strip underscores and digits (reference: model/dataset.py:86-88)."""
+    return _REDUNDANT_SYMBOL_CHARS.sub("", name)
+
+
+def subtokenize(normalized_name: str) -> list[str]:
+    """Split a normalized camelCase name into lowercase subtokens.
+
+    Mirrors Vocab.get_method_subtokens (reference: model/dataset.py:90-92):
+    re.split with capturing groups, dropping None and empty strings, then
+    lowercasing each piece.
+    """
+    return [
+        piece.lower()
+        for piece in _METHOD_SUBTOKEN_SEPARATOR.split(normalized_name)
+        if piece is not None and piece != ""
+    ]
+
+
+@lru_cache(maxsize=1 << 20)
+def normalize_and_subtokenize(name: str) -> tuple[str, tuple[str, ...]]:
+    """(normalized_lower_name, subtokens) for a raw method/variable name.
+
+    This is the composition applied to every label in the corpus
+    (reference: model/dataset_reader.py:97-100), cached because corpora
+    repeat names heavily.
+    """
+    normalized = normalize_method_name(name)
+    return normalized.lower(), tuple(subtokenize(normalized))

@@ -1,0 +1,32 @@
+"""Test config: force an 8-device virtual CPU platform for the whole suite.
+
+This is the TPU-pod analogue of a fake backend (SURVEY.md §4): pjit/shard_map
+logic runs on 8 virtual CPU devices, no pod required.
+
+jax may already be imported by pytest plugins (jaxtyping), but backends
+initialize lazily, so env + jax.config updates here still take effect as long
+as no devices were touched yet.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu" and jax.device_count() >= 8, (
+    "tests require the 8-device virtual CPU platform; a real backend was "
+    "initialized before tests/conftest.py could force it — run pytest from "
+    "the repo root"
+)
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} ({jax.default_backend()})"

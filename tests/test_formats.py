@@ -1,0 +1,143 @@
+"""Golden tests for the L1 artifact contract (SURVEY.md §2.4)."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import PAD_NAME, QUESTION_TOKEN_INDEX, QUESTION_TOKEN_NAME
+from code2vec_tpu.formats import (
+    CorpusRecord,
+    iter_corpus_records,
+    read_code_vectors,
+    read_corpus,
+    read_params,
+    read_vocab,
+    write_code_vectors_header,
+    append_code_vectors,
+    write_params,
+)
+from code2vec_tpu.formats.corpus_io import write_corpus
+from code2vec_tpu.formats.vocab_io import write_vocab_from_names
+
+GOLDEN_CORPUS = """#1
+label:getValue
+class:src/Foo.java
+paths:
+3\t7\t4
+5\t2\t3
+vars:
+counter\t@var_0
+name\t@var_1
+
+#2
+label:setCount_2
+class:src/Bar.java
+doc:some javadoc
+paths:
+1\t9\t2
+vars:
+
+"""
+
+
+class TestVocabIO:
+    def test_round_trip_with_pad(self, tmp_path):
+        p = tmp_path / "terminal_idxs.txt"
+        write_vocab_from_names(p, ["@method_0", "int", "@var_0"])
+        vocab = read_vocab(p)
+        assert vocab.stoi[PAD_NAME] == 0
+        assert vocab.stoi["@method_0"] == 1
+        assert vocab.stoi["@var_0"] == 3
+        assert len(vocab) == 4
+
+    def test_extra_token_shift(self, tmp_path):
+        # @question injection shifts every file index > 0 by one
+        # (reference: model/dataset_reader.py:22-41).
+        p = tmp_path / "terminal_idxs.txt"
+        write_vocab_from_names(p, ["@method_0", "int"])
+        vocab = read_vocab(p, extra_tokens=[QUESTION_TOKEN_NAME])
+        assert vocab.stoi[PAD_NAME] == 0
+        assert vocab.stoi[QUESTION_TOKEN_NAME] == QUESTION_TOKEN_INDEX == 1
+        assert vocab.stoi["@method_0"] == 2
+        assert vocab.stoi["int"] == 3
+
+    def test_blank_name_tolerated(self, tmp_path):
+        p = tmp_path / "path_idxs.txt"
+        p.write_text("0\t<PAD/>\n1\t\n2\tSimpleName^MethodCallExpr\n")
+        vocab = read_vocab(p)
+        assert vocab.itos[1] == ""
+        assert len(vocab) == 3
+
+    def test_real_reference_vocab_file(self):
+        # The reference ships dataset/terminal_idxs.txt — parse it for real.
+        vocab = read_vocab(
+            "/root/reference/dataset/terminal_idxs.txt",
+            extra_tokens=[QUESTION_TOKEN_NAME],
+        )
+        assert vocab.stoi[PAD_NAME] == 0
+        assert vocab.stoi[QUESTION_TOKEN_NAME] == 1
+        # file line "1\t@method_0" shifts to 2
+        assert vocab.stoi["@method_0"] == 2
+        assert len(vocab) == 11951  # 11950 file entries + @question
+
+
+class TestCorpusIO:
+    def test_parse_golden(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text(GOLDEN_CORPUS)
+        records = read_corpus(p)
+        assert len(records) == 2
+        r1, r2 = records
+        assert r1.id == 1
+        assert r1.label == "getValue"
+        assert r1.source == "src/Foo.java"
+        assert r1.path_contexts == [(3, 7, 4), (5, 2, 3)]
+        assert r1.aliases == [("counter", "@var_0"), ("name", "@var_1")]
+        assert r2.doc == "some javadoc"
+        assert r2.path_contexts == [(1, 9, 2)]
+        assert r2.aliases == []
+
+    def test_missing_trailing_blank(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("#5\nlabel:run\npaths:\n1\t1\t1")  # no trailing newline
+        records = read_corpus(p)
+        assert len(records) == 1 and records[0].id == 5
+
+    def test_round_trip(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text(GOLDEN_CORPUS)
+        records = read_corpus(p)
+        p2 = tmp_path / "corpus2.txt"
+        write_corpus(p2, records)
+        assert read_corpus(p2) == records
+
+    def test_streaming_matches_batch(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text(GOLDEN_CORPUS)
+        assert list(iter_corpus_records(p)) == read_corpus(p)
+
+
+class TestParamsIO:
+    def test_round_trip(self, tmp_path):
+        p = tmp_path / "params.txt"
+        write_params(p, {"max_length": 8, "max_width": 3, "method_count": 42})
+        assert read_params(p) == {
+            "max_length": "8",
+            "max_width": "3",
+            "method_count": "42",
+        }
+
+    def test_real_reference_params(self):
+        params = read_params("/root/reference/dataset/params.txt")
+        assert params["max_length"] == "8"
+        assert params["max_width"] == "3"
+
+
+class TestVectorsIO:
+    def test_round_trip(self, tmp_path):
+        p = tmp_path / "code.vec"
+        write_code_vectors_header(p, 2, 3)
+        vecs = np.array([[1.0, 2.5, -3.0], [0.0, 0.5, 9.0]], np.float32)
+        append_code_vectors(p, ["getvalue", "setcount"], vecs)
+        labels, arr = read_code_vectors(p)
+        assert labels == ["getvalue", "setcount"]
+        np.testing.assert_allclose(arr, vecs)

@@ -1,0 +1,64 @@
+"""Golden tests pinning normalization/subtokenization semantics
+(reference: model/dataset.py:55-56,86-92 — SURVEY.md §7 hard part (c))."""
+
+from code2vec_tpu.text import (
+    normalize_and_subtokenize,
+    normalize_method_name,
+    subtokenize,
+)
+
+
+class TestNormalizeMethodName:
+    def test_strips_underscores_and_digits(self):
+        assert normalize_method_name("get_value_2") == "getvalue"
+
+    def test_plain_name_unchanged(self):
+        assert normalize_method_name("toString") == "toString"
+
+    def test_leading_underscore(self):
+        assert normalize_method_name("_private") == "private"
+
+    def test_digits_inside(self):
+        assert normalize_method_name("md5Hash") == "mdHash"
+
+    def test_all_stripped(self):
+        assert normalize_method_name("_123_") == ""
+
+
+class TestSubtokenize:
+    # Golden outputs hand-derived from the reference regex
+    # ([a-z]+)([A-Z][a-z]+)|([A-Z][a-z]+) used via re.split + filter.
+    def test_simple_camel(self):
+        assert subtokenize("toString") == ["to", "string"]
+
+    def test_three_tokens(self):
+        assert subtokenize("getValueCount") == ["get", "value", "count"]
+
+    def test_single_lower(self):
+        # no match at all -> split returns the original string
+        assert subtokenize("main") == ["main"]
+
+    def test_leading_capital(self):
+        assert subtokenize("Parse") == ["parse"]
+
+    def test_acronym_behavior_pinned(self):
+        # Degenerate-but-pinned: "parseHTMLDocument" — the regex cannot split
+        # inside acronyms; "HTMLD" has no [A-Z][a-z]+ match until "Document".
+        assert subtokenize("parseHTMLDocument") == ["parsehtml", "document"]
+
+    def test_empty(self):
+        assert subtokenize("") == []
+
+
+class TestComposition:
+    def test_label_pipeline(self):
+        # Exactly what the corpus loader does per label
+        # (reference: model/dataset_reader.py:97-100).
+        lower, subtokens = normalize_and_subtokenize("writeObject_1")
+        assert lower == "writeobject"
+        assert subtokens == ("write", "object")
+
+    def test_cache_consistency(self):
+        a = normalize_and_subtokenize("equalsIgnoreCase")
+        b = normalize_and_subtokenize("equalsIgnoreCase")
+        assert a == b == ("equalsignorecase", ("equals", "ignore", "case"))
