@@ -1,0 +1,336 @@
+"""TPU-shaped input pipeline: split, per-epoch resampling, batching.
+
+The reference rebuilds all tensors in a Python loop per method per epoch
+(model/dataset_builder.py:112-210) — its host-side hot loop (SURVEY.md §3.1).
+Here the same semantics run as O(total log total) vectorized numpy over the
+CSR arrays:
+
+- seeded train/test split (fixing the reference's unseeded global-random
+  split, model/dataset_builder.py:19-26 / SURVEY.md §2.6);
+- per-epoch *random subsample* of up to ``max_contexts`` path-contexts per
+  method — the reference's load-bearing data augmentation
+  (model/dataset_builder.py:134-135);
+- ``@method_0 -> @question`` substitution so the answer isn't leaked
+  (model/dataset_builder.py:122-144);
+- the variable-name task expansion with optional index permutation
+  (model/dataset_builder.py:152-204);
+- static-shape ``[B, L]`` batches (PAD=0) with an example mask so the last
+  partial batch never changes compiled shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX
+from code2vec_tpu.data.reader import CorpusData
+
+
+@dataclass
+class EpochArrays:
+    """One epoch's worth of examples, padded to static shape [N, L]."""
+
+    ids: np.ndarray  # int64 [N]
+    starts: np.ndarray  # int32 [N, L]
+    paths: np.ndarray  # int32 [N, L]
+    ends: np.ndarray  # int32 [N, L]
+    labels: np.ndarray  # int32 [N]
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+
+def split_items(
+    n_items: int, rng: np.random.Generator, split_ratio: float = 0.2
+) -> tuple[np.ndarray, np.ndarray]:
+    """Seeded shuffle-then-slice split: first ``ratio`` fraction is test,
+    rest is train (same slicing as model/dataset_builder.py:23-26, but
+    reproducible — the reference leaves Python's global RNG unseeded)."""
+    perm = rng.permutation(n_items)
+    test_count = int(n_items * split_ratio)
+    return perm[test_count:], perm[:test_count]
+
+
+def _segment_subsample(
+    row_splits: np.ndarray,
+    item_idx: np.ndarray,
+    max_contexts: int,
+    rng: np.random.Generator,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pick up to ``max_contexts`` random contexts per selected item.
+
+    Returns ``(flat_idx, out_row, out_col)``: indices into the flat CSR
+    arrays plus the destination (row, col) in the padded [N, L] output.
+
+    Vectorized equivalent of "shuffle each method's context list, keep the
+    first L" (model/dataset_builder.py:134-135): draw one uniform per
+    context, stably sort by (segment, uniform), keep the first L positions
+    of each segment.
+    """
+    counts = (row_splits[item_idx + 1] - row_splits[item_idx]).astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        empty = np.zeros(0, np.int64)
+        return empty, empty, empty
+
+    seg = np.repeat(np.arange(len(item_idx), dtype=np.int64), counts)
+    # absolute flat index of every context of every selected item
+    seg_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    within = np.arange(total, dtype=np.int64) - np.repeat(seg_starts, counts)
+    flat = np.repeat(row_splits[item_idx], counts) + within
+
+    order = np.lexsort((rng.random(total), seg))
+    # after the stable per-segment sort the segment layout is unchanged,
+    # so position-in-segment is the same ``within`` sequence
+    keep = within < max_contexts
+    kept_order = order[keep]
+    return flat[kept_order], seg[keep], within[keep]
+
+
+def build_method_epoch(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    max_contexts: int,
+    rng: np.random.Generator,
+) -> EpochArrays:
+    """Method-name task epoch: fresh context subsample per method, with the
+    method's own ``@method_0`` token replaced by ``@question``
+    (model/dataset_builder.py:122-150)."""
+    n = len(item_idx)
+    flat, row, col = _segment_subsample(data.row_splits, item_idx, max_contexts, rng)
+
+    starts = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    paths = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    ends = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    starts[row, col] = data.starts[flat]
+    paths[row, col] = data.paths[flat]
+    ends[row, col] = data.ends[flat]
+
+    method_idx = data.method_token_index
+    if method_idx is not None:
+        np.putmask(starts, starts == method_idx, QUESTION_TOKEN_INDEX)
+        np.putmask(ends, ends == method_idx, QUESTION_TOKEN_INDEX)
+
+    return EpochArrays(
+        ids=data.ids[item_idx],
+        starts=starts,
+        paths=paths,
+        ends=ends,
+        labels=data.labels[item_idx],
+    )
+
+
+def build_variable_epoch(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    max_contexts: int,
+    rng: np.random.Generator,
+    shuffle_variable_indexes: bool = False,
+) -> EpochArrays:
+    """Variable-name task epoch (context2name-style extension).
+
+    One example per ``@var_*`` alias of each method: keep only contexts
+    touching *any* variable of interest, shuffle them once per method, then
+    per target variable keep its contexts, rename the target to
+    ``@question`` and optionally remap the other variable ids through a
+    shuffled permutation of the whole ``@var_*`` id set so the model can't
+    memorize id order (model/dataset_builder.py:152-204).
+
+    Examples-per-method varies, so this stays a per-method loop with
+    vectorized inner ops; corpora are method-bounded so this is not the
+    per-context hot path.
+    """
+    variable_indexes = data.variable_indexes
+    perm_map = None
+    if not shuffle_variable_indexes and len(variable_indexes):
+        # identity remap outside shuffle mode (reference builds the same
+        # dict once, model/dataset_builder.py:155-156)
+        perm_map = _index_remap(variable_indexes, variable_indexes)
+
+    ids: list[int] = []
+    labels: list[int] = []
+    rows_s: list[np.ndarray] = []
+    rows_p: list[np.ndarray] = []
+    rows_e: list[np.ndarray] = []
+
+    label_stoi = data.label_vocab.stoi
+    terminal_stoi = data.terminal_vocab.stoi
+
+    for i in item_idx:
+        alias_map = data.aliases[i]
+        alias_names = [a for a in alias_map if a.startswith("@var_")]
+        if not alias_names:
+            continue
+        alias_idx = np.asarray(
+            [terminal_stoi[a] for a in alias_names], dtype=np.int32
+        )
+
+        if shuffle_variable_indexes:
+            shuffled = variable_indexes.copy()
+            rng.shuffle(shuffled)
+            perm_map = _index_remap(variable_indexes, shuffled)
+
+        lo, hi = data.row_splits[i], data.row_splits[i + 1]
+        s, p, e = data.starts[lo:hi], data.paths[lo:hi], data.ends[lo:hi]
+        touches = np.isin(s, alias_idx) | np.isin(e, alias_idx)
+        s, p, e = s[touches], p[touches], e[touches]
+        order = rng.permutation(len(s))
+        s, p, e = s[order], p[order], e[order]
+
+        for alias_name, var_idx in zip(alias_names, alias_idx):
+            mine = (s == var_idx) | (e == var_idx)
+            ms, mp, me = s[mine][:max_contexts], p[mine][:max_contexts], e[mine][:max_contexts]
+            ms = _rename_target(ms, var_idx, perm_map)
+            me = _rename_target(me, var_idx, perm_map)
+            ids.append(int(data.ids[i]))
+            labels.append(label_stoi[alias_map[alias_name]])
+            rows_s.append(ms)
+            rows_p.append(mp)
+            rows_e.append(me)
+
+    n = len(ids)
+    starts = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    paths = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    ends = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    for r, (ms, mp, me) in enumerate(zip(rows_s, rows_p, rows_e)):
+        starts[r, : len(ms)] = ms
+        paths[r, : len(mp)] = mp
+        ends[r, : len(me)] = me
+
+    return EpochArrays(
+        ids=np.asarray(ids, np.int64),
+        starts=starts,
+        paths=paths,
+        ends=ends,
+        labels=np.asarray(labels, np.int32),
+    )
+
+
+def _index_remap(src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    """Dense lookup table mapping terminal id -> remapped id (identity
+    everywhere except the ``@var_*`` ids)."""
+    table = np.arange(int(src.max()) + 1, dtype=np.int32)
+    table[src] = dst
+    return table
+
+
+def _rename_target(
+    values: np.ndarray, target_idx: int, perm_map: np.ndarray | None
+) -> np.ndarray:
+    """Target variable -> @question; other variables through the remap
+    (model/dataset_builder.py:181-195)."""
+    is_target = values == target_idx
+    if perm_map is not None:
+        # the table only covers ids up to max(@var id); larger ids are plain
+        # identifiers and must pass through untouched
+        in_table = values < len(perm_map)
+        remapped = perm_map[np.where(in_table, values, 0)].astype(np.int32)
+        values = np.where(in_table, remapped, values)
+    return np.where(is_target, np.int32(QUESTION_TOKEN_INDEX), values)
+
+
+def build_epoch(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    max_contexts: int,
+    rng: np.random.Generator,
+    shuffle_variable_indexes: bool = False,
+) -> EpochArrays:
+    """Full epoch for whichever tasks the corpus was loaded with, method
+    examples first then variable examples (matching the reference's
+    concatenation order, model/dataset_builder.py:122-204)."""
+    parts: list[EpochArrays] = []
+    if data.infer_method:
+        parts.append(build_method_epoch(data, item_idx, max_contexts, rng))
+    if data.infer_variable:
+        parts.append(
+            build_variable_epoch(
+                data, item_idx, max_contexts, rng, shuffle_variable_indexes
+            )
+        )
+    if len(parts) == 1:
+        return parts[0]
+    return EpochArrays(
+        ids=np.concatenate([p.ids for p in parts]),
+        starts=np.concatenate([p.starts for p in parts]),
+        paths=np.concatenate([p.paths for p in parts]),
+        ends=np.concatenate([p.ends for p in parts]),
+        labels=np.concatenate([p.labels for p in parts]),
+    )
+
+
+def iter_batches(
+    epoch: EpochArrays,
+    batch_size: int,
+    rng: np.random.Generator | None = None,
+    pad_final: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield static-shape batches.
+
+    Every batch has exactly ``batch_size`` rows; the final partial batch is
+    padded with repeated row 0 and masked via ``example_mask`` so jitted
+    steps never see a new shape (XLA recompiles per shape — SURVEY.md §7
+    "static shapes" hard part). With ``pad_final=False`` the remainder is
+    dropped (training-style).
+    """
+    n = len(epoch)
+    order = rng.permutation(n) if rng is not None else np.arange(n)
+    stop = n if pad_final else (n - n % batch_size)
+    for lo in range(0, stop, batch_size):
+        idx = order[lo : lo + batch_size]
+        valid = len(idx)
+        if valid < batch_size:
+            idx = np.concatenate([idx, np.zeros(batch_size - valid, idx.dtype)])
+        mask = np.zeros(batch_size, np.float32)
+        mask[:valid] = 1.0
+        yield {
+            "ids": epoch.ids[idx],
+            "starts": epoch.starts[idx],
+            "paths": epoch.paths[idx],
+            "ends": epoch.ends[idx],
+            "labels": epoch.labels[idx],
+            "example_mask": mask,
+        }
+
+
+def oov_rate(
+    data: CorpusData,
+    train_idx: np.ndarray,
+    test_idx: np.ndarray,
+    exact: bool = False,
+) -> float:
+    """Fraction of test label (sub)tokens absent from the train label token
+    set (reference: model/dataset_builder.py:72-110). ``exact=True`` uses
+    whole labels (the ``eval_method == 'exact'`` branch)."""
+
+    def tokens_of(i: int, out: list[str]) -> None:
+        if data.infer_method:
+            out.extend(_label_tokens(data, data.normalized_labels[i], exact))
+        if data.infer_variable:
+            for alias, normalized in data.aliases[i].items():
+                if alias.startswith("@var_"):
+                    out.extend(_label_tokens(data, normalized, exact))
+
+    train_vocab: set[str] = set()
+    buf: list[str] = []
+    for i in train_idx:
+        tokens_of(int(i), buf)
+    train_vocab.update(buf)
+
+    match = count = 0
+    for i in test_idx:
+        buf = []
+        tokens_of(int(i), buf)
+        match += sum(1 for t in buf if t in train_vocab)
+        count += len(buf)
+    return 1.0 - match / count if count else 0.0
+
+
+def _label_tokens(data: CorpusData, normalized_label: str, exact: bool) -> list[str]:
+    if exact:
+        return [normalized_label]
+    index = data.label_vocab.stoi[normalized_label]
+    return list(data.label_vocab.itosubtokens.get(index, ()))

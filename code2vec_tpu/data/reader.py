@@ -1,0 +1,169 @@
+"""Corpus → flat CSR numpy arrays (the TPU-shaped in-memory representation).
+
+Replaces the reference's list-of-CodeData representation
+(model/dataset_reader.py:44-128) with structure-of-arrays storage: one flat
+int32 array per field plus row_splits, so per-epoch resampling and padding
+are vectorized numpy instead of a Python loop per method per epoch
+(the reference's hot host loop, SURVEY.md §3.1).
+
+Terminal indices are stored *shifted* (+1 for the injected ``@question``
+token), exactly as the reference applies at parse time
+(model/dataset_reader.py:113-115).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from code2vec_tpu import QUESTION_TOKEN_INDEX, QUESTION_TOKEN_NAME
+from code2vec_tpu.data.vocab import Vocab
+from code2vec_tpu.formats.corpus_io import iter_corpus_records
+from code2vec_tpu.formats.vocab_io import read_vocab
+from code2vec_tpu.text import normalize_and_subtokenize
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class CorpusData:
+    """Entire corpus in structure-of-arrays form.
+
+    ``starts/paths/ends`` are flat over all path-contexts of all methods;
+    method ``i`` owns slice ``row_splits[i]:row_splits[i+1]``.
+    """
+
+    # CSR context arrays (terminal ids already @question-shifted)
+    starts: np.ndarray  # int32 [total_contexts]
+    paths: np.ndarray  # int32 [total_contexts]
+    ends: np.ndarray  # int32 [total_contexts]
+    row_splits: np.ndarray  # int64 [n_items + 1]
+
+    # per-item fields
+    ids: np.ndarray  # int64 [n_items] — corpus record ids
+    labels: np.ndarray  # int32 [n_items] — label vocab index (-1 if no method task)
+    normalized_labels: list[str]
+    sources: list[str | None]
+    aliases: list[dict[str, str]]  # alias name -> normalized original name
+
+    # vocabs
+    terminal_vocab: Vocab = field(repr=False)
+    path_vocab: Vocab = field(repr=False)
+    label_vocab: Vocab = field(repr=False)
+
+    # task config this corpus was loaded with
+    infer_method: bool = True
+    infer_variable: bool = False
+
+    # terminal ids whose name starts with "@var_"
+    # (reference: model/dataset_reader.py:54-56)
+    variable_indexes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+
+    @property
+    def n_items(self) -> int:
+        return len(self.row_splits) - 1
+
+    @property
+    def n_contexts(self) -> int:
+        return int(self.row_splits[-1])
+
+    def context_counts(self) -> np.ndarray:
+        return np.diff(self.row_splits)
+
+    @property
+    def method_token_index(self) -> int | None:
+        """Shifted index of ``@method_0`` if present (needed for the
+        answer-leak substitution, reference: model/dataset_builder.py:124)."""
+        return self.terminal_vocab.stoi.get("@method_0")
+
+
+def load_corpus(
+    corpus_path: str | os.PathLike,
+    path_idx_path: str | os.PathLike,
+    terminal_idx_path: str | os.PathLike,
+    infer_method: bool = True,
+    infer_variable: bool = False,
+) -> CorpusData:
+    """Load vocabs + corpus into a CorpusData.
+
+    Mirrors DatasetReader (reference: model/dataset_reader.py:44-128):
+    terminal vocab read with ``@question`` injected at 1, raw corpus
+    terminal indices shifted +1, label vocab built record-by-record from
+    method labels (if ``infer_method``) and ``@var_*`` original names
+    (if ``infer_variable``) — same insertion order, hence identical indices.
+    """
+    path_vocab = read_vocab(path_idx_path)
+    logger.info("path vocab size: %d", len(path_vocab))
+    terminal_vocab = read_vocab(terminal_idx_path, extra_tokens=[QUESTION_TOKEN_NAME])
+    logger.info("terminal vocab size: %d", len(terminal_vocab))
+
+    variable_indexes = np.asarray(
+        sorted(
+            idx for name, idx in terminal_vocab.stoi.items() if name.startswith("@var_")
+        ),
+        dtype=np.int32,
+    )
+    logger.info("variable index size: %d", len(variable_indexes))
+
+    label_vocab = Vocab()
+    starts_parts: list[np.ndarray] = []
+    paths_parts: list[np.ndarray] = []
+    ends_parts: list[np.ndarray] = []
+    counts: list[int] = []
+    ids: list[int] = []
+    labels: list[int] = []
+    normalized_labels: list[str] = []
+    sources: list[str | None] = []
+    aliases: list[dict[str, str]] = []
+
+    for record in iter_corpus_records(corpus_path):
+        ids.append(record.id if record.id is not None else len(ids))
+        sources.append(record.source)
+
+        normalized_lower, _ = normalize_and_subtokenize(record.label or "")
+        normalized_labels.append(normalized_lower)
+        if infer_method:
+            labels.append(label_vocab.add_label(record.label or ""))
+        else:
+            labels.append(-1)
+
+        contexts = np.asarray(record.path_contexts, dtype=np.int32).reshape(-1, 3)
+        starts_parts.append(contexts[:, 0] + QUESTION_TOKEN_INDEX)
+        paths_parts.append(contexts[:, 1])
+        ends_parts.append(contexts[:, 2] + QUESTION_TOKEN_INDEX)
+        counts.append(len(contexts))
+
+        alias_map: dict[str, str] = {}
+        for original, alias in record.aliases:
+            normalized_var, _ = normalize_and_subtokenize(original)
+            alias_map[alias] = normalized_var.lower()
+            if infer_variable and alias.startswith("@var_"):
+                label_vocab.add_label(original)
+        aliases.append(alias_map)
+
+    row_splits = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_splits[1:])
+
+    data = CorpusData(
+        starts=np.concatenate(starts_parts) if starts_parts else np.zeros(0, np.int32),
+        paths=np.concatenate(paths_parts) if paths_parts else np.zeros(0, np.int32),
+        ends=np.concatenate(ends_parts) if ends_parts else np.zeros(0, np.int32),
+        row_splits=row_splits,
+        ids=np.asarray(ids, dtype=np.int64),
+        labels=np.asarray(labels, dtype=np.int32),
+        normalized_labels=normalized_labels,
+        sources=sources,
+        aliases=aliases,
+        terminal_vocab=terminal_vocab,
+        path_vocab=path_vocab,
+        label_vocab=label_vocab,
+        infer_method=infer_method,
+        infer_variable=infer_variable,
+        variable_indexes=variable_indexes,
+    )
+    logger.info("label vocab size: %d", len(label_vocab))
+    logger.info("corpus: %d items, %d contexts", data.n_items, data.n_contexts)
+    return data

@@ -1,0 +1,230 @@
+"""Tests for the reader + vectorized epoch pipeline (SURVEY.md §2.5-2.6)."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX
+from code2vec_tpu.data.pipeline import (
+    build_epoch,
+    build_method_epoch,
+    build_variable_epoch,
+    iter_batches,
+    oov_rate,
+    split_items,
+)
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.data.synth import SPECS, SynthSpec, generate_corpus_files
+
+
+@pytest.fixture(scope="module")
+def tiny_corpus(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tiny")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    return paths
+
+
+@pytest.fixture(scope="module")
+def tiny_data(tiny_corpus):
+    return load_corpus(
+        tiny_corpus["corpus"],
+        tiny_corpus["path_idx"],
+        tiny_corpus["terminal_idx"],
+        infer_method=True,
+        infer_variable=True,
+    )
+
+
+class TestReader:
+    def test_shapes_consistent(self, tiny_data):
+        d = tiny_data
+        assert d.n_items == 200
+        assert len(d.starts) == len(d.paths) == len(d.ends) == d.n_contexts
+        assert d.row_splits[0] == 0 and d.row_splits[-1] == d.n_contexts
+        assert (np.diff(d.row_splits) >= 0).all()
+
+    def test_question_shift_applied(self, tiny_data):
+        # @method_0 raw idx 1 -> shifted 2; @question occupies 1
+        assert tiny_data.terminal_vocab.stoi["@question"] == QUESTION_TOKEN_INDEX
+        assert tiny_data.method_token_index == 2
+        # paths are NOT shifted
+        assert tiny_data.paths.min() >= 1
+
+    def test_labels_built_in_order(self, tiny_data):
+        assert tiny_data.labels.min() >= 0
+        assert len(tiny_data.label_vocab) > 0
+        # every label id resolves to subtokens
+        for i in range(len(tiny_data.label_vocab)):
+            assert tiny_data.label_vocab.itos[i]
+
+    def test_variable_indexes(self, tiny_data):
+        names = [tiny_data.terminal_vocab.itos[i] for i in tiny_data.variable_indexes]
+        assert all(n.startswith("@var_") for n in names)
+        assert len(names) == SPECS["tiny"].n_vars
+
+
+class TestSplit:
+    def test_deterministic(self):
+        a = split_items(100, np.random.default_rng(7))
+        b = split_items(100, np.random.default_rng(7))
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+    def test_ratio_and_disjoint(self):
+        train, test = split_items(100, np.random.default_rng(0), 0.2)
+        assert len(test) == 20 and len(train) == 80
+        assert not set(train) & set(test)
+
+
+class TestMethodEpoch:
+    def test_static_shape_and_padding(self, tiny_data):
+        idx = np.arange(tiny_data.n_items)
+        ep = build_method_epoch(tiny_data, idx, 50, np.random.default_rng(0))
+        assert ep.starts.shape == (tiny_data.n_items, 50)
+        counts = tiny_data.context_counts()
+        for i in [0, 5, 17]:
+            n_real = min(int(counts[i]), 50)
+            assert (ep.starts[i, :n_real] != PAD_INDEX).all()
+            assert (ep.starts[i, n_real:] == PAD_INDEX).all()
+            assert (ep.paths[i, n_real:] == PAD_INDEX).all()
+
+    def test_subsample_is_subset_of_method_contexts(self, tiny_data):
+        idx = np.arange(10)
+        ep = build_method_epoch(tiny_data, idx, 8, np.random.default_rng(1))
+        for i in range(10):
+            lo, hi = tiny_data.row_splits[i], tiny_data.row_splits[i + 1]
+            legal_paths = set(tiny_data.paths[lo:hi].tolist())
+            got = [p for p in ep.paths[i] if p != PAD_INDEX]
+            assert set(got) <= legal_paths
+            assert len(got) == min(hi - lo, 8)
+
+    def test_no_method_token_leak(self, tiny_data):
+        idx = np.arange(tiny_data.n_items)
+        ep = build_method_epoch(tiny_data, idx, 200, np.random.default_rng(2))
+        m = tiny_data.method_token_index
+        assert not (ep.starts == m).any()
+        assert not (ep.ends == m).any()
+        # and substitution produced @question somewhere (synth sprinkles it)
+        assert (ep.starts == QUESTION_TOKEN_INDEX).any()
+
+    def test_resampling_differs_across_epochs(self, tiny_data):
+        idx = np.arange(tiny_data.n_items)
+        rng = np.random.default_rng(3)
+        a = build_method_epoch(tiny_data, idx, 10, rng)
+        b = build_method_epoch(tiny_data, idx, 10, rng)
+        assert (a.paths != b.paths).any()
+
+    def test_matches_naive_reference_semantics(self, tiny_data):
+        # Oracle: per-method "shuffle then take first L" yields some subset
+        # of size min(n, L); verify the vectorized path produces exactly a
+        # permutation-invariant subset with correct multiplicity.
+        idx = np.asarray([3])
+        ep = build_method_epoch(tiny_data, idx, 5, np.random.default_rng(4))
+        lo, hi = tiny_data.row_splits[3], tiny_data.row_splits[3 + 1]
+        bag = list(
+            zip(
+                tiny_data.starts[lo:hi].tolist(),
+                tiny_data.paths[lo:hi].tolist(),
+                tiny_data.ends[lo:hi].tolist(),
+            )
+        )
+        m = tiny_data.method_token_index
+        bag = [
+            (
+                QUESTION_TOKEN_INDEX if s == m else s,
+                p,
+                QUESTION_TOKEN_INDEX if e == m else e,
+            )
+            for s, p, e in bag
+        ]
+        got = [
+            (int(s), int(p), int(e))
+            for s, p, e in zip(ep.starts[0], ep.paths[0], ep.ends[0])
+            if p != PAD_INDEX
+        ]
+        # multiset containment
+        from collections import Counter
+
+        assert not Counter(got) - Counter(bag)
+        assert len(got) == min(len(bag), 5)
+
+
+class TestVariableEpoch:
+    def test_examples_per_alias(self, tiny_data):
+        idx = np.arange(tiny_data.n_items)
+        ep = build_variable_epoch(tiny_data, idx, 20, np.random.default_rng(0))
+        expected = sum(
+            len([a for a in tiny_data.aliases[i] if a.startswith("@var_")])
+            for i in range(tiny_data.n_items)
+        )
+        assert len(ep) == expected
+
+    def test_target_renamed_to_question(self, tiny_data):
+        idx = np.arange(tiny_data.n_items)
+        ep = build_variable_epoch(tiny_data, idx, 20, np.random.default_rng(0))
+        var_ids = set(tiny_data.variable_indexes.tolist())
+        for r in range(len(ep)):
+            row = [
+                (int(s), int(e))
+                for s, e in zip(ep.starts[r], ep.ends[r])
+                if (s, e) != (PAD_INDEX, PAD_INDEX) and ep.paths[r][0] != PAD_INDEX
+            ]
+            # every example must mention @question at least once
+            flat = [v for se in row for v in se]
+            if row:
+                assert QUESTION_TOKEN_INDEX in flat
+
+    def test_plain_identifiers_untouched_by_remap(self, tiny_data):
+        # regression: ids above max(@var id) must pass through the remap
+        # table untouched (clamping used to rewrite them to @var tokens)
+        from code2vec_tpu.data.pipeline import _index_remap, _rename_target
+
+        var_ids = np.asarray([3, 4, 5], np.int32)
+        table = _index_remap(var_ids, var_ids[::-1].copy())
+        values = np.asarray([3, 100, 250, 4], np.int32)
+        out = _rename_target(values, target_idx=3, perm_map=table)
+        assert out.tolist() == [QUESTION_TOKEN_INDEX, 100, 250, 4]
+
+    def test_shuffle_variable_indexes_remaps(self, tiny_data):
+        idx = np.arange(tiny_data.n_items)
+        a = build_variable_epoch(
+            tiny_data, idx, 20, np.random.default_rng(5), shuffle_variable_indexes=False
+        )
+        b = build_variable_epoch(
+            tiny_data, idx, 20, np.random.default_rng(5), shuffle_variable_indexes=True
+        )
+        assert len(a) == len(b)
+
+
+class TestBatches:
+    def test_static_batches_with_mask(self, tiny_data):
+        ep = build_epoch(
+            tiny_data, np.arange(50), 16, np.random.default_rng(0)
+        )
+        batches = list(iter_batches(ep, batch_size=32, rng=np.random.default_rng(1)))
+        assert all(b["starts"].shape == (32, 16) for b in batches)
+        total_valid = sum(int(b["example_mask"].sum()) for b in batches)
+        assert total_valid == len(ep)
+        # all but last fully valid
+        assert all(b["example_mask"].all() for b in batches[:-1])
+
+    def test_drop_remainder(self, tiny_data):
+        ep = build_epoch(tiny_data, np.arange(50), 16, np.random.default_rng(0))
+        batches = list(iter_batches(ep, 32, np.random.default_rng(1), pad_final=False))
+        assert len(batches) == len(ep) // 32
+
+
+class TestOOV:
+    def test_range_and_determinism(self, tiny_data):
+        train, test = split_items(tiny_data.n_items, np.random.default_rng(0))
+        r = oov_rate(tiny_data, train, test)
+        assert 0.0 <= r <= 1.0
+        assert r == oov_rate(tiny_data, train, test)
+
+
+class TestSynthFiles:
+    def test_params_written(self, tiny_corpus):
+        from code2vec_tpu.formats import read_params
+
+        params = read_params(tiny_corpus["params"])
+        assert params["method_count"] == "200"
+        assert params["max_length"] == "8"
