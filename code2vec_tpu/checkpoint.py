@@ -1,0 +1,108 @@
+"""Checkpoint save/restore via orbax.
+
+The reference only ever writes ``torch.save(state_dict)`` on a new best F1
+and has no load path at all (main.py:231; SURVEY.md §5.4). TPU pod runs get
+preempted, so this framework treats resume as first-class: params, optimizer
+state, RNG, epoch counter, and the early-stop bookkeeping all round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+CHECKPOINT_DIR = "code2vec_ckpt"
+META_FILE = "train_meta.json"
+
+
+@dataclass
+class TrainMeta:
+    """Host-side loop state saved alongside the device pytree."""
+
+    epoch: int = 0
+    best_f1: float | None = None
+    last_loss: float | None = None
+    last_accuracy: float | None = None
+    bad_count: int = 0
+    history: list[dict] = field(default_factory=list)
+
+
+def _state_pytree(state) -> dict:
+    dropout_rng = state.dropout_rng
+    if jax.dtypes.issubdtype(dropout_rng.dtype, jax.dtypes.prng_key):
+        dropout_rng = jax.random.key_data(dropout_rng)
+    return {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "dropout_rng": dropout_rng,
+        "step": np.asarray(state.step),
+    }
+
+
+def _latest_step_dir(base: str) -> str | None:
+    if not os.path.isdir(base):
+        return None
+    steps = sorted(
+        (int(name.split("_")[1]), name)
+        for name in os.listdir(base)
+        if name.startswith("step_") and name.split("_")[1].isdigit()
+    )
+    return os.path.join(base, steps[-1][1]) if steps else None
+
+
+def save_checkpoint(out_dir: str, state, meta: TrainMeta) -> str:
+    """Save the train state pytree + loop metadata under ``out_dir``.
+
+    Preemption-safe: each save goes to a fresh ``step_N`` directory and
+    older checkpoints are pruned only after the new one is fully written, so
+    a crash mid-save never leaves the run without a restorable checkpoint.
+    """
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    os.makedirs(base, exist_ok=True)
+    previous = _latest_step_dir(base)
+    path = os.path.join(base, f"step_{int(state.step)}")
+    if os.path.exists(path):
+        import shutil
+
+        shutil.rmtree(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, _state_pytree(state))
+    meta_tmp = os.path.join(out_dir, META_FILE + ".tmp")
+    with open(meta_tmp, "w") as f:
+        json.dump(asdict(meta), f)
+    os.replace(meta_tmp, os.path.join(out_dir, META_FILE))
+    if previous is not None and previous != path:
+        import shutil
+
+        shutil.rmtree(previous, ignore_errors=True)
+    return path
+
+
+def restore_checkpoint(out_dir: str, state) -> tuple[object, TrainMeta] | None:
+    """Restore into the shape of ``state``; returns None if no checkpoint."""
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    meta_path = os.path.join(out_dir, META_FILE)
+    path = _latest_step_dir(base)
+    if path is None or not os.path.exists(meta_path):
+        return None
+    template = _state_pytree(state)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+    dropout_rng = restored["dropout_rng"]
+    if jax.dtypes.issubdtype(state.dropout_rng.dtype, jax.dtypes.prng_key):
+        dropout_rng = jax.random.wrap_key_data(dropout_rng)
+    new_state = state.replace(
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        dropout_rng=dropout_rng,
+        step=int(restored["step"]),
+    )
+    with open(meta_path) as f:
+        meta = TrainMeta(**json.load(f))
+    return new_state, meta

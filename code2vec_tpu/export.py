@@ -1,0 +1,119 @@
+"""Vector export and sample printing (reference: main.py:226-230,362-423).
+
+``code.vec`` is rewritten on every new best F1: header line, then train rows
+followed by test rows. The optional test-result TSV records per-example
+predictions. ``print_sample`` logs one correctly-predicted example with its
+per-context attention, skipping PAD rows.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from code2vec_tpu import PAD_INDEX
+from code2vec_tpu.data.pipeline import EpochArrays, iter_batches
+from code2vec_tpu.data.reader import CorpusData
+from code2vec_tpu.formats.vectors_io import (
+    append_code_vectors,
+    write_code_vectors_header,
+    write_test_results,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _forward_all(eval_step, state, epoch: EpochArrays, batch_size: int):
+    """Run the jitted eval step over every example; returns host arrays
+    (labels, preds, max_logit, code_vectors) with padding rows removed."""
+    labels, preds, logits, vectors, ids = [], [], [], [], []
+    for batch in iter_batches(epoch, batch_size, rng=None, pad_final=True):
+        out = eval_step(state, batch)
+        valid = batch["example_mask"].astype(bool)
+        labels.append(batch["labels"][valid])
+        ids.append(batch["ids"][valid])
+        preds.append(np.asarray(out["preds"])[valid])
+        logits.append(np.asarray(out["max_logit"])[valid])
+        vectors.append(np.asarray(out["code_vector"])[valid])
+    return (
+        np.concatenate(labels),
+        np.concatenate(ids),
+        np.concatenate(preds),
+        np.concatenate(logits),
+        np.concatenate(vectors),
+    )
+
+
+def write_code_vectors(
+    data: CorpusData,
+    state,
+    eval_step,
+    train_epoch: EpochArrays,
+    test_epoch: EpochArrays,
+    batch_size: int,
+    vectors_path: str,
+    encode_size: int,
+    test_result_path: str | None = None,
+) -> None:
+    """Rewrite code.vec (train rows then test rows, reference
+    main.py:226-230) and optionally the test-result TSV (main.py:418-420).
+
+    Header counts the actual rows written — with the variable task enabled
+    an epoch holds one extra example per @var alias, so this can exceed
+    ``data.n_items`` (the reference writes n_items and under-counts;
+    external word2vec-format readers need the true count).
+    """
+    write_code_vectors_header(
+        vectors_path, len(train_epoch) + len(test_epoch), encode_size
+    )
+    itos = data.label_vocab.itos
+
+    for split_epoch, is_test in ((train_epoch, False), (test_epoch, True)):
+        labels, ids, preds, max_logit, vectors = _forward_all(
+            eval_step, state, split_epoch, batch_size
+        )
+        label_names = [itos[int(label)] for label in labels]
+        append_code_vectors(vectors_path, label_names, vectors)
+        if is_test and test_result_path is not None:
+            pred_names = [itos[int(p)] for p in preds]
+            with open(test_result_path, "w", encoding="utf-8") as f:
+                write_test_results(f, ids.tolist(), label_names, pred_names,
+                                   max_logit.tolist())
+
+
+def print_sample(
+    data: CorpusData,
+    state,
+    eval_step,
+    test_epoch: EpochArrays,
+    batch_size: int,
+) -> None:
+    """Log one correctly-predicted test example with per-context attention
+    weights, skipping PAD rows (reference: main.py:362-390)."""
+    terminal_itos = data.terminal_vocab.itos
+    path_itos = data.path_vocab.itos
+    label_itos = data.label_vocab.itos
+    for batch in iter_batches(test_epoch, batch_size, rng=None, pad_final=True):
+        out = eval_step(state, batch)
+        preds = np.asarray(out["preds"])
+        attn = np.asarray(out["attention"])
+        valid = batch["example_mask"].astype(bool)
+        hits = np.nonzero((preds == batch["labels"]) & valid)[0]
+        if not len(hits):
+            continue
+        i = int(hits[0])
+        for s, p, e, a in zip(
+            batch["starts"][i], batch["paths"][i], batch["ends"][i], attn[i]
+        ):
+            if s != PAD_INDEX:
+                logger.info(
+                    "%s %s %s [%s]",
+                    terminal_itos[int(s)],
+                    path_itos[int(p)],
+                    terminal_itos[int(e)],
+                    a,
+                )
+        logger.info("expected label: %s", label_itos[int(batch["labels"][i])])
+        logger.info("actual label:   %s", label_itos[int(preds[i])])
+        return

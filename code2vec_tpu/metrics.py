@@ -1,0 +1,102 @@
+"""Evaluation metrics: the reference's three matchers (main.py:291-359).
+
+All three consume label *ids* plus the label vocab's subtoken table and run
+host-side on numpy — they are string-set metrics, not device math.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from code2vec_tpu.data.vocab import Vocab
+
+
+def exact_match(
+    expected: np.ndarray, actual: np.ndarray
+) -> tuple[float, float, float, float]:
+    """Accuracy + weighted P/R/F1 on raw label ids (reference:
+    main.py:300-305, via sklearn)."""
+    from sklearn.metrics import accuracy_score, precision_recall_fscore_support
+
+    precision, recall, f1, _ = precision_recall_fscore_support(
+        expected, actual, average="weighted", zero_division=0
+    )
+    accuracy = accuracy_score(expected, actual)
+    return float(accuracy), float(precision), float(recall), float(f1)
+
+
+def subtoken_match(
+    expected: np.ndarray, actual: np.ndarray, label_vocab: Vocab
+) -> tuple[float, float, float, float]:
+    """Corpus-pooled subtoken overlap — the code2vec-paper-style metric and
+    the reference default (main.py:339-359).
+
+    A predicted subtoken counts as a match if it appears in the expected
+    name's subtoken list (membership, not multiset intersection — parity
+    with the reference's ``in`` loop).
+    """
+    match = expected_count = actual_count = 0.0
+    itosubtokens = label_vocab.itosubtokens
+    for exp, act in zip(expected.tolist(), actual.tolist()):
+        exp_subtokens = itosubtokens[int(exp)]
+        act_subtokens = itosubtokens[int(act)]
+        for subtoken in exp_subtokens:
+            if subtoken in act_subtokens:
+                match += 1
+        expected_count += len(exp_subtokens)
+        actual_count += len(act_subtokens)
+
+    denom = expected_count + actual_count - match
+    accuracy = match / denom if denom else 0.0
+    precision = match / actual_count if actual_count else 0.0
+    recall = match / expected_count if expected_count else 0.0
+    f1 = (
+        2.0 * precision * recall / (precision + recall)
+        if precision + recall > 0
+        else 0.0
+    )
+    return accuracy, precision, recall, f1
+
+
+def averaged_subtoken_match(
+    expected: np.ndarray, actual: np.ndarray, label_vocab: Vocab
+) -> tuple[float, float, float, float]:
+    """Per-example Jaccard-style subtoken metrics, then arithmetic mean
+    (reference: main.py:308-336)."""
+    accs, precs, recs, f1s = [], [], [], []
+    itosubtokens = label_vocab.itosubtokens
+    for exp, act in zip(expected.tolist(), actual.tolist()):
+        exp_subtokens = itosubtokens[int(exp)]
+        act_subtokens = itosubtokens[int(act)]
+        match = sum(1 for s in exp_subtokens if s in act_subtokens)
+        acc = match / float(len(exp_subtokens) + len(act_subtokens) - match)
+        rec = match / float(len(exp_subtokens))
+        prec = match / float(len(act_subtokens))
+        f1 = 2.0 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+        accs.append(acc)
+        precs.append(prec)
+        recs.append(rec)
+        f1s.append(f1)
+    return (
+        float(np.average(accs)),
+        float(np.average(precs)),
+        float(np.average(recs)),
+        float(np.average(f1s)),
+    )
+
+
+def evaluate(
+    eval_method: str,
+    expected: np.ndarray,
+    actual: np.ndarray,
+    label_vocab: Vocab,
+) -> tuple[float, float, float, float]:
+    """Dispatch mirroring main.py:291-296. Returns
+    (accuracy, precision, recall, f1)."""
+    if eval_method == "exact":
+        return exact_match(expected, actual)
+    if eval_method == "subtoken":
+        return subtoken_match(expected, actual, label_vocab)
+    if eval_method == "ave_subtoken":
+        return averaged_subtoken_match(expected, actual, label_vocab)
+    raise ValueError(f"unknown eval_method: {eval_method!r}")

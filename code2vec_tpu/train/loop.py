@@ -1,0 +1,316 @@
+"""The experiment driver loop (reference: main.py:118-248).
+
+Control-flow parity with ``_train``: per-epoch dataset refresh (fresh
+context subsample), train pass, test pass, metric emission, best-F1
+checkpoint + vector export, ``print_sample`` every N epochs, early stop when
+``bad_count > patience`` with the reference's quirky improvement test
+(train-loss OR accuracy improving resets the counter, main.py:233-242).
+
+Extensions over the reference: seeded split, resumable checkpoints, an
+injectable ``report_fn`` for HPO pruning, metric sinks (stdout JSON /
+logging / TensorBoard), and optional jax.profiler tracing.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu import export as export_mod
+from code2vec_tpu.checkpoint import TrainMeta, restore_checkpoint, save_checkpoint
+from code2vec_tpu.data.pipeline import build_epoch, iter_batches, oov_rate, split_items
+from code2vec_tpu.data.reader import CorpusData
+from code2vec_tpu.metrics import evaluate
+from code2vec_tpu.models.code2vec import Code2VecConfig
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.step import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrainResult:
+    best_f1: float
+    final_f1: float
+    epochs_run: int
+    history: list[dict] = field(default_factory=list)
+    state: object | None = None
+
+
+class StopTraining(Exception):
+    """Raised by a report_fn to end training early (the optuna-prune hook,
+    reference: main.py:207-211)."""
+
+
+def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
+    return Code2VecConfig(
+        terminal_count=len(data.terminal_vocab),
+        path_count=len(data.path_vocab),
+        label_count=len(data.label_vocab),
+        terminal_embed_size=config.terminal_embed_size,
+        path_embed_size=config.path_embed_size,
+        encode_size=config.encode_size,
+        dropout_prob=config.dropout_prob,
+        angular_margin_loss=config.angular_margin_loss,
+        angular_margin=config.angular_margin,
+        inverse_temp=config.inverse_temp,
+        dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
+    )
+
+
+def class_weights_from(config: TrainConfig, data: CorpusData) -> jnp.ndarray:
+    """1/freq over the de-facto-uniform freq table by default (reference
+    behavior, main.py:129-130 + SURVEY.md §2.2); true inverse-occurrence or
+    unweighted as opt-ins."""
+    if config.class_weighting == "reference":
+        freq = np.asarray(data.label_vocab.freq_list(), np.float32)
+    elif config.class_weighting == "occurrence":
+        freq = np.asarray(data.label_vocab.occurrence_list(), np.float32)
+    elif config.class_weighting == "none":
+        freq = np.ones(len(data.label_vocab), np.float32)
+    else:
+        raise ValueError(f"unknown class_weighting: {config.class_weighting!r}")
+    return jnp.asarray(1.0 / np.maximum(freq, 1.0))
+
+
+MetricSink = Callable[[int, dict[str, float]], None]
+
+
+def logging_sink(epoch: int, metrics: dict[str, float]) -> None:
+    """Per-epoch JSON metric lines (reference emits the same shape,
+    main.py:183-205)."""
+    logger.info("epoch %d", epoch)
+    for name, value in metrics.items():
+        logger.info('{"metric": "%s", "value": %s}', name, value)
+
+
+def train(
+    config: TrainConfig,
+    data: CorpusData,
+    out_dir: str | None = None,
+    vectors_path: str | None = None,
+    test_result_path: str | None = None,
+    sinks: tuple[MetricSink, ...] = (logging_sink,),
+    report_fn: Callable[[int, float], None] | None = None,
+    initial_state=None,
+    train_step=None,
+    eval_step=None,
+    profile_dir: str | None = None,
+) -> TrainResult:
+    """Run the full training loop on a loaded corpus.
+
+    ``initial_state``/``train_step``/``eval_step`` may be injected (the HPO
+    driver reuses jitted steps across trials; the parallel driver passes
+    sharded variants).
+    """
+    # task selection is fixed at corpus-load time; catch silent mismatches
+    # between the config's task flags and what the corpus was loaded with
+    if config.infer_method_name != data.infer_method or (
+        config.infer_variable_name != data.infer_variable
+    ):
+        raise ValueError(
+            "task flags disagree with the loaded corpus: config has "
+            f"infer_method_name={config.infer_method_name}, "
+            f"infer_variable_name={config.infer_variable_name} but the corpus "
+            f"was loaded with infer_method={data.infer_method}, "
+            f"infer_variable={data.infer_variable}; pass matching flags to "
+            "load_corpus"
+        )
+
+    np_rng = np.random.default_rng(config.random_seed)
+    jax_rng = jax.random.PRNGKey(config.random_seed)
+
+    train_idx, test_idx = split_items(data.n_items, np_rng)
+    logger.info("train item size: %d", len(train_idx))
+    logger.info("test item size: %d", len(test_idx))
+    logger.info(
+        "OOV rate: %s",
+        oov_rate(data, train_idx, test_idx, exact=config.eval_method == "exact"),
+    )
+
+    model_config = model_config_from(config, data)
+    class_weights = class_weights_from(config, data)
+
+    # shape-only dummy batch for init; avoids building a real epoch (which
+    # can be empty, e.g. a variable-task item with no @var aliases)
+    example_batch = {
+        "ids": np.zeros(config.batch_size, np.int64),
+        "starts": np.zeros((config.batch_size, config.max_path_length), np.int32),
+        "paths": np.zeros((config.batch_size, config.max_path_length), np.int32),
+        "ends": np.zeros((config.batch_size, config.max_path_length), np.int32),
+        "labels": np.zeros(config.batch_size, np.int32),
+        "example_mask": np.ones(config.batch_size, np.float32),
+    }
+    state = initial_state
+    if state is None:
+        state = create_train_state(config, model_config, jax_rng, example_batch)
+    if train_step is None:
+        train_step = make_train_step(model_config, class_weights)
+    if eval_step is None:
+        eval_step = make_eval_step(model_config, class_weights)
+
+    meta = TrainMeta()
+    if config.resume and out_dir is not None:
+        restored = restore_checkpoint(out_dir, state)
+        if restored is not None:
+            state, meta = restored
+            logger.info("resumed from epoch %d (best_f1=%s)", meta.epoch, meta.best_f1)
+
+    f1 = 0.0
+    start_epoch = meta.epoch
+    epoch = start_epoch
+    epochs_completed = 0
+    try:
+        for epoch in range(start_epoch, config.max_epoch):
+            if profile_dir is not None and epoch == start_epoch + 1:
+                jax.profiler.start_trace(profile_dir)
+            epoch_start = time.perf_counter()
+
+            train_epoch = build_epoch(
+                data,
+                train_idx,
+                config.max_path_length,
+                np_rng,
+                config.shuffle_variable_indexes,
+            )
+            train_loss = 0.0
+            n_batches = 0
+            for batch in iter_batches(
+                train_epoch, config.batch_size, rng=np_rng, pad_final=True
+            ):
+                state, loss = train_step(state, batch)
+                train_loss += float(loss)
+                n_batches += 1
+
+            test_epoch = build_epoch(
+                data,
+                test_idx,
+                config.max_path_length,
+                np_rng,
+                config.shuffle_variable_indexes,
+            )
+            test_loss, accuracy, precision, recall, f1 = _evaluate_epoch(
+                config, data, state, eval_step, test_epoch
+            )
+
+            metrics = {
+                "train_loss": train_loss,
+                "test_loss": test_loss,
+                "accuracy": accuracy,
+                "precision": precision,
+                "recall": recall,
+                "f1": f1,
+                "epoch_seconds": time.perf_counter() - epoch_start,
+            }
+            epochs_completed += 1
+            meta.history.append({"epoch": epoch, **metrics})
+            for sink in sinks:
+                sink(epoch, metrics)
+
+            if report_fn is not None:
+                report_fn(epoch, f1)  # may raise StopTraining (HPO pruning)
+
+            if (
+                epoch > 1
+                and config.print_sample_cycle
+                and epoch % config.print_sample_cycle == 0
+                and report_fn is None
+            ):
+                export_mod.print_sample(
+                    data, state, eval_step, test_epoch, config.batch_size
+                )
+
+            if meta.best_f1 is None or meta.best_f1 < f1:
+                for sink in sinks:
+                    sink(epoch, {"best_f1": f1})
+                meta.best_f1 = f1
+                if report_fn is None and vectors_path is not None:
+                    export_mod.write_code_vectors(
+                        data,
+                        state,
+                        eval_step,
+                        train_epoch,
+                        test_epoch,
+                        config.batch_size,
+                        vectors_path,
+                        config.encode_size,
+                        test_result_path,
+                    )
+                if report_fn is None and out_dir is not None:
+                    meta.epoch = epoch + 1
+                    save_checkpoint(out_dir, state, meta)
+
+            # early stop: the counter resets whenever train loss OR accuracy
+            # improves (reference quirk, main.py:233-242)
+            if (
+                meta.last_loss is None
+                or train_loss < meta.last_loss
+                or meta.last_accuracy is None
+                or meta.last_accuracy < accuracy
+            ):
+                meta.last_loss = train_loss
+                meta.last_accuracy = accuracy
+                meta.bad_count = 0
+            else:
+                meta.bad_count += 1
+            if meta.bad_count > config.early_stop_patience:
+                logger.info(
+                    "early stop loss:%s, bad:%d", train_loss, meta.bad_count
+                )
+                export_mod.print_sample(
+                    data, state, eval_step, test_epoch, config.batch_size
+                )
+                break
+    except StopTraining:
+        pass
+    finally:
+        if profile_dir is not None and epoch > start_epoch:
+            jax.profiler.stop_trace()
+
+    if epochs_completed == 0 and meta.history:
+        # resumed a finished run: report the last recorded score, not 0
+        f1 = meta.history[-1].get("f1", 0.0)
+    return TrainResult(
+        best_f1=meta.best_f1 if meta.best_f1 is not None else f1,
+        final_f1=f1,
+        epochs_run=epochs_completed,
+        history=meta.history,
+        state=state,
+    )
+
+
+def _evaluate_epoch(
+    config: TrainConfig,
+    data: CorpusData,
+    state,
+    eval_step,
+    test_epoch,
+) -> tuple[float, float, float, float, float]:
+    """Test pass: accumulate per-batch mean losses (reference semantics,
+    main.py:283-284) and pooled predictions, then dispatch the matcher."""
+    test_loss = 0.0
+    expected, actual = [], []
+    for batch in iter_batches(
+        test_epoch, config.batch_size, rng=None, pad_final=True
+    ):
+        out = eval_step(state, batch)
+        test_loss += float(out["loss"])
+        valid = batch["example_mask"].astype(bool)
+        expected.append(batch["labels"][valid])
+        actual.append(np.asarray(out["preds"])[valid])
+    expected = np.concatenate(expected) if expected else np.zeros(0, np.int32)
+    actual = np.concatenate(actual) if actual else np.zeros(0, np.int32)
+    accuracy, precision, recall, f1 = evaluate(
+        config.eval_method, expected, actual, data.label_vocab
+    )
+    return test_loss, accuracy, precision, recall, f1

@@ -1,0 +1,154 @@
+"""Jitted train/eval/forward steps.
+
+Everything under ``jax.jit`` here is traced once per (shape, config): batches
+are static ``[B, L]`` (pipeline pads the remainder batch and supplies an
+example mask), so one compilation serves the whole run.
+
+Optimizer parity: torch.optim.Adam applies weight decay as coupled L2 added
+to the gradient *before* the moment updates (reference: main.py:138), so the
+optax chain is add_decayed_weights -> scale_by_adam -> scale(-lr) — not
+decoupled AdamW.
+
+Loss parity: log_softmax + class-weighted NLL with mean reduction
+``sum(w_i * nll_i) / sum(w_i)`` (reference: main.py:129-130,251-264 and
+torch NLLLoss weighted-mean semantics), extended with the example mask for
+padded rows.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.train.config import TrainConfig
+
+
+class TrainState(train_state.TrainState):
+    """TrainState carrying the dropout RNG so steps are fully functional."""
+
+    dropout_rng: jax.Array
+
+
+def torch_style_adam(
+    lr: float, b1: float, b2: float, weight_decay: float
+) -> optax.GradientTransformation:
+    """Adam with coupled L2 (torch semantics), see module docstring."""
+    steps = []
+    if weight_decay:
+        steps.append(optax.add_decayed_weights(weight_decay))
+    steps.append(optax.scale_by_adam(b1=b1, b2=b2, eps=1e-8))
+    steps.append(optax.scale(-lr))
+    return optax.chain(*steps)
+
+
+def create_train_state(
+    config: TrainConfig,
+    model_config: Code2VecConfig,
+    rng: jax.Array,
+    example_batch: dict[str, Any],
+) -> TrainState:
+    model = Code2Vec(model_config)
+    params_rng, dropout_rng = jax.random.split(rng)
+    params = model.init(
+        {"params": params_rng},
+        example_batch["starts"],
+        example_batch["paths"],
+        example_batch["ends"],
+        labels=example_batch["labels"],
+        deterministic=True,
+    )["params"]
+    tx = torch_style_adam(
+        config.lr, config.beta_min, config.beta_max, config.weight_decay
+    )
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, dropout_rng=dropout_rng
+    )
+
+
+def weighted_nll(
+    logits: jnp.ndarray,  # [B, C] f32
+    labels: jnp.ndarray,  # [B] int
+    class_weights: jnp.ndarray,  # [C] f32
+    example_mask: jnp.ndarray,  # [B] f32
+) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    w = class_weights[labels] * example_mask
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def make_train_step(
+    model_config: Code2VecConfig,
+    class_weights: jnp.ndarray,
+) -> Callable[[TrainState, dict[str, jnp.ndarray]], tuple[TrainState, jnp.ndarray]]:
+    """Build the jitted SGD step. ``class_weights`` is captured as a device
+    constant (it never changes during a run)."""
+
+    needs_labels = model_config.angular_margin_loss
+
+    def loss_fn(params, apply_fn, batch, dropout_rng):
+        logits, _, _ = apply_fn(
+            {"params": params},
+            batch["starts"],
+            batch["paths"],
+            batch["ends"],
+            labels=batch["labels"] if needs_labels else None,
+            deterministic=False,
+            rngs={"dropout": dropout_rng},
+        )
+        return weighted_nll(
+            logits, batch["labels"], class_weights, batch["example_mask"]
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, batch):
+        dropout_rng, next_rng = jax.random.split(state.dropout_rng)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, state.apply_fn, batch, dropout_rng
+        )
+        state = state.apply_gradients(grads=grads, dropout_rng=next_rng)
+        return state, loss
+
+    return train_step
+
+
+def make_eval_step(
+    model_config: Code2VecConfig,
+    class_weights: jnp.ndarray,
+):
+    """Jitted eval: batch-mean loss (the reference accumulates per-batch
+    means, main.py:283-284), argmax predictions, and the max logit (what the
+    reference reports as the prediction 'prob', main.py:411)."""
+
+    needs_labels = model_config.angular_margin_loss
+
+    @jax.jit
+    def eval_step(state: TrainState, batch):
+        logits, code_vector, attention = state.apply_fn(
+            {"params": state.params},
+            batch["starts"],
+            batch["paths"],
+            batch["ends"],
+            labels=batch["labels"] if needs_labels else None,
+            deterministic=True,
+        )
+        loss = weighted_nll(
+            logits, batch["labels"], class_weights, batch["example_mask"]
+        )
+        preds = jnp.argmax(logits, axis=-1)
+        max_logit = jnp.max(logits, axis=-1)
+        return {
+            "loss": loss,
+            "preds": preds,
+            "max_logit": max_logit,
+            "code_vector": code_vector,
+            "attention": attention,
+        }
+
+    return eval_step

@@ -1,0 +1,85 @@
+"""Golden tests for the three eval matchers (reference: main.py:300-359)."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.vocab import Vocab
+from code2vec_tpu.metrics import (
+    averaged_subtoken_match,
+    evaluate,
+    exact_match,
+    subtoken_match,
+)
+
+
+@pytest.fixture
+def vocab():
+    v = Vocab()
+    v.add("getvalue", subtokens=("get", "value"))  # 0
+    v.add("setvaluecount", subtokens=("set", "value", "count"))  # 1
+    v.add("run", subtokens=("run",))  # 2
+    return v
+
+
+class TestSubtokenMatch:
+    def test_perfect(self, vocab):
+        e = np.array([0, 1, 2])
+        acc, p, r, f1 = subtoken_match(e, e, vocab)
+        assert acc == p == r == f1 == 1.0
+
+    def test_hand_computed(self, vocab):
+        # expected getvalue(2 toks), predicted setvaluecount(3 toks):
+        # matches: "value" -> 1; expected_count=2, actual_count=3
+        e = np.array([0])
+        a = np.array([1])
+        acc, p, r, f1 = subtoken_match(e, a, vocab)
+        assert acc == pytest.approx(1 / (2 + 3 - 1))
+        assert p == pytest.approx(1 / 3)
+        assert r == pytest.approx(1 / 2)
+        assert f1 == pytest.approx(2 * (1 / 3) * (1 / 2) / (1 / 3 + 1 / 2))
+
+    def test_pooled_not_averaged(self, vocab):
+        # two examples pooled: (0 vs 2): 0 matches, e=2,a=1; (2 vs 2): 1,1,1
+        e = np.array([0, 2])
+        a = np.array([2, 2])
+        acc, p, r, f1 = subtoken_match(e, a, vocab)
+        assert p == pytest.approx(1 / 2)  # 1 match / 2 actual
+        assert r == pytest.approx(1 / 3)  # 1 match / 3 expected
+        assert acc == pytest.approx(1 / (3 + 2 - 1))
+
+    def test_no_overlap(self, vocab):
+        acc, p, r, f1 = subtoken_match(np.array([0]), np.array([2]), vocab)
+        assert (acc, p, r, f1) == (0.0, 0.0, 0.0, 0.0)
+
+
+class TestAveragedSubtokenMatch:
+    def test_mean_of_per_example(self, vocab):
+        e = np.array([0, 2])
+        a = np.array([1, 2])
+        acc, p, r, f1 = averaged_subtoken_match(e, a, vocab)
+        # ex1: match=1 -> acc 1/4, p 1/3, r 1/2, f1 0.4; ex2: all 1.0
+        assert acc == pytest.approx((1 / 4 + 1.0) / 2)
+        assert p == pytest.approx((1 / 3 + 1.0) / 2)
+        assert r == pytest.approx((1 / 2 + 1.0) / 2)
+        assert f1 == pytest.approx((0.4 + 1.0) / 2)
+
+
+class TestExactMatch:
+    def test_accuracy(self):
+        e = np.array([0, 1, 2, 2])
+        a = np.array([0, 1, 1, 2])
+        acc, p, r, f1 = exact_match(e, a)
+        assert acc == pytest.approx(0.75)
+        assert 0 < f1 <= 1
+
+
+class TestDispatch:
+    def test_unknown_method_raises(self, vocab):
+        with pytest.raises(ValueError):
+            evaluate("bogus", np.array([0]), np.array([0]), vocab)
+
+    def test_dispatches(self, vocab):
+        e = np.array([0, 1])
+        for method in ("exact", "subtoken", "ave_subtoken"):
+            out = evaluate(method, e, e, vocab)
+            assert len(out) == 4 and out[3] == pytest.approx(1.0)

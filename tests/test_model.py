@@ -1,0 +1,185 @@
+"""Model semantics tests (reference parity: model/model.py — SURVEY.md §2.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.ops.attention import attention_pool, masked_attention_weights
+
+
+def small_config(**kw):
+    defaults = dict(
+        terminal_count=50,
+        path_count=40,
+        label_count=7,
+        terminal_embed_size=8,
+        path_embed_size=6,
+        encode_size=16,
+        dropout_prob=0.25,
+    )
+    defaults.update(kw)
+    return Code2VecConfig(**defaults)
+
+
+def make_batch(rng, B=4, L=10, config=None):
+    c = config or small_config()
+    starts = rng.integers(1, c.terminal_count, (B, L)).astype(np.int32)
+    paths = rng.integers(1, c.path_count, (B, L)).astype(np.int32)
+    ends = rng.integers(1, c.terminal_count, (B, L)).astype(np.int32)
+    # pad the tail of each row with varying lengths
+    for i in range(B):
+        n = rng.integers(1, L + 1)
+        starts[i, n:] = 0
+        paths[i, n:] = 0
+        ends[i, n:] = 0
+    labels = rng.integers(0, c.label_count, B).astype(np.int32)
+    return starts, paths, ends, labels
+
+
+class TestAttentionPool:
+    def test_pad_positions_get_zero_weight(self):
+        rng = np.random.default_rng(0)
+        ctx = jnp.asarray(rng.normal(size=(2, 5, 3)), jnp.float32)
+        mask = jnp.asarray([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        a = jnp.asarray(rng.normal(size=3), jnp.float32)
+        cv, attn = attention_pool(ctx, mask, a)
+        np.testing.assert_allclose(np.asarray(attn[0, 2:]), 0.0, atol=1e-30)
+        np.testing.assert_allclose(np.asarray(attn.sum(-1)), 1.0, rtol=1e-6)
+
+    def test_matches_numpy_oracle(self):
+        rng = np.random.default_rng(1)
+        ctx = rng.normal(size=(3, 6, 4)).astype(np.float32)
+        mask = (rng.random((3, 6)) > 0.3).astype(np.float32)
+        mask[:, 0] = 1.0  # at least one real position
+        a = rng.normal(size=4).astype(np.float32)
+        cv, attn = attention_pool(jnp.asarray(ctx), jnp.asarray(mask), jnp.asarray(a))
+        scores = ctx @ a
+        masked = scores * mask + (1 - mask) * -3.4e38
+        e = np.exp(masked - masked.max(-1, keepdims=True))
+        expected_attn = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(attn), expected_attn, rtol=1e-5)
+        expected_cv = np.einsum("bl,ble->be", expected_attn, ctx)
+        np.testing.assert_allclose(np.asarray(cv), expected_cv, rtol=1e-5)
+
+    def test_all_masked_row_is_uniform_not_nan(self):
+        # mirrors the reference arithmetic: all-NINF row softmaxes to uniform
+        attn = masked_attention_weights(
+            jnp.zeros((1, 4)), jnp.zeros((1, 4))
+        )
+        assert not np.isnan(np.asarray(attn)).any()
+
+
+class TestCode2VecForward:
+    def test_shapes_and_determinism(self):
+        c = small_config()
+        rng = np.random.default_rng(0)
+        starts, paths, ends, labels = make_batch(rng, config=c)
+        model = Code2Vec(c)
+        params = model.init(jax.random.PRNGKey(0), starts, paths, ends)
+        logits, cv, attn = model.apply(params, starts, paths, ends)
+        assert logits.shape == (4, c.label_count)
+        assert cv.shape == (4, c.encode_size)
+        assert attn.shape == (4, 10)
+        logits2, _, _ = model.apply(params, starts, paths, ends)
+        np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
+
+    def test_pad_contexts_do_not_affect_output(self):
+        c = small_config(dropout_prob=0.0)
+        rng = np.random.default_rng(2)
+        starts, paths, ends, _ = make_batch(rng, B=1, L=8, config=c)
+        starts[0, 4:] = 0
+        paths[0, 4:] = 0
+        ends[0, 4:] = 0
+        model = Code2Vec(c)
+        params = model.init(jax.random.PRNGKey(0), starts, paths, ends)
+        logits_a, cv_a, _ = model.apply(params, starts, paths, ends)
+        # change the content of PAD positions — must be invisible
+        paths2 = paths.copy()
+        paths2[0, 4:] = 7
+        ends2 = ends.copy()
+        ends2[0, 4:] = 3
+        logits_b, cv_b, _ = model.apply(params, starts, paths2, ends2)
+        np.testing.assert_allclose(np.asarray(cv_a), np.asarray(cv_b), atol=1e-6)
+
+    def test_dropout_gate(self):
+        # dropout_prob outside (0,1) disables dropout entirely
+        # (reference: model/model.py:26-29)
+        c = small_config(dropout_prob=0.0)
+        rng = np.random.default_rng(3)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        model = Code2Vec(c)
+        params = model.init(jax.random.PRNGKey(0), starts, paths, ends)
+        out1, _, _ = model.apply(
+            params, starts, paths, ends, deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(1)},
+        )
+        out2, _, _ = model.apply(params, starts, paths, ends, deterministic=True)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+    def test_dropout_active_in_training(self):
+        c = small_config(dropout_prob=0.5)
+        rng = np.random.default_rng(4)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        model = Code2Vec(c)
+        params = model.init(jax.random.PRNGKey(0), starts, paths, ends)
+        out1, _, _ = model.apply(
+            params, starts, paths, ends, deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(1)},
+        )
+        out2, _, _ = model.apply(
+            params, starts, paths, ends, deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(2)},
+        )
+        assert np.abs(np.asarray(out1) - np.asarray(out2)).max() > 1e-6
+
+    def test_bfloat16_compute(self):
+        c = small_config(dtype=jnp.bfloat16, dropout_prob=0.0)
+        rng = np.random.default_rng(5)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        model = Code2Vec(c)
+        params = model.init(jax.random.PRNGKey(0), starts, paths, ends)
+        logits, cv, attn = model.apply(params, starts, paths, ends)
+        # heads and outputs stay f32
+        assert logits.dtype == jnp.float32
+        assert cv.dtype == jnp.float32
+        assert not np.isnan(np.asarray(logits)).any()
+
+
+class TestAngularMarginHead:
+    def test_matches_numpy_oracle(self):
+        import math
+
+        c = small_config(angular_margin_loss=True, dropout_prob=0.0)
+        rng = np.random.default_rng(6)
+        starts, paths, ends, labels = make_batch(rng, config=c)
+        model = Code2Vec(c)
+        params = model.init(
+            jax.random.PRNGKey(0), starts, paths, ends, labels=labels
+        )
+        logits, cv, _ = model.apply(params, starts, paths, ends, labels=labels)
+
+        # oracle from the code vector + margin weight (model/model.py:71-80)
+        w = np.asarray(params["params"]["output_margin_weight"])
+        cvn = np.asarray(cv)
+        cvn = cvn / np.linalg.norm(cvn, axis=-1, keepdims=True)
+        wn = w / np.linalg.norm(w, axis=-1, keepdims=True)
+        cosine = cvn @ wn.T
+        sine = np.sqrt(np.clip(1 - cosine**2, 0, 1))
+        phi = cosine * math.cos(0.5) - sine * math.sin(0.5)
+        phi = np.where(cosine > 0, phi, cosine)
+        one_hot = np.eye(c.label_count)[labels]
+        expected = (one_hot * phi + (1 - one_hot) * cosine) * 30.0
+        np.testing.assert_allclose(np.asarray(logits), expected, rtol=1e-4, atol=1e-4)
+
+    def test_requires_labels(self):
+        c = small_config(angular_margin_loss=True)
+        rng = np.random.default_rng(7)
+        starts, paths, ends, labels = make_batch(rng, config=c)
+        model = Code2Vec(c)
+        params = model.init(
+            jax.random.PRNGKey(0), starts, paths, ends, labels=labels
+        )
+        with pytest.raises(ValueError):
+            model.apply(params, starts, paths, ends)

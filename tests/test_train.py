@@ -1,0 +1,195 @@
+"""Training-step parity + end-to-end integration (SURVEY.md §4 test plan)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.formats.vectors_io import read_code_vectors
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.loop import StopTraining, train
+from code2vec_tpu.train.step import torch_style_adam, weighted_nll
+
+
+@pytest.fixture(scope="module")
+def tiny(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tiny_train")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+    return paths, data
+
+
+TINY_CFG = dict(
+    max_epoch=4,
+    batch_size=32,
+    encode_size=64,
+    terminal_embed_size=32,
+    path_embed_size=32,
+    max_path_length=32,
+    print_sample_cycle=0,
+)
+
+
+class TestWeightedNLL:
+    def test_matches_torch_nllloss_semantics(self):
+        # weighted mean = sum(w_i * nll_i) / sum(w_i)
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(5, 4)), jnp.float32)
+        labels = jnp.asarray([0, 1, 2, 3, 1])
+        w = jnp.asarray([1.0, 2.0, 0.5, 1.5])
+        mask = jnp.ones(5)
+        loss = weighted_nll(logits, labels, w, mask)
+        logp = np.log(
+            np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        )
+        nll = -logp[np.arange(5), np.asarray(labels)]
+        wi = np.asarray(w)[np.asarray(labels)]
+        expected = (nll * wi).sum() / wi.sum()
+        assert float(loss) == pytest.approx(float(expected), rel=1e-5)
+
+    def test_example_mask_excludes_rows(self):
+        logits = jnp.asarray(np.random.default_rng(1).normal(size=(4, 3)), jnp.float32)
+        labels = jnp.asarray([0, 1, 2, 0])
+        w = jnp.ones(3)
+        full = weighted_nll(logits[:2], labels[:2], w, jnp.ones(2))
+        masked = weighted_nll(logits, labels, w, jnp.asarray([1.0, 1.0, 0.0, 0.0]))
+        assert float(full) == pytest.approx(float(masked), rel=1e-6)
+
+
+class TestTorchStyleAdam:
+    def test_weight_decay_is_coupled_l2(self):
+        # with zero gradient and nonzero weight decay, params must still move
+        # toward zero through the adam moments (torch semantics), and the
+        # first-step magnitude must match a hand-computed torch Adam step
+        tx = torch_style_adam(lr=0.1, b1=0.9, b2=0.999, weight_decay=0.01)
+        params = {"w": jnp.asarray([2.0])}
+        state = tx.init(params)
+        grads = {"w": jnp.asarray([0.0])}
+        updates, _ = tx.update(grads, state, params)
+        # effective grad = wd * w = 0.02; torch step1: m=0.002, v=4e-6*0.001..
+        # just assert direction and nonzero
+        assert float(updates["w"][0]) < 0.0
+
+    def test_first_step_matches_torch_formula(self):
+        lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+        g = 0.3
+        tx = torch_style_adam(lr, b1, b2, weight_decay=0.0)
+        params = {"w": jnp.asarray([1.0])}
+        state = tx.init(params)
+        updates, _ = tx.update({"w": jnp.asarray([g])}, state, params)
+        # bias-corrected: mhat = g, vhat = g^2 -> step = -lr * g/(|g|+eps)
+        expected = -lr * g / (np.sqrt(g * g) + eps)
+        assert float(updates["w"][0]) == pytest.approx(expected, rel=1e-5)
+
+
+class TestEndToEnd:
+    def test_f1_rises_and_artifacts_written(self, tiny, tmp_path):
+        paths, data = tiny
+        out = tmp_path / "run"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG)
+        res = train(
+            cfg,
+            data,
+            out_dir=str(out),
+            vectors_path=str(out / "code.vec"),
+            test_result_path=str(out / "test_result.tsv"),
+        )
+        assert res.best_f1 > 0.5  # learnable synthetic signal
+        labels, vectors = read_code_vectors(out / "code.vec")
+        assert len(labels) == data.n_items
+        assert vectors.shape == (data.n_items, cfg.encode_size)
+        # test-result TSV has one row per test example
+        rows = (out / "test_result.tsv").read_text().strip().split("\n")
+        assert len(rows) == int(data.n_items * 0.2)
+        fields = rows[0].split("\t")
+        assert len(fields) == 5 and fields[1] in ("True", "False")
+
+    def test_deterministic_given_seed(self, tiny):
+        paths, data = tiny
+        cfg = TrainConfig(**TINY_CFG).with_updates(max_epoch=2)
+        r1 = train(cfg, data)
+        r2 = train(cfg, data)
+        assert r1.history[-1]["train_loss"] == pytest.approx(
+            r2.history[-1]["train_loss"], rel=1e-5
+        )
+        assert r1.final_f1 == r2.final_f1
+
+    def test_resume_from_checkpoint(self, tiny, tmp_path):
+        paths, data = tiny
+        out = tmp_path / "resume"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(max_epoch=2)
+        first = train(cfg, data, out_dir=str(out))
+        cfg2 = cfg.with_updates(max_epoch=4, resume=True)
+        second = train(cfg2, data, out_dir=str(out))
+        # resumed run continues from epoch 2, runs 2 more
+        assert second.epochs_run <= 3
+        assert second.best_f1 >= first.best_f1
+
+    def test_task_flag_mismatch_rejected(self, tiny):
+        paths, data = tiny  # loaded with infer_method only
+        cfg = TrainConfig(**TINY_CFG).with_updates(infer_variable_name=True)
+        with pytest.raises(ValueError, match="task flags disagree"):
+            train(cfg, data)
+
+    def test_report_fn_can_stop(self, tiny):
+        paths, data = tiny
+        cfg = TrainConfig(**TINY_CFG)
+        calls = []
+
+        def report(epoch, f1):
+            calls.append(epoch)
+            if epoch >= 1:
+                raise StopTraining
+
+        res = train(cfg, data, report_fn=report)
+        assert calls == [0, 1]
+        assert res.epochs_run == 2
+
+    def test_variable_task_end_to_end(self, tiny_variable_corpus):
+        data = tiny_variable_corpus
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=2, infer_variable_name=True
+        )
+        res = train(cfg, data)
+        assert res.final_f1 >= 0.0
+        assert len(res.history) == 2
+
+
+@pytest.fixture(scope="module")
+def tiny_variable_corpus(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tiny_var")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    return load_corpus(
+        paths["corpus"],
+        paths["path_idx"],
+        paths["terminal_idx"],
+        infer_method=True,
+        infer_variable=True,
+    )
+
+
+class TestAngularMarginTraining:
+    def test_margin_head_trains(self, tiny):
+        paths, data = tiny
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=2, angular_margin_loss=True
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+
+
+class TestBf16Training:
+    def test_bfloat16_compute_trains(self, tiny):
+        paths, data = tiny
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=2, compute_dtype="bfloat16"
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+        assert res.final_f1 > 0.0
