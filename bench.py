@@ -1,0 +1,169 @@
+"""Headline benchmark: training throughput in path-contexts/sec/chip at
+top11 scale (BASELINE.md: the reference publishes no numbers; this run
+establishes/extends the baseline).
+
+Setup mirrors the reference's top11 recipe (README.md:34 — batch 1024,
+embed 100/100, encode 100) at the top11 corpus scale (605,945 methods,
+360,631 terminals, 342,845 paths — top11_dataset/params.txt), with bf16
+compute on TPU. The measured path is the real one: vectorized host epoch
+pipeline slicing static [1024, 200] batches feeding the jitted train step.
+Accounting matches the reference's work per step: B x L context slots.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares against the newest BENCH_r*.json in the repo (1.0 on
+the first ever run).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def _previous_benchmark() -> float | None:
+    best = None
+    best_round = -1
+    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            value = float(payload.get("value"))
+        except (ValueError, TypeError, json.JSONDecodeError, OSError):
+            continue
+        if int(m.group(1)) > best_round:
+            best_round = int(m.group(1))
+            best = value
+    return best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+    from code2vec_tpu.data.reader import CorpusData
+    from code2vec_tpu.data.synth import SynthSpec, generate_corpus_data
+    from code2vec_tpu.data.vocab import Vocab
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state, make_train_step
+
+    batch_size = int(os.environ.get("BENCH_BATCH", 1024))
+    bag = int(os.environ.get("BENCH_BAG", 200))
+    steps = int(os.environ.get("BENCH_STEPS", 60))
+    warmup = 5
+
+    # top11-scale synthetic corpus, shrunk in method count (the throughput
+    # metric depends on vocab/model/batch shape, not corpus length); vocab
+    # sizes are the real top11 ones
+    spec = SynthSpec(
+        n_methods=max(batch_size * 8, 8192),
+        n_terminals=360_631,
+        n_paths=342_845,
+        n_labels=8_000,
+        mean_contexts=120.0,
+        max_contexts=400,
+        seed=0,
+    )
+    raw = generate_corpus_data(spec)
+
+    label_vocab = Vocab()
+    for name in raw.label_names:
+        label_vocab.add_label(name)
+
+    data = CorpusData(
+        starts=raw.starts + 1,  # @question shift
+        paths=raw.paths,
+        ends=raw.ends + 1,
+        row_splits=raw.row_splits,
+        ids=np.arange(spec.n_methods, dtype=np.int64),
+        labels=raw.label_ids.astype(np.int32),
+        normalized_labels=[],
+        sources=[None] * spec.n_methods,
+        aliases=[{} for _ in range(spec.n_methods)],
+        terminal_vocab=Vocab(),
+        path_vocab=Vocab(),
+        label_vocab=label_vocab,
+    )
+    # method-token substitution indices (synth puts @method_0 at raw 1 -> 2)
+    data.terminal_vocab.add("<PAD/>", 0)
+    data.terminal_vocab.add("@question", 1)
+    data.terminal_vocab.add("@method_0", 2)
+
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(label_vocab),
+        terminal_embed_size=100,
+        path_embed_size=100,
+        encode_size=100,  # the reference top11 recipe (README.md:34)
+        dropout_prob=0.25,
+        dtype=jnp.bfloat16 if jax.default_backend() != "cpu" else jnp.float32,
+    )
+    config = TrainConfig(batch_size=batch_size, max_path_length=bag)
+
+    rng = np.random.default_rng(0)
+    epoch = build_method_epoch(data, np.arange(data.n_items), bag, rng)
+
+    example = next(iter_batches(epoch, batch_size, rng=rng, pad_final=False))
+    state = create_train_state(config, model_config, jax.random.PRNGKey(0), example)
+    class_weights = jnp.ones(model_config.label_count, jnp.float32)
+    train_step = make_train_step(model_config, class_weights)
+
+    def batches():
+        while True:
+            yield from iter_batches(epoch, batch_size, rng=rng, pad_final=False)
+
+    it = batches()
+    for _ in range(warmup):
+        state, loss = train_step(state, next(it))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = train_step(state, next(it))
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    contexts_per_sec = batch_size * bag * steps / elapsed
+    previous = _previous_benchmark()
+    vs_baseline = contexts_per_sec / previous if previous else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "path_contexts_per_sec_per_chip",
+                "value": round(contexts_per_sec, 1),
+                "unit": "contexts/sec",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": jax.default_backend(),
+                    "steps_per_sec": round(steps / elapsed, 3),
+                    "batch": batch_size,
+                    "bag": bag,
+                    "final_loss": float(loss),
+                    "compute_dtype": str(model_config.dtype.__name__ if hasattr(model_config.dtype, "__name__") else model_config.dtype),
+                }
+            }
+        ),
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -72,14 +72,17 @@ def save_checkpoint(out_dir: str, state, meta: TrainMeta) -> str:
         shutil.rmtree(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, _state_pytree(state))
-    meta_tmp = os.path.join(out_dir, META_FILE + ".tmp")
-    with open(meta_tmp, "w") as f:
-        json.dump(asdict(meta), f)
-    os.replace(meta_tmp, os.path.join(out_dir, META_FILE))
-    if previous is not None and previous != path:
-        import shutil
+    # orbax coordinates the multi-host array save; the sidecar metadata and
+    # pruning are process-0-only
+    if jax.process_index() == 0:
+        meta_tmp = os.path.join(out_dir, META_FILE + ".tmp")
+        with open(meta_tmp, "w") as f:
+            json.dump(asdict(meta), f)
+        os.replace(meta_tmp, os.path.join(out_dir, META_FILE))
+        if previous is not None and previous != path:
+            import shutil
 
-        shutil.rmtree(previous, ignore_errors=True)
+            shutil.rmtree(previous, ignore_errors=True)
     return path
 
 

@@ -24,18 +24,22 @@ from code2vec_tpu.formats.vectors_io import (
 logger = logging.getLogger(__name__)
 
 
-def _forward_all(eval_step, state, epoch: EpochArrays, batch_size: int):
+def _forward_all(
+    eval_step, state, epoch: EpochArrays, batch_size: int, to_device=lambda b: b
+):
     """Run the jitted eval step over every example; returns host arrays
     (labels, preds, max_logit, code_vectors) with padding rows removed."""
+    from code2vec_tpu.parallel.distributed import allgather_to_host
+
     labels, preds, logits, vectors, ids = [], [], [], [], []
     for batch in iter_batches(epoch, batch_size, rng=None, pad_final=True):
-        out = eval_step(state, batch)
+        out = eval_step(state, to_device(batch))
         valid = batch["example_mask"].astype(bool)
         labels.append(batch["labels"][valid])
         ids.append(batch["ids"][valid])
-        preds.append(np.asarray(out["preds"])[valid])
-        logits.append(np.asarray(out["max_logit"])[valid])
-        vectors.append(np.asarray(out["code_vector"])[valid])
+        preds.append(allgather_to_host(out["preds"])[valid])
+        logits.append(allgather_to_host(out["max_logit"])[valid])
+        vectors.append(allgather_to_host(out["code_vector"])[valid])
     return (
         np.concatenate(labels),
         np.concatenate(ids),
@@ -55,6 +59,7 @@ def write_code_vectors(
     vectors_path: str,
     encode_size: int,
     test_result_path: str | None = None,
+    to_device=lambda b: b,
 ) -> None:
     """Rewrite code.vec (train rows then test rows, reference
     main.py:226-230) and optionally the test-result TSV (main.py:418-420).
@@ -63,16 +68,25 @@ def write_code_vectors(
     an epoch holds one extra example per @var alias, so this can exceed
     ``data.n_items`` (the reference writes n_items and under-counts;
     external word2vec-format readers need the true count).
+
+    Multi-host: every process runs the forward passes (they participate in
+    the collectives) but only process 0 touches the files.
     """
-    write_code_vectors_header(
-        vectors_path, len(train_epoch) + len(test_epoch), encode_size
-    )
+    import jax
+
+    write_files = jax.process_index() == 0
+    if write_files:
+        write_code_vectors_header(
+            vectors_path, len(train_epoch) + len(test_epoch), encode_size
+        )
     itos = data.label_vocab.itos
 
     for split_epoch, is_test in ((train_epoch, False), (test_epoch, True)):
         labels, ids, preds, max_logit, vectors = _forward_all(
-            eval_step, state, split_epoch, batch_size
+            eval_step, state, split_epoch, batch_size, to_device
         )
+        if not write_files:
+            continue
         label_names = [itos[int(label)] for label in labels]
         append_code_vectors(vectors_path, label_names, vectors)
         if is_test and test_result_path is not None:
@@ -88,16 +102,19 @@ def print_sample(
     eval_step,
     test_epoch: EpochArrays,
     batch_size: int,
+    to_device=lambda b: b,
 ) -> None:
     """Log one correctly-predicted test example with per-context attention
     weights, skipping PAD rows (reference: main.py:362-390)."""
     terminal_itos = data.terminal_vocab.itos
     path_itos = data.path_vocab.itos
     label_itos = data.label_vocab.itos
+    from code2vec_tpu.parallel.distributed import allgather_to_host
+
     for batch in iter_batches(test_epoch, batch_size, rng=None, pad_final=True):
-        out = eval_step(state, batch)
-        preds = np.asarray(out["preds"])
-        attn = np.asarray(out["attention"])
+        out = eval_step(state, to_device(batch))
+        preds = allgather_to_host(out["preds"])
+        attn = allgather_to_host(out["attention"])
         valid = batch["example_mask"].astype(bool)
         hits = np.nonzero((preds == batch["labels"]) & valid)[0]
         if not len(hits):

@@ -45,6 +45,7 @@ class Code2VecConfig:
     angular_margin: float = 0.5
     inverse_temp: float = 30.0
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
+    use_pallas: bool = False  # fused attention-pooling kernel (ops.pallas_attention)
 
     def with_updates(self, **kw) -> "Code2VecConfig":
         return replace(self, **kw)
@@ -116,9 +117,16 @@ class Code2Vec(nn.Module):
             jnp.float32,
         )
         mask = (starts > 0).astype(jnp.float32)  # PAD = 0 (model/model.py:64)
-        code_vector, attention = attention_pool(
-            contexts, mask, attention_param.astype(c.dtype)
-        )
+        if c.use_pallas:
+            from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+            code_vector, attention = pallas_attention_pool(
+                contexts, mask, attention_param.astype(c.dtype)
+            )
+        else:
+            code_vector, attention = attention_pool(
+                contexts, mask, attention_param.astype(c.dtype)
+            )
         code_vector_f32 = code_vector.astype(jnp.float32)
 
         if c.angular_margin_loss:

@@ -4,11 +4,10 @@ The model's aggregation step (reference: model/model.py:63-69,90-105): one
 learned vector ``a`` scores every context, PAD positions are masked to -inf,
 softmax over the bag axis, weighted sum produces the code vector.
 
-``attention_pool`` is the public entry; it dispatches to the fused Pallas
-kernel on TPU when enabled (code2vec_tpu.ops.pallas_attention) and to this
-XLA implementation otherwise. XLA already fuses this chain well — the Pallas
-path exists for the large-bag regime where keeping the [B, L, E] context
-tensor out of HBM round-trips matters.
+This is the XLA implementation; XLA already fuses the chain well. A fused
+Pallas variant (for the large-bag regime, where keeping the [B, L, E]
+context tensor out of HBM round-trips matters) lives in
+code2vec_tpu.ops.pallas_attention.
 """
 
 from __future__ import annotations

@@ -1,0 +1,168 @@
+"""Fused masked-attention pooling as a Pallas TPU kernel.
+
+One VMEM-resident pass per batch tile fuses the whole aggregation chain
+(score matvec -> mask -> softmax -> weighted sum; reference semantics
+model/model.py:63-69,90-105): the [TB, L, E] context tile is read from HBM
+exactly once and only the [TB, E] code vector and [TB, L] weights go back —
+the XLA path materializes the score/weight intermediates between fusions in
+the large-bag regime.
+
+Autodiff: forward runs the kernel; the backward pass is closed-form XLA
+(softmax VJP) over the saved weights — exact, and itself fully fused by XLA.
+
+The wrapper pads B to the batch-tile and L to the lane width (128); padded
+bag columns are scored hard -inf inside the kernel (below even the finite
+NINF of user-masked positions), so padding is invisible in the outputs —
+including the degenerate all-masked row, which matches the XLA path's
+uniform-over-real-L behavior exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from code2vec_tpu.ops.attention import NINF
+
+_BLOCK_B = 8
+_LANE = 128
+
+
+def _make_kernel(real_l: int):
+    """Kernel closure over the un-padded bag length.
+
+    Lane-padding columns (l >= real_l) get a hard -inf — distinct from the
+    finite NINF that *user*-masked positions get (parity with
+    model/model.py:93) — so that a fully-masked row degenerates to uniform
+    over the real bag length exactly like the XLA path, instead of leaking
+    mass into the padding."""
+
+    def _kernel(ctx_ref, mask_ref, attn_ref, cv_ref, w_ref):
+        ctx = ctx_ref[:]  # [TB, Lp, E]
+        mask = mask_ref[:].astype(jnp.float32)  # [TB, Lp]
+        attn = attn_ref[:]  # [1, E]
+
+        # VPU form throughout: Mosaic cannot lower batched dot_general, and
+        # at these shapes (E <= a few hundred) the reductions are
+        # bandwidth-bound anyway
+        ctx32 = ctx.astype(jnp.float32)
+        scores = jnp.sum(ctx32 * attn[0][None, None, :], axis=2)  # [TB, Lp]
+        masked = scores * mask + (1.0 - mask) * NINF
+        tb, lp = masked.shape
+        col = jax.lax.broadcasted_iota(jnp.int32, (tb, lp), 1)
+        masked = jnp.where(col < real_l, masked, -jnp.inf)
+        masked = masked - jnp.max(masked, axis=-1, keepdims=True)
+        e = jnp.exp(masked)
+        weights = e / jnp.sum(e, axis=-1, keepdims=True)
+        cv = jnp.sum(ctx32 * weights[:, :, None], axis=1)  # [TB, E]
+        cv_ref[:] = cv.astype(cv_ref.dtype)
+        w_ref[:] = weights
+
+    return _kernel
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    target = -(-size // multiple) * multiple
+    if target == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - size)
+    return jnp.pad(x, pad)
+
+
+def _forward(contexts, mask, attn_param, *, block_b: int, interpret: bool):
+    b, bag, enc = contexts.shape
+    ctx_p = _pad_to(_pad_to(contexts, 0, block_b), 1, _LANE)
+    mask_p = _pad_to(_pad_to(mask.astype(jnp.float32), 0, block_b), 1, _LANE)
+    bp, lp = ctx_p.shape[0], ctx_p.shape[1]
+
+    grid = (bp // block_b,)
+    cv, weights = pl.pallas_call(
+        _make_kernel(bag),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (block_b, lp, enc), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, enc), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, enc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, enc), jnp.float32),
+            jax.ShapeDtypeStruct((bp, lp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ctx_p, mask_p, attn_param.reshape(1, enc).astype(jnp.float32))
+    return cv[:b], weights[:b, :bag]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _pool(contexts, mask, attn_param, block_b, interpret):
+    return _forward(
+        contexts, mask, attn_param, block_b=block_b, interpret=interpret
+    )
+
+
+def _pool_fwd(contexts, mask, attn_param, block_b, interpret):
+    cv, weights = _forward(
+        contexts, mask, attn_param, block_b=block_b, interpret=interpret
+    )
+    return (cv, weights), (contexts, mask, attn_param, weights)
+
+
+def _pool_bwd(block_b, interpret, residuals, grads):
+    contexts, mask, attn_param, weights = residuals
+    g_cv, g_w = grads
+    ctx32 = contexts.astype(jnp.float32)
+    mask32 = mask.astype(jnp.float32)
+    g_cv = g_cv.astype(jnp.float32)
+
+    # dL/dw_l: through the weighted sum, plus any direct grad on the weights
+    dldw = jnp.einsum("be,ble->bl", g_cv, ctx32)
+    if g_w is not None:
+        dldw = dldw + g_w.astype(jnp.float32)
+    # softmax VJP: ds = w * (dldw - sum_k w_k dldw_k); masked positions have
+    # w == 0 exactly, so their ds vanishes
+    ds = weights * (dldw - jnp.sum(weights * dldw, axis=-1, keepdims=True))
+    ds = ds * mask32  # d(masked score)/d(raw score) = mask
+
+    d_ctx = (
+        weights[..., None] * g_cv[:, None, :]
+        + ds[..., None] * attn_param.astype(jnp.float32)[None, None, :]
+    )
+    d_attn = jnp.einsum("bl,ble->e", ds, ctx32)
+    d_mask = None  # mask is data, not a differentiable input
+    return (
+        d_ctx.astype(contexts.dtype),
+        jnp.zeros_like(mask) if d_mask is None else d_mask,
+        d_attn.astype(attn_param.dtype),
+    )
+
+
+_pool.defvjp(_pool_fwd, _pool_bwd)
+
+
+def pallas_attention_pool(
+    contexts: jnp.ndarray,  # [B, L, E]
+    mask: jnp.ndarray,  # [B, L]
+    attn_param: jnp.ndarray,  # [E]
+    block_b: int = _BLOCK_B,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in replacement for ops.attention.attention_pool.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (so tests and the CPU mesh exercise the same code path).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _pool(contexts, mask, attn_param, block_b, interpret)

@@ -1,0 +1,21 @@
+"""Parallelism over a jax.sharding.Mesh — dp / tp / sp, multi-host init.
+
+The reference is strictly single-device (SURVEY.md §2: no DP/TP/PP/SP, no
+NCCL/MPI). This package is the TPU-native replacement: shardings over a
+(data, model, ctx) mesh, XLA collectives over ICI/DCN, multi-host process
+groups via jax.distributed.
+"""
+
+from code2vec_tpu.parallel.mesh import (
+    AXIS_CTX,
+    AXIS_DATA,
+    AXIS_MODEL,
+    make_mesh,
+)
+from code2vec_tpu.parallel.shardings import (
+    batch_shardings,
+    param_shardings,
+    shard_batch,
+    shard_state,
+    state_shardings,
+)

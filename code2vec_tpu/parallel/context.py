@@ -1,0 +1,65 @@
+"""Context (sequence) parallelism for the attention pooling.
+
+Long-bag regime: a method's path-context bag can far exceed HBM-friendly
+sizes when extraction caps are lifted (whole-file bags). The bag axis L is
+sharded over the ``ctx`` mesh axis and the masked softmax + weighted sum is
+computed with the streaming-softmax decomposition:
+
+    m   = pmax(max(local_scores))            one scalar per row
+    e   = exp(local_scores - m)
+    s   = psum(sum(e))
+    out = psum(e @ local_contexts) / s
+
+This is the exact counterpart of ring attention specialized to a rank-1
+query: because code2vec attention has a single learned query vector (not
+L x L), no K/V rotation is needed — one pmax + two psums over ICI are
+information-optimal, touching each context shard exactly once. (Ring
+attention's O(L^2) tiling degenerates to this when the query count is 1;
+see PAPERS.md ring-attention lineage.)
+
+Used under ``shard_map``; the GSPMD path in ops.attention reaches the same
+collectives automatically, this module is the explicit/inspectable variant
+the Pallas kernel plugs into.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from code2vec_tpu.ops.attention import NINF
+from code2vec_tpu.parallel.mesh import AXIS_CTX
+
+
+def _local_pool(contexts, mask, attn_param, axis_name):
+    scores = jnp.einsum("ble,e->bl", contexts, attn_param).astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    masked = scores * mask + (1.0 - mask) * NINF
+    local_max = jnp.max(masked, axis=-1)
+    global_max = jax.lax.pmax(local_max, axis_name)
+    e = jnp.exp(masked - global_max[:, None])
+    local_sum = jnp.sum(e, axis=-1)
+    global_sum = jax.lax.psum(local_sum, axis_name)
+    weights = e / jnp.maximum(global_sum[:, None], 1e-38)
+    local_cv = jnp.einsum("bl,ble->be", weights.astype(contexts.dtype), contexts)
+    code_vector = jax.lax.psum(local_cv, axis_name)
+    return code_vector, weights
+
+
+def context_parallel_attention_pool(
+    mesh: Mesh,
+    contexts: jnp.ndarray,  # [B, L, E], L sharded over ctx
+    mask: jnp.ndarray,  # [B, L]
+    attn_param: jnp.ndarray,  # [E] replicated
+):
+    """shard_map-wrapped pooling; returns (code_vector [B, E] replicated
+    over ctx, attention [B, L] sharded like the input)."""
+    return jax.shard_map(
+        partial(_local_pool, axis_name=AXIS_CTX),
+        mesh=mesh,
+        in_specs=(P(None, AXIS_CTX, None), P(None, AXIS_CTX), P()),
+        out_specs=(P(), P(None, AXIS_CTX)),
+    )(contexts, mask, attn_param)

@@ -1,0 +1,93 @@
+"""Multi-host (pod / multi-slice) process setup and host-local data feeding.
+
+Replaces the NCCL/MPI role of conventional frameworks (the reference has no
+distributed backend at all — SURVEY.md §5.8): jax.distributed forms the
+process group, XLA compiles the collectives, ICI carries intra-slice traffic
+and DCN carries inter-slice.
+
+Host-local batches become global arrays via
+``jax.make_array_from_process_local_data`` — each host loads only its shard
+of the corpus (``host_shard`` below gives the standard contiguous split).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from code2vec_tpu.parallel.shardings import batch_shardings
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_from_env() -> bool:
+    """Initialize jax.distributed from standard env vars when present
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID, or the TPU pod
+    metadata that jax autodetects). No-op for single-process runs."""
+    coordinator = os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = os.environ.get("NUM_PROCESSES")
+    process_id = os.environ.get("PROCESS_ID")
+    if coordinator and num_processes and process_id:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(num_processes),
+            process_id=int(process_id),
+        )
+        logger.info(
+            "jax.distributed up: process %s/%s via %s",
+            process_id,
+            num_processes,
+            coordinator,
+        )
+        return True
+    if os.environ.get("JAX_AUTO_DISTRIBUTED", ""):
+        jax.distributed.initialize()  # TPU pod autodetection
+        return True
+    return False
+
+
+def host_shard(n: int) -> slice:
+    """Contiguous slice of [0, n) owned by this host process."""
+    count = jax.process_count()
+    index = jax.process_index()
+    per = n // count
+    lo = index * per
+    hi = n if index == count - 1 else lo + per
+    return slice(lo, hi)
+
+
+def global_batch(mesh: Mesh, full_batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:
+    """Assemble a global device batch when every host holds the FULL batch
+    (the loop's epochs are seeded identically on all processes).
+
+    ``make_array_from_callback`` lets each host serve exactly the slices its
+    addressable devices need, for *any* batch sharding — data-sharded,
+    replicated, or mixed — with no per-process divisibility constraint.
+    """
+    shardings = batch_shardings(mesh)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, shardings[k]) for k, v in full_batch.items()}
+    return {
+        k: jax.make_array_from_callback(
+            v.shape, shardings[k], lambda idx, v=v: v[idx]
+        )
+        for k, v in full_batch.items()
+    }
+
+
+def allgather_to_host(x: jax.Array) -> np.ndarray:
+    """Fetch a possibly cross-process-sharded array to host numpy.
+
+    np.asarray on an array that spans non-addressable devices raises; the
+    multihost allgather replicates it first. Single-process arrays take the
+    direct path.
+    """
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -1,0 +1,59 @@
+"""Device mesh construction.
+
+Three logical axes (SURVEY.md §7.5 + §5.7-5.8):
+
+- ``data``  — batch (pure data parallelism; gradient psum over ICI)
+- ``model`` — tensor parallelism: the two embedding tables row-sharded over
+  vocab (360k+ rows at top11 scale) and the label head column-sharded
+- ``ctx``   — context/sequence parallelism: the bag axis L of each batch is
+  sharded, for the large-bag regime (whole-file context bags)
+
+Pipeline (pp) and expert (ep) axes deliberately do not exist: the model is a
+two-layer bag encoder with no sequential layer stack to pipeline and no MoE
+routing — dp/tp/sp are the parallelism axes this architecture admits
+(documented for parity auditing against SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_CTX = "ctx"
+AXES = (AXIS_DATA, AXIS_MODEL, AXIS_CTX)
+
+
+def make_mesh(
+    data: int | None = None,
+    model: int = 1,
+    ctx: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a (data, model, ctx) mesh. ``data=None`` absorbs all remaining
+    devices. On real TPU slices mesh_utils picks an ICI-friendly layout."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        data = len(devices) // (model * ctx)
+    n = data * model * ctx
+    if n > len(devices):
+        raise ValueError(
+            f"mesh ({data}x{model}x{ctx}={n}) exceeds {len(devices)} devices"
+        )
+    if n == len(devices):
+        try:
+            arr = mesh_utils.create_device_mesh((data, model, ctx), devices=devices)
+        except (ValueError, AssertionError):
+            arr = np.asarray(devices).reshape(data, model, ctx)
+    else:
+        arr = np.asarray(devices[:n]).reshape(data, model, ctx)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """Degenerate 1x1x1 mesh: the single-chip path uses the same code."""
+    device = device if device is not None else jax.devices()[0]
+    return make_mesh(data=1, model=1, ctx=1, devices=[device])

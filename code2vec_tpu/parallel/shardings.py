@@ -1,0 +1,121 @@
+"""Sharding rules: which parameter/batch dimension lives on which mesh axis.
+
+Parameter layout (SURVEY.md §7.5; vocab sizes from top11 params.txt make the
+embedding tables the only big tensors — 360k x d and 342k x d):
+
+- ``terminal_embedding`` / ``path_embedding`` tables: row-sharded over
+  ``model`` (vocab dim). XLA turns the gathers into local gathers + psum.
+- output head: column-sharded over ``model`` (label dim) — the label vocab
+  also scales with corpus size; the margin-head weight is row-sharded since
+  its layout is [label, encode].
+- encoder Dense/LayerNorm/attention vector: replicated (tiny at any scale).
+
+Batch layout: batch dim over ``data``, bag dim L over ``ctx``; labels and
+masks over ``data`` only. Gradients reduce over ``data`` via the psum XLA
+inserts automatically under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from code2vec_tpu.parallel.mesh import AXIS_CTX, AXIS_DATA, AXIS_MODEL
+
+
+def _spec_for_param(path: tuple[str, ...], mesh: Mesh, shape=None) -> P:
+    """Sharding spec for one parameter (or adam-moment) leaf.
+
+    A dim is only sharded if its size divides evenly by the axis; otherwise
+    it silently replicates. For the big tables, pad the vocab up front
+    (``pad_to_multiple``) so the shard actually happens — a few dummy rows
+    on a 360k-row table cost nothing.
+    """
+    names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    joined = "/".join(names)
+    model_axis = AXIS_MODEL if mesh.shape[AXIS_MODEL] > 1 else None
+
+    def axis_if_divisible(axis, dim):
+        if axis is None or shape is None:
+            return axis
+        if dim >= len(shape):
+            return None
+        return axis if shape[dim] % mesh.shape[axis] == 0 else None
+
+    if "terminal_embedding" in joined or "path_embedding" in joined:
+        return P(axis_if_divisible(model_axis, 0), None)  # row-shard vocab
+    if "output_dense" in joined:
+        if joined.endswith("kernel"):
+            return P(None, axis_if_divisible(model_axis, 1))  # [E, label]
+        return P(axis_if_divisible(model_axis, 0))  # bias [label]
+    if "output_margin_weight" in joined:
+        return P(axis_if_divisible(model_axis, 0), None)  # [label, E]
+    return P()  # replicate the small encoder params
+
+
+def pad_to_multiple(count: int, multiple: int) -> int:
+    """Round a vocab/label count up so the table shards evenly."""
+    return -(-count // multiple) * multiple
+
+
+def param_shardings(mesh: Mesh, params: Any) -> Any:
+    """NamedSharding pytree matching ``params`` (concrete or abstract)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, _spec_for_param(path, mesh, getattr(leaf, "shape", None))
+        ),
+        params,
+    )
+
+
+def batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    data_axis = AXIS_DATA if mesh.shape[AXIS_DATA] > 1 else None
+    ctx_axis = AXIS_CTX if mesh.shape[AXIS_CTX] > 1 else None
+    row = NamedSharding(mesh, P(data_axis))
+    bag = NamedSharding(mesh, P(data_axis, ctx_axis))
+    return {
+        "ids": row,
+        "starts": bag,
+        "paths": bag,
+        "ends": bag,
+        "labels": row,
+        "example_mask": row,
+    }
+
+
+def shard_batch(mesh: Mesh, batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:
+    """Place a host batch onto the mesh with the batch layout above."""
+    shardings = batch_shardings(mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
+
+
+def state_shardings(mesh: Mesh, state):
+    """A TrainState-shaped pytree of NamedShardings: params and the adam
+    moments (which mirror the param tree, so the same path rules apply) by
+    the parameter rules; RNG, step counter, and other scalars replicated."""
+    replicated = NamedSharding(mesh, P())
+    by_rules = lambda tree: jax.tree_util.tree_map_with_path(  # noqa: E731
+        lambda path, leaf: NamedSharding(
+            mesh, _spec_for_param(path, mesh, getattr(leaf, "shape", None))
+        ),
+        tree,
+    )
+    return state.replace(
+        params=by_rules(state.params),
+        opt_state=by_rules(state.opt_state),
+        dropout_rng=replicated,
+        step=replicated,
+    )
+
+
+def shard_state(mesh: Mesh, state):
+    """Place a TrainState onto the mesh per ``state_shardings``."""
+    sharding = state_shardings(mesh, state)
+    return state.replace(
+        params=jax.device_put(state.params, sharding.params),
+        opt_state=jax.device_put(state.opt_state, sharding.opt_state),
+        dropout_rng=jax.device_put(state.dropout_rng, sharding.dropout_rng),
+    )

@@ -1,0 +1,57 @@
+"""Sharded train/eval steps: the single-chip step math compiled over a mesh.
+
+Under jit with NamedSharding-annotated inputs, XLA's SPMD partitioner
+inserts every collective (SURVEY.md §5.8): gradient all-reduce over
+``data``, embedding-gather combines and label-head logit all-gather over
+``model``, softmax-statistic reductions over ``ctx``. The step functions
+are byte-identical to the single-chip ones (train.step.build_*_step_fn) —
+only the in/out shardings differ, which is the point of designing
+mesh-first.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from code2vec_tpu.models.code2vec import Code2VecConfig
+from code2vec_tpu.parallel.mesh import AXIS_DATA
+from code2vec_tpu.parallel.shardings import batch_shardings, state_shardings
+from code2vec_tpu.train.step import (
+    TrainState,
+    build_eval_step_fn,
+    build_train_step_fn,
+)
+
+
+def make_parallel_train_step(
+    model_config: Code2VecConfig, class_weights, mesh: Mesh, state: TrainState
+):
+    """jit the train step with explicit mesh shardings; ``state`` supplies
+    the pytree structure for the annotations."""
+    state_sh = state_shardings(mesh, state)
+    return jax.jit(
+        build_train_step_fn(model_config, class_weights),
+        in_shardings=(state_sh, batch_shardings(mesh)),
+        out_shardings=(state_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def make_parallel_eval_step(
+    model_config: Code2VecConfig, class_weights, mesh: Mesh, state: TrainState
+):
+    data_axis = AXIS_DATA if mesh.shape[AXIS_DATA] > 1 else None
+    row = NamedSharding(mesh, P(data_axis))
+    out_sh = {
+        "loss": NamedSharding(mesh, P()),
+        "preds": row,
+        "max_logit": row,
+        "code_vector": row,
+        "attention": row,
+    }
+    return jax.jit(
+        build_eval_step_fn(model_config, class_weights),
+        in_shardings=(state_shardings(mesh, state), batch_shardings(mesh)),
+        out_shardings=out_sh,
+    )

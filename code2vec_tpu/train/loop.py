@@ -65,6 +65,7 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
         angular_margin=config.angular_margin,
         inverse_temp=config.inverse_temp,
         dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
+        use_pallas=config.use_pallas,
     )
 
 
@@ -154,10 +155,75 @@ def train(
     state = initial_state
     if state is None:
         state = create_train_state(config, model_config, jax_rng, example_batch)
+
+    # mesh parallelism: any axis > 1 switches to sharded steps; the step
+    # math is identical (see parallel.step), XLA inserts the collectives
+    mesh = None
+    if config.data_axis * config.model_axis * config.context_axis > 1:
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_state
+        from code2vec_tpu.parallel.step import (
+            make_parallel_eval_step,
+            make_parallel_train_step,
+        )
+
+        if config.use_pallas:
+            # GSPMD has no partitioning rule for the Mosaic custom call; the
+            # kernel would be replicated with a full context all-gather
+            raise ValueError(
+                "use_pallas with mesh axes > 1 is not supported yet: the "
+                "fused kernel is single-device; use the XLA path (default) "
+                "on meshes"
+            )
+
+        if config.batch_size % config.data_axis:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"data_axis {config.data_axis}"
+            )
+        if config.max_path_length % config.context_axis:
+            raise ValueError(
+                f"max_path_length {config.max_path_length} not divisible by "
+                f"context_axis {config.context_axis}"
+            )
+        mesh = make_mesh(
+            data=config.data_axis,
+            model=config.model_axis,
+            ctx=config.context_axis,
+        )
+        if mesh.size < jax.device_count():
+            logger.warning(
+                "mesh uses %d of %d devices — raise data_axis/model_axis/"
+                "context_axis to use the whole slice",
+                mesh.size,
+                jax.device_count(),
+            )
+        state = shard_state(mesh, state)
+        if train_step is None:
+            train_step = make_parallel_train_step(
+                model_config, class_weights, mesh, state
+            )
+        if eval_step is None:
+            # host numpy batches are auto-placed by the in_shardings
+            eval_step = make_parallel_eval_step(
+                model_config, class_weights, mesh, state
+            )
+
     if train_step is None:
         train_step = make_train_step(model_config, class_weights)
     if eval_step is None:
         eval_step = make_eval_step(model_config, class_weights)
+
+    # multi-host: every process builds the same full batch (epochs are
+    # seeded identically) and serves the slices its devices own
+    if mesh is not None and jax.process_count() > 1:
+        from code2vec_tpu.parallel.distributed import global_batch
+
+        def to_device(batch):
+            return global_batch(mesh, batch)
+    else:
+        def to_device(batch):
+            return batch  # jit in_shardings place host arrays directly
 
     meta = TrainMeta()
     if config.resume and out_dir is not None:
@@ -188,7 +254,7 @@ def train(
             for batch in iter_batches(
                 train_epoch, config.batch_size, rng=np_rng, pad_final=True
             ):
-                state, loss = train_step(state, batch)
+                state, loss = train_step(state, to_device(batch))
                 train_loss += float(loss)
                 n_batches += 1
 
@@ -200,7 +266,7 @@ def train(
                 config.shuffle_variable_indexes,
             )
             test_loss, accuracy, precision, recall, f1 = _evaluate_epoch(
-                config, data, state, eval_step, test_epoch
+                config, data, state, eval_step, test_epoch, to_device
             )
 
             metrics = {
@@ -227,7 +293,8 @@ def train(
                 and report_fn is None
             ):
                 export_mod.print_sample(
-                    data, state, eval_step, test_epoch, config.batch_size
+                    data, state, eval_step, test_epoch, config.batch_size,
+                    to_device,
                 )
 
             if meta.best_f1 is None or meta.best_f1 < f1:
@@ -245,6 +312,7 @@ def train(
                         vectors_path,
                         config.encode_size,
                         test_result_path,
+                        to_device,
                     )
                 if report_fn is None and out_dir is not None:
                     meta.epoch = epoch + 1
@@ -268,7 +336,8 @@ def train(
                     "early stop loss:%s, bad:%d", train_loss, meta.bad_count
                 )
                 export_mod.print_sample(
-                    data, state, eval_step, test_epoch, config.batch_size
+                    data, state, eval_step, test_epoch, config.batch_size,
+                    to_device,
                 )
                 break
     except StopTraining:
@@ -295,19 +364,22 @@ def _evaluate_epoch(
     state,
     eval_step,
     test_epoch,
+    to_device=lambda batch: batch,
 ) -> tuple[float, float, float, float, float]:
     """Test pass: accumulate per-batch mean losses (reference semantics,
     main.py:283-284) and pooled predictions, then dispatch the matcher."""
+    from code2vec_tpu.parallel.distributed import allgather_to_host
+
     test_loss = 0.0
     expected, actual = [], []
     for batch in iter_batches(
         test_epoch, config.batch_size, rng=None, pad_final=True
     ):
-        out = eval_step(state, batch)
+        out = eval_step(state, to_device(batch))
         test_loss += float(out["loss"])
         valid = batch["example_mask"].astype(bool)
         expected.append(batch["labels"][valid])
-        actual.append(np.asarray(out["preds"])[valid])
+        actual.append(allgather_to_host(out["preds"])[valid])
     expected = np.concatenate(expected) if expected else np.zeros(0, np.int32)
     actual = np.concatenate(actual) if actual else np.zeros(0, np.int32)
     accuracy, precision, recall, f1 = evaluate(

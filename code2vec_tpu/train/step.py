@@ -17,7 +17,6 @@ padded rows.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -83,12 +82,12 @@ def weighted_nll(
     return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1e-12)
 
 
-def make_train_step(
+def build_train_step_fn(
     model_config: Code2VecConfig,
     class_weights: jnp.ndarray,
 ) -> Callable[[TrainState, dict[str, jnp.ndarray]], tuple[TrainState, jnp.ndarray]]:
-    """Build the jitted SGD step. ``class_weights`` is captured as a device
-    constant (it never changes during a run)."""
+    """The raw (unjitted) SGD step; the single-chip and mesh-sharded
+    variants jit this same function with different sharding annotations."""
 
     needs_labels = model_config.angular_margin_loss
 
@@ -106,7 +105,6 @@ def make_train_step(
             logits, batch["labels"], class_weights, batch["example_mask"]
         )
 
-    @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch):
         dropout_rng, next_rng = jax.random.split(state.dropout_rng)
         loss, grads = jax.value_and_grad(loss_fn)(
@@ -118,17 +116,16 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(
+def build_eval_step_fn(
     model_config: Code2VecConfig,
     class_weights: jnp.ndarray,
 ):
-    """Jitted eval: batch-mean loss (the reference accumulates per-batch
+    """Raw eval step: batch-mean loss (the reference accumulates per-batch
     means, main.py:283-284), argmax predictions, and the max logit (what the
     reference reports as the prediction 'prob', main.py:411)."""
 
     needs_labels = model_config.angular_margin_loss
 
-    @jax.jit
     def eval_step(state: TrainState, batch):
         logits, code_vector, attention = state.apply_fn(
             {"params": state.params},
@@ -152,3 +149,15 @@ def make_eval_step(
         }
 
     return eval_step
+
+
+def make_train_step(model_config: Code2VecConfig, class_weights: jnp.ndarray):
+    """Single-device jitted train step."""
+    return jax.jit(
+        build_train_step_fn(model_config, class_weights), donate_argnums=(0,)
+    )
+
+
+def make_eval_step(model_config: Code2VecConfig, class_weights: jnp.ndarray):
+    """Single-device jitted eval step."""
+    return jax.jit(build_eval_step_fn(model_config, class_weights))

@@ -1,0 +1,129 @@
+"""Fused attention-pooling kernel vs the XLA reference op.
+
+Runs in Pallas interpreter mode on CPU (same code path the TPU compiles);
+the numerical contract is identical either way.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.ops.attention import attention_pool
+from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+
+def random_inputs(B=5, L=37, E=24, seed=0, all_pad_row=False):
+    rng = np.random.default_rng(seed)
+    ctx = rng.normal(size=(B, L, E)).astype(np.float32)
+    mask = (rng.random((B, L)) > 0.4).astype(np.float32)
+    mask[:, 0] = 1.0
+    if all_pad_row:
+        mask[1, :] = 0.0
+    a = rng.normal(size=E).astype(np.float32)
+    return jnp.asarray(ctx), jnp.asarray(mask), jnp.asarray(a)
+
+
+class TestForward:
+    @pytest.mark.parametrize("shape", [(5, 37, 24), (8, 128, 128), (3, 200, 100), (1, 1, 8)])
+    def test_matches_xla_op(self, shape):
+        B, L, E = shape
+        ctx, mask, a = random_inputs(B, L, E)
+        cv_ref, w_ref = attention_pool(ctx, mask, a)
+        cv_k, w_k = pallas_attention_pool(ctx, mask, a)
+        np.testing.assert_allclose(np.asarray(cv_k), np.asarray(cv_ref), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(w_k), np.asarray(w_ref), rtol=1e-5, atol=1e-6)
+
+    def test_padding_rows_invisible(self):
+        # B=5 pads to block 8; L=37 pads to 128 — outputs must be unaffected
+        ctx, mask, a = random_inputs(5, 37, 16, seed=3)
+        cv, w = pallas_attention_pool(ctx, mask, a)
+        assert cv.shape == (5, 16) and w.shape == (5, 37)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
+
+    def test_bf16_contexts(self):
+        ctx, mask, a = random_inputs(4, 32, 16, seed=5)
+        cv_ref, _ = attention_pool(ctx.astype(jnp.bfloat16), mask, a)
+        cv_k, _ = pallas_attention_pool(ctx.astype(jnp.bfloat16), mask, a)
+        np.testing.assert_allclose(
+            np.asarray(cv_k), np.asarray(cv_ref, dtype=np.float32), rtol=2e-2, atol=2e-2
+        )
+
+
+class TestGradients:
+    def test_grads_match_xla(self):
+        ctx, mask, a = random_inputs(4, 21, 12, seed=7)
+
+        def loss_xla(ctx, a):
+            cv, w = attention_pool(ctx, mask, a)
+            return jnp.sum(cv**2) + jnp.sum(w * jnp.cos(w))
+
+        def loss_pallas(ctx, a):
+            cv, w = pallas_attention_pool(ctx, mask, a)
+            return jnp.sum(cv**2) + jnp.sum(w * jnp.cos(w))
+
+        g_ref = jax.grad(loss_xla, argnums=(0, 1))(ctx, a)
+        g_k = jax.grad(loss_pallas, argnums=(0, 1))(ctx, a)
+        np.testing.assert_allclose(np.asarray(g_k[0]), np.asarray(g_ref[0]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_k[1]), np.asarray(g_ref[1]), rtol=1e-4, atol=1e-5)
+
+    def test_grads_with_fully_masked_row(self):
+        ctx, mask, a = random_inputs(4, 16, 8, seed=9, all_pad_row=True)
+
+        def loss(ctx, a):
+            cv, _ = pallas_attention_pool(ctx, mask, a)
+            return jnp.sum(cv**2)
+
+        g = jax.grad(loss, argnums=(0, 1))(ctx, a)
+        assert np.isfinite(np.asarray(g[0])).all()
+        assert np.isfinite(np.asarray(g[1])).all()
+
+
+class TestDegenerateRows:
+    def test_fully_masked_row_matches_xla_exactly(self):
+        # regression: the all-masked row must softmax uniformly over the
+        # REAL bag length, not the lane-padded one
+        ctx, mask, a = random_inputs(4, 37, 16, seed=11, all_pad_row=True)
+        cv_ref, w_ref = attention_pool(ctx, mask, a)
+        cv_k, w_k = pallas_attention_pool(ctx, mask, a)
+        np.testing.assert_allclose(np.asarray(w_k[1]), np.asarray(w_ref[1]), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(cv_k[1]), np.asarray(cv_ref[1]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(w_k[1].sum()), 1.0, rtol=1e-5)
+
+
+class TestMeshGate:
+    def test_pallas_plus_mesh_rejected(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        cfg = TrainConfig(use_pallas=True, data_axis=2, max_epoch=1)
+        with pytest.raises(ValueError, match="use_pallas with mesh"):
+            train(cfg, data)
+
+
+class TestEndToEnd:
+    def test_training_with_pallas_model(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        cfg = TrainConfig(
+            max_epoch=2,
+            batch_size=32,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=16,
+            print_sample_cycle=0,
+            use_pallas=True,
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+        assert res.final_f1 > 0.0

@@ -1,0 +1,276 @@
+"""Mesh-parallelism tests on the 8-device virtual CPU platform
+(SURVEY.md §4: the TPU-pod analogue of a fake backend)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.ops.attention import attention_pool
+from code2vec_tpu.parallel.context import context_parallel_attention_pool
+from code2vec_tpu.parallel.distributed import global_batch, host_shard
+from code2vec_tpu.parallel.mesh import AXIS_MODEL, make_mesh, single_device_mesh
+from code2vec_tpu.parallel.shardings import (
+    batch_shardings,
+    param_shardings,
+    shard_batch,
+    shard_state,
+)
+from code2vec_tpu.parallel.step import (
+    make_parallel_eval_step,
+    make_parallel_train_step,
+)
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.step import create_train_state, make_train_step
+
+
+def tiny_model_config(**kw):
+    defaults = dict(
+        terminal_count=63,  # deliberately NOT divisible by the model axis
+        path_count=41,
+        label_count=13,
+        terminal_embed_size=8,
+        path_embed_size=8,
+        encode_size=16,
+        dropout_prob=0.25,
+    )
+    defaults.update(kw)
+    return Code2VecConfig(**defaults)
+
+
+def make_batch(model_config, B=8, L=8, seed=0):
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(1, model_config.terminal_count, (B, L)).astype(np.int32)
+    starts[:, L // 2 :] = 0
+    return {
+        "ids": np.arange(B, dtype=np.int64),
+        "starts": starts,
+        "paths": rng.integers(1, model_config.path_count, (B, L)).astype(np.int32),
+        "ends": rng.integers(1, model_config.terminal_count, (B, L)).astype(np.int32),
+        "labels": rng.integers(0, model_config.label_count, B).astype(np.int32),
+        "example_mask": np.ones(B, np.float32),
+    }
+
+
+class TestMesh:
+    def test_three_axes(self):
+        mesh = make_mesh(data=2, model=2, ctx=2)
+        assert mesh.shape == {"data": 2, "model": 2, "ctx": 2}
+
+    def test_data_absorbs_remaining(self):
+        mesh = make_mesh(model=2)
+        assert mesh.shape["data"] == jax.device_count() // 2
+
+    def test_too_many_devices_rejected(self):
+        with pytest.raises(ValueError):
+            make_mesh(data=1000)
+
+    def test_single_device(self):
+        mesh = single_device_mesh()
+        assert mesh.shape == {"data": 1, "model": 1, "ctx": 1}
+
+
+class TestParamShardings:
+    def test_embedding_row_sharded_head_col_sharded(self):
+        mesh = make_mesh(data=2, model=2, ctx=2)
+        # divisible sizes so every rule actually shards
+        mc = tiny_model_config(terminal_count=64, path_count=48, label_count=16)
+        model = Code2Vec(mc)
+        batch = make_batch(mc)
+        params = model.init(
+            jax.random.PRNGKey(0), batch["starts"], batch["paths"], batch["ends"]
+        )["params"]
+        sh = param_shardings(mesh, params)
+        assert sh["terminal_embedding"]["embedding"].spec == P(AXIS_MODEL, None)
+        assert sh["path_embedding"]["embedding"].spec == P(AXIS_MODEL, None)
+        assert sh["output_dense"]["kernel"].spec == P(None, AXIS_MODEL)
+        assert sh["output_dense"]["bias"].spec == P(AXIS_MODEL)
+        assert sh["input_dense"]["kernel"].spec == P()
+        assert sh["attention"].spec == P()
+
+    def test_model_axis_1_replicates(self):
+        mesh = make_mesh(data=8, model=1, ctx=1)
+        mc = tiny_model_config()
+        model = Code2Vec(mc)
+        batch = make_batch(mc)
+        params = model.init(
+            jax.random.PRNGKey(0), batch["starts"], batch["paths"], batch["ends"]
+        )["params"]
+        sh = param_shardings(mesh, params)
+        assert sh["terminal_embedding"]["embedding"].spec == P(None, None)
+
+
+class TestParallelStepEquivalence:
+    """The sharded step must compute the same numbers as the single-device
+    step — dp/tp/sp is an implementation detail, not a semantics change."""
+
+    @pytest.mark.parametrize(
+        "axes", [(8, 1, 1), (2, 2, 2), (1, 4, 2), (4, 2, 1), (2, 1, 4)]
+    )
+    def test_loss_matches_single_device(self, axes):
+        data, model_ax, ctx = axes
+        mc = tiny_model_config()
+        batch = make_batch(mc, B=8, L=8)
+        cfg = TrainConfig(batch_size=8, max_path_length=8)
+        class_weights = jnp.ones(mc.label_count)
+
+        state_single = create_train_state(cfg, mc, jax.random.PRNGKey(7), batch)
+        single_step = make_train_step(mc, class_weights)
+        _, loss_single = single_step(state_single, batch)
+
+        mesh = make_mesh(data=data, model=model_ax, ctx=ctx)
+        state_sharded = shard_state(
+            mesh, create_train_state(cfg, mc, jax.random.PRNGKey(7), batch)
+        )
+        parallel_step = make_parallel_train_step(mc, class_weights, mesh, state_sharded)
+        state_sharded, loss_sharded = parallel_step(state_sharded, batch)
+
+        assert float(loss_single) == pytest.approx(float(loss_sharded), rel=1e-4)
+
+    def test_multi_step_training_matches(self):
+        mc = tiny_model_config(dropout_prob=0.0)
+        batch = make_batch(mc, B=8, L=8)
+        cfg = TrainConfig(batch_size=8, max_path_length=8)
+        class_weights = jnp.ones(mc.label_count)
+
+        state_a = create_train_state(cfg, mc, jax.random.PRNGKey(1), batch)
+        step_a = make_train_step(mc, class_weights)
+        for _ in range(3):
+            state_a, loss_a = step_a(state_a, batch)
+
+        mesh = make_mesh(data=2, model=2, ctx=2)
+        state_b = shard_state(
+            mesh, create_train_state(cfg, mc, jax.random.PRNGKey(1), batch)
+        )
+        step_b = make_parallel_train_step(mc, class_weights, mesh, state_b)
+        for _ in range(3):
+            state_b, loss_b = step_b(state_b, batch)
+
+        assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-4)
+
+    def test_eval_step_outputs_match(self):
+        mc = tiny_model_config()
+        batch = make_batch(mc, B=8, L=8)
+        cfg = TrainConfig(batch_size=8, max_path_length=8)
+        class_weights = jnp.ones(mc.label_count)
+        from code2vec_tpu.train.step import make_eval_step
+
+        state = create_train_state(cfg, mc, jax.random.PRNGKey(3), batch)
+        out_single = make_eval_step(mc, class_weights)(state, batch)
+
+        mesh = make_mesh(data=2, model=2, ctx=2)
+        state_sh = shard_state(
+            mesh, create_train_state(cfg, mc, jax.random.PRNGKey(3), batch)
+        )
+        out_par = make_parallel_eval_step(mc, class_weights, mesh, state_sh)(
+            state_sh, batch
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_single["preds"]), np.asarray(out_par["preds"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_single["code_vector"]),
+            np.asarray(out_par["code_vector"]),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+
+class TestContextParallelAttention:
+    def test_matches_reference_pool(self):
+        mesh = make_mesh(data=1, model=1, ctx=8)
+        rng = np.random.default_rng(0)
+        B, L, E = 4, 32, 16
+        ctx = rng.normal(size=(B, L, E)).astype(np.float32)
+        mask = (rng.random((B, L)) > 0.3).astype(np.float32)
+        mask[:, 0] = 1.0
+        a = rng.normal(size=E).astype(np.float32)
+
+        cv_ref, attn_ref = attention_pool(
+            jnp.asarray(ctx), jnp.asarray(mask), jnp.asarray(a)
+        )
+        with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else mesh:
+            cv_cp, attn_cp = context_parallel_attention_pool(
+                mesh, jnp.asarray(ctx), jnp.asarray(mask), jnp.asarray(a)
+            )
+        np.testing.assert_allclose(
+            np.asarray(cv_cp), np.asarray(cv_ref), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(attn_cp), np.asarray(attn_ref), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestShardBatchAndState:
+    def test_batch_placement(self):
+        mesh = make_mesh(data=4, model=2, ctx=1)
+        mc = tiny_model_config()
+        batch = make_batch(mc, B=8, L=8)
+        device_batch = shard_batch(mesh, batch)
+        assert device_batch["starts"].sharding.spec == P("data", None)
+        assert device_batch["labels"].sharding.spec == P("data")
+
+    def test_uneven_vocab_sharding_works(self):
+        # vocab 63 / labels 13 over model axis 2 — the indivisible dims fall
+        # back to replication and training still works
+        mc = tiny_model_config()
+        batch = make_batch(mc, B=8, L=8)
+        cfg = TrainConfig(batch_size=8, max_path_length=8)
+        mesh = make_mesh(data=2, model=2, ctx=1)
+        state = shard_state(
+            mesh, create_train_state(cfg, mc, jax.random.PRNGKey(0), batch)
+        )
+        step = make_parallel_train_step(mc, jnp.ones(mc.label_count), mesh, state)
+        _, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+
+
+class TestDistributedHelpers:
+    def test_host_shard_single_process(self):
+        s = host_shard(100)
+        assert (s.start, s.stop) == (0, 100)
+
+    def test_global_batch_single_process(self):
+        mesh = make_mesh(data=8, model=1, ctx=1)
+        mc = tiny_model_config()
+        batch = make_batch(mc, B=8, L=8)
+        out = global_batch(mesh, batch)
+        assert out["starts"].shape == (8, 8)
+
+
+class TestTrainLoopWithMesh:
+    def test_loop_trains_on_mesh(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        cfg = TrainConfig(
+            max_epoch=2,
+            batch_size=32,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=16,
+            print_sample_cycle=0,
+            data_axis=2,
+            model_axis=2,
+            context_axis=2,
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+        assert res.final_f1 > 0.0
+
+    def test_indivisible_batch_rejected(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        cfg = TrainConfig(batch_size=31, data_axis=2, max_epoch=1)
+        with pytest.raises(ValueError, match="not divisible"):
+            train(cfg, data)
