@@ -1,0 +1,179 @@
+"""Python interface to the native C++ path-context extractor.
+
+The extractor (``extractor/`` — lexer, Java parser, normalizer, path
+enumerator; the TPU-framework equivalent of the reference's Scala/JVM
+notebook pipeline, SURVEY.md §2.3) is exposed two ways:
+
+- ``extract_source``: in-process via ctypes against ``libc2v.so`` — parse a
+  Java source string, get records + vocabs back without touching disk;
+- ``extract_dataset``: the ``c2v-extract`` CLI over a methods.txt, writing
+  the five corpus artifacts (the createDataset equivalent, ipynb cell11).
+
+``build_extractor`` compiles both with cmake+ninja on first use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXTRACTOR_DIR = os.path.join(REPO_ROOT, "extractor")
+BUILD_DIR = os.path.join(EXTRACTOR_DIR, "build")
+BINARY = os.path.join(BUILD_DIR, "c2v-extract")
+LIBRARY = os.path.join(BUILD_DIR, "libc2v.so")
+
+
+def build_extractor(force: bool = False) -> str:
+    """Compile the extractor if needed; returns the binary path."""
+    if not force and os.path.exists(BINARY) and os.path.exists(LIBRARY):
+        return BINARY
+    subprocess.run(
+        ["cmake", "-S", EXTRACTOR_DIR, "-B", BUILD_DIR, "-G", "Ninja"],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD_DIR], check=True, capture_output=True
+    )
+    return BINARY
+
+
+@dataclass
+class ExtractedMethod:
+    label: str
+    path_contexts: list[tuple[int, int, int]] = field(default_factory=list)
+    aliases: list[tuple[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class ExtractResult:
+    methods: list[ExtractedMethod]
+    terminal_vocab: dict[int, str]  # 1-based raw indices (no PAD row)
+    path_vocab: dict[int, str]
+
+
+_lib = None
+
+
+def _load_library():
+    global _lib
+    if _lib is None:
+        build_extractor()
+        _lib = ctypes.CDLL(LIBRARY)
+        _lib.c2v_extract_source.restype = ctypes.c_void_p
+        _lib.c2v_extract_source.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        _lib.c2v_free.argtypes = [ctypes.c_void_p]
+        _lib.c2v_last_error.restype = ctypes.c_char_p
+    return _lib
+
+
+def extract_source(
+    source: str,
+    method_name: str = "*",
+    max_length: int = 8,
+    max_width: int = 3,
+    normalize_string: bool = True,
+    normalize_char: bool = True,
+    normalize_int: bool = False,
+    normalize_double: bool = True,
+) -> ExtractResult:
+    """Extract path-contexts from a Java source string, in process."""
+    lib = _load_library()
+    raw = lib.c2v_extract_source(
+        source.encode("utf-8"),
+        method_name.encode("utf-8"),
+        max_length,
+        max_width,
+        int(normalize_string),
+        int(normalize_char),
+        int(normalize_int),
+        int(normalize_double),
+    )
+    if not raw:
+        raise ValueError(
+            "extraction failed: " + lib.c2v_last_error().decode("utf-8")
+        )
+    try:
+        text = ctypes.string_at(raw).decode("utf-8")
+    finally:
+        lib.c2v_free(raw)
+    return _parse_blob(text)
+
+
+def _parse_blob(text: str) -> ExtractResult:
+    body, _, tail = text.partition("===TERMINALS===\n")
+    terminal_part, _, path_part = tail.partition("===PATHS===\n")
+
+    def parse_vocab(chunk: str) -> dict[int, str]:
+        out = {}
+        for line in chunk.splitlines():
+            if "\t" in line:
+                index, name = line.split("\t", 1)
+                out[int(index)] = name
+        return out
+
+    methods: list[ExtractedMethod] = []
+    current: ExtractedMethod | None = None
+    mode = 0
+    for line in body.splitlines():
+        if not line:
+            current = None
+            continue
+        if line.startswith("#"):
+            current = ExtractedMethod(label="")
+            methods.append(current)
+            mode = 0
+        elif line.startswith("label:"):
+            current.label = line[6:]
+        elif line == "paths:":
+            mode = 1
+        elif line == "vars:":
+            mode = 2
+        elif mode == 1:
+            start, path, end = line.split("\t")
+            current.path_contexts.append((int(start), int(path), int(end)))
+        elif mode == 2:
+            original, alias = line.split("\t")
+            current.aliases.append((original, alias))
+    return ExtractResult(
+        methods=methods,
+        terminal_vocab=parse_vocab(terminal_part),
+        path_vocab=parse_vocab(path_part),
+    )
+
+
+def extract_dataset(
+    dataset_dir: str,
+    source_dir: str,
+    max_length: int = 8,
+    max_width: int = 3,
+    method_declarations: str | None = None,
+    extra_args: list[str] = (),
+) -> subprocess.CompletedProcess:
+    """Run the CLI over <dataset_dir>/methods.txt (createDataset parity)."""
+    build_extractor()
+    cmd = [
+        BINARY,
+        dataset_dir,
+        source_dir,
+        "--max-length",
+        str(max_length),
+        "--max-width",
+        str(max_width),
+    ]
+    if method_declarations:
+        cmd += ["--method-declarations", method_declarations]
+    cmd += list(extra_args)
+    return subprocess.run(cmd, check=True, capture_output=True, text=True)

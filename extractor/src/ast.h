@@ -1,0 +1,48 @@
+// AST node for the Java path-context extractor.
+//
+// Node type names follow javaparser's class names (the reference extraction
+// pipeline is built on javaparser 3.6 — create_path_contexts.ipynb cell1)
+// so that path strings like "SimpleName<UP>MethodCallExpr<DOWN>NameExpr"
+// carry the same vocabulary of node kinds. Child ordering is source order
+// within each construct (documented per-production in parser.cc); this can
+// differ from javaparser's metamodel ordering in corner cases, which
+// changes some path strings but not the extraction semantics.
+
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace c2v {
+
+struct JNode {
+  std::string type;          // javaparser-style class name, e.g. "MethodCallExpr"
+  std::string text;          // identifier/literal source text where applicable
+  std::string op;            // operator enum name for Unary/Binary/Assign
+  bool is_var_args = false;  // Parameter only
+  std::vector<std::unique_ptr<JNode>> children;
+
+  JNode() = default;
+  explicit JNode(std::string t) : type(std::move(t)) {}
+  JNode(std::string t, std::string s) : type(std::move(t)), text(std::move(s)) {}
+
+  JNode* add(std::unique_ptr<JNode> child) {
+    children.push_back(std::move(child));
+    return children.back().get();
+  }
+  bool leaf() const { return children.empty(); }
+};
+
+using JNodePtr = std::unique_ptr<JNode>;
+
+inline JNodePtr make(std::string type) { return std::make_unique<JNode>(std::move(type)); }
+inline JNodePtr make(std::string type, std::string text) {
+  return std::make_unique<JNode>(std::move(type), std::move(text));
+}
+
+// Pretty-printed source text of a node, used as the terminal symbol for
+// leaf Expression/Name/Type nodes (ipynb cell6: node.toString(prettyPrintConfig)).
+std::string node_source(const JNode& n);
+
+}  // namespace c2v

@@ -1,0 +1,86 @@
+// C API for in-process use from Python via ctypes (pybind11 is not
+// available in this environment; the CPython-visible surface is plain C).
+//
+// One call parses a Java source buffer and extracts all (or one) method's
+// path-contexts, returning a single malloc'd UTF-8 blob:
+//
+//   corpus-format records (SURVEY.md §2.4)
+//   "===TERMINALS===\n" <index>\t<name> lines
+//   "===PATHS===\n"     <index>\t<name> lines
+//
+// The caller frees with c2v_free. Errors return NULL with the message
+// available via c2v_last_error (thread-local).
+
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+
+#include "extract.h"
+#include "parser.h"
+
+namespace {
+thread_local std::string g_last_error;
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out) std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+}  // namespace
+
+extern "C" {
+
+const char* c2v_last_error() { return g_last_error.c_str(); }
+
+void c2v_free(char* p) { std::free(p); }
+
+char* c2v_extract_source(const char* source, const char* method_name,
+                         int max_length, int max_width,
+                         int normalize_string, int normalize_char,
+                         int normalize_int, int normalize_double) {
+  try {
+    c2v::ExtractConfig config;
+    config.max_length = max_length;
+    config.max_width = max_width;
+    config.normalize_string_literal = normalize_string != 0;
+    config.normalize_char_literal = normalize_char != 0;
+    config.normalize_int_literal = normalize_int != 0;
+    config.normalize_double_literal = normalize_double != 0;
+
+    auto cu = c2v::parse_compilation_unit(source);
+    c2v::Vocabs vocabs;
+    auto methods = c2v::extract_features(
+        *cu, method_name ? method_name : "*", vocabs, config);
+
+    std::ostringstream out;
+    int id = 0;
+    for (const auto& mf : methods) {
+      out << "#" << id++ << "\n";
+      out << "label:" << mf.method_name << "\n";
+      out << "paths:\n";
+      for (const auto& f : mf.features)
+        out << f.start << "\t" << f.path << "\t" << f.end << "\n";
+      out << "vars:\n";
+      for (auto it = mf.env.vars.variables.rbegin();
+           it != mf.env.vars.variables.rend(); ++it)
+        out << it->name << "\t" << it->id << "\n";
+      for (auto it = mf.env.labels.variables.rbegin();
+           it != mf.env.labels.variables.rend(); ++it)
+        out << it->name << "\t" << it->id << "\n";
+      out << "\n";
+    }
+    out << "===TERMINALS===\n";
+    for (const auto& [name, index] : vocabs.terminals())
+      out << index << "\t" << name << "\n";
+    out << "===PATHS===\n";
+    for (const auto& [name, index] : vocabs.paths())
+      out << index << "\t" << name << "\n";
+    return dup_string(out.str());
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+}  // extern "C"

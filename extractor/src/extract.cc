@@ -1,0 +1,460 @@
+#include "extract.h"
+
+#include <algorithm>
+#include <cctype>
+#include <set>
+#include <stdexcept>
+
+namespace c2v {
+
+namespace {
+
+const std::set<std::string> kObjectMethods = {"clone", "equals", "finalize",
+                                              "hashCode", "toString"};
+
+std::string lower(std::string s) {
+  std::transform(s.begin(), s.end(), s.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return s;
+}
+
+const JNode* find_child(const JNode& n, const std::string& type) {
+  for (const auto& c : n.children)
+    if (c->type == type) return c.get();
+  return nullptr;
+}
+
+int count_children(const JNode& n, const std::string& type) {
+  int k = 0;
+  for (const auto& c : n.children) k += c->type == type;
+  return k;
+}
+
+// immutable binding list (ipynb cell5 `ParseContext`): a new cons cell per
+// declaration, structurally shared, dropped on scope exit
+struct Binding {
+  std::string space;  // "var" | "method" | "label"
+  std::string name;
+  std::string id;
+  std::shared_ptr<const Binding> next;
+};
+using Ctx = std::shared_ptr<const Binding>;
+
+Ctx bind(const Ctx& ctx, const std::string& space, const Variable& v) {
+  return std::make_shared<const Binding>(Binding{space, v.name, v.id, ctx});
+}
+
+std::string lookup(const Ctx& ctx, const std::string& space,
+                   const std::string& name) {
+  for (const Binding* b = ctx.get(); b; b = b->next.get())
+    if (b->space == space && b->name == name) return b->id;
+  return name;  // unresolved names keep their own text (cell5 getOrElse)
+}
+
+// node-kind classification for the default/leaf case of extractAST (cell6)
+const std::set<std::string> kExpressionKinds = {
+    "NameExpr", "MethodCallExpr", "FieldAccessExpr", "ObjectCreationExpr",
+    "ArrayCreationExpr", "ArrayAccessExpr", "ArrayInitializerExpr",
+    "CastExpr", "InstanceOfExpr", "EnclosedExpr", "ConditionalExpr",
+    "UnaryExpr", "BinaryExpr", "AssignExpr", "LambdaExpr",
+    "MethodReferenceExpr", "ClassExpr", "TypeExpr", "VariableDeclarationExpr",
+    "MarkerAnnotationExpr", "SingleMemberAnnotationExpr",
+    "NormalAnnotationExpr", "StringLiteralExpr", "CharLiteralExpr",
+    "IntegerLiteralExpr", "LongLiteralExpr", "DoubleLiteralExpr",
+    "BooleanLiteralExpr", "NullLiteralExpr", "ThisExpr", "SuperExpr"};
+const std::set<std::string> kTypeKinds = {
+    "PrimitiveType", "VoidType", "ClassOrInterfaceType", "ArrayType",
+    "WildcardType", "UnionType", "IntersectionType", "TypeParameter"};
+const std::set<std::string> kNameKinds = {"Name", "SimpleName"};
+const std::set<std::string> kLeafStatementKinds = {
+    "BreakStmt", "ReturnStmt", "ContinueStmt", "SwitchEntryStmt", "EmptyStmt"};
+
+// scope-closing node types (cell6's big isInstanceOf disjunction)
+const std::set<std::string> kScopeClosers = {
+    "BlockStmt", "LambdaExpr", "MethodDeclaration", "ConstructorDeclaration",
+    "ClassOrInterfaceDeclaration", "EnumDeclaration",
+    "EnumConstantDeclaration", "AnnotationDeclaration",
+    "AnnotationMemberDeclaration", "TryStmt", "CatchClause"};
+
+ENodePtr enode(std::string name) {
+  auto n = std::make_unique<ENode>();
+  n->name = std::move(name);
+  return n;
+}
+ENodePtr enode_terminal(std::string name, std::string terminal) {
+  auto n = enode(std::move(name));
+  n->terminal = std::move(terminal);
+  return n;
+}
+
+struct Extractor {
+  VarEnv& env;
+  const ExtractConfig& config;
+
+  using Result = std::pair<ENodePtr, Ctx>;
+
+  // evaluate children in order, chaining contexts (cell6 extractAstList);
+  // `special` intercepts specific children (the SimpleName-replacement
+  // pattern of Parameter/VariableDeclarator/MethodDeclaration/...)
+  template <typename Handler>
+  std::pair<std::vector<ENodePtr>, Ctx> eval_list(const JNode& n, Ctx ctx,
+                                                  Handler&& special) {
+    std::vector<ENodePtr> out;
+    Ctx current = ctx;
+    for (const auto& child : n.children) {
+      Result r = special(*child, current);
+      out.push_back(std::move(r.first));
+      current = r.second;
+    }
+    return {std::move(out), current};
+  }
+
+  std::pair<std::vector<ENodePtr>, Ctx> eval_children(const JNode& n, Ctx ctx) {
+    return eval_list(n, ctx, [&](const JNode& c, Ctx cur) { return extract(c, cur); });
+  }
+
+  Result extract(const JNode& n, Ctx ctx) {
+    const std::string& t = n.type;
+
+    // ---- literal normalization (cell6) --------------------------------
+    if (t == "StringLiteralExpr" && config.normalize_string_literal)
+      return {enode_terminal(t, "@string_literal"), ctx};
+    if (t == "CharLiteralExpr" && config.normalize_char_literal)
+      return {enode_terminal(t, "@char_literal"), ctx};
+    if ((t == "IntegerLiteralExpr" || t == "LongLiteralExpr") &&
+        config.normalize_int_literal)
+      return {enode_terminal(t, "@int_literal"), ctx};
+    if (t == "DoubleLiteralExpr" && config.normalize_double_literal)
+      return {enode_terminal(t, "@double_literal"), ctx};
+
+    // ---- parameter anonymization (cell6 `case p: Parameter`) ----------
+    if (t == "Parameter") {
+      const JNode* name_node = find_child(n, "SimpleName");
+      std::string original = name_node ? name_node->text : "";
+      Variable alias = env.vars.fresh(original);
+      Ctx new_ctx = bind(ctx, "var", alias);
+      auto [children, _] = eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
+        if (c.type == "SimpleName")
+          return {enode_terminal("SimpleName", alias.id), cur};
+        if (kTypeKinds.count(c.type)) {
+          auto type_ast = extract(c, cur).first;
+          if (n.is_var_args) {
+            auto wrapper = enode("VarArgs");
+            wrapper->children.push_back(std::move(type_ast));
+            return {std::move(wrapper), cur};
+          }
+          return {std::move(type_ast), cur};
+        }
+        return extract(c, cur);
+      });
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), new_ctx};
+    }
+
+    // ---- operator-suffixed nodes (cell6 Unary/Binary/Assign) ----------
+    if (t == "UnaryExpr" || t == "BinaryExpr" || t == "AssignExpr") {
+      auto [children, new_ctx] = eval_children(n, ctx);
+      auto ast = enode(t + ":" + n.op);
+      ast->children = std::move(children);
+      return {std::move(ast), new_ctx};
+    }
+
+    // ---- variable declarator (cell6) ----------------------------------
+    if (t == "VariableDeclarator") {
+      const JNode* name_node = find_child(n, "SimpleName");
+      std::string original = name_node ? name_node->text : "";
+      Variable alias = env.vars.fresh(original);
+      Ctx new_ctx = bind(ctx, "var", alias);
+      auto [children, _] = eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
+        if (c.type == "SimpleName")
+          // the reference's handler returns newContext here, so the
+          // initializer (a later sibling) sees the fresh binding — Java
+          // self-reference semantics
+          return {enode_terminal("SimpleName", alias.id), new_ctx};
+        return extract(c, cur);
+      });
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), new_ctx};
+    }
+
+    // ---- variable reference (cell6 `case e: NameExpr`) ----------------
+    if (t == "NameExpr") {
+      const JNode* name_node = find_child(n, "SimpleName");
+      std::string name = name_node ? name_node->text : "";
+      auto ast = enode(t);
+      ast->children.push_back(
+          enode_terminal("SimpleName", lookup(ctx, "var", name)));
+      return {std::move(ast), ctx};
+    }
+
+    // ---- method declaration (cell6) -----------------------------------
+    if (t == "MethodDeclaration") {
+      const JNode* name_node = find_child(n, "SimpleName");
+      std::string original = name_node ? name_node->text : "";
+      Variable alias = env.methods.fresh(original);
+      Ctx new_ctx = bind(ctx, "method", alias);
+      auto [children, _] = eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
+        if (c.type == "SimpleName")
+          // params/body (later siblings) see the @method_0 binding, so
+          // self-recursion resolves (cell6's recursion-aware comment)
+          return {enode_terminal("SimpleName", alias.id), new_ctx};
+        return extract(c, cur);
+      });
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), ctx};  // close scope
+    }
+
+    // ---- method call (cell6) ------------------------------------------
+    if (t == "MethodCallExpr") {
+      // my AST shape: [scope?, SimpleName, args...] — scope is any non-
+      // SimpleName first child
+      const JNode* scope = nullptr;
+      if (!n.children.empty() && n.children[0]->type != "SimpleName")
+        scope = n.children[0].get();
+      const JNode* name_node = find_child(n, "SimpleName");
+      std::string name = name_node ? name_node->text : "";
+      bool self_call =
+          scope == nullptr || (scope->type == "ThisExpr" && scope->leaf());
+      ENodePtr ast_name =
+          self_call ? enode_terminal("SimpleName", lookup(ctx, "method", name))
+                    : enode_terminal("SimpleName", name);
+      auto [children, _] = eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
+        if (c.type == "SimpleName") {
+          auto copy = enode_terminal("SimpleName", *ast_name->terminal);
+          return {std::move(copy), cur};
+        }
+        return extract(c, cur);
+      });
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), ctx};  // close scope
+    }
+
+    // ---- labeled statement / break / continue (cell6) -----------------
+    if (t == "LabeledStmt") {
+      const JNode* label_node = find_child(n, "SimpleName");
+      std::string label = label_node ? label_node->text : "";
+      Variable alias = env.labels.fresh(label);
+      Ctx new_ctx = bind(ctx, "label", alias);
+      auto [children, final_ctx] =
+          eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
+            if (c.type == "SimpleName")
+              return {enode_terminal("SimpleName", alias.id), new_ctx};
+            return extract(c, cur);
+          });
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), final_ctx};  // label stays bound (cell6)
+    }
+    if (t == "BreakStmt" || t == "ContinueStmt") {
+      auto ast = enode(t);
+      const JNode* label_node = find_child(n, "SimpleName");
+      if (label_node)
+        ast->children.push_back(enode_terminal(
+            "SimpleName", lookup(ctx, "label", label_node->text)));
+      return {std::move(ast), ctx};
+    }
+
+    // ---- ternary with Condition wrapper (cell6) -----------------------
+    if (t == "ConditionalExpr" && n.children.size() == 3) {
+      auto ast = enode(t);
+      auto condition = enode("Condition");
+      condition->children.push_back(
+          extract(*n.children[0], ctx).first);
+      ast->children.push_back(std::move(condition));
+      ast->children.push_back(extract(*n.children[1], ctx).first);
+      ast->children.push_back(extract(*n.children[2], ctx).first);
+      return {std::move(ast), ctx};
+    }
+
+    // ---- scope-closing containers (cell6) -----------------------------
+    if (kScopeClosers.count(t)) {
+      auto [children, _] = eval_children(n, ctx);
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), ctx};  // close scope
+    }
+
+    // ---- default case (cell6) -----------------------------------------
+    auto [children, new_ctx] = eval_children(n, ctx);
+    if (n.leaf()) {
+      if (kExpressionKinds.count(t) || kNameKinds.count(t) ||
+          kTypeKinds.count(t) || t == "ArrayCreationLevel") {
+        return {enode_terminal(t, node_source(n)), new_ctx};
+      }
+      if (kLeafStatementKinds.count(t)) {
+        auto ast = enode(t);
+        return {std::move(ast), new_ctx};
+      }
+      throw std::runtime_error("unhandled empty node: " + t);
+    }
+    auto ast = enode(t);
+    ast->children = std::move(children);
+    return {std::move(ast), new_ctx};
+  }
+};
+
+// ---- terminal discovery (cell8 `findTerminal`) -------------------------
+struct TerminalEntry {
+  const ENode* node;
+  std::vector<std::pair<const ENode*, int>> path_from_root;
+  int terminal_index;
+};
+
+void find_terminals(const ENode& ast,
+                    std::vector<std::pair<const ENode*, int>>& path,
+                    Vocabs& vocabs, std::vector<TerminalEntry>& out) {
+  if (ast.terminal.has_value()) {
+    out.push_back({&ast, path, vocabs.terminal_index(*ast.terminal)});
+    return;
+  }
+  for (size_t i = 0; i < ast.children.size(); ++i) {
+    path.emplace_back(ast.children[i].get(), static_cast<int>(i));
+    find_terminals(*ast.children[i], path, vocabs, out);
+    path.pop_back();
+  }
+}
+
+// ---- path computation (cell9 `getPath`) --------------------------------
+// Path string uses the reference's UTF-8 arrows.
+const char* kUp = "↑";    // ↑
+const char* kDown = "↓";  // ↓
+
+std::string get_path(const std::vector<std::pair<const ENode*, int>>& a,
+                     const std::vector<std::pair<const ENode*, int>>& b,
+                     int max_length, int max_width) {
+  // strip common prefix; paths start with the shared root
+  size_t i = 1;  // index 0 is the root in both
+  const ENode* hinge = a[0].first;
+  while (i < a.size() && i < b.size() && a[i].first == b[i].first) {
+    hinge = a[i].first;
+    ++i;
+  }
+  // both must have a distinct remainder (two different terminals)
+  int width = a[i].second - b[i].second;
+  if (width > max_width || -width > max_width) return "";
+  size_t up_len = a.size() - i, down_len = b.size() - i;
+  if (static_cast<int>(up_len + down_len + 1) > max_length) return "";
+
+  std::string out;
+  for (size_t k = a.size(); k-- > i;) {  // terminal-side, reversed
+    out += a[k].first->name;
+    out += kUp;
+  }
+  out += hinge->name;
+  out += kDown;
+  for (size_t k = i; k < b.size() - 1; ++k) {
+    out += b[k].first->name;
+    out += kDown;
+  }
+  out += b.back().first->name;  // last node, no arrow (cell9 Direction.Last)
+  return out;
+}
+
+void collect_methods(const JNode& n, std::vector<const JNode*>& out) {
+  if (n.type == "MethodDeclaration") out.push_back(&n);
+  for (const auto& c : n.children) collect_methods(*c, out);
+}
+
+}  // namespace
+
+Variable Env::fresh(const std::string& original) {
+  Variable v{"@" + space + "_" + std::to_string(next_index), original};
+  ++next_index;
+  variables.push_back(v);
+  return v;
+}
+
+int Vocabs::terminal_index(const std::string& terminal) {
+  std::string name = lower(terminal);  // vocab-size reduction (cell7)
+  auto it = terminal_map_.find(name);
+  if (it != terminal_map_.end()) return it->second;
+  int index = static_cast<int>(terminal_list_.size()) + 1;
+  terminal_map_[name] = index;
+  terminal_list_.emplace_back(name, index);
+  return index;
+}
+
+int Vocabs::path_index(const std::string& path) {
+  auto it = path_map_.find(path);
+  if (it != path_map_.end()) return it->second;
+  int index = static_cast<int>(path_list_.size()) + 1;
+  path_map_[path] = index;
+  path_list_.emplace_back(path, index);
+  return index;
+}
+
+bool is_ignorable_method(const JNode& method) {
+  const JNode* name_node = find_child(method, "SimpleName");
+  std::string name = name_node ? name_node->text : "";
+  const JNode* body = find_child(method, "BlockStmt");
+  if (body == nullptr) return true;  // abstract
+  if (kObjectMethods.count(name)) return true;
+  if (name.rfind("set", 0) == 0) {
+    if (count_children(method, "Parameter") == 1 &&
+        body->children.size() == 1 &&
+        body->children[0]->type == "ExpressionStmt" &&
+        !body->children[0]->children.empty() &&
+        body->children[0]->children[0]->type == "AssignExpr")
+      return true;
+    return false;
+  }
+  if (name.rfind("get", 0) == 0 || name.rfind("is", 0) == 0) {
+    return count_children(method, "Parameter") == 0 &&
+           body->children.size() == 1 &&
+           body->children[0]->type == "ReturnStmt";
+  }
+  return false;
+}
+
+ENodePtr extract_ast(const JNode& method, VarEnv& env,
+                     const ExtractConfig& config) {
+  Extractor extractor{env, config};
+  return extractor.extract(method, nullptr).first;
+}
+
+std::vector<MethodFeatures> extract_features(const JNode& cu,
+                                             const std::string& method_name,
+                                             Vocabs& vocabs,
+                                             const ExtractConfig& config) {
+  std::string target = lower(method_name);
+  std::vector<const JNode*> methods;
+  collect_methods(cu, methods);
+
+  std::vector<MethodFeatures> out;
+  for (const JNode* m : methods) {
+    const JNode* name_node = find_child(*m, "SimpleName");
+    std::string name = name_node ? name_node->text : "";
+    if (!(method_name == "*" || lower(name) == target)) continue;
+    if (is_ignorable_method(*m)) continue;
+
+    MethodFeatures mf;
+    mf.method_name = name;
+    mf.method_source = m->text;
+    ENodePtr ast = extract_ast(*m, mf.env, config);
+
+    std::vector<TerminalEntry> terminals;
+    std::vector<std::pair<const ENode*, int>> path{{ast.get(), 0}};
+    find_terminals(*ast, path, vocabs, terminals);
+
+    for (size_t i = 0; i < terminals.size(); ++i) {
+      for (size_t j = i + 1; j < terminals.size(); ++j) {
+        std::string p =
+            get_path(terminals[i].path_from_root, terminals[j].path_from_root,
+                     config.max_length, config.max_width);
+        if (!p.empty()) {
+          mf.features.push_back({terminals[i].terminal_index,
+                                 vocabs.path_index(p),
+                                 terminals[j].terminal_index});
+        }
+      }
+    }
+    out.push_back(std::move(mf));
+  }
+  return out;
+}
+
+}  // namespace c2v

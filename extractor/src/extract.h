@@ -1,0 +1,104 @@
+// Path-context extraction: AST normalization/anonymization, leaf-pair path
+// enumeration, vocab interning. Faithful reimplementation of the reference
+// Scala pipeline (create_path_contexts.ipynb cells 4-10); each piece cites
+// its cell.
+
+#pragma once
+
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "ast.h"
+
+namespace c2v {
+
+// ---- normalized AST (ipynb cell5 `AstNode`) ---------------------------
+struct ENode {
+  std::string name;
+  std::optional<std::string> terminal;
+  std::vector<std::unique_ptr<ENode>> children;
+};
+using ENodePtr = std::unique_ptr<ENode>;
+
+// ---- extraction config (ipynb cell6 `ExtractConfig`) ------------------
+struct ExtractConfig {
+  bool normalize_string_literal = true;
+  bool normalize_char_literal = true;
+  bool normalize_int_literal = false;
+  bool normalize_double_literal = true;
+  int max_length = 8;
+  int max_width = 3;
+};
+
+// ---- anonymization environment (ipynb cell6 `Env`/`VarEnv`) -----------
+struct Variable {
+  std::string id;    // e.g. "@var_0"
+  std::string name;  // original source name
+};
+
+struct Env {
+  explicit Env(std::string s) : space(std::move(s)) {}
+  std::string space;  // "var" | "method" | "label"
+  int next_index = 0;
+  std::vector<Variable> variables;  // encounter order (the reference's list
+                                    // is prepend-order; writers iterate in
+                                    // reverse for output parity)
+  Variable fresh(const std::string& original);
+};
+
+struct VarEnv {
+  Env vars{"var"};
+  Env methods{"method"};
+  Env labels{"label"};
+};
+
+// ---- vocab interning (ipynb cell7 `Vocabs`) ---------------------------
+// Insertion-ordered, 1-based; terminals lowercased to shrink the vocab.
+class Vocabs {
+ public:
+  int terminal_index(const std::string& terminal);
+  int path_index(const std::string& path);
+  const std::vector<std::pair<std::string, int>>& terminals() const {
+    return terminal_list_;
+  }
+  const std::vector<std::pair<std::string, int>>& paths() const {
+    return path_list_;
+  }
+
+ private:
+  std::map<std::string, int> terminal_map_;
+  std::map<std::string, int> path_map_;
+  std::vector<std::pair<std::string, int>> terminal_list_;
+  std::vector<std::pair<std::string, int>> path_list_;
+};
+
+// ---- per-method extraction result (ipynb cell10) ----------------------
+struct Feature {
+  int start, path, end;
+};
+
+struct MethodFeatures {
+  std::vector<Feature> features;
+  VarEnv env;
+  std::string method_name;     // original (label line)
+  std::string method_source;   // raw decl text (method_declarations.txt)
+};
+
+// Trivial-method filter (ipynb cell4 `isIgnorableMethod`).
+bool is_ignorable_method(const JNode& method);
+
+// Normalize/anonymize one method declaration (ipynb cell6 `extractAST`).
+ENodePtr extract_ast(const JNode& method, VarEnv& env, const ExtractConfig& config);
+
+// All matching methods of a compilation unit -> features
+// (ipynb cell10 `extractFeature`; method_name "*" matches everything,
+// otherwise case-insensitive name match).
+std::vector<MethodFeatures> extract_features(const JNode& cu,
+                                             const std::string& method_name,
+                                             Vocabs& vocabs,
+                                             const ExtractConfig& config);
+
+}  // namespace c2v

@@ -1,0 +1,124 @@
+#include "lexer.h"
+
+#include <cctype>
+#include <cstring>
+
+namespace c2v {
+
+namespace {
+
+bool ident_start(char c) { return std::isalpha(static_cast<unsigned char>(c)) || c == '_' || c == '$' || (static_cast<unsigned char>(c) >= 0x80); }
+bool ident_part(char c) { return ident_start(c) || std::isdigit(static_cast<unsigned char>(c)); }
+
+// Multi-char operators, longest first within each leading char.
+const char* kOps3[] = {">>>=", nullptr};
+const char* kOps2[] = {"<<=", ">>=", ">>>", "->",  "::",  "==", "!=", "<=",
+                       ">=",  "&&",  "||", "++",  "--",  "+=", "-=", "*=",
+                       "/=",  "%=",  "&=", "|=",  "^=",  "<<", ">>", nullptr};
+
+}  // namespace
+
+Lexer::Lexer(const std::string& src) { run(src); }
+
+void Lexer::run(const std::string& src) {
+  size_t i = 0, n = src.size();
+  int line = 1;
+  while (i < n) {
+    char c = src[i];
+    if (c == '\n') { ++line; ++i; continue; }
+    if (std::isspace(static_cast<unsigned char>(c))) { ++i; continue; }
+    // comments (stripped — parity with ipynb cell6's comment filter)
+    if (c == '/' && i + 1 < n && src[i + 1] == '/') {
+      while (i < n && src[i] != '\n') ++i;
+      continue;
+    }
+    if (c == '/' && i + 1 < n && src[i + 1] == '*') {
+      i += 2;
+      while (i + 1 < n && !(src[i] == '*' && src[i + 1] == '/')) {
+        if (src[i] == '\n') ++line;
+        ++i;
+      }
+      i = (i + 1 < n) ? i + 2 : n;
+      continue;
+    }
+    if (ident_start(c)) {
+      size_t start = i;
+      while (i < n && ident_part(src[i])) ++i;
+      tokens_.push_back({Tok::kIdent, src.substr(start, i - start), line, start, i});
+      continue;
+    }
+    if (std::isdigit(static_cast<unsigned char>(c)) ||
+        (c == '.' && i + 1 < n && std::isdigit(static_cast<unsigned char>(src[i + 1])))) {
+      size_t start = i;
+      bool is_float = false;
+      if (c == '0' && i + 1 < n && (src[i + 1] == 'x' || src[i + 1] == 'X')) {
+        i += 2;
+        while (i < n && (std::isxdigit(static_cast<unsigned char>(src[i])) || src[i] == '_')) ++i;
+      } else if (c == '0' && i + 1 < n && (src[i + 1] == 'b' || src[i + 1] == 'B')) {
+        i += 2;
+        while (i < n && (src[i] == '0' || src[i] == '1' || src[i] == '_')) ++i;
+      } else {
+        while (i < n && (std::isdigit(static_cast<unsigned char>(src[i])) || src[i] == '_')) ++i;
+        if (i < n && src[i] == '.') {
+          is_float = true;
+          ++i;
+          while (i < n && (std::isdigit(static_cast<unsigned char>(src[i])) || src[i] == '_')) ++i;
+        }
+        if (i < n && (src[i] == 'e' || src[i] == 'E')) {
+          is_float = true;
+          ++i;
+          if (i < n && (src[i] == '+' || src[i] == '-')) ++i;
+          while (i < n && std::isdigit(static_cast<unsigned char>(src[i]))) ++i;
+        }
+      }
+      Tok kind = is_float ? Tok::kDouble : Tok::kInt;
+      if (i < n) {
+        if (src[i] == 'l' || src[i] == 'L') { kind = Tok::kLong; ++i; }
+        else if (src[i] == 'f' || src[i] == 'F' || src[i] == 'd' || src[i] == 'D') { kind = Tok::kDouble; ++i; }
+      }
+      tokens_.push_back({kind, src.substr(start, i - start), line, start, i});
+      continue;
+    }
+    if (c == '"') {
+      size_t start = i++;
+      while (i < n && src[i] != '"') {
+        if (src[i] == '\\' && i + 1 < n) ++i;
+        if (src[i] == '\n') ++line;
+        ++i;
+      }
+      if (i < n) ++i;
+      tokens_.push_back({Tok::kString, src.substr(start, i - start), line, start, i});
+      continue;
+    }
+    if (c == '\'') {
+      size_t start = i++;
+      while (i < n && src[i] != '\'') {
+        if (src[i] == '\\' && i + 1 < n) ++i;
+        ++i;
+      }
+      if (i < n) ++i;
+      tokens_.push_back({Tok::kChar, src.substr(start, i - start), line, start, i});
+      continue;
+    }
+    // operators / punctuation: longest match
+    bool matched = false;
+    for (const char** ops : {kOps3, kOps2}) {
+      for (int k = 0; ops[k]; ++k) {
+        size_t len = std::strlen(ops[k]);
+        if (src.compare(i, len, ops[k]) == 0) {
+          tokens_.push_back({Tok::kPunct, ops[k], line, i, i + len});
+          i += len;
+          matched = true;
+          break;
+        }
+      }
+      if (matched) break;
+    }
+    if (matched) continue;
+    tokens_.push_back({Tok::kPunct, std::string(1, c), line, i, i + 1});
+    ++i;
+  }
+  tokens_.push_back({Tok::kEnd, "", line, n, n});
+}
+
+}  // namespace c2v

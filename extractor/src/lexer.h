@@ -1,0 +1,41 @@
+// Java lexer: comments stripped, string/char escapes handled, numeric
+// literal classification (int/long/double incl. hex/binary/underscores),
+// longest-match operators.
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace c2v {
+
+enum class Tok {
+  kEnd,
+  kIdent,      // identifiers and keywords (parser distinguishes)
+  kInt,        // integer literal
+  kLong,       // integer literal with l/L suffix
+  kDouble,     // floating literal (also float 'f' suffix)
+  kChar,       // 'c'
+  kString,     // "..."
+  kPunct,      // operators & punctuation, text holds the lexeme
+};
+
+struct Token {
+  Tok kind = Tok::kEnd;
+  std::string text;  // lexeme (for strings/chars: raw source incl. quotes)
+  int line = 0;
+  size_t begin = 0;  // source offsets (method_declarations.txt slicing)
+  size_t end = 0;
+};
+
+class Lexer {
+ public:
+  explicit Lexer(const std::string& src);
+  const std::vector<Token>& tokens() const { return tokens_; }
+
+ private:
+  void run(const std::string& src);
+  std::vector<Token> tokens_;
+};
+
+}  // namespace c2v

@@ -1,0 +1,168 @@
+// c2v-extract: Java sources -> path-context corpus artifacts.
+//
+// CLI equivalent of the reference's createDataset (create_path_contexts
+// .ipynb cell11): reads <dataset_dir>/methods.txt (TSV: java-file<TAB>
+// method-name, method "*" = all), parses each file (compilation unit cached
+// across consecutive rows of the same file), extracts features, and writes
+// corpus.txt, terminal_idxs.txt, path_idxs.txt, params.txt,
+// actual_methods.txt, and optionally method_declarations.txt.
+//
+// Usage:
+//   c2v-extract <dataset_dir> <source_dir> [options]
+// Options:
+//   --max-length N               path length cap (default 8)
+//   --max-width N                sibling-width cap (default 3)
+//   --method-declarations FILE   also dump raw method sources
+//   --no-normalize-string / --no-normalize-char
+//   --normalize-int / --normalize-double
+
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "extract.h"
+#include "parser.h"
+
+namespace {
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: c2v-extract <dataset_dir> <source_dir> [options]\n";
+    return 2;
+  }
+  std::string dataset_dir = argv[1];
+  std::string source_dir = argv[2];
+  c2v::ExtractConfig config;
+  std::string method_declarations_name;
+  for (int i = 3; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "--max-length" && i + 1 < argc) config.max_length = std::stoi(argv[++i]);
+    else if (arg == "--max-width" && i + 1 < argc) config.max_width = std::stoi(argv[++i]);
+    else if (arg == "--method-declarations" && i + 1 < argc) method_declarations_name = argv[++i];
+    else if (arg == "--no-normalize-string") config.normalize_string_literal = false;
+    else if (arg == "--no-normalize-char") config.normalize_char_literal = false;
+    else if (arg == "--normalize-int") config.normalize_int_literal = true;
+    else if (arg == "--normalize-double") config.normalize_double_literal = true;
+    else if (arg == "--no-normalize-double") config.normalize_double_literal = false;
+    else {
+      std::cerr << "unknown option: " << arg << "\n";
+      return 2;
+    }
+  }
+
+  std::ifstream method_list(dataset_dir + "/methods.txt");
+  if (!method_list) {
+    std::cerr << "ERROR: cannot open " << dataset_dir << "/methods.txt\n";
+    return 1;
+  }
+
+  std::ofstream corpus(dataset_dir + "/corpus.txt");
+  std::ofstream actual_methods(dataset_dir + "/actual_methods.txt");
+  std::ofstream method_declarations;
+  if (!method_declarations_name.empty())
+    method_declarations.open(dataset_dir + "/" + method_declarations_name);
+
+  c2v::Vocabs vocabs;
+  std::map<std::string, int> method_names;  // method_name_vocab_count
+  int id_counter = 0;
+
+  std::string last_file;
+  c2v::JNodePtr last_cu;
+  std::string line;
+  while (std::getline(method_list, line)) {
+    while (!line.empty() && (line.back() == '\r' || line.back() == '\n'))
+      line.pop_back();
+    if (line.empty()) continue;
+    size_t tab = line.find('\t');
+    if (tab == std::string::npos) continue;
+    std::string java_file = line.substr(0, tab);
+    std::string method_name = line.substr(tab + 1);
+
+    try {
+      if (java_file != last_file) {
+        last_cu = c2v::parse_compilation_unit(
+            read_file(source_dir + "/" + java_file));
+        last_file = java_file;
+      }
+      auto features =
+          c2v::extract_features(*last_cu, method_name, vocabs, config);
+      for (auto& mf : features) {
+        int corpus_id = id_counter++;
+        corpus << "#" << corpus_id << "\n";
+        corpus << "label:" << mf.method_name << "\n";
+        corpus << "class:" << java_file << "\n";
+        corpus << "paths:\n";
+        for (const auto& f : mf.features)
+          corpus << f.start << "\t" << f.path << "\t" << f.end << "\n";
+        corpus << "vars:\n";
+        // reverse encounter order (the reference's prepend-built lists)
+        for (auto it = mf.env.vars.variables.rbegin();
+             it != mf.env.vars.variables.rend(); ++it)
+          corpus << it->name << "\t" << it->id << "\n";
+        for (auto it = mf.env.labels.variables.rbegin();
+             it != mf.env.labels.variables.rend(); ++it)
+          corpus << it->name << "\t" << it->id << "\n";
+        corpus << "\n";
+
+        actual_methods << java_file << "\t" << mf.method_name << "\t"
+                       << corpus_id << "\t" << mf.features.size() << "\n";
+        if (method_declarations.is_open())
+          method_declarations << "#" << corpus_id << "\t" << java_file << "#"
+                              << mf.method_name << "\n"
+                              << mf.method_source << "\n\n";
+        ++method_names[mf.method_name];
+      }
+      if (features.empty() && method_name != "*")
+        std::cerr << "WARNING: method not found. " << line << "\n";
+    } catch (const c2v::ParseError& e) {
+      std::cerr << "ERROR: parse error. " << line << " (" << e.what() << ")\n";
+      last_file.clear();  // do not reuse a broken unit
+    } catch (const std::exception& e) {
+      std::cerr << "WARNING: " << e.what() << "\n";
+      last_file.clear();
+    }
+  }
+
+  {
+    std::ofstream terminal_idx(dataset_dir + "/terminal_idxs.txt");
+    terminal_idx << "0\t<PAD/>\n";
+    for (const auto& [name, index] : vocabs.terminals())
+      terminal_idx << index << "\t" << name << "\n";
+  }
+  {
+    std::ofstream path_idx(dataset_dir + "/path_idxs.txt");
+    path_idx << "0\t<PAD/>\n";
+    for (const auto& [name, index] : vocabs.paths())
+      path_idx << index << "\t" << name << "\n";
+  }
+  {
+    std::ofstream params(dataset_dir + "/params.txt");
+    params << "max_length:" << config.max_length << "\n"
+           << "max_width:" << config.max_width << "\n"
+           << "nomalize_string_literal:" << (config.normalize_string_literal ? "true" : "false") << "\n"
+           << "nomalize_char_literal:" << (config.normalize_char_literal ? "true" : "false") << "\n"
+           << "nomalize_int_literal:" << (config.normalize_int_literal ? "true" : "false") << "\n"
+           << "nomalize_double_literal:" << (config.normalize_double_literal ? "true" : "false") << "\n"
+           << "terminal_vocab_count:" << vocabs.terminals().size() << "\n"
+           << "path_vocab_count:" << vocabs.paths().size() << "\n"
+           << "method_count:" << id_counter << "\n"
+           << "method_name_vocab_count:" << method_names.size() << "\n";
+  }
+  std::cerr << "extracted " << id_counter << " methods, "
+            << vocabs.terminals().size() << " terminals, "
+            << vocabs.paths().size() << " paths\n";
+  return 0;
+}

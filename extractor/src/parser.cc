@@ -1,0 +1,1358 @@
+#include "parser.h"
+
+#include <set>
+
+namespace c2v {
+
+namespace {
+
+const std::set<std::string> kPrimitives = {
+    "boolean", "byte", "char", "short", "int", "long", "float", "double"};
+
+const std::set<std::string> kModifiers = {
+    "public", "protected", "private", "static",   "final",    "abstract",
+    "native", "synchronized", "transient", "volatile", "strictfp", "default"};
+
+// javaparser operator enum names (BinaryExpr.Operator etc.)
+std::string binary_op_name(const std::string& op) {
+  if (op == "||") return "OR";
+  if (op == "&&") return "AND";
+  if (op == "|") return "BINARY_OR";
+  if (op == "&") return "BINARY_AND";
+  if (op == "^") return "XOR";
+  if (op == "==") return "EQUALS";
+  if (op == "!=") return "NOT_EQUALS";
+  if (op == "<") return "LESS";
+  if (op == ">") return "GREATER";
+  if (op == "<=") return "LESS_EQUALS";
+  if (op == ">=") return "GREATER_EQUALS";
+  if (op == "<<") return "LEFT_SHIFT";
+  if (op == ">>") return "SIGNED_RIGHT_SHIFT";
+  if (op == ">>>") return "UNSIGNED_RIGHT_SHIFT";
+  if (op == "+") return "PLUS";
+  if (op == "-") return "MINUS";
+  if (op == "*") return "MULTIPLY";
+  if (op == "/") return "DIVIDE";
+  if (op == "%") return "REMAINDER";
+  return "UNKNOWN";
+}
+
+std::string assign_op_name(const std::string& op) {
+  if (op == "=") return "ASSIGN";
+  if (op == "+=") return "PLUS";
+  if (op == "-=") return "MINUS";
+  if (op == "*=") return "MULTIPLY";
+  if (op == "/=") return "DIVIDE";
+  if (op == "&=") return "AND";
+  if (op == "|=") return "OR";
+  if (op == "^=") return "XOR";
+  if (op == "%=") return "REMAINDER";
+  if (op == "<<=") return "LEFT_SHIFT";
+  if (op == ">>=") return "SIGNED_RIGHT_SHIFT";
+  if (op == ">>>=") return "UNSIGNED_RIGHT_SHIFT";
+  return "UNKNOWN";
+}
+
+class Parser {
+ public:
+  Parser(const std::string& source)
+      : source_(source), lexer_(source), toks_(lexer_.tokens()) {}
+
+  JNodePtr run() {
+    auto cu = make("CompilationUnit");
+    if (at_ident("package")) {
+      next();
+      auto pd = make("PackageDeclaration");
+      pd->add(parse_qualified_name());
+      expect(";");
+      cu->add(std::move(pd));
+    }
+    while (at_ident("import")) {
+      next();
+      auto im = make("ImportDeclaration");
+      if (at_ident("static")) next();
+      im->add(parse_qualified_name(/*allow_star=*/true));
+      expect(";");
+      cu->add(std::move(im));
+    }
+    while (!at_end()) {
+      if (at(";")) { next(); continue; }
+      cu->add(parse_type_declaration());
+    }
+    return cu;
+  }
+
+ private:
+  // ---- token helpers -------------------------------------------------
+  const Token& cur() const { return toks_[pos_]; }
+  const Token& peek(int k = 1) const {
+    size_t p = pos_ + k;
+    return toks_[p < toks_.size() ? p : toks_.size() - 1];
+  }
+  bool at_end() const { return cur().kind == Tok::kEnd; }
+  bool at(const std::string& p) const {
+    return cur().kind == Tok::kPunct && cur().text == p;
+  }
+  bool at_ident(const std::string& name) const {
+    return cur().kind == Tok::kIdent && cur().text == name;
+  }
+  void next() { if (!at_end()) ++pos_; }
+  void expect(const std::string& p) {
+    if (!at(p)) fail("expected '" + p + "'");
+    next();
+  }
+  std::string expect_ident() {
+    if (cur().kind != Tok::kIdent) fail("expected identifier");
+    std::string s = cur().text;
+    next();
+    return s;
+  }
+  [[noreturn]] void fail(const std::string& message) const {
+    throw ParseError("line " + std::to_string(cur().line) + ": " + message +
+                     " (got '" + cur().text + "')");
+  }
+
+  // '>' inside nested generics may be lexed as '>>'/'>>>'; split in place.
+  void expect_close_angle() {
+    if (at(">")) { next(); return; }
+    if (cur().kind == Tok::kPunct &&
+        (cur().text == ">>" || cur().text == ">>>" || cur().text == ">=")) {
+      mutable_tok().text = cur().text.substr(1);
+      return;
+    }
+    fail("expected '>'");
+  }
+  Token& mutable_tok() { return const_cast<Token&>(toks_[pos_]); }
+
+  void skip_annotations_into(JNode* parent) {
+    while (at("@") && peek().kind == Tok::kIdent &&
+           !(peek().text == "interface")) {
+      parent->add(parse_annotation());
+    }
+  }
+
+  void skip_modifiers() {
+    while (cur().kind == Tok::kIdent && kModifiers.count(cur().text)) next();
+  }
+
+  // ---- names & annotations -------------------------------------------
+  JNodePtr parse_qualified_name(bool allow_star = false) {
+    std::string name = expect_ident();
+    while (at(".")) {
+      if (allow_star && peek().kind == Tok::kPunct && peek().text == "*") {
+        next();  // .
+        next();  // *
+        name += ".*";
+        break;
+      }
+      next();
+      name += "." + expect_ident();
+    }
+    return make("Name", name);
+  }
+
+  JNodePtr parse_annotation() {
+    expect("@");
+    auto name = parse_qualified_name();
+    if (at("(")) {
+      next();
+      if (at(")")) {
+        next();
+        auto a = make("NormalAnnotationExpr");
+        a->add(std::move(name));
+        return a;
+      }
+      // key=value pairs or a single member value
+      if (cur().kind == Tok::kIdent && peek().kind == Tok::kPunct &&
+          peek().text == "=") {
+        auto a = make("NormalAnnotationExpr");
+        a->add(std::move(name));
+        while (true) {
+          auto pair = make("MemberValuePair");
+          pair->add(make("SimpleName", expect_ident()));
+          expect("=");
+          pair->add(parse_member_value());
+          a->add(std::move(pair));
+          if (at(",")) { next(); continue; }
+          break;
+        }
+        expect(")");
+        return a;
+      }
+      auto a = make("SingleMemberAnnotationExpr");
+      a->add(std::move(name));
+      a->add(parse_member_value());
+      expect(")");
+      return a;
+    }
+    auto a = make("MarkerAnnotationExpr");
+    a->add(std::move(name));
+    return a;
+  }
+
+  JNodePtr parse_member_value() {
+    if (at("{")) {  // array initializer inside annotation
+      next();
+      auto arr = make("ArrayInitializerExpr");
+      while (!at("}")) {
+        arr->add(parse_member_value());
+        if (at(",")) next();
+      }
+      expect("}");
+      return arr;
+    }
+    if (at("@")) return parse_annotation();
+    return parse_expression();
+  }
+
+  // ---- types ----------------------------------------------------------
+  bool looks_like_type_start() const {
+    return cur().kind == Tok::kIdent &&
+           (kPrimitives.count(cur().text) || cur().text == "void" ||
+            (!kReservedNonType.count(cur().text)));
+  }
+
+  JNodePtr parse_type() {
+    JNodePtr base;
+    if (cur().kind == Tok::kIdent && kPrimitives.count(cur().text)) {
+      base = make("PrimitiveType", cur().text);
+      next();
+    } else if (at_ident("void")) {
+      base = make("VoidType", "void");
+      next();
+    } else if (at("?")) {
+      next();
+      base = make("WildcardType", "?");
+      if (at_ident("extends") || at_ident("super")) {
+        next();
+        base->add(parse_type());
+      }
+    } else {
+      base = parse_class_type();
+    }
+    while (at("[")) {
+      next();
+      expect("]");
+      auto arr = make("ArrayType");
+      arr->add(std::move(base));
+      base = std::move(arr);
+    }
+    return base;
+  }
+
+  JNodePtr parse_class_type() {
+    auto t = make("ClassOrInterfaceType");
+    t->add(make("SimpleName", expect_ident()));
+    if (at("<")) parse_type_arguments_into(t.get());
+    while (at(".") && peek().kind == Tok::kIdent) {
+      next();
+      auto outer = std::move(t);
+      t = make("ClassOrInterfaceType");
+      t->add(std::move(outer));  // scope
+      t->add(make("SimpleName", expect_ident()));
+      if (at("<")) parse_type_arguments_into(t.get());
+    }
+    return t;
+  }
+
+  void parse_type_arguments_into(JNode* t) {
+    expect("<");
+    if (at(">")) { next(); return; }  // diamond <>
+    if (cur().kind == Tok::kPunct && cur().text.rfind(">", 0) == 0) {
+      expect_close_angle();
+      return;
+    }
+    while (true) {
+      t->add(parse_type());
+      if (at(",")) { next(); continue; }
+      break;
+    }
+    expect_close_angle();
+  }
+
+  // heuristic: could the token sequence starting at pos_ be `(Type)` for a
+  // cast, given what follows the ')'?
+  bool looks_like_cast() const {
+    size_t p = pos_ + 1;  // after '('
+    int depth = 0;
+    bool saw_ident = false;
+    while (p < toks_.size()) {
+      const Token& t = toks_[p];
+      if (t.kind == Tok::kPunct) {
+        if (t.text == "(") return false;
+        if (t.text == ")" && depth == 0) break;
+        if (t.text == "<") ++depth;
+        else if (t.text == ">") --depth;
+        else if (t.text == ">>") depth -= 2;
+        else if (t.text == ">>>") depth -= 3;
+        else if (t.text != "." && t.text != "[" && t.text != "]" &&
+                 t.text != "," && t.text != "&" && t.text != "?")
+          return false;
+      } else if (t.kind == Tok::kIdent) {
+        if (kReservedNonType.count(t.text) && !kPrimitives.count(t.text) &&
+            t.text != "extends" && t.text != "super")
+          return false;
+        saw_ident = true;
+      } else {
+        return false;
+      }
+      ++p;
+    }
+    if (!saw_ident || p >= toks_.size()) return false;
+    const Token& after = toks_[p + 1 < toks_.size() ? p + 1 : p];
+    if (after.kind == Tok::kIdent)
+      // any identifier or keyword expression-starter continues a cast —
+      // including null/true/false ('(String) null') — except a binary-ish
+      // keyword that can follow an EnclosedExpr
+      return after.text != "instanceof";
+    if (after.kind == Tok::kPunct)
+      return after.text == "(" || after.text == "!" || after.text == "~";
+    return after.kind == Tok::kInt || after.kind == Tok::kLong ||
+           after.kind == Tok::kDouble || after.kind == Tok::kChar ||
+           after.kind == Tok::kString;
+  }
+
+  // lambda lookahead: '(' ... ')' '->'
+  bool looks_like_lambda_parens() const {
+    size_t p = pos_ + 1;
+    int depth = 1;
+    while (p < toks_.size() && depth > 0) {
+      const Token& t = toks_[p];
+      if (t.kind == Tok::kPunct) {
+        if (t.text == "(") ++depth;
+        else if (t.text == ")") --depth;
+      }
+      ++p;
+    }
+    return p < toks_.size() && toks_[p].kind == Tok::kPunct &&
+           toks_[p].text == "->";
+  }
+
+  // ---- type declarations ----------------------------------------------
+  JNodePtr parse_type_declaration() {
+    auto pending_annotations = make("__annotations__");
+    while (at("@") && !(peek().kind == Tok::kIdent && peek().text == "interface"))
+      pending_annotations->add(parse_annotation());
+    skip_modifiers();
+    while (at("@") && !(peek().kind == Tok::kIdent && peek().text == "interface"))
+      pending_annotations->add(parse_annotation());
+    skip_modifiers();
+
+    if (at_ident("class") || at_ident("interface")) {
+      bool is_interface = at_ident("interface");
+      next();
+      auto decl = make("ClassOrInterfaceDeclaration");
+      for (auto& a : pending_annotations->children) decl->add(std::move(a));
+      decl->add(make("SimpleName", expect_ident()));
+      if (at("<")) parse_type_parameters_into(decl.get());
+      if (at_ident("extends")) {
+        next();
+        decl->add(parse_class_type());
+        while (at(",")) { next(); decl->add(parse_class_type()); }
+      }
+      if (at_ident("implements")) {
+        next();
+        decl->add(parse_class_type());
+        while (at(",")) { next(); decl->add(parse_class_type()); }
+      }
+      parse_class_body_into(decl.get(), is_interface);
+      return decl;
+    }
+    if (at_ident("enum")) {
+      next();
+      auto decl = make("EnumDeclaration");
+      for (auto& a : pending_annotations->children) decl->add(std::move(a));
+      decl->add(make("SimpleName", expect_ident()));
+      if (at_ident("implements")) {
+        next();
+        decl->add(parse_class_type());
+        while (at(",")) { next(); decl->add(parse_class_type()); }
+      }
+      expect("{");
+      while (cur().kind == Tok::kIdent || at("@")) {
+        auto constant = make("EnumConstantDeclaration");
+        while (at("@")) constant->add(parse_annotation());
+        constant->add(make("SimpleName", expect_ident()));
+        if (at("(")) parse_arguments_into(constant.get());
+        if (at("{")) parse_class_body_into(constant.get(), false, /*already_open=*/false);
+        if (at(",")) { next(); continue; }
+        break;
+      }
+      if (at(";")) {
+        next();
+        while (!at("}")) parse_member_into(decl.get(), false);
+      }
+      expect("}");
+      return decl;
+    }
+    if (at("@") && peek().kind == Tok::kIdent && peek().text == "interface") {
+      next();  // @
+      next();  // interface
+      auto decl = make("AnnotationDeclaration");
+      decl->add(make("SimpleName", expect_ident()));
+      expect("{");
+      while (!at("}")) {
+        if (at(";")) { next(); continue; }
+        skip_modifiers();
+        auto member = make("AnnotationMemberDeclaration");
+        member->add(parse_type());
+        member->add(make("SimpleName", expect_ident()));
+        expect("(");
+        expect(")");
+        if (at_ident("default")) { next(); member->add(parse_member_value()); }
+        expect(";");
+        decl->add(std::move(member));
+      }
+      expect("}");
+      return decl;
+    }
+    fail("expected type declaration");
+  }
+
+  void parse_type_parameters_into(JNode* decl) {
+    expect("<");
+    while (true) {
+      auto tp = make("TypeParameter");
+      tp->add(make("SimpleName", expect_ident()));
+      if (at_ident("extends")) {
+        next();
+        tp->add(parse_class_type());
+        while (at("&")) { next(); tp->add(parse_class_type()); }
+      }
+      decl->add(std::move(tp));
+      if (at(",")) { next(); continue; }
+      break;
+    }
+    expect_close_angle();
+  }
+
+  void parse_class_body_into(JNode* decl, bool is_interface,
+                             bool already_open = false) {
+    if (!already_open) expect("{");
+    while (!at("}")) {
+      if (at(";")) { next(); continue; }
+      parse_member_into(decl, is_interface);
+    }
+    expect("}");
+  }
+
+  void parse_member_into(JNode* decl, bool is_interface) {
+    auto annotations = make("__annotations__");
+    while (at("@") && !(peek().kind == Tok::kIdent && peek().text == "interface"))
+      annotations->add(parse_annotation());
+    skip_modifiers();
+    while (at("@") && !(peek().kind == Tok::kIdent && peek().text == "interface"))
+      annotations->add(parse_annotation());
+    skip_modifiers();
+
+    if (at_ident("class") || at_ident("interface") || at_ident("enum") ||
+        (at("@") && peek().kind == Tok::kIdent && peek().text == "interface")) {
+      decl->add(parse_type_declaration());
+      return;
+    }
+    if (at("{")) {  // instance/static initializer
+      auto init = make("InitializerDeclaration");
+      init->add(parse_block());
+      decl->add(std::move(init));
+      return;
+    }
+
+    size_t decl_begin = cur().begin;
+
+    // constructor: Ident '(' with Ident == enclosing simple name shape
+    auto type_params = make("__tps__");
+    if (at("<")) parse_type_parameters_into(type_params.get());
+
+    if (cur().kind == Tok::kIdent && peek().kind == Tok::kPunct &&
+        peek().text == "(" && !kPrimitives.count(cur().text)) {
+      auto ctor = make("ConstructorDeclaration");
+      for (auto& a : annotations->children) ctor->add(std::move(a));
+      for (auto& tp : type_params->children) ctor->add(std::move(tp));
+      ctor->add(make("SimpleName", expect_ident()));
+      parse_parameters_into(ctor.get());
+      if (at_ident("throws")) {
+        next();
+        ctor->add(parse_class_type());
+        while (at(",")) { next(); ctor->add(parse_class_type()); }
+      }
+      ctor->add(parse_block());
+      decl->add(std::move(ctor));
+      return;
+    }
+
+    auto return_type = parse_type();
+    if (cur().kind != Tok::kIdent) fail("expected member name");
+    std::string name = expect_ident();
+
+    if (at("(")) {  // method
+      auto method = make("MethodDeclaration");
+      for (auto& a : annotations->children) method->add(std::move(a));
+      for (auto& tp : type_params->children) method->add(std::move(tp));
+      method->add(std::move(return_type));
+      method->add(make("SimpleName", name));
+      parse_parameters_into(method.get());
+      while (at("[")) { next(); expect("]"); }  // legacy array-return syntax
+      if (at_ident("throws")) {
+        next();
+        method->add(parse_class_type());
+        while (at(",")) { next(); method->add(parse_class_type()); }
+      }
+      if (at(";")) {
+        next();  // abstract/interface method: no body child
+      } else if (at_ident("default") || at("{")) {
+        method->add(parse_block());
+      } else {
+        fail("expected method body or ';'");
+      }
+      method->text = source_.substr(decl_begin, prev_end() - decl_begin);
+      decl->add(std::move(method));
+      (void)is_interface;
+      return;
+    }
+
+    // field(s)
+    auto field = make("FieldDeclaration");
+    for (auto& a : annotations->children) field->add(std::move(a));
+    field->add(
+        parse_variable_declarators(std::move(return_type), name));
+    while (at(",")) {
+      next();
+      std::string more = expect_ident();
+      field->add(parse_variable_declarators(nullptr, more));
+    }
+    expect(";");
+    decl->add(std::move(field));
+  }
+
+  size_t prev_end() const { return pos_ ? toks_[pos_ - 1].end : 0; }
+
+  JNodePtr parse_variable_declarators(JNodePtr type, const std::string& name) {
+    auto declarator = make("VariableDeclarator");
+    declarator->add(make("SimpleName", name));
+    JNodePtr t = std::move(type);
+    while (at("[")) { next(); expect("]");
+      auto arr = make("ArrayType");
+      if (t) arr->add(std::move(t));
+      t = std::move(arr);
+    }
+    if (t) declarator->add(std::move(t));
+    if (at("=")) {
+      next();
+      declarator->add(parse_variable_initializer());
+    }
+    return declarator;
+  }
+
+  JNodePtr parse_variable_initializer() {
+    if (at("{")) {
+      next();
+      auto arr = make("ArrayInitializerExpr");
+      while (!at("}")) {
+        arr->add(parse_variable_initializer());
+        if (at(",")) next();
+      }
+      expect("}");
+      return arr;
+    }
+    return parse_expression();
+  }
+
+  void parse_parameters_into(JNode* owner) {
+    expect("(");
+    while (!at(")")) {
+      auto param = make("Parameter");
+      while (at("@")) param->add(parse_annotation());
+      if (at_ident("final")) next();
+      while (at("@")) param->add(parse_annotation());
+      // bare lambda-style params have no type; method params always do
+      auto type = parse_type();
+      bool varargs = false;
+      if (at(".")) {  // '...' lexed as three '.' puncts
+        next(); expect("."); expect(".");
+        varargs = true;
+      }
+      param->is_var_args = varargs;
+      param->add(std::move(type));
+      param->add(make("SimpleName", expect_ident()));
+      while (at("[")) { next(); expect("]"); }
+      owner->add(std::move(param));
+      if (at(",")) next();
+    }
+    expect(")");
+  }
+
+  // ---- statements ------------------------------------------------------
+  JNodePtr parse_block() {
+    expect("{");
+    auto block = make("BlockStmt");
+    while (!at("}")) block->add(parse_statement());
+    expect("}");
+    return block;
+  }
+
+  JNodePtr parse_statement() {
+    if (at("{")) return parse_block();
+    if (at(";")) { next(); return make("EmptyStmt"); }
+    if (at_ident("if")) {
+      next();
+      auto s = make("IfStmt");
+      expect("(");
+      s->add(parse_expression());
+      expect(")");
+      s->add(parse_statement());
+      if (at_ident("else")) { next(); s->add(parse_statement()); }
+      return s;
+    }
+    if (at_ident("while")) {
+      next();
+      auto s = make("WhileStmt");
+      expect("(");
+      s->add(parse_expression());
+      expect(")");
+      s->add(parse_statement());
+      return s;
+    }
+    if (at_ident("do")) {
+      next();
+      auto s = make("DoStmt");
+      s->add(parse_statement());
+      if (!at_ident("while")) fail("expected 'while'");
+      next();
+      expect("(");
+      s->add(parse_expression());
+      expect(")");
+      expect(";");
+      return s;
+    }
+    if (at_ident("for")) return parse_for();
+    if (at_ident("return")) {
+      next();
+      auto s = make("ReturnStmt");
+      if (!at(";")) s->add(parse_expression());
+      expect(";");
+      return s;
+    }
+    if (at_ident("throw")) {
+      next();
+      auto s = make("ThrowStmt");
+      s->add(parse_expression());
+      expect(";");
+      return s;
+    }
+    if (at_ident("break")) {
+      next();
+      auto s = make("BreakStmt");
+      if (cur().kind == Tok::kIdent) s->add(make("SimpleName", expect_ident()));
+      expect(";");
+      return s;
+    }
+    if (at_ident("continue")) {
+      next();
+      auto s = make("ContinueStmt");
+      if (cur().kind == Tok::kIdent) s->add(make("SimpleName", expect_ident()));
+      expect(";");
+      return s;
+    }
+    if (at_ident("switch")) return parse_switch();
+    if (at_ident("try")) return parse_try();
+    if (at_ident("synchronized") && peek().kind == Tok::kPunct && peek().text == "(") {
+      next();
+      auto s = make("SynchronizedStmt");
+      expect("(");
+      s->add(parse_expression());
+      expect(")");
+      s->add(parse_block());
+      return s;
+    }
+    if (at_ident("assert")) {
+      next();
+      auto s = make("AssertStmt");
+      s->add(parse_expression());
+      if (at(":")) { next(); s->add(parse_expression()); }
+      expect(";");
+      return s;
+    }
+    if (at_ident("class") || leads_to_local_class()) {
+      auto s = make("LocalClassDeclarationStmt");
+      s->add(parse_type_declaration());
+      return s;
+    }
+    // annotated local variable declaration ('@SuppressWarnings(...) T x = ...')
+    if (at("@") && !annotation_precedes_class()) {
+      auto s = make("ExpressionStmt");
+      s->add(parse_local_var_decl());
+      expect(";");
+      return s;
+    }
+    if (at("@")) {  // annotated local class
+      auto s = make("LocalClassDeclarationStmt");
+      s->add(parse_type_declaration());
+      return s;
+    }
+    // labeled statement: Ident ':'
+    if (cur().kind == Tok::kIdent && peek().kind == Tok::kPunct &&
+        peek().text == ":" && !kReservedNonType.count(cur().text)) {
+      auto s = make("LabeledStmt");
+      s->add(make("SimpleName", expect_ident()));
+      expect(":");
+      s->add(parse_statement());
+      return s;
+    }
+    // local variable declaration vs expression statement
+    if (starts_local_var_decl()) {
+      auto s = make("ExpressionStmt");
+      s->add(parse_local_var_decl());
+      expect(";");
+      return s;
+    }
+    auto s = make("ExpressionStmt");
+    s->add(parse_expression());
+    expect(";");
+    return s;
+  }
+
+  // 'final'/'abstract'/'static' (possibly stacked) directly before 'class'
+  // means a modifier-prefixed local class declaration
+  bool leads_to_local_class() const {
+    size_t p = pos_;
+    while (p < toks_.size() && toks_[p].kind == Tok::kIdent &&
+           (toks_[p].text == "final" || toks_[p].text == "abstract" ||
+            toks_[p].text == "static"))
+      ++p;
+    return p > pos_ && p < toks_.size() && toks_[p].kind == Tok::kIdent &&
+           toks_[p].text == "class";
+  }
+
+  // after leading annotations (and modifiers), is this a class declaration?
+  bool annotation_precedes_class() const {
+    size_t p = pos_;
+    while (p < toks_.size() && toks_[p].kind == Tok::kPunct &&
+           toks_[p].text == "@") {
+      ++p;  // @
+      if (p < toks_.size() && toks_[p].kind == Tok::kIdent) ++p;
+      while (p < toks_.size() && toks_[p].kind == Tok::kPunct &&
+             toks_[p].text == ".") {
+        p += 2;  // .Ident
+      }
+      if (p < toks_.size() && toks_[p].kind == Tok::kPunct &&
+          toks_[p].text == "(") {
+        int depth = 1;
+        ++p;
+        while (p < toks_.size() && depth > 0) {
+          if (toks_[p].kind == Tok::kPunct) {
+            if (toks_[p].text == "(") ++depth;
+            else if (toks_[p].text == ")") --depth;
+          }
+          ++p;
+        }
+      }
+    }
+    while (p < toks_.size() && toks_[p].kind == Tok::kIdent &&
+           kModifiers.count(toks_[p].text))
+      ++p;
+    return p < toks_.size() && toks_[p].kind == Tok::kIdent &&
+           (toks_[p].text == "class" || toks_[p].text == "interface" ||
+            toks_[p].text == "enum");
+  }
+
+  bool starts_local_var_decl() {
+    if (cur().kind != Tok::kIdent) return false;
+    if (at_ident("final") || (kPrimitives.count(cur().text))) return true;
+    if (kReservedNonType.count(cur().text)) return false;
+    // Ident(.Ident)*(<...>)?([])* Ident  (=> declaration)
+    size_t p = pos_;
+    int angle = 0;
+    bool seen_type = false;
+    while (p < toks_.size()) {
+      const Token& t = toks_[p];
+      if (t.kind == Tok::kIdent) {
+        if (kReservedNonType.count(t.text) && !kPrimitives.count(t.text) &&
+            t.text != "extends" && t.text != "super")
+          return false;
+        if (seen_type && angle == 0) return true;  // second bare ident
+        seen_type = true;
+        ++p;
+        continue;
+      }
+      if (t.kind != Tok::kPunct) return false;
+      if (t.text == ".") {
+        // '.<' is an explicit-type-argument call (Foo.<String>bar()), never
+        // a declaration
+        if (p + 1 < toks_.size() && toks_[p + 1].kind == Tok::kPunct &&
+            toks_[p + 1].text == "<")
+          return false;
+        seen_type = false; ++p; continue;
+      }
+      if (t.text == "<") { ++angle; ++p; continue; }
+      if (t.text == ">") { --angle; ++p; continue; }
+      if (t.text == ">>") { angle -= 2; ++p; continue; }
+      if (t.text == ">>>") { angle -= 3; ++p; continue; }
+      if (t.text == "[") {
+        if (p + 1 < toks_.size() && toks_[p + 1].kind == Tok::kPunct &&
+            toks_[p + 1].text == "]") { p += 2; continue; }
+        return false;
+      }
+      if (t.text == "," && angle > 0) { ++p; continue; }
+      if (t.text == "?" && angle > 0) { ++p; continue; }
+      return false;
+    }
+    return false;
+  }
+
+  JNodePtr parse_local_var_decl() {
+    if (at_ident("final")) next();
+    auto decl_expr = make("VariableDeclarationExpr");
+    while (at("@")) decl_expr->add(parse_annotation());
+    if (at_ident("final")) next();
+    auto type = parse_type();
+    std::string name = expect_ident();
+    decl_expr->add(parse_variable_declarators(clone(type.get()), name));
+    while (at(",")) {
+      next();
+      std::string more = expect_ident();
+      decl_expr->add(parse_variable_declarators(clone(type.get()), more));
+    }
+    return decl_expr;
+  }
+
+  JNodePtr parse_for() {
+    next();  // for
+    expect("(");
+    // enhanced for: [final] Type Ident ':'
+    size_t save = pos_;
+    bool enhanced = false;
+    try {
+      if (at_ident("final")) next();
+      if (starts_local_var_decl() || kPrimitives.count(cur().text)) {
+        auto probe_type = parse_type();
+        (void)probe_type;
+        if (cur().kind == Tok::kIdent && peek().kind == Tok::kPunct &&
+            peek().text == ":")
+          enhanced = true;
+      }
+    } catch (const ParseError&) {}
+    pos_ = save;
+
+    if (enhanced) {
+      auto s = make("ForeachStmt");  // javaparser 3.6 name
+      auto var = make("VariableDeclarationExpr");
+      if (at_ident("final")) next();
+      auto type = parse_type();
+      auto declarator = make("VariableDeclarator");
+      declarator->add(make("SimpleName", expect_ident()));
+      declarator->add(std::move(type));
+      var->add(std::move(declarator));
+      s->add(std::move(var));
+      expect(":");
+      s->add(parse_expression());
+      expect(")");
+      s->add(parse_statement());
+      return s;
+    }
+
+    auto s = make("ForStmt");
+    if (!at(";")) {
+      if (starts_local_var_decl()) {
+        s->add(parse_local_var_decl());
+      } else {
+        s->add(parse_expression());
+        while (at(",")) { next(); s->add(parse_expression()); }
+      }
+    }
+    expect(";");
+    if (!at(";")) s->add(parse_expression());
+    expect(";");
+    if (!at(")")) {
+      s->add(parse_expression());
+      while (at(",")) { next(); s->add(parse_expression()); }
+    }
+    expect(")");
+    s->add(parse_statement());
+    return s;
+  }
+
+  JNodePtr parse_switch() {
+    next();  // switch
+    auto s = make("SwitchStmt");
+    expect("(");
+    s->add(parse_expression());
+    expect(")");
+    expect("{");
+    while (!at("}")) {
+      auto entry = make("SwitchEntryStmt");  // javaparser 3.6 name
+      if (at_ident("case")) {
+        next();
+        entry->add(parse_expression());
+        expect(":");
+      } else if (at_ident("default")) {
+        next();
+        expect(":");
+      } else {
+        fail("expected 'case' or 'default'");
+      }
+      while (!at("}") && !at_ident("case") && !at_ident("default"))
+        entry->add(parse_statement());
+      s->add(std::move(entry));
+    }
+    expect("}");
+    return s;
+  }
+
+  JNodePtr parse_try() {
+    next();  // try
+    auto s = make("TryStmt");
+    if (at("(")) {  // try-with-resources
+      next();
+      while (!at(")")) {
+        s->add(parse_local_var_decl());
+        if (at(";")) next();
+      }
+      expect(")");
+    }
+    s->add(parse_block());
+    while (at_ident("catch")) {
+      next();
+      auto clause = make("CatchClause");
+      expect("(");
+      auto param = make("Parameter");
+      if (at_ident("final")) next();
+      auto type = parse_type();
+      while (at("|")) {  // multi-catch -> UnionType
+        next();
+        auto union_type = make("UnionType");
+        union_type->add(std::move(type));
+        union_type->add(parse_type());
+        type = std::move(union_type);
+        while (at("|")) {
+          next();
+          type->add(parse_type());
+        }
+      }
+      param->add(std::move(type));
+      param->add(make("SimpleName", expect_ident()));
+      expect(")");
+      clause->add(std::move(param));
+      clause->add(parse_block());
+      s->add(std::move(clause));
+    }
+    if (at_ident("finally")) {
+      next();
+      s->add(parse_block());
+    }
+    return s;
+  }
+
+  // ---- expressions -----------------------------------------------------
+  JNodePtr parse_expression() { return parse_assignment(); }
+
+  JNodePtr parse_assignment() {
+    auto lhs = parse_ternary();
+    static const std::set<std::string> kAssignOps = {
+        "=",  "+=", "-=", "*=",  "/=",  "&=",
+        "|=", "^=", "%=", "<<=", ">>=", ">>>="};
+    if (cur().kind == Tok::kPunct && kAssignOps.count(cur().text)) {
+      std::string op = cur().text;
+      next();
+      auto e = make("AssignExpr");
+      e->op = assign_op_name(op);
+      e->add(std::move(lhs));
+      e->add(parse_assignment());
+      return e;
+    }
+    return lhs;
+  }
+
+  JNodePtr parse_ternary() {
+    auto cond = parse_binary(0);
+    if (at("?")) {
+      next();
+      auto e = make("ConditionalExpr");
+      e->add(std::move(cond));
+      e->add(parse_expression());
+      expect(":");
+      e->add(parse_ternary());
+      return e;
+    }
+    return cond;
+  }
+
+  // precedence climbing over binary operators + instanceof
+  struct Level { std::set<std::string> ops; };
+  static const std::vector<Level>& levels() {
+    static const std::vector<Level> kLevels = {
+        {{"||"}},
+        {{"&&"}},
+        {{"|"}},
+        {{"^"}},
+        {{"&"}},
+        {{"==", "!="}},
+        {{"<", ">", "<=", ">=", "__instanceof__"}},
+        {{"<<", ">>", ">>>"}},
+        {{"+", "-"}},
+        {{"*", "/", "%"}},
+    };
+    return kLevels;
+  }
+
+  JNodePtr parse_binary(size_t level) {
+    if (level >= levels().size()) return parse_unary();
+    auto lhs = parse_binary(level + 1);
+    while (true) {
+      if (levels()[level].ops.count("__instanceof__") && at_ident("instanceof")) {
+        next();
+        auto e = make("InstanceOfExpr");
+        e->add(std::move(lhs));
+        e->add(parse_type());
+        lhs = std::move(e);
+        continue;
+      }
+      if (cur().kind == Tok::kPunct && levels()[level].ops.count(cur().text)) {
+        // '<' might open generics of a method call — conservatively treat as
+        // operator; generic method calls with explicit type args are rare
+        std::string op = cur().text;
+        next();
+        auto e = make("BinaryExpr");
+        e->op = binary_op_name(op);
+        e->add(std::move(lhs));
+        e->add(parse_binary(level + 1));
+        lhs = std::move(e);
+        continue;
+      }
+      break;
+    }
+    return lhs;
+  }
+
+  JNodePtr parse_unary() {
+    if (at("+") || at("-") || at("!") || at("~") || at("++") || at("--")) {
+      std::string op = cur().text;
+      next();
+      auto e = make("UnaryExpr");
+      if (op == "+") e->op = "PLUS";
+      else if (op == "-") e->op = "MINUS";
+      else if (op == "!") e->op = "LOGICAL_COMPLEMENT";
+      else if (op == "~") e->op = "BITWISE_COMPLEMENT";
+      else if (op == "++") e->op = "PREFIX_INCREMENT";
+      else if (op == "--") e->op = "PREFIX_DECREMENT";
+      e->add(parse_unary());
+      return e;
+    }
+    if (at("(") && looks_like_cast() && !looks_like_lambda_parens()) {
+      next();
+      auto e = make("CastExpr");
+      auto type = parse_type();
+      while (at("&")) {  // intersection cast
+        next();
+        auto intersection = make("IntersectionType");
+        intersection->add(std::move(type));
+        intersection->add(parse_type());
+        type = std::move(intersection);
+      }
+      e->add(std::move(type));
+      expect(")");
+      e->add(parse_unary());
+      return e;
+    }
+    return parse_postfix();
+  }
+
+  JNodePtr parse_postfix() {
+    auto e = parse_primary();
+    while (true) {
+      if (at(".")) {
+        next();
+        if (at_ident("new")) fail("qualified new unsupported");
+        if (at("<")) {  // explicit type args on call: skip
+          int depth = 0;
+          do {
+            if (at("<")) ++depth;
+            else if (at(">")) --depth;
+            else if (at(">>")) depth -= 2;
+            else if (at(">>>")) depth -= 3;
+            next();
+          } while (depth > 0 && !at_end());
+        }
+        if (at_ident("class")) {
+          next();
+          auto ce = make("ClassExpr");
+          ce->add(std::move(e));
+          e = std::move(ce);
+          continue;
+        }
+        if (at_ident("this")) {
+          next();
+          auto te = make("ThisExpr");
+          te->add(std::move(e));
+          e = std::move(te);
+          continue;
+        }
+        std::string name = expect_ident();
+        if (at("(")) {
+          auto call = make("MethodCallExpr");
+          call->add(std::move(e));  // scope
+          call->add(make("SimpleName", name));
+          parse_arguments_into(call.get());
+          e = std::move(call);
+        } else {
+          auto fa = make("FieldAccessExpr");
+          fa->add(std::move(e));
+          fa->add(make("SimpleName", name));
+          e = std::move(fa);
+        }
+        continue;
+      }
+      if (at("[")) {
+        next();
+        auto ae = make("ArrayAccessExpr");
+        ae->add(std::move(e));
+        ae->add(parse_expression());
+        expect("]");
+        e = std::move(ae);
+        continue;
+      }
+      if (at("::")) {
+        next();
+        auto mr = make("MethodReferenceExpr");
+        mr->add(std::move(e));
+        mr->text = at_ident("new") ? "new" : expect_ident_or_new();
+        e = std::move(mr);
+        continue;
+      }
+      if (at("++") || at("--")) {
+        auto ue = make("UnaryExpr");
+        ue->op = at("++") ? "POSTFIX_INCREMENT" : "POSTFIX_DECREMENT";
+        next();
+        ue->add(std::move(e));
+        e = std::move(ue);
+        continue;
+      }
+      break;
+    }
+    return e;
+  }
+
+  std::string expect_ident_or_new() {
+    if (at_ident("new")) { next(); return "new"; }
+    return expect_ident();
+  }
+
+  void parse_arguments_into(JNode* call) {
+    expect("(");
+    while (!at(")")) {
+      call->add(parse_expression());
+      if (at(",")) next();
+    }
+    expect(")");
+  }
+
+  JNodePtr parse_primary() {
+    // literals
+    if (cur().kind == Tok::kString) {
+      auto e = make("StringLiteralExpr", cur().text);
+      next();
+      return e;
+    }
+    if (cur().kind == Tok::kChar) {
+      auto e = make("CharLiteralExpr", cur().text);
+      next();
+      return e;
+    }
+    if (cur().kind == Tok::kInt) {
+      auto e = make("IntegerLiteralExpr", cur().text);
+      next();
+      return e;
+    }
+    if (cur().kind == Tok::kLong) {
+      auto e = make("LongLiteralExpr", cur().text);
+      next();
+      return e;
+    }
+    if (cur().kind == Tok::kDouble) {
+      auto e = make("DoubleLiteralExpr", cur().text);
+      next();
+      return e;
+    }
+    if (at_ident("true") || at_ident("false")) {
+      auto e = make("BooleanLiteralExpr", cur().text);
+      next();
+      return e;
+    }
+    if (at_ident("null")) {
+      next();
+      return make("NullLiteralExpr", "null");
+    }
+    if (at_ident("this")) {
+      next();
+      return make("ThisExpr", "this");
+    }
+    if (at_ident("super")) {
+      next();
+      return make("SuperExpr", "super");
+    }
+    if (at_ident("new")) return parse_new();
+
+    // lambda: Ident '->' or '(' params ')' '->'
+    if (cur().kind == Tok::kIdent && peek().kind == Tok::kPunct &&
+        peek().text == "->" && !kReservedNonType.count(cur().text)) {
+      auto lambda = make("LambdaExpr");
+      auto param = make("Parameter");
+      param->add(make("SimpleName", expect_ident()));
+      lambda->add(std::move(param));
+      expect("->");
+      lambda->add(parse_lambda_body());
+      return lambda;
+    }
+    if (at("(") && looks_like_lambda_parens()) {
+      auto lambda = make("LambdaExpr");
+      next();
+      while (!at(")")) {
+        auto param = make("Parameter");
+        while (at("@")) param->add(parse_annotation());
+        if (at_ident("final")) next();
+        // typed or bare param
+        if (cur().kind == Tok::kIdent && (peek().text == "," || peek().text == ")")) {
+          param->add(make("SimpleName", expect_ident()));
+        } else {
+          param->add(parse_type());
+          param->add(make("SimpleName", expect_ident()));
+        }
+        lambda->add(std::move(param));
+        if (at(",")) next();
+      }
+      expect(")");
+      expect("->");
+      lambda->add(parse_lambda_body());
+      return lambda;
+    }
+    if (at("(")) {
+      next();
+      auto e = make("EnclosedExpr");
+      e->add(parse_expression());
+      expect(")");
+      return e;
+    }
+    if (cur().kind == Tok::kIdent && kPrimitives.count(cur().text)) {
+      // e.g. int.class / int[]::new
+      auto type = parse_type();
+      if (at(".")) {
+        next();
+        if (at_ident("class")) {
+          next();
+          auto ce = make("ClassExpr");
+          ce->add(std::move(type));
+          return ce;
+        }
+        fail("unexpected primitive member access");
+      }
+      auto te = make("TypeExpr");
+      te->add(std::move(type));
+      return te;
+    }
+    if (cur().kind == Tok::kIdent && !kReservedNonType.count(cur().text)) {
+      std::string name = expect_ident();
+      if (at("(")) {
+        auto call = make("MethodCallExpr");  // unscoped call
+        call->add(make("SimpleName", name));
+        parse_arguments_into(call.get());
+        return call;
+      }
+      auto ne = make("NameExpr");
+      ne->add(make("SimpleName", name));
+      return ne;
+    }
+    fail("expected expression");
+  }
+
+  JNodePtr parse_lambda_body() {
+    if (at("{")) return parse_block();
+    auto stmt = make("ExpressionStmt");
+    stmt->add(parse_expression());
+    return stmt;
+  }
+
+  JNodePtr parse_new() {
+    next();  // new
+    // array creation?
+    auto type = (cur().kind == Tok::kIdent && kPrimitives.count(cur().text))
+                    ? [&] { auto t = make("PrimitiveType", cur().text); next(); return t; }()
+                    : parse_class_type();
+    if (at("[")) {
+      auto e = make("ArrayCreationExpr");
+      e->add(std::move(type));
+      bool saw_dim = false;
+      while (at("[")) {
+        next();
+        auto lvl = make("ArrayCreationLevel");
+        if (!at("]")) {
+          lvl->add(parse_expression());
+          saw_dim = true;
+        } else {
+          lvl->text = "[]";
+        }
+        expect("]");
+        e->add(std::move(lvl));
+      }
+      if (at("{")) {
+        e->add(parse_variable_initializer());
+      }
+      (void)saw_dim;
+      return e;
+    }
+    auto e = make("ObjectCreationExpr");
+    e->add(std::move(type));
+    parse_arguments_into(e.get());
+    if (at("{")) {  // anonymous class body
+      parse_class_body_into(e.get(), false);
+    }
+    return e;
+  }
+
+  static JNodePtr clone(const JNode* n) {
+    auto copy = make(n->type, n->text);
+    copy->op = n->op;
+    copy->is_var_args = n->is_var_args;
+    for (const auto& c : n->children) copy->add(clone(c.get()));
+    return copy;
+  }
+
+  static const std::set<std::string> kReservedNonType;
+
+  const std::string& source_;
+  Lexer lexer_;
+  std::vector<Token> toks_;
+  size_t pos_ = 0;
+};
+
+const std::set<std::string> Parser::kReservedNonType = {
+    "abstract", "assert",   "break",     "case",       "catch",  "class",
+    "const",    "continue", "default",   "do",         "else",   "enum",
+    "extends",  "final",    "finally",   "for",        "goto",   "if",
+    "implements", "import", "instanceof", "interface", "native", "new",
+    "package",  "private",  "protected", "public",     "return", "static",
+    "strictfp", "super",    "switch",    "synchronized", "this", "throw",
+    "throws",   "transient", "try",      "volatile",   "while",  "true",
+    "false",    "null"};
+
+}  // namespace
+
+JNodePtr parse_compilation_unit(const std::string& source) {
+  Parser parser(source);
+  return parser.run();
+}
+
+std::string node_source(const JNode& n) {
+  // leaf terminal text: identifiers/literals carry their lexeme; composite
+  // leaves print their minimal source form
+  if (!n.text.empty()) return n.text;
+  if (n.type == "WildcardType") return "?";
+  if (n.type == "ArrayCreationLevel") return "[]";
+  // fallback: reconstruct from children (e.g. qualified Name)
+  std::string out;
+  for (const auto& c : n.children) {
+    if (!out.empty()) out += ".";
+    out += node_source(*c);
+  }
+  return out;
+}
+
+}  // namespace c2v

@@ -1,0 +1,33 @@
+// Recursive-descent parser for a practical Java subset, producing
+// javaparser-shaped ASTs (node-type names per javaparser 3.6, the library
+// the reference notebook uses — create_path_contexts.ipynb cell1).
+//
+// Coverage: classes/interfaces/enums/annotations, fields, methods,
+// constructors, initializer blocks, generics (incl. nested '>>' splitting),
+// lambdas, method references, anonymous classes, arrays, the full
+// statement/expression grammar with precedence, try-with-resources,
+// multi-catch, labeled statements, switch.
+//
+// Out of scope (rejected with ParseError, reported as a parse warning by
+// the dataset writer, matching the reference's swallow-and-warn behavior,
+// ipynb cell11): records, sealed classes, pattern-matching switch, text
+// blocks, modules.
+
+#pragma once
+
+#include <stdexcept>
+#include <string>
+
+#include "ast.h"
+#include "lexer.h"
+
+namespace c2v {
+
+struct ParseError : std::runtime_error {
+  explicit ParseError(const std::string& message) : std::runtime_error(message) {}
+};
+
+// Parse a whole source file into a CompilationUnit node.
+JNodePtr parse_compilation_unit(const std::string& source);
+
+}  // namespace c2v

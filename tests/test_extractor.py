@@ -1,0 +1,352 @@
+"""Golden tests for the native extractor (SURVEY.md §4: 'small Java methods
+-> exact expected path-context sets').
+
+Expected paths are hand-derived from the extraction rules
+(create_path_contexts.ipynb cells 6-10): anonymization, DFS terminal order,
+common-prefix-strip paths with the width/length caps.
+"""
+
+import os
+
+import pytest
+
+from code2vec_tpu.extractor import (
+    build_extractor,
+    extract_dataset,
+    extract_source,
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def built():
+    build_extractor()
+
+
+UP, DOWN = "↑", "↓"
+
+
+def path_str(*parts):
+    # helper: ("A","^"),("B","v"),... -> "A↑B↓..."
+    out = []
+    for name, arrow in parts[:-1]:
+        out.append(name + (UP if arrow == "^" else DOWN))
+    out.append(parts[-1][0])
+    return "".join(out)
+
+
+class TestMinimalMethod:
+    SOURCE = "class A { int f(int a) { return a; } }"
+
+    def test_exact_path_set(self):
+        result = extract_source(self.SOURCE, "f")
+        assert len(result.methods) == 1
+        m = result.methods[0]
+        assert m.label == "f"
+        assert m.aliases == [("a", "@var_0")]
+
+        terminals = result.terminal_vocab
+        paths = result.path_vocab
+        assert terminals == {1: "int", 2: "@method_0", 3: "@var_0"}
+
+        # resolve features into (start_name, path_string, end_name)
+        got = {
+            (terminals[s], paths[p], terminals[e])
+            for s, p, e in m.path_contexts
+        }
+        MD, PT, SN, PRM, BLK, RET, NE = (
+            "MethodDeclaration",
+            "PrimitiveType",
+            "SimpleName",
+            "Parameter",
+            "BlockStmt",
+            "ReturnStmt",
+            "NameExpr",
+        )
+        expected = {
+            ("int", f"{PT}{UP}{MD}{DOWN}{SN}", "@method_0"),
+            ("int", f"{PT}{UP}{MD}{DOWN}{PRM}{DOWN}{PT}", "int"),
+            ("int", f"{PT}{UP}{MD}{DOWN}{PRM}{DOWN}{SN}", "@var_0"),
+            ("int", f"{PT}{UP}{MD}{DOWN}{BLK}{DOWN}{RET}{DOWN}{NE}{DOWN}{SN}", "@var_0"),
+            ("@method_0", f"{SN}{UP}{MD}{DOWN}{PRM}{DOWN}{PT}", "int"),
+            ("@method_0", f"{SN}{UP}{MD}{DOWN}{PRM}{DOWN}{SN}", "@var_0"),
+            ("@method_0", f"{SN}{UP}{MD}{DOWN}{BLK}{DOWN}{RET}{DOWN}{NE}{DOWN}{SN}", "@var_0"),
+            ("int", f"{PT}{UP}{PRM}{DOWN}{SN}", "@var_0"),
+            ("int", f"{PT}{UP}{PRM}{UP}{MD}{DOWN}{BLK}{DOWN}{RET}{DOWN}{NE}{DOWN}{SN}", "@var_0"),
+            ("@var_0", f"{SN}{UP}{PRM}{UP}{MD}{DOWN}{BLK}{DOWN}{RET}{DOWN}{NE}{DOWN}{SN}", "@var_0"),
+        }
+        assert got == expected
+
+
+class TestAnonymization:
+    def test_self_recursion_resolves_to_method_alias(self):
+        result = extract_source(
+            "class A { int f(int x) { return f(x + 1); } }", "f"
+        )
+        terminals = set(result.terminal_vocab.values())
+        assert "@method_0" in terminals
+        assert "f" not in terminals  # the name itself must not leak
+
+    def test_external_call_keeps_name(self):
+        result = extract_source(
+            "class A { void f(B b) { b.run(); } }", "f"
+        )
+        assert "run" in set(result.terminal_vocab.values())
+
+    def test_this_call_resolves_like_self(self):
+        result = extract_source(
+            "class A { int f() { return this.f(); } }", "f"
+        )
+        terminals = set(result.terminal_vocab.values())
+        assert "@method_0" in terminals and "f" not in terminals
+
+    def test_scoped_shadowing(self):
+        # two independent blocks declare x -> two aliases; references
+        # resolve to the innermost declaration
+        src = """
+        class A { void f() {
+            { int x = 1; use(x); }
+            { int x = 2; use(x); }
+        } }
+        """
+        result = extract_source(src, "f")
+        m = result.methods[0]
+        # both declarations of x get distinct aliases (duplicate original
+        # names are legitimate — dict() would collapse them)
+        assert {alias for _, alias in m.aliases} >= {"@var_0", "@var_1"}
+        assert [orig for orig, _ in m.aliases] == ["x", "x"]
+
+    def test_label_resolution(self):
+        src = "class A { void f() { foo: while (true) { break foo; } } }"
+        result = extract_source(src, "f")
+        terminals = set(result.terminal_vocab.values())
+        assert "@label_0" in terminals
+        assert "foo" not in terminals
+        assert ("foo", "@label_0") in result.methods[0].aliases
+
+    def test_variable_reference_uses_declaration_alias(self):
+        src = "class A { void f(int count) { int total = count; } }"
+        result = extract_source(src, "f")
+        m = result.methods[0]
+        assert dict(m.aliases) == {"count": "@var_0", "total": "@var_1"}
+
+
+class TestLiteralNormalization:
+    SRC = 'class A { void f() { g("s", \'c\', 7, 3.5); } }'
+
+    def test_defaults(self):
+        terminals = set(extract_source(self.SRC, "f").terminal_vocab.values())
+        assert "@string_literal" in terminals
+        assert "@char_literal" in terminals
+        assert "@double_literal" in terminals
+        assert "7" in terminals  # ints NOT normalized by default (cell12)
+
+    def test_int_normalization_flag(self):
+        terminals = set(
+            extract_source(self.SRC, "f", normalize_int=True).terminal_vocab.values()
+        )
+        assert "@int_literal" in terminals and "7" not in terminals
+
+    def test_terminals_lowercased(self):
+        result = extract_source("class A { void f(Foo myVar) { } }", "f")
+        names = set(result.terminal_vocab.values())
+        assert "foo" in names  # type name lowercased (cell7)
+
+
+class TestIgnorableMethods:
+    @pytest.mark.parametrize(
+        "src",
+        [
+            "class A { public String getHashKey(); }",  # abstract
+            "class A { public String toString() { return \"x\"; } }",
+            "class A { void setX(int x) { this.x = x; } }",  # trivial setter
+            "class A { int getX() { return x; } }",  # trivial getter
+            "class A { boolean isOk() { return ok; } }",
+        ],
+    )
+    def test_skipped(self, src):
+        assert extract_source(src, "*").methods == []
+
+    @pytest.mark.parametrize(
+        "src,name",
+        [
+            # setter with 2 params is NOT trivial
+            ("class A { void setX(int x, int y) { this.x = x; } }", "setX"),
+            # getter with a param is NOT trivial
+            ("class A { int getX(int i) { return a[i]; } }", "getX"),
+            # get* with 2 statements is NOT trivial
+            ("class A { int getY() { int z = 1; return z; } }", "getY"),
+        ],
+    )
+    def test_kept(self, src, name):
+        assert [m.label for m in extract_source(src, "*").methods] == [name]
+
+
+class TestPathCaps:
+    def test_width_cap(self):
+        # call with 5 args: first and last arg diverge at sibling distance 5
+        src = "class A { void f() { g(a, b, c, d, e); } }"
+        wide = extract_source(src, "f", max_width=10)
+        narrow = extract_source(src, "f", max_width=1)
+        assert len(wide.methods[0].path_contexts) > len(
+            narrow.methods[0].path_contexts
+        )
+
+    def test_length_cap(self):
+        src = "class A { int f(int a) { return ((((a)))); } }"
+        long_ok = extract_source(src, "f", max_length=20)
+        short = extract_source(src, "f", max_length=4)
+        assert len(long_ok.methods[0].path_contexts) > len(
+            short.methods[0].path_contexts
+        )
+
+    def test_caps_match_reference_defaults(self):
+        # defaults 8/3 (top11_dataset/params.txt:1-2)
+        result = extract_source("class A { int f(int a) { return a; } }", "f")
+        assert len(result.methods[0].path_contexts) == 10
+
+
+class TestOperatorsAndStructures:
+    def test_operator_suffixed_nodes(self):
+        result = extract_source(
+            "class A { int f(int a, int b) { a += b * 2; return -a; } }", "f"
+        )
+        paths = " ".join(result.path_vocab.values())
+        assert "AssignExpr:PLUS" in paths
+        assert "BinaryExpr:MULTIPLY" in paths
+        assert "UnaryExpr:MINUS" in paths
+
+    def test_conditional_wrapper(self):
+        result = extract_source(
+            "class A { int f(int a) { return a > 0 ? a : 0; } }", "f"
+        )
+        assert "Condition" in " ".join(result.path_vocab.values())
+
+    def test_lambda_and_generics(self):
+        src = """
+        class A {
+            java.util.List<String> f(java.util.Map<String, java.util.List<Integer>> m) {
+                return m.keys().stream().map(k -> k.trim()).collect();
+            }
+        }
+        """
+        result = extract_source(src, "f")
+        assert result.methods and result.methods[0].path_contexts
+
+    def test_try_catch_foreach_switch(self):
+        src = """
+        class A {
+            int f(int[] xs) {
+                int total = 0;
+                for (int x : xs) {
+                    try { total += x; } catch (RuntimeException | Error e) { throw e; }
+                }
+                switch (total) { case 0: return 1; default: break; }
+                do { total--; } while (total > 10);
+                return total;
+            }
+        }
+        """
+        result = extract_source(src, "f")
+        assert len(result.methods[0].path_contexts) > 20
+
+    def test_anonymous_class_and_arrays(self):
+        src = """
+        class A {
+            Object f() {
+                int[][] grid = new int[3][];
+                String[] names = new String[] { "x", "y" };
+                return new Runnable() { public void go() { } };
+            }
+        }
+        """
+        result = extract_source(src, "f")
+        assert result.methods[0].path_contexts
+
+    def test_parse_error_raises(self):
+        with pytest.raises(ValueError, match="extraction failed"):
+            extract_source("class A { int f( { }", "f")
+
+    @pytest.mark.parametrize(
+        "src",
+        [
+            # regressions from review: constructs that used to drop files
+            "class A { String f() { return (String) null; } }",
+            "class A { boolean f() { return (Boolean) true; } }",
+            'class A { void f() { @SuppressWarnings("x") int y = 1; g(y); } }',
+            "class A { void f() { java.util.Collections.<String>emptyList(); } }",
+            "class A { void f() { Foo.<java.util.List<String>>of(); } }",
+            "class A { void f() { final class B { void g() { } } } }",
+        ],
+    )
+    def test_review_regressions_parse(self, src):
+        result = extract_source(src, "f")
+        assert len(result.methods) == 1
+
+
+class TestDatasetCLI:
+    def test_end_to_end_to_training(self, tmp_path):
+        """Java sources -> extractor CLI -> load_corpus -> a training epoch:
+        the full pipeline the reference implements in two disconnected
+        halves, end to end."""
+        src_dir = tmp_path / "src"
+        ds_dir = tmp_path / "ds"
+        os.makedirs(src_dir)
+        os.makedirs(ds_dir)
+        for i in range(6):
+            (src_dir / f"C{i}.java").write_text(
+                f"""
+                class C{i} {{
+                    int computeTotal(int[] values) {{
+                        int total = 0;
+                        for (int v : values) {{ total += v + {i}; }}
+                        return total;
+                    }}
+                    String formatName(String first, String last) {{
+                        return first + " " + last + {i};
+                    }}
+                }}
+                """
+            )
+        rows = []
+        for i in range(6):
+            rows.append(f"C{i}.java\tcomputeTotal")
+            rows.append(f"C{i}.java\tformatName")
+        (ds_dir / "methods.txt").write_text("\n".join(rows) + "\n")
+
+        result = extract_dataset(str(ds_dir), str(src_dir),
+                                 method_declarations="method_declarations.txt")
+        assert "extracted 12 methods" in result.stderr
+
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.formats import read_params
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        params = read_params(ds_dir / "params.txt")
+        assert params["method_count"] == "12"
+        assert params["max_length"] == "8"
+
+        data = load_corpus(
+            ds_dir / "corpus.txt",
+            ds_dir / "path_idxs.txt",
+            ds_dir / "terminal_idxs.txt",
+        )
+        assert data.n_items == 12
+        assert data.method_token_index is not None
+
+        cfg = TrainConfig(
+            max_epoch=1,
+            batch_size=8,
+            encode_size=16,
+            terminal_embed_size=8,
+            path_embed_size=8,
+            max_path_length=32,
+            print_sample_cycle=0,
+        )
+        res = train(cfg, data)
+        assert res.epochs_run == 1
+
+        # auxiliary artifacts
+        assert (ds_dir / "actual_methods.txt").read_text().count("\n") == 12
+        decls = (ds_dir / "method_declarations.txt").read_text()
+        assert "computeTotal" in decls
