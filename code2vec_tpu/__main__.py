@@ -1,0 +1,5 @@
+"""``python -m code2vec_tpu`` — the training/HPO entry point."""
+
+from code2vec_tpu.cli import main
+
+main()

@@ -1,0 +1,255 @@
+"""The experiment-driver CLI — flag-for-flag parity with the reference's
+entry point (reference: main.py:37-81,494-502) plus the TPU-native knobs.
+
+Usage mirrors the reference README:
+
+    python -m code2vec_tpu --corpus_path d/corpus.txt \
+        --path_idx_path d/path_idxs.txt --terminal_idx_path d/terminal_idxs.txt
+
+Reference flags kept verbatim: seeds, corpus paths, model dims, optimizer,
+dropout, output paths, ``--env`` (tensorboard|floyd), eval/print cycles,
+HPO (``--find_hyperparams`` / ``--num_trials``), angular-margin head, task
+selection. CUDA-machinery flags (``--no_cuda``, ``--gpu``,
+``--num_workers``) are accepted for drop-in compatibility but are no-ops:
+device placement is JAX's job and the input pipeline is vectorized
+host-side (no worker pool to size).
+
+TPU-native additions (no reference counterpart): ``--compute_dtype``,
+``--use_pallas``, mesh axes (``--data_axis``/``--model_axis``/
+``--context_axis``), ``--resume``, ``--profile_dir``,
+``--class_weighting``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def _strtobool(value: str) -> bool:
+    """The reference parses bool flags via distutils ``strtobool``
+    (main.py:77-79); distutils is gone in py3.12, so re-state the rule."""
+    lowered = value.strip().lower()
+    if lowered in ("y", "yes", "t", "true", "on", "1"):
+        return True
+    if lowered in ("n", "no", "f", "false", "off", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"invalid truth value {value!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="code2vec_tpu",
+        description="TPU-native code2vec: train, search, export",
+    )
+    # reproducibility (main.py:38) — also seeds the train/test split here
+    parser.add_argument("--random_seed", type=int, default=123)
+
+    # dataset artifacts (main.py:40-42)
+    parser.add_argument("--corpus_path", type=str, default="./dataset/corpus.txt")
+    parser.add_argument("--path_idx_path", type=str, default="./dataset/path_idxs.txt")
+    parser.add_argument("--terminal_idx_path", type=str,
+                        default="./dataset/terminal_idxs.txt")
+    parser.add_argument("--synthetic", type=str, default=None,
+                        metavar="SPEC",
+                        help="ignore the corpus flags and train on a generated "
+                             "corpus (tiny|small|top11) — smoke runs/benchmarks")
+
+    # model dims (main.py:44-48)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--terminal_embed_size", type=int, default=100)
+    parser.add_argument("--path_embed_size", type=int, default=100)
+    parser.add_argument("--encode_size", type=int, default=300)
+    parser.add_argument("--max_path_length", type=int, default=200)
+
+    # outputs (main.py:50-52)
+    parser.add_argument("--model_path", type=str, default="./output")
+    parser.add_argument("--vectors_path", type=str, default="./output/code.vec")
+    parser.add_argument("--test_result_path", type=str, default=None)
+
+    # optimizer (main.py:54-58)
+    parser.add_argument("--max_epoch", type=int, default=40)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--beta_min", type=float, default=0.9)
+    parser.add_argument("--beta_max", type=float, default=0.999)
+    parser.add_argument("--weight_decay", type=float, default=0.0)
+    parser.add_argument("--dropout_prob", type=float, default=0.25)
+
+    # device flags accepted for drop-in compatibility, no-ops under JAX
+    # (main.py:62-64)
+    parser.add_argument("--no_cuda", action="store_true", default=False,
+                        help="no-op (JAX owns device placement)")
+    parser.add_argument("--gpu", type=str, default=None,
+                        help="no-op (JAX owns device placement)")
+    parser.add_argument("--num_workers", type=int, default=None,
+                        help="no-op (vectorized host pipeline)")
+
+    # observability + eval control (main.py:66-68)
+    parser.add_argument("--env", type=str, default=None,
+                        choices=(None, "tensorboard", "floyd"),
+                        help="extra metric sink: tensorboard | floyd")
+    parser.add_argument("--print_sample_cycle", type=int, default=10)
+    parser.add_argument("--eval_method", type=str, default="subtoken",
+                        choices=("exact", "subtoken", "ave_subtoken"))
+
+    # HPO (main.py:70-71)
+    parser.add_argument("--find_hyperparams", action="store_true", default=False)
+    parser.add_argument("--num_trials", type=int, default=100)
+
+    # angular-margin head (main.py:73-75)
+    parser.add_argument("--angular_margin_loss", action="store_true", default=False)
+    parser.add_argument("--angular_margin", type=float, default=0.5)
+    parser.add_argument("--inverse_temp", type=float, default=30.0)
+
+    # task selection (main.py:77-79)
+    parser.add_argument("--infer_method_name", type=_strtobool, default=True)
+    parser.add_argument("--infer_variable_name", type=_strtobool, default=False)
+    parser.add_argument("--shuffle_variable_indexes", type=_strtobool, default=False)
+
+    # ---- TPU-native flags (no reference counterpart) ----
+    parser.add_argument("--compute_dtype", type=str, default="float32",
+                        choices=("float32", "bfloat16"),
+                        help="matmul/activation dtype; bfloat16 for TPU MXU")
+    parser.add_argument("--use_pallas", action="store_true", default=False,
+                        help="fused attention-pooling Pallas kernel (single-chip)")
+    parser.add_argument("--data_axis", type=int, default=1,
+                        help="mesh data-parallel axis size")
+    parser.add_argument("--model_axis", type=int, default=1,
+                        help="mesh model-parallel axis size (shards vocab tables)")
+    parser.add_argument("--context_axis", type=int, default=1,
+                        help="mesh context-parallel axis size (shards the bag)")
+    parser.add_argument("--class_weighting", type=str, default="reference",
+                        choices=("reference", "occurrence", "none"))
+    parser.add_argument("--resume", action="store_true", default=False,
+                        help="resume from the checkpoint in --model_path")
+    parser.add_argument("--profile_dir", type=str, default=None,
+                        help="write a jax.profiler trace of epoch 2 here")
+    parser.add_argument("--tensorboard_dir", type=str, default="runs",
+                        help="scalar log dir for --env tensorboard")
+    return parser
+
+
+def config_from_args(args: argparse.Namespace):
+    from code2vec_tpu.train.config import TrainConfig
+
+    return TrainConfig(
+        random_seed=args.random_seed,
+        terminal_embed_size=args.terminal_embed_size,
+        path_embed_size=args.path_embed_size,
+        encode_size=args.encode_size,
+        max_path_length=args.max_path_length,
+        batch_size=args.batch_size,
+        max_epoch=args.max_epoch,
+        lr=args.lr,
+        beta_min=args.beta_min,
+        beta_max=args.beta_max,
+        weight_decay=args.weight_decay,
+        dropout_prob=args.dropout_prob,
+        angular_margin_loss=args.angular_margin_loss,
+        angular_margin=args.angular_margin,
+        inverse_temp=args.inverse_temp,
+        infer_method_name=args.infer_method_name,
+        infer_variable_name=args.infer_variable_name,
+        shuffle_variable_indexes=args.shuffle_variable_indexes,
+        eval_method=args.eval_method,
+        print_sample_cycle=args.print_sample_cycle,
+        class_weighting=args.class_weighting,
+        compute_dtype=args.compute_dtype,
+        data_axis=args.data_axis,
+        model_axis=args.model_axis,
+        context_axis=args.context_axis,
+        use_pallas=args.use_pallas,
+        resume=args.resume,
+    )
+
+
+def sinks_from_args(args: argparse.Namespace):
+    from code2vec_tpu.sinks import floyd_sink, logging_sink, tensorboard_sink
+
+    sinks = [logging_sink]
+    if args.env == "floyd":
+        sinks.append(floyd_sink)
+    elif args.env == "tensorboard":
+        sinks.append(tensorboard_sink(args.tensorboard_dir))
+    return tuple(sinks)
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s: %(message)s",
+                        datefmt="%m/%d/%Y %I:%M:%S %p")
+    args = build_parser().parse_args(argv)
+    if args.no_cuda or args.gpu is not None or args.num_workers is not None:
+        logger.info("--no_cuda/--gpu/--num_workers are no-ops on this "
+                    "framework: JAX selects the backend (current: %s)",
+                    _backend_name())
+
+    from code2vec_tpu.data.reader import load_corpus
+
+    config = config_from_args(args)
+    if args.synthetic is not None:
+        import tempfile
+
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+
+        if args.synthetic not in SPECS:
+            raise SystemExit(
+                f"--synthetic must be one of {sorted(SPECS)}, "
+                f"got {args.synthetic!r}")
+        synth_dir = tempfile.mkdtemp(prefix="c2v_synth_")
+        logger.info("generating %r synthetic corpus in %s", args.synthetic,
+                    synth_dir)
+        paths = generate_corpus_files(synth_dir, SPECS[args.synthetic])
+        args.corpus_path = paths["corpus"]
+        args.path_idx_path = paths["path_idx"]
+        args.terminal_idx_path = paths["terminal_idx"]
+    data = load_corpus(
+        args.corpus_path,
+        args.path_idx_path,
+        args.terminal_idx_path,
+        infer_method=args.infer_method_name,
+        infer_variable=args.infer_variable_name,
+    )
+
+    if args.find_hyperparams:
+        from code2vec_tpu.hpo import find_optimal_hyperparams
+
+        study = find_optimal_hyperparams(
+            data, config, n_trials=args.num_trials, seed=args.random_seed)
+        best = study.best_trial
+        logger.info("Number of finished trials: %d", len(study.trials))
+        logger.info("Best trial value: %s", best.value)
+        for key, value in best.params.items():
+            logger.info("    %s: %s", key, value)
+        return
+
+    from code2vec_tpu.train.loop import train
+
+    os.makedirs(args.model_path, exist_ok=True)
+    result = train(
+        config,
+        data,
+        out_dir=args.model_path,
+        vectors_path=args.vectors_path,
+        test_result_path=args.test_result_path,
+        sinks=sinks_from_args(args),
+        profile_dir=args.profile_dir,
+    )
+    logger.info("done: best_f1=%s after %d epochs", result.best_f1,
+                result.epochs_run)
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # pragma: no cover - jax always present here
+        return "unknown"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""Hyperparameter search — the Optuna-HPO equivalent, self-contained.
+
+The reference drives ``optuna.create_study(MedianPruner()).optimize``
+(main.py:429-488) with per-epoch ``trial.report(1 - f1)`` + pruning
+(main.py:207-211). Optuna is not available in this image, so this module
+implements the same surface natively:
+
+- a :class:`Study` with random sampling over the same distributions the
+  reference's objective draws from (main.py:447-449, 477-483):
+  ``encode_size`` log-int 100..300, ``dropout_prob`` 0.5..0.9,
+  ``batch_size`` log-int 256..2048, Adam ``lr`` log 1e-5..1e-1 and
+  ``weight_decay`` log 1e-10..1e-3;
+- a :class:`MedianPruner` with optuna's semantics: after
+  ``n_startup_trials`` finished trials, prune when the trial's best
+  intermediate value so far is worse than the median of prior trials'
+  intermediate values at the same step;
+- :func:`find_optimal_hyperparams`, the ``main.py --find_hyperparams``
+  entry: objective = ``1 - best_f1`` (minimized), pruning wired into the
+  train loop through its ``report_fn`` hook (which raises
+  :class:`~code2vec_tpu.train.loop.StopTraining`).
+
+The corpus is loaded ONCE and shared across trials, matching the
+reference's reader/builder reuse (main.py:431-441). Each trial still
+traces/compiles its own train step — trial dims change model shapes, so
+jit caches cannot be shared; XLA's compilation cache softens repeats.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class TrialPruned(Exception):
+    """Raised inside an objective to mark the running trial pruned."""
+
+
+@dataclass
+class FrozenTrial:
+    """Completed/pruned trial record (optuna's FrozenTrial analogue)."""
+
+    number: int
+    params: dict[str, float | int]
+    intermediates: dict[int, float] = field(default_factory=dict)
+    value: float | None = None
+    state: str = "running"  # running | complete | pruned | failed
+
+
+class MedianPruner:
+    """Prune when the trial's best intermediate so far is worse (for
+    minimization: greater) than the median of previous finished trials'
+    intermediate values at the same step.
+
+    ``n_startup_trials`` trials run unpruned first; steps below
+    ``n_warmup_steps`` never prune. Matches optuna's defaults (5 / 0).
+    """
+
+    def __init__(self, n_startup_trials: int = 5, n_warmup_steps: int = 0):
+        self.n_startup_trials = n_startup_trials
+        self.n_warmup_steps = n_warmup_steps
+
+    def should_prune(self, study: "Study", trial: FrozenTrial) -> bool:
+        if not trial.intermediates:
+            return False
+        step = max(trial.intermediates)
+        if step < self.n_warmup_steps:
+            return False
+        # optuna parity: only COMPLETE trials gate startup and feed the
+        # median (pruned trials' bad tails would skew it), and each prior
+        # trial contributes its BEST intermediate up to this step, not the
+        # raw value at the step (a trial that regressed late still counts
+        # by its early best)
+        finished = [
+            t for t in study.trials
+            if t.number != trial.number and t.state == "complete"
+        ]
+        if len(finished) < self.n_startup_trials:
+            return False
+        at_step = [
+            min(v for s, v in t.intermediates.items() if s <= step)
+            for t in finished
+            if step in t.intermediates
+        ]
+        if not at_step:
+            return False
+        best_so_far = min(trial.intermediates.values())
+        return best_so_far > float(np.median(at_step))
+
+
+class Trial:
+    """Sampling + reporting handle passed to the objective."""
+
+    def __init__(self, study: "Study", record: FrozenTrial,
+                 rng: np.random.Generator):
+        self._study = study
+        self._record = record
+        self._rng = rng
+
+    @property
+    def number(self) -> int:
+        return self._record.number
+
+    @property
+    def params(self) -> dict[str, float | int]:
+        return self._record.params
+
+    def suggest_float(self, name: str, low: float, high: float,
+                      log: bool = False) -> float:
+        if log:
+            value = math.exp(self._rng.uniform(math.log(low), math.log(high)))
+        else:
+            value = float(self._rng.uniform(low, high))
+        self._record.params[name] = value
+        return value
+
+    def suggest_int(self, name: str, low: int, high: int,
+                    log: bool = False) -> int:
+        if log:
+            value = int(round(math.exp(
+                self._rng.uniform(math.log(low), math.log(high)))))
+            value = min(max(value, low), high)
+        else:
+            value = int(self._rng.integers(low, high + 1))
+        self._record.params[name] = value
+        return value
+
+    def report(self, value: float, step: int) -> None:
+        self._record.intermediates[step] = float(value)
+
+    def should_prune(self) -> bool:
+        return self._study.pruner.should_prune(self._study, self._record)
+
+
+class Study:
+    """Minimizing random-search study with pruning."""
+
+    def __init__(self, pruner: MedianPruner | None = None, seed: int = 0):
+        self.pruner = pruner if pruner is not None else MedianPruner()
+        self.trials: list[FrozenTrial] = []
+        self._rng = np.random.default_rng(seed)
+
+    def optimize(self, objective: Callable[[Trial], float],
+                 n_trials: int) -> None:
+        for _ in range(n_trials):
+            record = FrozenTrial(number=len(self.trials), params={})
+            self.trials.append(record)
+            trial = Trial(self, record, self._rng)
+            try:
+                record.value = float(objective(trial))
+                record.state = "complete"
+            except TrialPruned:
+                # a pruned trial still scores: its best intermediate
+                record.value = (
+                    min(record.intermediates.values())
+                    if record.intermediates else None
+                )
+                record.state = "pruned"
+                logger.info("trial %d pruned at step %s", record.number,
+                            max(record.intermediates, default=None))
+            logger.info("trial %d %s value=%s params=%s", record.number,
+                        record.state, record.value, record.params)
+
+    @property
+    def best_trial(self) -> FrozenTrial:
+        scored = [t for t in self.trials
+                  if t.state == "complete" and t.value is not None]
+        if not scored:
+            raise ValueError("no completed trials")
+        return min(scored, key=lambda t: t.value)
+
+    @property
+    def best_value(self) -> float:
+        return self.best_trial.value
+
+    @property
+    def best_params(self) -> dict[str, float | int]:
+        return self.best_trial.params
+
+
+def sample_train_config(trial: Trial, base_config):
+    """Draw the reference's search space into a TrainConfig
+    (main.py:447-449 for dims, 477-483 for Adam)."""
+    return base_config.with_updates(
+        encode_size=trial.suggest_int("encode_size", 100, 300, log=True),
+        dropout_prob=trial.suggest_float("dropout_prob", 0.5, 0.9),
+        batch_size=trial.suggest_int("batch_size", 256, 2048, log=True),
+        lr=trial.suggest_float("adam_lr", 1e-5, 1e-1, log=True),
+        weight_decay=trial.suggest_float(
+            "adam_weight_decay", 1e-10, 1e-3, log=True),
+    )
+
+
+def find_optimal_hyperparams(
+    data,
+    base_config,
+    n_trials: int = 100,
+    seed: int = 0,
+    pruner: MedianPruner | None = None,
+) -> Study:
+    """The ``--find_hyperparams`` entry (reference: main.py:429-488).
+
+    Each trial trains with the sampled config; per-epoch ``1 - f1`` is
+    reported for median pruning (reference: main.py:207-211), and the
+    objective value is ``1 - best_f1``. Checkpoint/vector export is
+    suppressed during search, as in the reference (``trial is not None``
+    guards, main.py:226-231).
+    """
+    from code2vec_tpu.train.loop import StopTraining, train
+
+    def objective(trial: Trial) -> float:
+        config = sample_train_config(trial, base_config)
+        logger.info("trial %d config: %s", trial.number, trial.params)
+        pruned = False
+
+        def report_fn(epoch: int, f1: float) -> None:
+            nonlocal pruned
+            trial.report(1.0 - f1, epoch)
+            if trial.should_prune():
+                pruned = True
+                raise StopTraining  # caught by the train loop; ends the run
+
+        result = train(config, data, report_fn=report_fn)
+        if pruned:
+            raise TrialPruned
+        return 1.0 - result.best_f1
+
+    study = Study(pruner=pruner, seed=seed)
+    study.optimize(objective, n_trials)
+    best = study.best_trial
+    logger.info("best trial: #%d value=%s params=%s", best.number, best.value,
+                best.params)
+    return study

@@ -1,0 +1,62 @@
+"""Metric sinks — the reference's three observability backends
+(reference: main.py:183-205): stdlib logging (``train.loop.logging_sink``),
+Floyd-style JSON lines on stdout, and TensorBoard scalars.
+
+Sinks are plain callables ``(epoch, metrics_dict) -> None`` so the train
+loop stays backend-agnostic; compose any number of them via the ``sinks``
+tuple of :func:`code2vec_tpu.train.loop.train`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+MetricSink = Callable[[int, dict[str, float]], None]
+
+
+def logging_sink(epoch: int, metrics: dict[str, float]) -> None:
+    """Per-epoch JSON metric lines through stdlib logging — the default
+    sink (reference emits the same shape, main.py:183-205)."""
+    logger.info("epoch %d", epoch)
+    for name, value in metrics.items():
+        logger.info('{"metric": "%s", "value": %s}', name, value)
+
+
+def floyd_sink(epoch: int, metrics: dict[str, float]) -> None:
+    """One ``{"metric": name, "value": value}`` JSON line per metric on
+    stdout (reference ``--env floyd``, main.py:183-190)."""
+    for name, value in metrics.items():
+        sys.stdout.write(json.dumps({"metric": name, "value": value}) + "\n")
+    sys.stdout.flush()
+
+
+def tensorboard_sink(log_dir: str) -> MetricSink:
+    """TensorBoard scalar sink (reference ``--env tensorboard``,
+    main.py:152-155,199-205): each metric becomes a scalar series keyed by
+    its name, stepped by epoch.
+
+    Requires ``tensorboardX`` (present in this image); raises ImportError
+    with a clear message otherwise — the import is deferred exactly like the
+    reference's lazy ``--env``-gated import (main.py:87-88).
+    """
+    try:
+        from tensorboardX import SummaryWriter
+    except ImportError as e:  # pragma: no cover - env without tensorboardX
+        raise ImportError(
+            "tensorboard_sink requires tensorboardX; install it or drop "
+            "--env tensorboard"
+        ) from e
+
+    writer = SummaryWriter(log_dir)
+
+    def sink(epoch: int, metrics: dict[str, float]) -> None:
+        for name, value in metrics.items():
+            writer.add_scalar(name, value, epoch)
+        writer.flush()
+
+    return sink

@@ -28,6 +28,7 @@ from code2vec_tpu.data.pipeline import build_epoch, iter_batches, oov_rate, spli
 from code2vec_tpu.data.reader import CorpusData
 from code2vec_tpu.metrics import evaluate
 from code2vec_tpu.models.code2vec import Code2VecConfig
+from code2vec_tpu.sinks import MetricSink, logging_sink  # re-export: canonical home is sinks
 from code2vec_tpu.train.config import TrainConfig
 from code2vec_tpu.train.step import (
     create_train_state,
@@ -84,15 +85,6 @@ def class_weights_from(config: TrainConfig, data: CorpusData) -> jnp.ndarray:
     return jnp.asarray(1.0 / np.maximum(freq, 1.0))
 
 
-MetricSink = Callable[[int, dict[str, float]], None]
-
-
-def logging_sink(epoch: int, metrics: dict[str, float]) -> None:
-    """Per-epoch JSON metric lines (reference emits the same shape,
-    main.py:183-205)."""
-    logger.info("epoch %d", epoch)
-    for name, value in metrics.items():
-        logger.info('{"metric": "%s", "value": %s}', name, value)
 
 
 def train(

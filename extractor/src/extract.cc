@@ -67,7 +67,8 @@ const std::set<std::string> kTypeKinds = {
     "WildcardType", "UnionType", "IntersectionType", "TypeParameter"};
 const std::set<std::string> kNameKinds = {"Name", "SimpleName"};
 const std::set<std::string> kLeafStatementKinds = {
-    "BreakStmt", "ReturnStmt", "ContinueStmt", "SwitchEntryStmt", "EmptyStmt"};
+    "BreakStmt", "ReturnStmt", "ContinueStmt", "SwitchEntryStmt", "EmptyStmt",
+    "ExplicitConstructorInvocationStmt"};  // zero-arg this()/super()
 
 // scope-closing node types (cell6's big isInstanceOf disjunction)
 const std::set<std::string> kScopeClosers = {

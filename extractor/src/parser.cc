@@ -593,6 +593,16 @@ class Parser {
   JNodePtr parse_statement() {
     if (at("{")) return parse_block();
     if (at(";")) { next(); return make("EmptyStmt"); }
+    if ((at_ident("this") || at_ident("super")) &&
+        peek().kind == Tok::kPunct && peek().text == "(") {
+      // constructor chaining: this(...) / super(...)
+      auto s = make("ExplicitConstructorInvocationStmt");
+      s->text = cur().text;  // which form was chained
+      next();
+      parse_arguments_into(s.get());
+      expect(";");
+      return s;
+    }
     if (at_ident("if")) {
       next();
       auto s = make("IfStmt");
@@ -1102,6 +1112,14 @@ class Parser {
         continue;
       }
       if (at("[")) {
+        if (peek().kind == Tok::kPunct && peek().text == "]") {
+          // array-type method-reference prefix: String[]::new
+          next(); expect("]");
+          auto at_node = make("ArrayType");
+          at_node->add(std::move(e));
+          e = std::move(at_node);
+          continue;
+        }
         next();
         auto ae = make("ArrayAccessExpr");
         ae->add(std::move(e));
@@ -1114,7 +1132,7 @@ class Parser {
         next();
         auto mr = make("MethodReferenceExpr");
         mr->add(std::move(e));
-        mr->text = at_ident("new") ? "new" : expect_ident_or_new();
+        mr->text = expect_ident_or_new();
         e = std::move(mr);
         continue;
       }

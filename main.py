@@ -1,0 +1,9 @@
+"""Drop-in entry-point shim: ``python main.py <flags>`` works exactly like
+the reference repo's invocation (reference: main.py:494-502); the real
+driver lives in :mod:`code2vec_tpu.cli`.
+"""
+
+from code2vec_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

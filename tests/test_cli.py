@@ -1,0 +1,136 @@
+"""CLI driver tests: flag surface parity (reference main.py:37-81), flag →
+TrainConfig mapping, metric sinks, and an end-to-end smoke train through
+``main()`` on a synthetic corpus."""
+
+import json
+import os
+
+import pytest
+
+from code2vec_tpu.cli import build_parser, config_from_args, main, sinks_from_args
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.sinks import floyd_sink
+
+
+@pytest.fixture(scope="module")
+def corpus_files(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cli_corpus")
+    return generate_corpus_files(out, SPECS["tiny"])
+
+
+# every flag the reference's argparse block defines (main.py:37-81)
+REFERENCE_FLAGS = [
+    "random_seed", "corpus_path", "path_idx_path", "terminal_idx_path",
+    "batch_size", "terminal_embed_size", "path_embed_size", "encode_size",
+    "max_path_length", "model_path", "vectors_path", "test_result_path",
+    "max_epoch", "lr", "beta_min", "beta_max", "weight_decay",
+    "dropout_prob", "no_cuda", "gpu", "num_workers", "env",
+    "print_sample_cycle", "eval_method", "find_hyperparams", "num_trials",
+    "angular_margin_loss", "angular_margin", "inverse_temp",
+    "infer_method_name", "infer_variable_name", "shuffle_variable_indexes",
+]
+
+
+class TestFlagSurface:
+    def test_every_reference_flag_exists(self):
+        args = build_parser().parse_args([])
+        for flag in REFERENCE_FLAGS:
+            assert hasattr(args, flag), f"missing reference flag --{flag}"
+
+    def test_reference_defaults_preserved(self):
+        args = build_parser().parse_args([])
+        assert args.random_seed == 123
+        assert args.batch_size == 32
+        assert args.encode_size == 300
+        assert args.max_path_length == 200
+        assert args.lr == 0.01
+        assert args.dropout_prob == 0.25
+        assert args.max_epoch == 40
+        assert args.eval_method == "subtoken"
+        assert args.angular_margin == 0.5
+        assert args.inverse_temp == 30.0
+        assert args.infer_method_name is True
+        assert args.infer_variable_name is False
+
+    def test_strtobool_flags(self):
+        parser = build_parser()
+        args = parser.parse_args(
+            ["--infer_method_name", "False", "--infer_variable_name", "true"])
+        assert args.infer_method_name is False
+        assert args.infer_variable_name is True
+        with pytest.raises(SystemExit):
+            parser.parse_args(["--infer_method_name", "maybe"])
+
+    def test_config_mapping(self):
+        args = build_parser().parse_args([
+            "--encode_size", "64", "--lr", "0.005",
+            "--angular_margin_loss", "--compute_dtype", "bfloat16",
+            "--data_axis", "4",
+        ])
+        config = config_from_args(args)
+        assert config.encode_size == 64
+        assert config.lr == 0.005
+        assert config.angular_margin_loss is True
+        assert config.compute_dtype == "bfloat16"
+        assert config.data_axis == 4
+
+
+class TestSinks:
+    def test_floyd_sink_emits_json_lines(self, capsys):
+        floyd_sink(3, {"train_loss": 1.5, "f1": 0.25})
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+        assert {"metric": "train_loss", "value": 1.5} in lines
+        assert {"metric": "f1", "value": 0.25} in lines
+
+    def test_sink_selection(self):
+        args = build_parser().parse_args([])
+        assert len(sinks_from_args(args)) == 1
+        args = build_parser().parse_args(["--env", "floyd"])
+        assert floyd_sink in sinks_from_args(args)
+
+    def test_tensorboard_sink_writes_events(self, tmp_path):
+        pytest.importorskip("tensorboardX")
+        args = build_parser().parse_args(
+            ["--env", "tensorboard", "--tensorboard_dir", str(tmp_path)])
+        sinks = sinks_from_args(args)
+        sinks[-1](0, {"f1": 0.5})
+        assert any(f.startswith("events") for f in os.listdir(tmp_path))
+
+
+class TestEndToEnd:
+    def test_main_trains_and_writes_artifacts(self, corpus_files, tmp_path):
+        out = tmp_path / "out"
+        main([
+            "--corpus_path", corpus_files["corpus"],
+            "--path_idx_path", corpus_files["path_idx"],
+            "--terminal_idx_path", corpus_files["terminal_idx"],
+            "--model_path", str(out),
+            "--vectors_path", str(out / "code.vec"),
+            "--max_epoch", "2",
+            "--encode_size", "32",
+            "--terminal_embed_size", "16",
+            "--path_embed_size", "16",
+            "--max_path_length", "16",
+            "--batch_size", "32",
+            "--print_sample_cycle", "0",
+        ])
+        assert (out / "code.vec").exists()
+
+    def test_main_hpo_path(self, corpus_files, tmp_path, monkeypatch):
+        # wire-up only: 1 trial, 1 epoch; shrink the sampled space
+        import code2vec_tpu.hpo as hpo_mod
+
+        monkeypatch.setattr(
+            hpo_mod, "sample_train_config",
+            lambda trial, cfg: cfg.with_updates(
+                encode_size=trial.suggest_int("encode_size", 8, 16, log=True)),
+        )
+        main([
+            "--corpus_path", corpus_files["corpus"],
+            "--path_idx_path", corpus_files["path_idx"],
+            "--terminal_idx_path", corpus_files["terminal_idx"],
+            "--find_hyperparams", "--num_trials", "1",
+            "--max_epoch", "1",
+            "--terminal_embed_size", "8", "--path_embed_size", "8",
+            "--max_path_length", "8", "--batch_size", "16",
+        ])

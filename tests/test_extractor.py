@@ -350,3 +350,29 @@ class TestDatasetCLI:
         assert (ds_dir / "actual_methods.txt").read_text().count("\n") == 12
         decls = (ds_dir / "method_declarations.txt").read_text()
         assert "computeTotal" in decls
+
+
+class TestConstructorChainingAndMethodRefs:
+    """Regression: these constructs previously failed the whole file
+    (parser.cc parse_statement / parse_postfix)."""
+
+    def test_super_invocation_with_args(self):
+        src = "class B extends A { B(int x) { super(x); } void f() { g(); } }"
+        assert [m.label for m in extract_source(src, "f").methods] == ["f"]
+
+    def test_zero_arg_super_and_this_chain(self):
+        src = "class C { C() { this(1); } C(int x) { super(); } void f() { h(); } }"
+        assert [m.label for m in extract_source(src, "f").methods] == ["f"]
+
+    def test_constructor_reference(self):
+        result = extract_source("class A { void f() { g(Runnable::new); } }", "f")
+        assert any(
+            "MethodReferenceExpr" in p for p in result.path_vocab.values()
+        )
+
+    def test_array_constructor_reference(self):
+        result = extract_source("class A { void f() { g(String[]::new); } }", "f")
+        assert any(
+            "MethodReferenceExpr↓ArrayType" in p
+            for p in result.path_vocab.values()
+        )

@@ -1,0 +1,180 @@
+"""HPO engine tests: sampling distributions, median-pruner semantics
+(optuna MedianPruner parity), and an end-to-end tiny search through the
+real train loop (reference flow: main.py:429-488, 207-211)."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.hpo import (
+    FrozenTrial,
+    MedianPruner,
+    Study,
+    Trial,
+    TrialPruned,
+    find_optimal_hyperparams,
+    sample_train_config,
+)
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.train.config import TrainConfig
+
+
+@pytest.fixture(scope="module")
+def tiny_corpus(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tiny_hpo")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    return load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+
+
+def _trial(study: Study, seed: int = 0) -> Trial:
+    record = FrozenTrial(number=len(study.trials), params={})
+    study.trials.append(record)
+    return Trial(study, record, np.random.default_rng(seed))
+
+
+class TestSampling:
+    def test_reference_search_space_ranges(self):
+        study = Study(seed=7)
+        base = TrainConfig()
+        for seed in range(50):
+            config = sample_train_config(_trial(study, seed), base)
+            assert 100 <= config.encode_size <= 300
+            assert 0.5 <= config.dropout_prob <= 0.9
+            assert 256 <= config.batch_size <= 2048
+            assert 1e-5 <= config.lr <= 1e-1
+            assert 1e-10 <= config.weight_decay <= 1e-3
+
+    def test_log_sampling_spans_orders_of_magnitude(self):
+        study = Study()
+        lrs = [
+            _trial(study, s).suggest_float("lr", 1e-5, 1e-1, log=True)
+            for s in range(200)
+        ]
+        # log-uniform: both the bottom and top decade should be populated
+        assert any(lr < 1e-4 for lr in lrs)
+        assert any(lr > 1e-2 for lr in lrs)
+
+    def test_suggest_records_params(self):
+        study = Study()
+        trial = _trial(study)
+        trial.suggest_int("encode_size", 100, 300, log=True)
+        assert "encode_size" in trial.params
+
+
+class TestMedianPruner:
+    def _finished(self, number, values, state="complete"):
+        return FrozenTrial(
+            number=number,
+            params={},
+            intermediates=dict(enumerate(values)),
+            value=values[-1],
+            state=state,
+        )
+
+    def test_no_prune_during_startup_trials(self):
+        study = Study(pruner=MedianPruner(n_startup_trials=5))
+        for i in range(4):
+            study.trials.append(self._finished(i, [0.1]))
+        trial = _trial(study)
+        trial.report(9.9, 0)
+        assert not trial.should_prune()
+
+    def test_prunes_below_median(self):
+        study = Study(pruner=MedianPruner(n_startup_trials=2))
+        for i, v in enumerate([0.1, 0.2, 0.3]):
+            study.trials.append(self._finished(i, [v, v]))
+        bad = _trial(study)
+        bad.report(0.9, 0)
+        assert bad.should_prune()
+        good = _trial(study)
+        good.report(0.05, 0)
+        assert not good.should_prune()
+
+    def test_uses_best_intermediate_so_far(self):
+        # a trial that was once better than the median survives a bad epoch
+        study = Study(pruner=MedianPruner(n_startup_trials=1))
+        study.trials.append(self._finished(0, [0.5, 0.5]))
+        trial = _trial(study)
+        trial.report(0.1, 0)
+        trial.report(0.9, 1)
+        assert not trial.should_prune()
+
+    def test_median_pool_uses_prior_trials_best_up_to_step(self):
+        # a completed trial that regressed late ({0: 0.1, 1: 0.9})
+        # contributes its best 0.1 to the median at step 1, so a 0.5 trial
+        # is pruned (optuna semantics)
+        study = Study(pruner=MedianPruner(n_startup_trials=1))
+        study.trials.append(self._finished(0, [0.1, 0.9]))
+        trial = _trial(study)
+        trial.report(0.5, 1)
+        assert trial.should_prune()
+
+    def test_pruned_trials_excluded_from_median_pool(self):
+        study = Study(pruner=MedianPruner(n_startup_trials=1))
+        study.trials.append(self._finished(0, [0.2, 0.2]))
+        study.trials.append(self._finished(1, [0.9, 0.9], state="pruned"))
+        trial = _trial(study)
+        trial.report(0.5, 1)  # above complete-median 0.2; pruned-0.9 ignored
+        assert trial.should_prune()
+
+    def test_warmup_steps_block_pruning(self):
+        study = Study(pruner=MedianPruner(n_startup_trials=1, n_warmup_steps=3))
+        study.trials.append(self._finished(0, [0.1, 0.1]))
+        trial = _trial(study)
+        trial.report(0.9, 1)
+        assert not trial.should_prune()
+
+
+class TestStudy:
+    def test_optimize_tracks_best(self):
+        study = Study(seed=3)
+        values = iter([0.7, 0.2, 0.5])
+        study.optimize(lambda t: next(values), n_trials=3)
+        assert study.best_value == 0.2
+        assert study.best_trial.number == 1
+
+    def test_pruned_trials_are_recorded_not_best(self):
+        study = Study(seed=3)
+
+        def objective(trial):
+            if trial.number == 0:
+                trial.report(0.9, 0)
+                raise TrialPruned
+            return 0.4
+
+        study.optimize(objective, n_trials=2)
+        assert study.trials[0].state == "pruned"
+        assert study.trials[0].value == pytest.approx(0.9)
+        assert study.best_trial.number == 1
+
+
+class TestEndToEnd:
+    def test_tiny_search_runs_and_prunes_wire_up(self, tiny_corpus):
+        # 2 trials x 2 epochs through the real jitted train loop; shrink the
+        # space so shapes stay tiny (the sampler is exercised by TestSampling)
+        base = TrainConfig(
+            max_epoch=2,
+            batch_size=16,
+            max_path_length=16,
+            terminal_embed_size=8,
+            path_embed_size=8,
+            print_sample_cycle=0,
+            early_stop_patience=100,
+        )
+        import code2vec_tpu.hpo as hpo_mod
+
+        original = hpo_mod.sample_train_config
+        hpo_mod.sample_train_config = lambda trial, cfg: cfg.with_updates(
+            encode_size=trial.suggest_int("encode_size", 8, 16, log=True),
+            lr=trial.suggest_float("adam_lr", 1e-3, 1e-2, log=True),
+        )
+        try:
+            study = find_optimal_hyperparams(
+                tiny_corpus, base, n_trials=2, seed=0)
+        finally:
+            hpo_mod.sample_train_config = original
+        assert len(study.trials) == 2
+        assert all(t.state in ("complete", "pruned") for t in study.trials)
+        best = study.best_trial
+        assert 0.0 <= best.value <= 1.0
+        assert best.intermediates  # per-epoch 1-f1 reports got recorded

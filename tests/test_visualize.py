@@ -1,0 +1,43 @@
+"""Visualization-layer tests (reference parity: visualize_code_vec.py)."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.formats.vectors_io import (
+    append_code_vectors,
+    write_code_vectors_header,
+)
+from code2vec_tpu.visualize import visualize_code_vectors, write_projector_tsv
+
+
+@pytest.fixture()
+def code_vec(tmp_path):
+    path = tmp_path / "code.vec"
+    vectors = np.asarray([[0.5, -1.25, 3.0], [1.0, 2.0, -0.5]], np.float32)
+    write_code_vectors_header(path, 2, 3)
+    append_code_vectors(path, ["getName", "setValue"], vectors)
+    return path, vectors
+
+
+class TestProjectorTSV:
+    def test_round_trip(self, tmp_path, code_vec):
+        path, vectors = code_vec
+        out = visualize_code_vectors(path, tmp_path / "runs")
+        loaded = np.loadtxt(out["vectors"], delimiter="\t")
+        np.testing.assert_allclose(loaded, vectors)
+        labels = (tmp_path / "runs" / "metadata.tsv").read_text().splitlines()
+        assert labels == ["getName", "setValue"]
+        config = (tmp_path / "runs" / "projector_config.pbtxt").read_text()
+        assert "vectors.tsv" in config and "metadata.tsv" in config
+
+    def test_labels_with_tabs_sanitized(self, tmp_path):
+        out = write_projector_tsv(
+            tmp_path, ["a\tb"], np.zeros((1, 2), np.float32))
+        assert (tmp_path / "metadata.tsv").read_text() == "a b\n"
+
+    def test_cli_entry(self, tmp_path, code_vec):
+        from code2vec_tpu.visualize import main
+
+        path, _ = code_vec
+        main([str(path), "--log_dir", str(tmp_path / "viz")])
+        assert (tmp_path / "viz" / "vectors.tsv").exists()

@@ -1,0 +1,9 @@
+"""Drop-in shim matching the reference's ``python visualize_code_vec.py``
+entry (reference: visualize_code_vec.py:1-23); the implementation lives in
+:mod:`code2vec_tpu.visualize`.
+"""
+
+from code2vec_tpu.visualize import main
+
+if __name__ == "__main__":
+    main()
