@@ -5,9 +5,11 @@ establishes/extends the baseline).
 Setup mirrors the reference's top11 recipe (README.md:34 — batch 1024,
 embed 100/100, encode 100) at the top11 corpus scale (605,945 methods,
 360,631 terminals, 342,845 paths — top11_dataset/params.txt), with bf16
-compute on TPU. The measured path is the real one: vectorized host epoch
-pipeline slicing static [1024, 200] batches feeding the jitted train step.
-Accounting matches the reference's work per step: B x L context slots.
+compute on TPU. The measured path is the flagship one: the corpus staged to
+device memory once (CSR), per-epoch context subsampling on device, and
+scanned chunks of [1024, 200] train steps per dispatch
+(train/device_epoch.py). Accounting matches the reference's work per step:
+B x L context slots.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline compares against the newest BENCH_r*.json in the repo (1.0 on
@@ -49,18 +51,19 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+    from code2vec_tpu.data.pipeline import iter_batches, build_method_epoch
     from code2vec_tpu.data.reader import CorpusData
     from code2vec_tpu.data.synth import SynthSpec, generate_corpus_data
     from code2vec_tpu.data.vocab import Vocab
     from code2vec_tpu.models.code2vec import Code2VecConfig
     from code2vec_tpu.train.config import TrainConfig
-    from code2vec_tpu.train.step import create_train_state, make_train_step
+    from code2vec_tpu.train.device_epoch import EpochRunner, stage_method_corpus
+    from code2vec_tpu.train.step import create_train_state
 
     batch_size = int(os.environ.get("BENCH_BATCH", 1024))
     bag = int(os.environ.get("BENCH_BAG", 200))
     steps = int(os.environ.get("BENCH_STEPS", 60))
-    warmup = 5
+    warmup = int(os.environ.get("BENCH_WARMUP_CHUNKS", 5))
 
     # top11-scale synthetic corpus, shrunk in method count (the throughput
     # metric depends on vocab/model/batch shape, not corpus length); vocab
@@ -112,25 +115,39 @@ def main() -> None:
     config = TrainConfig(batch_size=batch_size, max_path_length=bag)
 
     rng = np.random.default_rng(0)
-    epoch = build_method_epoch(data, np.arange(data.n_items), bag, rng)
-
+    epoch = build_method_epoch(data, np.arange(batch_size), bag, rng)
     example = next(iter_batches(epoch, batch_size, rng=rng, pad_final=False))
     state = create_train_state(config, model_config, jax.random.PRNGKey(0), example)
     class_weights = jnp.ones(model_config.label_count, jnp.float32)
-    train_step = make_train_step(model_config, class_weights)
 
-    def batches():
-        while True:
-            yield from iter_batches(epoch, batch_size, rng=rng, pad_final=False)
+    # the measured path is the flagship one: corpus staged to device memory
+    # once, per-epoch context sampling on device, scanned chunks of batches
+    # per dispatch (train/device_epoch.py)
+    chunk = int(os.environ.get("BENCH_CHUNK", 16))
+    runner = EpochRunner(model_config, class_weights, batch_size, bag, chunk)
+    staged = stage_method_corpus(data, np.arange(data.n_items), rng)
+    run_chunk = runner._train_chunk(chunk)
+    n_valid = chunk * batch_size
 
-    it = batches()
-    for _ in range(warmup):
-        state, loss = train_step(state, next(it))
+    def run(state, key):
+        rows = rng.integers(0, data.n_items, n_valid).astype(np.int32)
+        key, sub = jax.random.split(key)
+        state, loss = run_chunk(
+            state, staged.contexts, staged.row_splits, staged.labels,
+            rows, n_valid, sub,
+        )
+        return state, loss, key
+
+    key = jax.random.PRNGKey(1)
+    for _ in range(max(warmup, 2)):  # chunks, not steps; includes compile
+        state, loss, key = run(state, key)
     jax.block_until_ready(loss)
 
+    n_chunks = -(-steps // chunk)
+    steps = n_chunks * chunk
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = train_step(state, next(it))
+    for _ in range(n_chunks):
+        state, loss, key = run(state, key)
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
 
@@ -156,7 +173,7 @@ def main() -> None:
                     "steps_per_sec": round(steps / elapsed, 3),
                     "batch": batch_size,
                     "bag": bag,
-                    "final_loss": float(loss),
+                    "final_chunk_loss_sum": float(loss),  # sum over BENCH_CHUNK batch losses
                     "compute_dtype": str(model_config.dtype.__name__ if hasattr(model_config.dtype, "__name__") else model_config.dtype),
                 }
             }

@@ -121,6 +121,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="mesh model-parallel axis size (shards vocab tables)")
     parser.add_argument("--context_axis", type=int, default=1,
                         help="mesh context-parallel axis size (shards the bag)")
+    parser.add_argument("--device_epoch", action="store_true", default=False,
+                        help="stage the corpus in device memory and run "
+                        "scanned chunks of batches per dispatch "
+                        "(method task, single device)")
+    parser.add_argument("--device_chunk_batches", type=int, default=16,
+                        help="batches per device-epoch dispatch")
     parser.add_argument("--class_weighting", type=str, default="reference",
                         choices=("reference", "occurrence", "none"))
     parser.add_argument("--resume", action="store_true", default=False,
@@ -163,6 +169,8 @@ def config_from_args(args: argparse.Namespace):
         context_axis=args.context_axis,
         use_pallas=args.use_pallas,
         resume=args.resume,
+        device_epoch=args.device_epoch,
+        device_chunk_batches=args.device_chunk_batches,
     )
 
 
@@ -229,6 +237,9 @@ def main(argv: list[str] | None = None) -> None:
     from code2vec_tpu.train.loop import train
 
     os.makedirs(args.model_path, exist_ok=True)
+    for out_file in (args.vectors_path, args.test_result_path):
+        if out_file and os.path.dirname(out_file):
+            os.makedirs(os.path.dirname(out_file), exist_ok=True)
     result = train(
         config,
         data,

@@ -54,6 +54,27 @@ def split_items(
     return perm[test_count:], perm[:test_count]
 
 
+def flat_context_indices(
+    row_splits: np.ndarray, item_idx: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized CSR row gather: for the selected items, the flat indices
+    of all their contexts plus each context's (segment, position-in-segment).
+
+    Returns ``(flat, seg, within)``, each of length ``counts.sum()``. Shared
+    by the host epoch builder and device staging (train/device_epoch.py).
+    """
+    counts = (row_splits[item_idx + 1] - row_splits[item_idx]).astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        empty = np.zeros(0, np.int64)
+        return empty, empty, empty
+    seg = np.repeat(np.arange(len(item_idx), dtype=np.int64), counts)
+    seg_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    within = np.arange(total, dtype=np.int64) - np.repeat(seg_starts, counts)
+    flat = np.repeat(row_splits[item_idx], counts) + within
+    return flat, seg, within
+
+
 def _segment_subsample(
     row_splits: np.ndarray,
     item_idx: np.ndarray,
@@ -70,17 +91,10 @@ def _segment_subsample(
     context, stably sort by (segment, uniform), keep the first L positions
     of each segment.
     """
-    counts = (row_splits[item_idx + 1] - row_splits[item_idx]).astype(np.int64)
-    total = int(counts.sum())
+    flat, seg, within = flat_context_indices(row_splits, item_idx)
+    total = len(flat)
     if total == 0:
-        empty = np.zeros(0, np.int64)
-        return empty, empty, empty
-
-    seg = np.repeat(np.arange(len(item_idx), dtype=np.int64), counts)
-    # absolute flat index of every context of every selected item
-    seg_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
-    within = np.arange(total, dtype=np.int64) - np.repeat(seg_starts, counts)
-    flat = np.repeat(row_splits[item_idx], counts) + within
+        return flat, seg, within
 
     order = np.lexsort((rng.random(total), seg))
     # after the stable per-segment sort the segment layout is unchanged,

@@ -61,5 +61,13 @@ class TrainConfig:
     # SURVEY.md §5.4)
     resume: bool = False
 
+    # device-resident epochs (train/device_epoch.py): stage the corpus in
+    # HBM once and run whole scanned chunks of batches per dispatch, with
+    # per-epoch context sampling on device. Biggest win when host->device
+    # bandwidth is the bottleneck. Method-name task on a single device only;
+    # other configurations fall back to the host pipeline.
+    device_epoch: bool = False
+    device_chunk_batches: int = 16
+
     def with_updates(self, **kw) -> "TrainConfig":
         return replace(self, **kw)

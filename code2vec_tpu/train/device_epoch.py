@@ -1,0 +1,320 @@
+"""Device-resident epochs: HBM-staged corpus, on-device sampling, scanned steps.
+
+The host pipeline (data/pipeline.py) rebuilds `[N, L]` epoch tensors in numpy
+and ships one `[B, L]` batch per step to the device. That reproduces the
+reference's data flow (model/dataset_builder.py:112-210 + DataLoader,
+main.py:162-172), but on TPU the per-step host->device transfer is pure
+overhead: the *corpus* is static across epochs, and the per-epoch work —
+context subsampling, `@method_0 -> @question` substitution, batch assembly —
+is all gather/where arithmetic the TPU does in microseconds.
+
+So this module moves the whole epoch on-device:
+
+- ``stage_method_corpus``: one-time transfer of the CSR context arrays
+  (interleaved ``[total, 3]`` so each batch slot is a single 12-byte row
+  gather), with the ``@question`` substitution (model/dataset_builder.py:
+  122-144) pre-applied and each method's contexts pre-shuffled host-side.
+- ``make_epoch_runner``: jitted ``lax.scan`` over whole chunks of batches.
+  Each scan iteration samples a fresh context window per method and runs the
+  *same* raw train step the per-batch path uses (train/step.py) — one
+  dispatch per ~16 batches instead of one transfer + dispatch per batch.
+  Per-epoch traffic is a `[N]` int32 permutation and a PRNG key.
+
+Sampling semantics vs the reference: the reference shuffles each method's
+context list every epoch and keeps the first L (model/dataset_builder.py:
+134-135) — a uniform sample without replacement. Here each method's contexts
+are shuffled once at staging, and each epoch takes a random *rotation window*
+of length L: ``ctx[(shift + j) % n]``. For methods with ``n <= L`` (the
+common case) both schemes take every context, and attention pooling is
+permutation-invariant, so they are equivalent. For ``n > L`` the window keeps
+uniform per-context inclusion probability ``L/n`` without duplicates, but
+adjacent (post-shuffle) contexts co-occur; the host pipeline remains the
+exact-parity path. Re-staging (with a different shuffle seed) redraws the
+within-method order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX
+from code2vec_tpu.data.pipeline import flat_context_indices
+from code2vec_tpu.data.reader import CorpusData
+from code2vec_tpu.models.code2vec import Code2VecConfig
+from code2vec_tpu.train.step import build_eval_step_fn, build_train_step_fn
+
+
+@dataclass
+class StagedCorpus:
+    """Device-resident method-task corpus (CSR, interleaved contexts)."""
+
+    contexts: jax.Array  # int32 [total, 3] — (start, path, end), @question applied
+    row_splits: jax.Array  # int32 [n_items + 1]
+    labels: jax.Array  # int32 [n_items]
+    n_items: int
+
+    @property
+    def n_contexts(self) -> int:
+        return int(self.contexts.shape[0])
+
+
+def _per_row_shuffle(
+    total: int, row_splits: np.ndarray, rng: np.random.Generator
+) -> np.ndarray:
+    """A permutation of [0, total) that shuffles within each CSR row only.
+
+    Vectorized: sort (row_id, uniform) pairs — stable layout per row, random
+    order within. O(total log total) once at staging.
+    """
+    row_ids = np.repeat(
+        np.arange(len(row_splits) - 1, dtype=np.int64), np.diff(row_splits)
+    )
+    return np.lexsort((rng.random(total), row_ids))
+
+
+def stage_method_corpus(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    rng: np.random.Generator,
+    device: Any | None = None,
+) -> StagedCorpus:
+    """Stage the selected items' contexts into device memory.
+
+    ``item_idx`` is the train (or test) split; only those rows are shipped.
+    The method's own anonymized token is replaced by ``@question`` here, once,
+    instead of per epoch (same global substitution the host pipeline applies,
+    model/dataset_builder.py:122-144 — ``@method_0`` is a single vocab id).
+    """
+    counts = np.diff(data.row_splits)[item_idx]
+    new_splits = np.zeros(len(item_idx) + 1, np.int64)
+    np.cumsum(counts, out=new_splits[1:])
+    total = int(new_splits[-1])
+    if total >= 2**31:
+        raise ValueError(
+            f"staged corpus has {total} contexts; device row_splits are "
+            "int32 — stage a subset (or shard the corpus over hosts)"
+        )
+
+    # flat indices of every context of every selected item, in item order
+    flat, _, _ = flat_context_indices(data.row_splits, item_idx)
+
+    contexts = np.empty((total, 3), np.int32)
+    contexts[:, 0] = data.starts[flat]
+    contexts[:, 1] = data.paths[flat]
+    contexts[:, 2] = data.ends[flat]
+
+    method_idx = data.method_token_index
+    if method_idx is not None:
+        terms = contexts[:, (0, 2)]
+        np.putmask(terms, terms == method_idx, QUESTION_TOKEN_INDEX)
+        contexts[:, (0, 2)] = terms
+
+    contexts = contexts[_per_row_shuffle(total, new_splits, rng)]
+
+    put = partial(jax.device_put, device=device)
+    return StagedCorpus(
+        contexts=put(contexts),
+        row_splits=put(new_splits.astype(np.int32)),
+        labels=put(data.labels[item_idx].astype(np.int32)),
+        n_items=len(item_idx),
+    )
+
+
+def _sample_batch(
+    corpus_contexts: jax.Array,  # [total, 3]
+    row_splits: jax.Array,  # [n_items + 1]
+    labels: jax.Array,  # [n_items]
+    rows: jax.Array,  # int32 [B] item indices (may repeat for padding)
+    row_valid: jax.Array,  # f32 [B] example mask
+    bag: int,
+    key: jax.Array,
+) -> dict[str, jax.Array]:
+    """Assemble one [B, bag] batch on device: rotation-window subsample."""
+    batch_size = rows.shape[0]
+    off = row_splits[rows]  # [B]
+    n = row_splits[rows + 1] - off  # [B]
+    n_safe = jnp.maximum(n, 1)[:, None]  # [B, 1]
+
+    shift = jax.random.randint(key, (batch_size, 1), 0, 1 << 30)
+    j = jnp.arange(bag, dtype=jnp.int32)[None, :]  # [1, bag]
+    idx = (j + shift % n_safe) % n_safe  # [B, bag]
+    valid = j < jnp.minimum(n, bag)[:, None]  # [B, bag]
+
+    trip = corpus_contexts[jnp.where(valid, off[:, None] + idx, 0)]  # [B, bag, 3]
+    pad = jnp.int32(PAD_INDEX)
+    return {
+        "starts": jnp.where(valid, trip[..., 0], pad),
+        "paths": jnp.where(valid, trip[..., 1], pad),
+        "ends": jnp.where(valid, trip[..., 2], pad),
+        "labels": labels[rows],
+        "example_mask": row_valid,
+    }
+
+
+class EpochRunner:
+    """Scanned on-device train/eval epochs over a :class:`StagedCorpus`.
+
+    One jitted program per (chunk length) — the full chunk plus one tail
+    shape per distinct epoch size; split sizes are fixed for a run, so in
+    practice two compilations each for train and eval.
+    """
+
+    def __init__(
+        self,
+        model_config: Code2VecConfig,
+        class_weights: jnp.ndarray,
+        batch_size: int,
+        bag: int,
+        chunk_batches: int = 16,
+    ):
+        self.batch_size = batch_size
+        self.bag = bag
+        self.chunk_batches = chunk_batches
+        self._raw_train = build_train_step_fn(model_config, class_weights)
+        self._raw_eval = build_eval_step_fn(model_config, class_weights)
+        self._train_chunks: dict[int, Callable] = {}
+        self._eval_chunks: dict[int, Callable] = {}
+
+    # -- jitted chunk programs -------------------------------------------
+
+    def _train_chunk(self, n_batches: int) -> Callable:
+        if n_batches not in self._train_chunks:
+            batch_size, bag = self.batch_size, self.bag
+
+            @partial(jax.jit, donate_argnums=(0,), static_argnums=(5,))
+            def run(state, contexts, row_splits, labels, perm_rows, n_valid, key):
+                perm_valid = (
+                    jnp.arange(n_batches * batch_size) < n_valid
+                ).astype(jnp.float32)
+
+                def body(carry, i):
+                    state, key = carry
+                    key, sample_key = jax.random.split(key)
+                    sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, i * batch_size, batch_size, 0
+                    )
+                    batch = _sample_batch(
+                        contexts, row_splits, labels,
+                        sl(perm_rows), sl(perm_valid), bag, sample_key,
+                    )
+                    state, loss = self._raw_train(state, batch)
+                    return (state, key), loss
+
+                (state, _), losses = jax.lax.scan(
+                    body, (state, key), jnp.arange(n_batches)
+                )
+                return state, jnp.sum(losses)
+
+            self._train_chunks[n_batches] = run
+        return self._train_chunks[n_batches]
+
+    def _eval_chunk(self, n_batches: int) -> Callable:
+        if n_batches not in self._eval_chunks:
+            batch_size, bag = self.batch_size, self.bag
+
+            @partial(jax.jit, static_argnums=(5,))
+            def run(state, contexts, row_splits, labels, perm_rows, n_valid, key):
+                perm_valid = (
+                    jnp.arange(n_batches * batch_size) < n_valid
+                ).astype(jnp.float32)
+
+                def body(key, i):
+                    key, sample_key = jax.random.split(key)
+                    sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, i * batch_size, batch_size, 0
+                    )
+                    batch = _sample_batch(
+                        contexts, row_splits, labels,
+                        sl(perm_rows), sl(perm_valid), bag, sample_key,
+                    )
+                    out = self._raw_eval(state, batch)
+                    return key, (out["loss"], out["preds"], out["max_logit"])
+
+                _, (losses, preds, max_logits) = jax.lax.scan(
+                    body, key, jnp.arange(n_batches)
+                )
+                return jnp.sum(losses), preds.reshape(-1), max_logits.reshape(-1)
+
+            self._eval_chunks[n_batches] = run
+        return self._eval_chunks[n_batches]
+
+    # -- host-facing epoch drivers ---------------------------------------
+
+    def _chunk_plan(self, n_rows: int) -> list[tuple[int, int, int]]:
+        """[(row_lo, n_batches, n_valid_rows)] covering ceil(n/B) batches."""
+        n_batches_total = -(-n_rows // self.batch_size)
+        plan = []
+        lo = 0
+        while lo < n_batches_total:
+            nb = min(self.chunk_batches, n_batches_total - lo)
+            row_lo = lo * self.batch_size
+            n_valid = min(n_rows - row_lo, nb * self.batch_size)
+            plan.append((row_lo, nb, n_valid))
+            lo += nb
+        return plan
+
+    def _padded_rows(self, order: np.ndarray, row_lo: int, nb: int) -> np.ndarray:
+        rows = order[row_lo : row_lo + nb * self.batch_size]
+        if len(rows) < nb * self.batch_size:
+            # repeat row 0 for the masked tail (same as iter_batches padding)
+            fill = np.full(nb * self.batch_size - len(rows), order[0], rows.dtype)
+            rows = np.concatenate([rows, fill])
+        return rows.astype(np.int32)
+
+    def run_train_epoch(
+        self,
+        state,
+        corpus: StagedCorpus,
+        rng: np.random.Generator,
+        key: jax.Array,
+    ) -> tuple[Any, float, int]:
+        """One training epoch; returns (state, summed loss, n_batches).
+
+        ``rng`` draws the epoch's method order on host (matching the host
+        loop's seeded shuffle); ``key`` drives on-device context sampling.
+        """
+        order = rng.permutation(corpus.n_items)
+        chunk_losses = []  # device scalars; summed after the last dispatch
+        n_batches = 0
+        for row_lo, nb, n_valid in self._chunk_plan(corpus.n_items):
+            key, chunk_key = jax.random.split(key)
+            state, loss = self._train_chunk(nb)(
+                state, corpus.contexts, corpus.row_splits, corpus.labels,
+                self._padded_rows(order, row_lo, nb), n_valid, chunk_key,
+            )
+            chunk_losses.append(loss)
+            n_batches += nb
+        return state, float(np.sum(jax.device_get(chunk_losses))), n_batches
+
+    def run_eval_epoch(
+        self,
+        state,
+        corpus: StagedCorpus,
+        key: jax.Array,
+    ) -> tuple[float, np.ndarray, np.ndarray]:
+        """One eval pass in corpus order; returns (summed per-batch mean
+        loss, preds [n_items], max_logits [n_items])."""
+        order = np.arange(corpus.n_items)
+        total_loss = 0.0
+        preds: list[np.ndarray] = []
+        max_logits: list[np.ndarray] = []
+        for row_lo, nb, n_valid in self._chunk_plan(corpus.n_items):
+            key, chunk_key = jax.random.split(key)
+            loss, p, m = self._eval_chunk(nb)(
+                state, corpus.contexts, corpus.row_splits, corpus.labels,
+                self._padded_rows(order, row_lo, nb), n_valid, chunk_key,
+            )
+            total_loss += float(loss)
+            preds.append(np.asarray(p[:n_valid]))
+            max_logits.append(np.asarray(m[:n_valid]))
+        return (
+            total_loss,
+            np.concatenate(preds) if preds else np.zeros(0, np.int64),
+            np.concatenate(max_logits) if max_logits else np.zeros(0, np.float32),
+        )

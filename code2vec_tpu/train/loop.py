@@ -217,6 +217,43 @@ def train(
         def to_device(batch):
             return batch  # jit in_shardings place host arrays directly
 
+    # device-resident epochs: corpus staged to HBM once, whole chunks of
+    # batches per dispatch (train/device_epoch.py). Method task, single
+    # process, no mesh; anything else falls back to the host pipeline.
+    device_runner = None
+    if config.device_epoch:
+        if (
+            mesh is None
+            and data.infer_method
+            and not data.infer_variable
+            and jax.process_count() == 1
+        ):
+            from code2vec_tpu.train.device_epoch import (
+                EpochRunner,
+                stage_method_corpus,
+            )
+
+            device_runner = EpochRunner(
+                model_config,
+                class_weights,
+                config.batch_size,
+                config.max_path_length,
+                config.device_chunk_batches,
+            )
+            staged_train = stage_method_corpus(data, train_idx, np_rng)
+            staged_test = stage_method_corpus(data, test_idx, np_rng)
+            logger.info(
+                "device epochs: staged %d train / %d test contexts to %s",
+                staged_train.n_contexts,
+                staged_test.n_contexts,
+                staged_train.contexts.devices(),
+            )
+        else:
+            logger.warning(
+                "device_epoch requested but unsupported here (mesh axes, "
+                "variable task, or multi-host); using the host pipeline"
+            )
+
     meta = TrainMeta()
     if config.resume and out_dir is not None:
         restored = restore_checkpoint(out_dir, state)
@@ -234,32 +271,47 @@ def train(
                 jax.profiler.start_trace(profile_dir)
             epoch_start = time.perf_counter()
 
-            train_epoch = build_epoch(
-                data,
-                train_idx,
-                config.max_path_length,
-                np_rng,
-                config.shuffle_variable_indexes,
-            )
-            train_loss = 0.0
-            n_batches = 0
-            for batch in iter_batches(
-                train_epoch, config.batch_size, rng=np_rng, pad_final=True
-            ):
-                state, loss = train_step(state, to_device(batch))
-                train_loss += float(loss)
-                n_batches += 1
+            train_epoch = None  # host epoch arrays, built lazily in device mode
+            test_epoch = None
+            if device_runner is not None:
+                jax_rng, train_key, eval_key = jax.random.split(jax_rng, 3)
+                state, train_loss, _ = device_runner.run_train_epoch(
+                    state, staged_train, np_rng, train_key
+                )
+                test_loss, preds, _ = device_runner.run_eval_epoch(
+                    state, staged_test, eval_key
+                )
+                accuracy, precision, recall, f1 = evaluate(
+                    config.eval_method,
+                    data.labels[test_idx],
+                    preds,
+                    data.label_vocab,
+                )
+            else:
+                train_epoch = build_epoch(
+                    data,
+                    train_idx,
+                    config.max_path_length,
+                    np_rng,
+                    config.shuffle_variable_indexes,
+                )
+                train_loss = 0.0
+                for batch in iter_batches(
+                    train_epoch, config.batch_size, rng=np_rng, pad_final=True
+                ):
+                    state, loss = train_step(state, to_device(batch))
+                    train_loss += float(loss)
 
-            test_epoch = build_epoch(
-                data,
-                test_idx,
-                config.max_path_length,
-                np_rng,
-                config.shuffle_variable_indexes,
-            )
-            test_loss, accuracy, precision, recall, f1 = _evaluate_epoch(
-                config, data, state, eval_step, test_epoch, to_device
-            )
+                test_epoch = build_epoch(
+                    data,
+                    test_idx,
+                    config.max_path_length,
+                    np_rng,
+                    config.shuffle_variable_indexes,
+                )
+                test_loss, accuracy, precision, recall, f1 = _evaluate_epoch(
+                    config, data, state, eval_step, test_epoch, to_device
+                )
 
             metrics = {
                 "train_loss": train_loss,
@@ -278,12 +330,28 @@ def train(
             if report_fn is not None:
                 report_fn(epoch, f1)  # may raise StopTraining (HPO pruning)
 
+            def host_epoch(item_idx):
+                # device mode skips host epoch builds; exports still need
+                # them. Note: this draws a FRESH context subsample, so for
+                # methods with more contexts than the bag size an exported
+                # prediction can differ from the one behind the logged F1
+                # (host mode re-runs forward on the same sampled epoch).
+                return build_epoch(
+                    data,
+                    item_idx,
+                    config.max_path_length,
+                    np_rng,
+                    config.shuffle_variable_indexes,
+                )
+
             if (
                 epoch > 1
                 and config.print_sample_cycle
                 and epoch % config.print_sample_cycle == 0
                 and report_fn is None
             ):
+                if test_epoch is None:
+                    test_epoch = host_epoch(test_idx)
                 export_mod.print_sample(
                     data, state, eval_step, test_epoch, config.batch_size,
                     to_device,
@@ -294,6 +362,10 @@ def train(
                     sink(epoch, {"best_f1": f1})
                 meta.best_f1 = f1
                 if report_fn is None and vectors_path is not None:
+                    if train_epoch is None:
+                        train_epoch = host_epoch(train_idx)
+                    if test_epoch is None:
+                        test_epoch = host_epoch(test_idx)
                     export_mod.write_code_vectors(
                         data,
                         state,
@@ -327,6 +399,8 @@ def train(
                 logger.info(
                     "early stop loss:%s, bad:%d", train_loss, meta.bad_count
                 )
+                if test_epoch is None:
+                    test_epoch = host_epoch(test_idx)
                 export_mod.print_sample(
                     data, state, eval_step, test_epoch, config.batch_size,
                     to_device,

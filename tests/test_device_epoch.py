@@ -1,0 +1,308 @@
+"""Device-resident epoch pipeline (train/device_epoch.py).
+
+Covers: staging correctness (contexts preserved per method, @question
+substitution), rotation-window sampling semantics (all contexts when
+n <= L, no duplicates, inclusion marginals), loss equivalence with the
+per-batch host pipeline when subsampling is inactive, and the end-to-end
+training loop with device_epoch=True.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.models.code2vec import Code2VecConfig
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.device_epoch import (
+    EpochRunner,
+    _sample_batch,
+    stage_method_corpus,
+)
+from code2vec_tpu.train.loop import train
+from code2vec_tpu.train.step import create_train_state, make_train_step
+
+
+@pytest.fixture(scope="module")
+def tiny(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tiny_device_epoch")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+    return paths, data
+
+
+class TestStaging:
+    def test_rows_preserved_and_shuffled_within(self, tiny):
+        _, data = tiny
+        rng = np.random.default_rng(0)
+        idx = np.arange(data.n_items)
+        staged = stage_method_corpus(data, idx, rng)
+        assert staged.n_items == data.n_items
+        assert staged.n_contexts == data.n_contexts
+        splits = np.asarray(staged.row_splits)
+        ctx = np.asarray(staged.contexts)
+        mid = data.method_token_index
+        for i in range(min(data.n_items, 20)):
+            lo, hi = data.row_splits[i], data.row_splits[i + 1]
+            want_s = data.starts[lo:hi].copy()
+            want_e = data.ends[lo:hi].copy()
+            if mid is not None:
+                want_s[want_s == mid] = QUESTION_TOKEN_INDEX
+                want_e[want_e == mid] = QUESTION_TOKEN_INDEX
+            got = ctx[splits[i] : splits[i + 1]]
+            # same multiset of (start, path, end) triples, any order
+            want = sorted(zip(want_s, data.paths[lo:hi], want_e))
+            assert sorted(map(tuple, got)) == [tuple(map(int, t)) for t in want]
+
+    def test_subset_staging_respects_item_idx(self, tiny):
+        _, data = tiny
+        rng = np.random.default_rng(1)
+        idx = np.asarray([3, 0, 5])
+        staged = stage_method_corpus(data, idx, rng)
+        counts = np.diff(np.asarray(staged.row_splits))
+        want = np.diff(data.row_splits)[idx]
+        assert np.array_equal(counts, want)
+        assert np.array_equal(np.asarray(staged.labels), data.labels[idx])
+
+    def test_no_method_token_leak(self, tiny):
+        _, data = tiny
+        mid = data.method_token_index
+        if mid is None:
+            pytest.skip("corpus has no @method_0 token")
+        staged = stage_method_corpus(
+            data, np.arange(data.n_items), np.random.default_rng(0)
+        )
+        ctx = np.asarray(staged.contexts)
+        assert not (ctx[:, 0] == mid).any()
+        assert not (ctx[:, 2] == mid).any()
+
+
+class TestSampling:
+    def _csr(self, lens, seed=0):
+        rng = np.random.default_rng(seed)
+        splits = np.zeros(len(lens) + 1, np.int32)
+        np.cumsum(lens, out=splits[1:])
+        total = int(splits[-1])
+        ctx = rng.integers(1, 1000, (total, 3)).astype(np.int32)
+        labels = rng.integers(0, 7, len(lens)).astype(np.int32)
+        return jnp.asarray(ctx), jnp.asarray(splits), jnp.asarray(labels), ctx
+
+    def test_small_rows_take_everything_once(self):
+        bag = 8
+        ctx, splits, labels, ctx_np = self._csr([5, 8, 1, 0])
+        rows = jnp.arange(4, dtype=jnp.int32)
+        batch = _sample_batch(
+            ctx, splits, labels, rows, jnp.ones(4), bag, jax.random.PRNGKey(0)
+        )
+        starts = np.asarray(batch["starts"])
+        sp = np.asarray(splits)
+        for i, n in enumerate([5, 8, 1, 0]):
+            row = starts[i]
+            assert (row[n:] == PAD_INDEX).all()
+            # every context appears exactly once (rotation of the full row)
+            want = sorted(ctx_np[sp[i] : sp[i] + n, 0])
+            assert sorted(row[:n]) == [int(x) for x in want]
+
+    def test_large_rows_no_duplicates_and_fresh_windows(self):
+        bag = 8
+        ctx, splits, labels, ctx_np = self._csr([40])
+        rows = jnp.zeros(1, jnp.int32)
+        seen = set()
+        for seed in range(6):
+            batch = _sample_batch(
+                ctx, splits, labels, rows, jnp.ones(1), bag,
+                jax.random.PRNGKey(seed),
+            )
+            window = tuple(int(x) for x in np.asarray(batch["paths"])[0])
+            assert len(set(window)) == bag  # no duplicates within a bag
+            seen.add(window)
+        assert len(seen) > 1  # different epochs draw different windows
+
+    def test_inclusion_marginals_uniform(self):
+        # over many draws every context of an oversized row should be
+        # included ~ bag/n of the time
+        bag, n = 16, 64
+        ctx, splits, labels, _ = self._csr([n])
+        # unique start values so hits map back to one context each
+        ctx = ctx.at[:, 0].set(jnp.arange(1, n + 1, dtype=jnp.int32))
+        counts = np.zeros(n)
+        draws = 300
+        for seed in range(draws):
+            batch = _sample_batch(
+                ctx, splits, labels, jnp.zeros(1, jnp.int32), jnp.ones(1),
+                bag, jax.random.PRNGKey(seed),
+            )
+            got = np.asarray(batch["starts"])[0]
+            flat = np.asarray(ctx)[:, 0]
+            for v in got:
+                counts[np.where(flat == v)[0][0]] += 1
+        expect = draws * bag / n
+        assert counts.min() > 0.5 * expect
+        assert counts.max() < 1.7 * expect
+
+
+class TestRunnerEquivalence:
+    def test_matches_host_loop_without_subsampling(self, tiny):
+        """With bag >= every row length, dropout off and identical batch
+        order, the scanned device epoch must equal the per-batch host loop
+        (bags are permutation-invariant under attention pooling)."""
+        _, data = tiny
+        bag = int(np.diff(data.row_splits).max())
+        config = TrainConfig(
+            batch_size=16,
+            max_path_length=bag,
+            dropout_prob=0.0,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+        )
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16,
+            path_embed_size=16,
+            encode_size=32,
+            dropout_prob=0.0,
+        )
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        idx = np.arange(data.n_items)
+
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        state_a = create_train_state(
+            config, model_config, jax.random.PRNGKey(0), example
+        )
+        state_b = create_train_state(
+            config, model_config, jax.random.PRNGKey(0), example
+        )
+
+        # host path: one epoch over idx in a fixed order
+        from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+
+        epoch = build_method_epoch(
+            data, idx, bag, np.random.default_rng(0)
+        )
+        step = make_train_step(model_config, cw)
+        host_losses = []
+        for batch in iter_batches(epoch, 16, rng=None, pad_final=True):
+            state_a, loss = step(state_a, batch)
+            host_losses.append(float(loss))
+
+        # device path: same order (corpus staged in idx order, identity perm)
+        runner = EpochRunner(model_config, cw, 16, bag, chunk_batches=4)
+        staged = stage_method_corpus(data, idx, np.random.default_rng(0))
+
+        class _IdentityRng:
+            def permutation(self, n):
+                return np.arange(n)
+
+        state_b, dev_loss, n_batches = runner.run_train_epoch(
+            state_b, staged, _IdentityRng(), jax.random.PRNGKey(7)
+        )
+        assert n_batches == len(host_losses)
+        assert dev_loss == pytest.approx(sum(host_losses), rel=2e-4)
+
+        # final params identical too (same batches, same math)
+        diff = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            state_a.params,
+            state_b.params,
+        )
+        # not bit-identical: bag order differs (rotation vs shuffle), and
+        # Adam's grad^2 / sqrt amplify float-association differences
+        assert max(jax.tree.leaves(diff)) < 5e-4
+
+    def test_eval_epoch_prediction_parity(self, tiny):
+        _, data = tiny
+        bag = int(np.diff(data.row_splits).max())
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16,
+            path_embed_size=16,
+            encode_size=32,
+            dropout_prob=0.0,
+        )
+        config = TrainConfig(batch_size=16, max_path_length=bag, dropout_prob=0.0)
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        idx = np.arange(data.n_items)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        state = create_train_state(
+            config, model_config, jax.random.PRNGKey(3), example
+        )
+
+        from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+        from code2vec_tpu.train.step import make_eval_step
+
+        epoch = build_method_epoch(data, idx, bag, np.random.default_rng(0))
+        eval_step = make_eval_step(model_config, cw)
+        host_preds = []
+        for batch in iter_batches(epoch, 16, rng=None, pad_final=True):
+            out = eval_step(state, batch)
+            valid = batch["example_mask"].astype(bool)
+            host_preds.append(np.asarray(out["preds"])[valid])
+        host_preds = np.concatenate(host_preds)
+
+        runner = EpochRunner(model_config, cw, 16, bag, chunk_batches=4)
+        staged = stage_method_corpus(data, idx, np.random.default_rng(0))
+        _, dev_preds, _ = runner.run_eval_epoch(state, staged, jax.random.PRNGKey(9))
+        assert np.array_equal(host_preds, dev_preds)
+
+
+class TestLoopIntegration:
+    def test_end_to_end_device_epoch_training(self, tiny, tmp_path):
+        _, data = tiny
+        config = TrainConfig(
+            max_epoch=3,
+            batch_size=32,
+            encode_size=64,
+            terminal_embed_size=32,
+            path_embed_size=32,
+            max_path_length=32,
+            print_sample_cycle=0,
+            device_epoch=True,
+            device_chunk_batches=4,
+        )
+        vectors = tmp_path / "code.vec"
+        result = train(
+            config, data, out_dir=str(tmp_path), vectors_path=str(vectors)
+        )
+        assert result.epochs_run == 3
+        assert np.isfinite(result.history[-1]["train_loss"])
+        assert result.best_f1 >= 0.0
+        assert vectors.exists()  # best-F1 export built host epochs on demand
+
+    def test_device_and_host_loops_converge_similarly(self, tiny):
+        _, data = tiny
+        base = dict(
+            max_epoch=3,
+            batch_size=32,
+            encode_size=64,
+            terminal_embed_size=32,
+            path_embed_size=32,
+            max_path_length=32,
+            print_sample_cycle=0,
+        )
+        host = train(TrainConfig(**base), data)
+        dev = train(TrainConfig(**base, device_epoch=True, device_chunk_batches=4), data)
+        # same data, same recipe -> same ballpark (not bit-identical: the
+        # device path samples windows, the host path samples subsets)
+        h = host.history[-1]["train_loss"]
+        d = dev.history[-1]["train_loss"]
+        assert d == pytest.approx(h, rel=0.35)
