@@ -9,10 +9,11 @@ Usage mirrors the reference README:
 Reference flags kept verbatim: seeds, corpus paths, model dims, optimizer,
 dropout, output paths, ``--env`` (tensorboard|floyd), eval/print cycles,
 HPO (``--find_hyperparams`` / ``--num_trials``), angular-margin head, task
-selection. CUDA-machinery flags (``--no_cuda``, ``--gpu``,
-``--num_workers``) are accepted for drop-in compatibility but are no-ops:
-device placement is JAX's job and the input pipeline is vectorized
-host-side (no worker pool to size).
+selection. ``--no_cuda`` keeps its reference meaning — don't use the
+accelerator — by pinning the CPU backend. The remaining CUDA-machinery
+flags (``--gpu``, ``--num_workers``) are accepted for drop-in compatibility
+but are no-ops: device placement is JAX's job and the input pipeline is
+vectorized host-side (no worker pool to size).
 
 TPU-native additions (no reference counterpart): ``--compute_dtype``,
 ``--use_pallas``, mesh axes (``--data_axis``/``--model_axis``/
@@ -81,7 +82,7 @@ def build_parser() -> argparse.ArgumentParser:
     # device flags accepted for drop-in compatibility, no-ops under JAX
     # (main.py:62-64)
     parser.add_argument("--no_cuda", action="store_true", default=False,
-                        help="no-op (JAX owns device placement)")
+                        help="run on CPU (pins the cpu JAX backend)")
     parser.add_argument("--gpu", type=str, default=None,
                         help="no-op (JAX owns device placement)")
     parser.add_argument("--num_workers", type=int, default=None,
@@ -190,10 +191,28 @@ def main(argv: list[str] | None = None) -> None:
                         format="%(asctime)s: %(message)s",
                         datefmt="%m/%d/%Y %I:%M:%S %p")
     args = build_parser().parse_args(argv)
-    if args.no_cuda or args.gpu is not None or args.num_workers is not None:
-        logger.info("--no_cuda/--gpu/--num_workers are no-ops on this "
-                    "framework: JAX selects the backend (current: %s)",
-                    _backend_name())
+    if args.no_cuda or os.environ.get("JAX_PLATFORMS", "").strip():
+        # Force the platform through the config API: experimental device
+        # plugins can pre-empt the JAX_PLATFORMS env var, so the env route
+        # alone is unreliable. --no_cuda keeps the reference's semantics
+        # (reference: main.py:62,83 — don't use the accelerator) by pinning
+        # the CPU backend. Works as long as no backend is initialized yet.
+        import jax
+
+        platforms = "cpu" if args.no_cuda else os.environ["JAX_PLATFORMS"]
+        if not getattr(jax._src.xla_bridge, "_backends", None):
+            jax.config.update("jax_platforms", platforms)
+        else:
+            requested = {p.strip() for p in platforms.split(",") if p.strip()}
+            if "cuda" in requested or "rocm" in requested:
+                requested.add("gpu")  # default_backend() reports the alias
+            if jax.default_backend() not in requested:
+                logger.warning(
+                    "cannot honor platform request %r: the %s backend is "
+                    "already initialized", platforms, jax.default_backend())
+    if args.gpu is not None or args.num_workers is not None:
+        logger.info("--gpu/--num_workers are no-ops on this framework: "
+                    "JAX selects the device (current: %s)", _backend_name())
 
     from code2vec_tpu.data.reader import load_corpus
 

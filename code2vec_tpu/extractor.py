@@ -177,3 +177,45 @@ def extract_dataset(
         cmd += ["--method-declarations", method_declarations]
     cmd += list(extra_args)
     return subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m code2vec_tpu.extractor <dataset_dir> <source_dir> …`` —
+    builds the native extractor on first use and forwards to ``c2v-extract``
+    (createDataset parity, ipynb cell11)."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="code2vec_tpu.extractor",
+        description="Java sources -> path-context corpus artifacts "
+        "(reads <dataset_dir>/methods.txt, writes corpus.txt, "
+        "terminal_idxs.txt, path_idxs.txt, params.txt, actual_methods.txt)",
+    )
+    parser.add_argument("dataset_dir")
+    parser.add_argument("source_dir")
+    parser.add_argument("--max-length", type=int, default=8)
+    parser.add_argument("--max-width", type=int, default=3)
+    parser.add_argument("--method-declarations", default=None)
+    args, extra = parser.parse_known_args(argv)
+    try:
+        result = extract_dataset(
+            args.dataset_dir,
+            args.source_dir,
+            max_length=args.max_length,
+            max_width=args.max_width,
+            method_declarations=args.method_declarations,
+            extra_args=extra,
+        )
+    except subprocess.CalledProcessError as e:
+        if e.stdout:
+            sys.stdout.write(e.stdout)
+        if e.stderr:
+            sys.stderr.write(e.stderr)
+        raise SystemExit(e.returncode)
+    sys.stdout.write(result.stdout)
+    sys.stderr.write(result.stderr)
+
+
+if __name__ == "__main__":
+    main()

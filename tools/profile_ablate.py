@@ -1,0 +1,88 @@
+"""Ablation inside a scanned chunk (donated state, unique calls): find what
+dominates the ~20.5ms/step."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.train.step import weighted_nll, torch_style_adam, TrainState
+
+B, L = 1024, 200
+mc = Code2VecConfig(
+    terminal_count=360_633, path_count=342_846, label_count=8_000,
+    terminal_embed_size=100, path_embed_size=100, encode_size=100,
+    dropout_prob=0.25, dtype=jnp.bfloat16)
+
+rng = np.random.default_rng(0)
+batch = {
+    "starts": jax.device_put(rng.integers(1, mc.terminal_count, (B, L)).astype(np.int32)),
+    "paths": jax.device_put(rng.integers(1, mc.path_count, (B, L)).astype(np.int32)),
+    "ends": jax.device_put(rng.integers(1, mc.terminal_count, (B, L)).astype(np.int32)),
+    "labels": jax.device_put(rng.integers(0, mc.label_count, B).astype(np.int32)),
+    "example_mask": jax.device_put(np.ones(B, np.float32)),
+}
+model = Code2Vec(mc)
+cw = jnp.ones(mc.label_count, jnp.float32)
+params = model.init({"params": jax.random.PRNGKey(0)}, batch["starts"],
+                    batch["paths"], batch["ends"], deterministic=True)["params"]
+
+
+def make_step(tx, freeze_embeds=False, fwd_only=False, no_dropout=False):
+    def loss_fn(p, batch, key):
+        if freeze_embeds:
+            p = dict(p)
+            for k in ("terminal_embedding", "path_embedding"):
+                p[k] = jax.tree.map(jax.lax.stop_gradient, p[k])
+        logits, _, _ = model.apply(
+            {"params": p}, batch["starts"], batch["paths"], batch["ends"],
+            deterministic=no_dropout, rngs={} if no_dropout else {"dropout": key})
+        return weighted_nll(logits, batch["labels"], cw, batch["example_mask"])
+
+    def step(state, batch):
+        key, nxt = jax.random.split(state.dropout_rng)
+        if fwd_only:
+            loss = loss_fn(state.params, batch, key)
+            return state.replace(dropout_rng=nxt), loss
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch, key)
+        state = state.apply_gradients(grads=grads, dropout_rng=nxt)
+        return state, loss
+    return step
+
+
+def bench(name, tx, n_scan=10, reps=6, **kw):
+    fresh = jax.tree.map(jnp.copy, params)  # params get donated per-bench
+    state = TrainState.create(apply_fn=model.apply, params=fresh, tx=tx,
+                              dropout_rng=jax.random.PRNGKey(1))
+    step = make_step(tx, **kw)
+
+    @partial(jax.jit, donate_argnums=0)
+    def chunk(state, batch):
+        def body(s, _):
+            return step(s, batch)
+        state, losses = jax.lax.scan(body, state, None, length=n_scan)
+        return state, losses.sum()
+
+    state, l = chunk(state, batch)
+    jax.block_until_ready(l)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, l = chunk(state, batch)
+    jax.block_until_ready(l)
+    dt = (time.perf_counter() - t0) / (reps * n_scan) * 1e3
+    print(f"{name:44s} {dt:8.3f} ms/step")
+
+
+adam = torch_style_adam(0.01, 0.9, 0.999, 0.0)
+sgd = optax.sgd(0.01)
+
+bench("full step, adam (baseline)", adam)
+bench("full step, sgd", sgd)
+bench("frozen embeddings, adam", adam, freeze_embeds=True)
+bench("frozen embeddings, sgd", sgd, freeze_embeds=True)
+bench("forward only", sgd, fwd_only=True)
+bench("forward only, no dropout", sgd, fwd_only=True, no_dropout=True)

@@ -1,0 +1,141 @@
+"""Real-step variants: custom-vjp embedding backward + rbg dropout RNG."""
+
+import time
+from functools import partial
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from code2vec_tpu.train.step import weighted_nll, torch_style_adam
+
+B, L, DIM, ENC = 1024, 200, 100, 100
+VT, VP, C = 360_633, 342_846, 8_000
+
+rng = np.random.default_rng(0)
+batch = {
+    "starts": jax.device_put(rng.integers(1, VT, (B, L)).astype(np.int32)),
+    "paths": jax.device_put(rng.integers(1, VP, (B, L)).astype(np.int32)),
+    "ends": jax.device_put(rng.integers(1, VT, (B, L)).astype(np.int32)),
+    "labels": jax.device_put(rng.integers(0, C, B).astype(np.int32)),
+    "example_mask": jax.device_put(np.ones(B, np.float32)),
+}
+cw = jnp.ones(C, jnp.float32)
+
+
+def init_params(key):
+    k = jax.random.split(key, 5)
+    return {
+        "T": jax.random.normal(k[0], (VT, DIM), jnp.float32),
+        "P": jax.random.normal(k[1], (VP, DIM), jnp.float32),
+        "W": jax.random.normal(k[2], (3 * DIM, ENC), jnp.float32) * 0.05,
+        "ln_scale": jnp.ones(ENC, jnp.float32),
+        "ln_bias": jnp.zeros(ENC, jnp.float32),
+        "a": jax.random.normal(k[3], (ENC,), jnp.float32) * 0.1,
+        "head_w": jax.random.normal(k[4], (ENC, C), jnp.float32) * 0.05,
+        "head_b": jnp.zeros(C, jnp.float32),
+    }
+
+
+# ---- embedding lookup variants ------------------------------------------
+
+def take_embed(table, ids):
+    return table[ids].astype(jnp.bfloat16)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def sorted_embed(table, ids, grad_mode):
+    return table[ids].astype(jnp.bfloat16)
+
+
+def _se_fwd(table, ids, grad_mode):
+    return table[ids].astype(jnp.bfloat16), (ids, table.shape[0])
+
+
+def _se_bwd(grad_mode, res, g):
+    ids, V = res
+    flat_ids = ids.reshape(-1)
+    gf = g.reshape(-1, g.shape[-1])
+    if "f32" in grad_mode:
+        gf = gf.astype(jnp.float32)
+    if "sort" in grad_mode:
+        order = jnp.argsort(flat_ids)
+        dt = jax.ops.segment_sum(
+            gf[order], flat_ids[order], num_segments=V, indices_are_sorted=True
+        )
+    else:
+        dt = jax.ops.segment_sum(gf, flat_ids, num_segments=V)
+    return dt.astype(jnp.float32), None
+
+
+sorted_embed.defvjp(_se_fwd, _se_bwd)
+
+
+def model_apply(params, batch, dropout_key, embed_fn, deterministic=False):
+    es = embed_fn(params["T"], batch["starts"])
+    ep = embed_fn(params["P"], batch["paths"])
+    ee = embed_fn(params["T"], batch["ends"])
+    x = jnp.concatenate([es, ep, ee], axis=-1)  # [B, L, 3*DIM] bf16
+    h = x @ params["W"].astype(jnp.bfloat16)  # [B, L, ENC]
+    h32 = h.astype(jnp.float32)
+    mean = h32.mean(-1, keepdims=True)
+    var = h32.var(-1, keepdims=True)
+    h32 = (h32 - mean) * jax.lax.rsqrt(var + 1e-6) * params["ln_scale"] + params["ln_bias"]
+    h = jnp.tanh(h32).astype(jnp.bfloat16)
+    if not deterministic:
+        keep = jax.random.bernoulli(dropout_key, 0.75, h.shape)
+        h = jnp.where(keep, h / 0.75, 0).astype(jnp.bfloat16)
+    scores = (h @ params["a"].astype(jnp.bfloat16)).astype(jnp.float32)  # [B, L]
+    mask = (batch["starts"] != 0).astype(jnp.float32)
+    scores = jnp.where(mask > 0, scores, -3.4e38)
+    attn = jax.nn.softmax(scores, axis=-1)
+    code = jnp.einsum("bl,bld->bd", attn.astype(jnp.bfloat16), h)  # [B, ENC]
+    logits = code.astype(jnp.float32) @ params["head_w"] + params["head_b"]
+    return logits
+
+
+def bench(name, embed_fn, impl="threefry", n_scan=10, reps=6):
+    params = init_params(jax.random.PRNGKey(0))
+    tx = torch_style_adam(0.01, 0.9, 0.999, 0.0)
+    opt = tx.init(params)
+    key = jax.random.key(1, impl=impl)
+
+    def loss_fn(p, batch, dk):
+        logits = model_apply(p, batch, dk, embed_fn)
+        return weighted_nll(logits, batch["labels"], cw, batch["example_mask"])
+
+    @partial(jax.jit, donate_argnums=0)
+    def chunk(carry, batch):
+        params, opt, key = carry
+        def step(c, _):
+            params, opt, key = c
+            key, dk = jax.random.split(key)
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, dk)
+            upd, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, upd)
+            return (params, opt, key), loss
+        (params, opt, key), losses = jax.lax.scan(step, (params, opt, key), None, length=n_scan)
+        return (params, opt, key), losses.sum()
+
+    print(f"{name}: compiling...", flush=True)
+    t0 = time.perf_counter()
+    carry = (params, opt, key)
+    carry, l = chunk(carry, batch)
+    jax.block_until_ready(l)
+    print(f"{name}: compile+first {time.perf_counter() - t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        carry, l = chunk(carry, batch)
+    jax.block_until_ready(l)
+    print(f"{name:46s} {(time.perf_counter() - t0) / (reps * n_scan) * 1e3:8.3f} ms/step  loss={float(l)/n_scan:.4f}")
+
+
+bench("inline model, take embed (baseline)", take_embed)
+bench("custom vjp segsum bf16", partial(sorted_embed, grad_mode="plain"))
+bench("custom vjp sort+segsum bf16", partial(sorted_embed, grad_mode="sort"))
+bench("custom vjp sort+segsum f32", partial(sorted_embed, grad_mode="sort+f32"))
+bench("take embed + rbg dropout", take_embed, impl="rbg")

@@ -1,0 +1,103 @@
+"""Microbenchmark: split the train-step time into sampling / fwd / fwd+bwd /
+full step to find the bottleneck. Not part of the package; dev tool."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu.data.synth import SynthSpec, generate_corpus_data
+from code2vec_tpu.data.vocab import Vocab
+from code2vec_tpu.data.reader import CorpusData
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.device_epoch import EpochRunner, stage_method_corpus, _sample_batch
+from code2vec_tpu.train.step import create_train_state, build_train_step_fn
+
+B, L = 1024, 200
+spec = SynthSpec(n_methods=8192, n_terminals=360_631, n_paths=342_845,
+                 n_labels=8_000, mean_contexts=120.0, max_contexts=400, seed=0)
+raw = generate_corpus_data(spec)
+label_vocab = Vocab()
+for name in raw.label_names:
+    label_vocab.add_label(name)
+data = CorpusData(
+    starts=raw.starts + 1, paths=raw.paths, ends=raw.ends + 1,
+    row_splits=raw.row_splits, ids=np.arange(spec.n_methods, dtype=np.int64),
+    labels=raw.label_ids.astype(np.int32), normalized_labels=[],
+    sources=[None] * spec.n_methods, aliases=[{} for _ in range(spec.n_methods)],
+    terminal_vocab=Vocab(), path_vocab=Vocab(), label_vocab=label_vocab)
+data.terminal_vocab.add("<PAD/>", 0)
+data.terminal_vocab.add("@question", 1)
+data.terminal_vocab.add("@method_0", 2)
+
+mc = Code2VecConfig(
+    terminal_count=spec.n_terminals + 2, path_count=spec.n_paths + 1,
+    label_count=len(label_vocab), terminal_embed_size=100, path_embed_size=100,
+    encode_size=100, dropout_prob=0.25, dtype=jnp.bfloat16)
+tc = TrainConfig(batch_size=B, max_path_length=L)
+
+rng = np.random.default_rng(0)
+staged = stage_method_corpus(data, np.arange(data.n_items), rng)
+rows = jnp.asarray(rng.integers(0, data.n_items, B).astype(np.int32))
+valid = jnp.ones(B, jnp.float32)
+key = jax.random.PRNGKey(0)
+
+sample = jax.jit(partial(_sample_batch, bag=L))
+batch = sample(staged.contexts, staged.row_splits, staged.labels, rows, valid, key=key)
+batch = jax.device_put(batch)
+
+state = create_train_state(tc, mc, jax.random.PRNGKey(0), jax.tree.map(np.asarray, batch))
+cw = jnp.ones(mc.label_count, jnp.float32)
+raw_train = build_train_step_fn(mc, cw)
+train = jax.jit(raw_train, donate_argnums=0)
+
+model = Code2Vec(mc)
+
+@jax.jit
+def fwd(params, batch):
+    logits, _, _ = model.apply({"params": params}, batch["starts"], batch["paths"],
+                               batch["ends"], deterministic=True)
+    return logits.sum()
+
+def loss_fn(params, batch, key):
+    logits, _, _ = model.apply({"params": params}, batch["starts"], batch["paths"],
+                               batch["ends"], deterministic=False, rngs={"dropout": key})
+    return logits.astype(jnp.float32).sum()
+
+grad = jax.jit(jax.grad(loss_fn))
+
+def bench(name, fn, *args, n=30, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n * 1e3
+    print(f"{name:28s} {dt:8.3f} ms")
+    return dt
+
+bench("sample_batch", sample, staged.contexts, staged.row_splits, staged.labels, rows, valid, key=key)
+bench("forward", fwd, state.params, batch)
+bench("grad (fwd+bwd)", grad, state.params, batch, key)
+
+# full step without donation pitfalls: rebuild state each call is costly; instead
+# time N chained steps
+@jax.jit
+def steps10(state, batch):
+    def body(s, _):
+        s, loss = raw_train(s, batch)
+        return s, loss
+    state, losses = jax.lax.scan(body, state, None, length=10)
+    return state, losses.sum()
+
+st = state
+out = steps10(st, batch); jax.block_until_ready(out[1])
+t0 = time.perf_counter()
+for _ in range(10):
+    st, l = steps10(st, batch)
+jax.block_until_ready(l)
+print(f"{'full step (scan/10)':28s} {(time.perf_counter()-t0)/100*1e3:8.3f} ms")
