@@ -116,6 +116,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="matmul/activation dtype; bfloat16 for TPU MXU")
     parser.add_argument("--use_pallas", action="store_true", default=False,
                         help="fused attention-pooling Pallas kernel (single-chip)")
+    from code2vec_tpu.ops.embed import GRAD_MODES
+
+    parser.add_argument("--embed_grad", type=str, default="dense",
+                        choices=GRAD_MODES,
+                        help="embedding-table backward formulation (ops.embed)")
     parser.add_argument("--data_axis", type=int, default=1,
                         help="mesh data-parallel axis size")
     parser.add_argument("--model_axis", type=int, default=1,
@@ -169,6 +174,7 @@ def config_from_args(args: argparse.Namespace):
         model_axis=args.model_axis,
         context_axis=args.context_axis,
         use_pallas=args.use_pallas,
+        embed_grad=args.embed_grad,
         resume=args.resume,
         device_epoch=args.device_epoch,
         device_chunk_batches=args.device_chunk_batches,

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.nn.initializers import normal, zeros
 
 from code2vec_tpu.ops.attention import attention_pool
+from code2vec_tpu.ops.embed import embedding_lookup
 
 
 @dataclass(frozen=True)
@@ -46,9 +47,25 @@ class Code2VecConfig:
     inverse_temp: float = 30.0
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
     use_pallas: bool = False  # fused attention-pooling kernel (ops.pallas_attention)
+    embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
 
     def with_updates(self, **kw) -> "Code2VecConfig":
         return replace(self, **kw)
+
+
+class _EmbedTable(nn.Module):
+    """Bare embedding-table param with nn.Embed's param layout
+    (``{<name>: {"embedding": [vocab, dim] f32}}``); the lookup itself is
+    done by :func:`code2vec_tpu.ops.embed.embedding_lookup`."""
+
+    vocab: int
+    dim: int
+
+    @nn.compact
+    def __call__(self) -> jnp.ndarray:
+        return self.param(
+            "embedding", normal(stddev=1.0), (self.vocab, self.dim), jnp.float32
+        )
 
 
 class Code2Vec(nn.Module):
@@ -68,27 +85,29 @@ class Code2Vec(nn.Module):
     ):
         c = self.config
 
-        terminal_embedding = nn.Embed(
-            c.terminal_count,
-            c.terminal_embed_size,
-            dtype=c.dtype,
-            param_dtype=jnp.float32,
-            embedding_init=normal(stddev=1.0),  # torch nn.Embedding default
-            name="terminal_embedding",
-        )
-        path_embedding = nn.Embed(
-            c.path_count,
-            c.path_embed_size,
-            dtype=c.dtype,
-            param_dtype=jnp.float32,
-            embedding_init=normal(stddev=1.0),
-            name="path_embedding",
-        )
+        # the param tree matches nn.Embed's ({name: {"embedding": table}}),
+        # but the lookup goes through ops.embed so the backward formulation
+        # is selectable (c.embed_grad); tables init per torch nn.Embedding
+        # defaults (std-normal, model/model.py:21-22)
+        terminal_table = _EmbedTable(
+            c.terminal_count, c.terminal_embed_size, name="terminal_embedding"
+        )()
+        path_table = _EmbedTable(
+            c.path_count, c.path_embed_size, name="path_embedding"
+        )()
 
-        # shared table for start & end terminals (model/model.py:21,48-50)
-        embed_starts = terminal_embedding(starts)
-        embed_paths = path_embedding(paths)
-        embed_ends = terminal_embedding(ends)
+        # shared table for start & end terminals (model/model.py:21,48-50);
+        # one fused [B, 2L] lookup so the backward reduces both in one pass
+        embed_se = embedding_lookup(
+            terminal_table,
+            jnp.concatenate([starts, ends], axis=1),
+            compute_dtype=c.dtype,
+            grad_mode=c.embed_grad,
+        )
+        embed_starts, embed_ends = jnp.split(embed_se, 2, axis=1)
+        embed_paths = embedding_lookup(
+            path_table, paths, compute_dtype=c.dtype, grad_mode=c.embed_grad
+        )
         contexts = jnp.concatenate([embed_starts, embed_paths, embed_ends], axis=-1)
 
         contexts = nn.Dense(

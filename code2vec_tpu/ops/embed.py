@@ -1,0 +1,75 @@
+"""Embedding lookup with a selectable backward pass.
+
+The forward is always a row gather (``table[ids]`` cast to the compute
+dtype). The backward — the gradient w.r.t. a ``[vocab, dim]`` table from
+``[..., dim]`` upstream grads — is where big-vocab models spend their time
+on TPU (vocabs reach 360k+ rows here, SURVEY.md §5.7), and XLA's default
+autodiff lowering (scatter-add with duplicate indices) is not always the
+fastest formulation. Modes:
+
+- ``dense``: plain autodiff (scatter-add), the default and the semantic
+  twin of the reference's ``nn.Embedding`` backward (model/model.py:21-22);
+- ``segment``: custom VJP computing the table grad as
+  ``jax.ops.segment_sum`` over the flattened ids;
+- ``segment_sorted``: same, but argsorts the ids first and tells XLA the
+  indices are sorted — trades a bitonic sort of the id vector for a
+  collision-free sequential accumulation pattern.
+
+All modes accumulate the table gradient in float32 regardless of compute
+dtype, matching the f32 param/optimizer precision recipe. Gradients are
+mathematically identical across modes (same sums, different reduction
+order — bitwise differences are float-associativity only).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+GRAD_MODES = ("dense", "segment", "segment_sorted")
+
+
+def _segment_grad(ids: jnp.ndarray, g: jnp.ndarray, vocab: int, sort: bool):
+    flat = ids.reshape(-1)
+    gf = g.reshape(-1, g.shape[-1]).astype(jnp.float32)
+    if sort:
+        order = jnp.argsort(flat)
+        return jax.ops.segment_sum(
+            gf[order], flat[order], num_segments=vocab, indices_are_sorted=True
+        )
+    return jax.ops.segment_sum(gf, flat, num_segments=vocab)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _lookup_segment(table, ids, compute_dtype, sort):
+    return table[ids].astype(compute_dtype)
+
+
+def _lookup_segment_fwd(table, ids, compute_dtype, sort):
+    return table[ids].astype(compute_dtype), (ids, table.shape[0])
+
+
+def _lookup_segment_bwd(compute_dtype, sort, res, g):
+    ids, vocab = res
+    return _segment_grad(ids, g, vocab, sort), None
+
+
+_lookup_segment.defvjp(_lookup_segment_fwd, _lookup_segment_bwd)
+
+
+def embedding_lookup(
+    table: jnp.ndarray,  # f32 [vocab, dim]
+    ids: jnp.ndarray,  # int [...]
+    compute_dtype: jnp.dtype = jnp.float32,
+    grad_mode: str = "dense",
+) -> jnp.ndarray:  # [..., dim] in compute_dtype
+    """Gather rows of ``table`` at ``ids``; backward per ``grad_mode``."""
+    if grad_mode == "dense":
+        return table[ids].astype(compute_dtype)
+    if grad_mode == "segment":
+        return _lookup_segment(table, ids, compute_dtype, False)
+    if grad_mode == "segment_sorted":
+        return _lookup_segment(table, ids, compute_dtype, True)
+    raise ValueError(f"grad_mode must be one of {GRAD_MODES}, got {grad_mode!r}")

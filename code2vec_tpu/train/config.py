@@ -56,6 +56,7 @@ class TrainConfig:
     model_axis: int = 1
     context_axis: int = 1
     use_pallas: bool = False  # fused attention-pooling kernel on TPU
+    embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
 
     # checkpoint/resume (framework extension; the reference cannot resume,
     # SURVEY.md §5.4)

@@ -67,6 +67,7 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
         inverse_temp=config.inverse_temp,
         dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
         use_pallas=config.use_pallas,
+        embed_grad=config.embed_grad,
     )
 
 

@@ -183,3 +183,60 @@ class TestAngularMarginHead:
         )
         with pytest.raises(ValueError):
             model.apply(params, starts, paths, ends)
+
+
+class TestEmbedGradModes:
+    """ops.embed: all backward formulations produce the same gradients and
+    the param tree stays nn.Embed-shaped (checkpoint/sharding compat)."""
+
+    def _grads(self, embed_grad):
+        config = small_config(dropout_prob=0.0, embed_grad=embed_grad)
+        model = Code2Vec(config)
+        rng = np.random.default_rng(3)
+        starts, paths, ends, labels = make_batch(rng, config=config)
+        params = model.init(jax.random.PRNGKey(0), starts, paths, ends)
+
+        def loss(params):
+            logits, _, _ = model.apply(params, starts, paths, ends)
+            return (logits.astype(jnp.float32) ** 2).sum()
+
+        return params, jax.grad(loss)(params)
+
+    def test_param_tree_matches_nn_embed_layout(self):
+        params, _ = self._grads("dense")
+        table = params["params"]["terminal_embedding"]["embedding"]
+        assert table.shape == (50, 8) and table.dtype == jnp.float32
+        assert params["params"]["path_embedding"]["embedding"].shape == (40, 6)
+
+    @pytest.mark.parametrize("mode", ["segment", "segment_sorted"])
+    def test_grads_match_dense(self, mode):
+        params_d, grads_d = self._grads("dense")
+        params_m, grads_m = self._grads(mode)
+        # same init regardless of mode
+        jax.tree.map(np.testing.assert_array_equal, params_d, params_m)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+            grads_d,
+            grads_m,
+        )
+
+    def test_duplicate_ids_accumulate(self):
+        # repeated ids in a batch must sum their contributions in every mode
+        table = jnp.eye(4, dtype=jnp.float32)
+        from code2vec_tpu.ops.embed import embedding_lookup
+
+        ids = jnp.array([[1, 1, 2]], dtype=jnp.int32)
+        for mode in ("dense", "segment", "segment_sorted"):
+            g = jax.grad(
+                lambda t: embedding_lookup(t, ids, grad_mode=mode).sum()
+            )(table)
+            np.testing.assert_allclose(g[1], np.full(4, 2.0))
+            np.testing.assert_allclose(g[2], np.full(4, 1.0))
+            np.testing.assert_allclose(g[0], np.zeros(4))
+
+    def test_invalid_mode_raises(self):
+        from code2vec_tpu.ops.embed import embedding_lookup
+
+        with pytest.raises(ValueError):
+            embedding_lookup(jnp.zeros((3, 2)), jnp.zeros((1,), jnp.int32),
+                             grad_mode="bogus")
