@@ -111,8 +111,13 @@ def main() -> None:
         encode_size=100,  # the reference top11 recipe (README.md:34)
         dropout_prob=0.25,
         dtype=jnp.bfloat16 if jax.default_backend() != "cpu" else jnp.float32,
+        embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
     )
-    config = TrainConfig(batch_size=batch_size, max_path_length=bag)
+    config = TrainConfig(
+        batch_size=batch_size,
+        max_path_length=bag,
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "threefry2x32"),
+    )
 
     rng = np.random.default_rng(0)
     epoch = build_method_epoch(data, np.arange(batch_size), bag, rng)

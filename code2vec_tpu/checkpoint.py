@@ -9,12 +9,16 @@ state, RNG, epoch counter, and the early-stop bookkeeping all round-trip.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 from dataclasses import asdict, dataclass, field
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
 
 CHECKPOINT_DIR = "code2vec_ckpt"
 META_FILE = "train_meta.json"
@@ -30,6 +34,15 @@ class TrainMeta:
     last_accuracy: float | None = None
     bad_count: int = 0
     history: list[dict] = field(default_factory=list)
+    # PRNG impl of the saved dropout key — validated on restore so an
+    # --rng_impl mismatch fails with guidance, not an orbax shape error
+    rng_impl: str | None = None
+
+
+def _rng_impl_name(dropout_rng) -> str:
+    if jax.dtypes.issubdtype(dropout_rng.dtype, jax.dtypes.prng_key):
+        return str(jax.random.key_impl(dropout_rng))
+    return "threefry2x32"  # raw uint32 PRNGKey arrays are threefry
 
 
 def _state_pytree(state) -> dict:
@@ -44,31 +57,37 @@ def _state_pytree(state) -> dict:
     }
 
 
-def _latest_step_dir(base: str) -> str | None:
+def _latest_step_dir(base: str, prefix: str = "step") -> str | None:
     if not os.path.isdir(base):
         return None
     steps = sorted(
-        (int(name.split("_")[1]), name)
+        (int(name.rsplit("_", 1)[1]), name)
         for name in os.listdir(base)
-        if name.startswith("step_") and name.split("_")[1].isdigit()
+        if name.startswith(prefix + "_") and name.rsplit("_", 1)[1].isdigit()
     )
     return os.path.join(base, steps[-1][1]) if steps else None
 
 
-def save_checkpoint(out_dir: str, state, meta: TrainMeta) -> str:
+def save_checkpoint(out_dir: str, state, meta: TrainMeta, slot: str = "best") -> str:
     """Save the train state pytree + loop metadata under ``out_dir``.
 
-    Preemption-safe: each save goes to a fresh ``step_N`` directory and
-    older checkpoints are pruned only after the new one is fully written, so
-    a crash mid-save never leaves the run without a restorable checkpoint.
+    Two slots: ``best`` (``step_N`` dirs — the reference's best-F1 model
+    contract, main.py:231) and ``last`` (``last_N`` dirs — periodic
+    preemption-safety saves). Each slot prunes only its own older dirs, so
+    a periodic save never deletes the best model.
+
+    Preemption-safe: each save goes to a fresh directory and older ones are
+    pruned only after the new one is fully written, so a crash mid-save
+    never leaves the run without a restorable checkpoint.
     """
+    assert slot in ("best", "last"), slot
+    prefix = "step" if slot == "best" else "last"
     base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
     os.makedirs(base, exist_ok=True)
-    previous = _latest_step_dir(base)
-    path = os.path.join(base, f"step_{int(state.step)}")
+    previous = _latest_step_dir(base, prefix)
+    meta.rng_impl = _rng_impl_name(state.dropout_rng)
+    path = os.path.join(base, f"{prefix}_{int(state.step)}")
     if os.path.exists(path):
-        import shutil
-
         shutil.rmtree(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, _state_pytree(state))
@@ -80,32 +99,87 @@ def save_checkpoint(out_dir: str, state, meta: TrainMeta) -> str:
             json.dump(asdict(meta), f)
         os.replace(meta_tmp, os.path.join(out_dir, META_FILE))
         if previous is not None and previous != path:
-            import shutil
-
             shutil.rmtree(previous, ignore_errors=True)
+        if slot == "best":
+            # a newer best supersedes any older periodic save: prune
+            # `last_N` with N <= this step so dead checkpoints don't
+            # accumulate (restore picks max-N, which is now this one)
+            stale = _latest_step_dir(base, "last")
+            if stale is not None and int(stale.rsplit("_", 1)[1]) <= int(
+                state.step
+            ):
+                shutil.rmtree(stale, ignore_errors=True)
     return path
 
 
+def clear_checkpoints(out_dir: str, slot: str = "last") -> None:
+    """Remove a checkpoint slot under ``out_dir``.
+
+    Fresh (non-resume) runs clear only the ``last`` (periodic) slot: it
+    belongs to the interrupted run it was saved by, and left in place it
+    could outrank the new run's ``best`` saves at a later ``--resume``. The
+    ``best`` slot and metadata are preserved until the new run's first save
+    overwrites them, so a crash before that never leaves the directory
+    without a restorable checkpoint.
+
+    Process-0-only under multi-host; other processes race benignly since
+    they never read before the barrier implied by the first save.
+    """
+    if jax.process_index() != 0:
+        return
+    prefix = "step" if slot == "best" else "last"
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    if not os.path.isdir(base):
+        return
+    for name in os.listdir(base):
+        if name.startswith(prefix + "_"):
+            logger.info("fresh run: clearing stale checkpoint %s", name)
+            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+
+
 def restore_checkpoint(out_dir: str, state) -> tuple[object, TrainMeta] | None:
-    """Restore into the shape of ``state``; returns None if no checkpoint."""
+    """Restore into the shape of ``state``; returns None if no checkpoint.
+
+    Resumes from the newest save across both slots (the ``last`` periodic
+    save when it is fresher than the ``best`` one); ``step`` counts
+    optimizer steps monotonically, so the larger suffix is the later save.
+    """
     base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
     meta_path = os.path.join(out_dir, META_FILE)
-    path = _latest_step_dir(base)
-    if path is None or not os.path.exists(meta_path):
+    candidates = [
+        p
+        for p in (_latest_step_dir(base, "step"), _latest_step_dir(base, "last"))
+        if p is not None
+    ]
+    if not candidates or not os.path.exists(meta_path):
         return None
+    path = max(candidates, key=lambda p: int(p.rsplit("_", 1)[1]))
+    with open(meta_path) as f:
+        saved_meta = TrainMeta(**json.load(f))
+    want_impl = _rng_impl_name(state.dropout_rng)
+    # checkpoints from before rng_impl was recorded hold raw threefry keys
+    saved_impl = saved_meta.rng_impl or "threefry2x32"
+    if saved_impl != want_impl:
+        raise ValueError(
+            f"checkpoint in {base} was saved with --rng_impl "
+            f"{saved_impl} but this run uses {want_impl}; pass "
+            f"--rng_impl {saved_impl} to resume it"
+        )
     template = _state_pytree(state)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, abstract)
     dropout_rng = restored["dropout_rng"]
     if jax.dtypes.issubdtype(state.dropout_rng.dtype, jax.dtypes.prng_key):
-        dropout_rng = jax.random.wrap_key_data(dropout_rng)
+        # re-wrap with the template's impl: key-data shape differs between
+        # threefry ([2] uint32) and rbg ([4] uint32) keys
+        dropout_rng = jax.random.wrap_key_data(
+            dropout_rng, impl=jax.random.key_impl(state.dropout_rng)
+        )
     new_state = state.replace(
         params=restored["params"],
         opt_state=restored["opt_state"],
         dropout_rng=dropout_rng,
         step=int(restored["step"]),
     )
-    with open(meta_path) as f:
-        meta = TrainMeta(**json.load(f))
-    return new_state, meta
+    return new_state, saved_meta

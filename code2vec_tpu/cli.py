@@ -135,6 +135,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="batches per device-epoch dispatch")
     parser.add_argument("--class_weighting", type=str, default="reference",
                         choices=("reference", "occurrence", "none"))
+    parser.add_argument("--rng_impl", type=str, default="threefry2x32",
+                        choices=("threefry2x32", "rbg", "unsafe_rbg"),
+                        help="dropout-stream PRNG (rbg/unsafe_rbg are "
+                             "faster on TPU)")
+    parser.add_argument("--checkpoint_cycle", type=int, default=0,
+                        help="also checkpoint every N epochs (0 = best-F1 "
+                             "only) — preemption safety for pod runs")
     parser.add_argument("--resume", action="store_true", default=False,
                         help="resume from the checkpoint in --model_path")
     parser.add_argument("--profile_dir", type=str, default=None,
@@ -175,7 +182,9 @@ def config_from_args(args: argparse.Namespace):
         context_axis=args.context_axis,
         use_pallas=args.use_pallas,
         embed_grad=args.embed_grad,
+        rng_impl=args.rng_impl,
         resume=args.resume,
+        checkpoint_cycle=args.checkpoint_cycle,
         device_epoch=args.device_epoch,
         device_chunk_batches=args.device_chunk_batches,
     )

@@ -57,10 +57,17 @@ class TrainConfig:
     context_axis: int = 1
     use_pallas: bool = False  # fused attention-pooling kernel on TPU
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
+    # PRNG impl for the dropout stream: threefry2x32 (jax default,
+    # reproducible everywhere) | rbg | unsafe_rbg (faster on TPU; different
+    # stream, still seeded-deterministic per backend)
+    rng_impl: str = "threefry2x32"
 
     # checkpoint/resume (framework extension; the reference cannot resume,
     # SURVEY.md §5.4)
     resume: bool = False
+    # also save every N epochs (0 = best-F1 only) — preemption safety for
+    # pod runs; resume restores params/opt state/RNG/early-stop counters
+    checkpoint_cycle: int = 0
 
     # device-resident epochs (train/device_epoch.py): stage the corpus in
     # HBM once and run whole scanned chunks of batches per dispatch, with

@@ -23,7 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from code2vec_tpu import export as export_mod
-from code2vec_tpu.checkpoint import TrainMeta, restore_checkpoint, save_checkpoint
+from code2vec_tpu.checkpoint import (
+    TrainMeta,
+    clear_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from code2vec_tpu.data.pipeline import build_epoch, iter_batches, oov_rate, split_items
 from code2vec_tpu.data.reader import CorpusData
 from code2vec_tpu.metrics import evaluate
@@ -261,6 +266,12 @@ def train(
         if restored is not None:
             state, meta = restored
             logger.info("resumed from epoch %d (best_f1=%s)", meta.epoch, meta.best_f1)
+    elif out_dir is not None:
+        # fresh run: clear any checkpoints from a previous run in the same
+        # model_path (the reference likewise overwrites its model file,
+        # main.py:231) — otherwise a stale periodic `last_N` save could
+        # outrank this run's `step_N` saves at a later --resume
+        clear_checkpoints(out_dir)
 
     f1 = 0.0
     start_epoch = meta.epoch
@@ -379,9 +390,22 @@ def train(
                         test_result_path,
                         to_device,
                     )
-                if report_fn is None and out_dir is not None:
-                    meta.epoch = epoch + 1
-                    save_checkpoint(out_dir, state, meta)
+                save_slot = (
+                    "best" if report_fn is None and out_dir is not None else None
+                )
+            else:
+                # periodic save for preemption safety: pod slices get
+                # reclaimed mid-run; best-F1-only saves (the reference's
+                # policy, main.py:231) would lose every epoch since the
+                # last improvement on resume. Goes to the separate "last"
+                # slot so it never overwrites the best model.
+                periodic = (
+                    report_fn is None
+                    and out_dir is not None
+                    and bool(config.checkpoint_cycle)
+                    and (epoch + 1) % config.checkpoint_cycle == 0
+                )
+                save_slot = "last" if periodic else None
 
             # early stop: the counter resets whenever train loss OR accuracy
             # improves (reference quirk, main.py:233-242)
@@ -396,6 +420,11 @@ def train(
                 meta.bad_count = 0
             else:
                 meta.bad_count += 1
+
+            if save_slot is not None:
+                meta.epoch = epoch + 1
+                save_checkpoint(out_dir, state, meta, slot=save_slot)
+
             if meta.bad_count > config.early_stop_patience:
                 logger.info(
                     "early stop loss:%s, bad:%d", train_loss, meta.bad_count

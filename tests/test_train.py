@@ -131,6 +131,99 @@ class TestEndToEnd:
         assert second.epochs_run <= 3
         assert second.best_f1 >= first.best_f1
 
+    def test_periodic_checkpoint_cycle(self, tiny, tmp_path):
+        # preemption safety: with checkpoint_cycle the meta on disk advances
+        # every cycle even when F1 stops improving (best-F1-only would not)
+        import json
+
+        paths, data = tiny
+        out = tmp_path / "cycle"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=4, checkpoint_cycle=1
+        )
+        train(cfg, data, out_dir=str(out))
+        meta = json.loads((out / "train_meta.json").read_text())
+        assert meta["epoch"] == 4  # saved after the final epoch, best or not
+        # and the saved early-stop counters reflect the post-epoch state
+        assert "bad_count" in meta and "last_loss" in meta
+
+    def test_checkpoint_slots_coexist_and_fresh_run_clears(self, tiny, tmp_path):
+        # slot mechanics at the API level (independent of the F1 trajectory):
+        # a "last" save never prunes the "best" slot, restore picks the
+        # newer of the two, and a fresh (non-resume) run clears both
+        from code2vec_tpu.checkpoint import (
+            TrainMeta, clear_checkpoints, restore_checkpoint, save_checkpoint,
+        )
+        from code2vec_tpu.models.code2vec import Code2VecConfig
+        from code2vec_tpu.train.step import create_train_state
+        from code2vec_tpu.data.pipeline import build_epoch, iter_batches
+
+        paths, data = tiny
+        cfg = TrainConfig(**TINY_CFG)
+        mc = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=8, path_embed_size=8, encode_size=16,
+        )
+        rng = np.random.default_rng(0)
+        epoch = build_epoch(data, np.arange(data.n_items), cfg.max_path_length, rng)
+        batch = next(iter_batches(epoch, cfg.batch_size, rng=rng))
+        state = create_train_state(cfg, mc, jax.random.PRNGKey(0), batch)
+
+        out = tmp_path / "slots"
+        os.makedirs(out)
+        save_checkpoint(str(out), state, TrainMeta(epoch=1), slot="best")
+        later = state.replace(step=state.step + 5)
+        save_checkpoint(str(out), later, TrainMeta(epoch=3), slot="last")
+        names = sorted(d.name for d in (out / "code2vec_ckpt").iterdir())
+        assert names == ["last_5", "step_0"], names
+        restored = restore_checkpoint(str(out), state)
+        assert restored is not None
+        new_state, meta = restored
+        assert int(new_state.step) == 5 and meta.epoch == 3  # newer slot wins
+
+        clear_checkpoints(str(out))  # fresh-run reset: "last" slot only
+        names = sorted(d.name for d in (out / "code2vec_ckpt").iterdir())
+        assert names == ["step_0"], names  # best model survives
+        restored = restore_checkpoint(str(out), state)
+        assert restored is not None and int(restored[0].step) == 0
+
+        # a newer best save prunes the superseded periodic save
+        save_checkpoint(str(out), later, TrainMeta(epoch=3), slot="last")
+        newest = state.replace(step=state.step + 9)
+        save_checkpoint(str(out), newest, TrainMeta(epoch=4), slot="best")
+        names = sorted(d.name for d in (out / "code2vec_ckpt").iterdir())
+        assert names == ["step_9"], names
+
+    def test_rng_impl_mismatch_rejected(self, tiny, tmp_path):
+        paths, data = tiny
+        out = tmp_path / "mismatch"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(max_epoch=1, rng_impl="rbg")
+        train(cfg, data, out_dir=str(out))
+        cfg2 = cfg.with_updates(
+            max_epoch=2, resume=True, rng_impl="threefry2x32"
+        )
+        with pytest.raises(ValueError, match="--rng_impl rbg"):
+            train(cfg2, data, out_dir=str(out))
+
+    def test_rbg_rng_trains_and_resumes(self, tiny, tmp_path):
+        # rbg dropout stream: trains, checkpoints, and restores (key-data
+        # shape [4] differs from threefry's [2])
+        paths, data = tiny
+        out = tmp_path / "rbg"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=2, rng_impl="rbg"
+        )
+        first = train(cfg, data, out_dir=str(out))
+        assert first.best_f1 >= 0.0
+        cfg2 = cfg.with_updates(max_epoch=3, resume=True)
+        second = train(cfg2, data, out_dir=str(out))
+        assert second.epochs_run <= 2
+
     def test_task_flag_mismatch_rejected(self, tiny):
         paths, data = tiny  # loaded with infer_method only
         cfg = TrainConfig(**TINY_CFG).with_updates(infer_variable_name=True)
