@@ -135,6 +135,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="batches per device-epoch dispatch")
     parser.add_argument("--class_weighting", type=str, default="reference",
                         choices=("reference", "occurrence", "none"))
+    parser.add_argument("--no_corpus_cache", action="store_true", default=False,
+                        help="disable the <corpus>.cache.npz sidecar that "
+                             "makes repeat startups fast at top11 scale")
     parser.add_argument("--rng_impl", type=str, default="threefry2x32",
                         choices=("threefry2x32", "rbg", "unsafe_rbg"),
                         help="dropout-stream PRNG (rbg/unsafe_rbg are "
@@ -254,6 +257,7 @@ def main(argv: list[str] | None = None) -> None:
         args.terminal_idx_path,
         infer_method=args.infer_method_name,
         infer_variable=args.infer_variable_name,
+        cache=not args.no_corpus_cache,
     )
 
     if args.find_hyperparams:

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# End-to-end walkthrough: Java sources -> path contexts -> trained model ->
+# exported code vectors. Run from this directory. CPU-friendly (~2 min).
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO_ROOT="$(cd ../.. && pwd)"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+
+# 1. Extract path contexts (builds the C++ extractor on first use).
+#    dataset/methods.txt lists "<java-file>\t<method-name>" rows; "*" = all.
+python -m code2vec_tpu.extractor dataset/ . --method-declarations method_declarations.txt
+
+# 2. Train method-name prediction on the extracted corpus. The corpus is
+#    tiny, so this just demonstrates the pipeline — expect the model to
+#    memorize it within a few epochs.
+python "$REPO_ROOT/main.py" \
+  --corpus_path dataset/corpus.txt \
+  --path_idx_path dataset/path_idxs.txt \
+  --terminal_idx_path dataset/terminal_idxs.txt \
+  --batch_size 4 --encode_size 64 --max_epoch 8 --lr 0.01 \
+  --model_path output --vectors_path output/code.vec --no_cuda
+
+# 3. Inspect the exported vectors (one "label\tfloats" row per method).
+head -3 output/code.vec
+echo "---"
+echo "artifacts: dataset/{corpus,terminal_idxs,path_idxs,params}.txt, output/code.vec"
+echo "visualize: python $REPO_ROOT/visualize_code_vec.py --code_vec_path output/code.vec"

@@ -1,0 +1,61 @@
+package util;
+
+public class MathUtils {
+
+    public static int clamp(int value, int low, int high) {
+        if (value < low) {
+            return low;
+        }
+        if (value > high) {
+            return high;
+        }
+        return value;
+    }
+
+    public static long factorial(int n) {
+        long result = 1;
+        for (int i = 2; i <= n; i++) {
+            result *= i;
+        }
+        return result;
+    }
+
+    public static int gcd(int a, int b) {
+        while (b != 0) {
+            int remainder = a % b;
+            a = b;
+            b = remainder;
+        }
+        return a;
+    }
+
+    public static boolean isPrime(int candidate) {
+        if (candidate < 2) {
+            return false;
+        }
+        for (int divisor = 2; (long) divisor * divisor <= candidate; divisor++) {
+            if (candidate % divisor == 0) {
+                return false;
+            }
+        }
+        return true;
+    }
+
+    public static double mean(double[] values) {
+        double total = 0.0;
+        for (double value : values) {
+            total += value;
+        }
+        return total / values.length;
+    }
+
+    public static int maxIndex(int[] values) {
+        int best = 0;
+        for (int i = 1; i < values.length; i++) {
+            if (values[i] > values[best]) {
+                best = i;
+            }
+        }
+        return best;
+    }
+}

@@ -1,0 +1,71 @@
+package util;
+
+import java.util.ArrayList;
+import java.util.List;
+
+public class TextUtils {
+
+    public static String capitalize(String input) {
+        if (input == null || input.isEmpty()) {
+            return input;
+        }
+        char first = Character.toUpperCase(input.charAt(0));
+        return first + input.substring(1);
+    }
+
+    public static List<String> splitLines(String text) {
+        List<String> lines = new ArrayList<>();
+        int start = 0;
+        for (int i = 0; i < text.length(); i++) {
+            if (text.charAt(i) == '\n') {
+                lines.add(text.substring(start, i));
+                start = i + 1;
+            }
+        }
+        if (start < text.length()) {
+            lines.add(text.substring(start));
+        }
+        return lines;
+    }
+
+    public static int countOccurrences(String haystack, char needle) {
+        int count = 0;
+        for (int i = 0; i < haystack.length(); i++) {
+            if (haystack.charAt(i) == needle) {
+                count++;
+            }
+        }
+        return count;
+    }
+
+    public static String joinWith(List<String> parts, String separator) {
+        StringBuilder builder = new StringBuilder();
+        for (int i = 0; i < parts.size(); i++) {
+            if (i > 0) {
+                builder.append(separator);
+            }
+            builder.append(parts.get(i));
+        }
+        return builder.toString();
+    }
+
+    public static boolean isBlank(String value) {
+        if (value == null) {
+            return true;
+        }
+        for (int i = 0; i < value.length(); i++) {
+            if (!Character.isWhitespace(value.charAt(i))) {
+                return false;
+            }
+        }
+        return true;
+    }
+
+    public static String reverse(String input) {
+        StringBuilder builder = new StringBuilder(input.length());
+        for (int i = input.length() - 1; i >= 0; i--) {
+            builder.append(input.charAt(i));
+        }
+        return builder.toString();
+    }
+}

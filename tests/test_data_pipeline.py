@@ -228,3 +228,77 @@ class TestSynthFiles:
         params = read_params(tiny_corpus["params"])
         assert params["method_count"] == "200"
         assert params["max_length"] == "8"
+
+
+class TestCorpusCache:
+    def _load(self, paths, **kw):
+        return load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"], **kw
+        )
+
+    def test_cache_round_trip_identical(self, tmp_path):
+        import os
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        import glob
+
+        cold = self._load(paths, infer_method=True, infer_variable=True)
+        assert glob.glob(str(paths["corpus"]) + ".cache-*.npz")
+        warm = self._load(paths, infer_method=True, infer_variable=True)
+        np.testing.assert_array_equal(cold.starts, warm.starts)
+        np.testing.assert_array_equal(cold.paths, warm.paths)
+        np.testing.assert_array_equal(cold.ends, warm.ends)
+        np.testing.assert_array_equal(cold.row_splits, warm.row_splits)
+        np.testing.assert_array_equal(cold.labels, warm.labels)
+        np.testing.assert_array_equal(
+            cold.variable_indexes, warm.variable_indexes
+        )
+        assert cold.normalized_labels == warm.normalized_labels
+        assert cold.sources == warm.sources
+        assert cold.aliases == warm.aliases
+        assert cold.label_vocab.stoi == warm.label_vocab.stoi
+        assert cold.label_vocab.freq == warm.label_vocab.freq
+        assert (
+            cold.label_vocab.itosubtokens == warm.label_vocab.itosubtokens
+        )
+
+    def test_cache_invalidated_on_corpus_change(self, tmp_path):
+        import os
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        self._load(paths)
+        # append a record: size/mtime change must invalidate the cache
+        with open(paths["corpus"], "a", encoding="utf-8") as f:
+            f.write("#9999\nlabel:extraMethod\nclass:X.java\npaths:\n1\t1\t1\n\n")
+        fresh = self._load(paths)
+        assert fresh.n_items == SPECS["tiny"].n_methods + 1
+        assert "extramethod" in fresh.label_vocab.stoi  # normalized label present
+
+    def test_cache_keyed_on_task_flags(self, tmp_path):
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        method_only = self._load(paths, infer_method=True, infer_variable=False)
+        both = self._load(paths, infer_method=True, infer_variable=True)
+        # the variable task adds @var_* original names to the label vocab;
+        # strict > proves the second load did NOT reuse the first's cache
+        assert len(both.label_vocab) > len(method_only.label_vocab)
+        # and a second method-only load hits its own (flag-keyed) cache
+        again = self._load(paths, infer_method=True, infer_variable=False)
+        assert again.label_vocab.stoi == method_only.label_vocab.stoi
+
+    def test_corrupt_cache_degrades_to_reparse(self, tmp_path):
+        import glob
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        cold = self._load(paths)
+        npz = glob.glob(str(paths["corpus"]) + ".cache-*.npz")[0]
+        with open(npz, "wb") as f:
+            f.write(b"not a zip file")
+        recovered = self._load(paths)  # must warn + reparse, not crash
+        np.testing.assert_array_equal(cold.starts, recovered.starts)
+
+    def test_cache_off(self, tmp_path):
+        import os
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        self._load(paths, cache=False)
+        assert not os.path.exists(str(paths["corpus"]) + ".cache.npz")
