@@ -186,6 +186,7 @@ def load_corpus(
     infer_method: bool = True,
     infer_variable: bool = False,
     cache: bool = True,
+    native: bool = True,
 ) -> CorpusData:
     """Load vocabs + corpus into a CorpusData.
 
@@ -250,51 +251,83 @@ def load_corpus(
     )
     logger.info("variable index size: %d", len(variable_indexes))
 
+    native_arrays = None
+    if native:
+        try:
+            from code2vec_tpu.extractor import parse_corpus_native
+
+            native_arrays = parse_corpus_native(corpus_path)
+        except Exception as e:  # missing toolchain, parse error, ...
+            logger.warning(
+                "native corpus parser unavailable (%s); using Python parser", e
+            )
+
+    if native_arrays is not None:
+        raw_starts, raw_paths, raw_ends, row_splits, ids_arr, headers, var_lists = (
+            native_arrays
+        )
+        starts = raw_starts + QUESTION_TOKEN_INDEX
+        ends = raw_ends + QUESTION_TOKEN_INDEX
+        paths = raw_paths
+        missing_id = ids_arr < 0  # records without a #id line: positional
+        if missing_id.any():
+            ids_arr = ids_arr.copy()
+            ids_arr[missing_id] = np.nonzero(missing_id)[0]
+        parser_tag = "native parse"
+    else:
+        starts_parts: list[np.ndarray] = []
+        paths_parts: list[np.ndarray] = []
+        ends_parts: list[np.ndarray] = []
+        counts: list[int] = []
+        id_list: list[int] = []
+        headers = []
+        var_lists = []
+        for record in iter_corpus_records(corpus_path):
+            id_list.append(record.id if record.id is not None else len(id_list))
+            headers.append((record.label or "", record.source))
+            var_lists.append(record.aliases)
+            contexts = np.asarray(record.path_contexts, dtype=np.int32).reshape(-1, 3)
+            starts_parts.append(contexts[:, 0] + QUESTION_TOKEN_INDEX)
+            paths_parts.append(contexts[:, 1])
+            ends_parts.append(contexts[:, 2] + QUESTION_TOKEN_INDEX)
+            counts.append(len(contexts))
+        row_splits = np.zeros(len(counts) + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_splits[1:])
+        starts = (
+            np.concatenate(starts_parts) if starts_parts else np.zeros(0, np.int32)
+        )
+        paths = np.concatenate(paths_parts) if paths_parts else np.zeros(0, np.int32)
+        ends = np.concatenate(ends_parts) if ends_parts else np.zeros(0, np.int32)
+        ids_arr = np.asarray(id_list, dtype=np.int64)
+        parser_tag = "python parse"
+
+    # per-record label/alias processing — ONE implementation for both
+    # parsers, so label-vocab insertion order (and hence label indices)
+    # cannot drift between them (reference: model/dataset_reader.py:94-125)
     label_vocab = Vocab()
-    starts_parts: list[np.ndarray] = []
-    paths_parts: list[np.ndarray] = []
-    ends_parts: list[np.ndarray] = []
-    counts: list[int] = []
-    ids: list[int] = []
     labels: list[int] = []
     normalized_labels: list[str] = []
     sources: list[str | None] = []
     aliases: list[dict[str, str]] = []
-
-    for record in iter_corpus_records(corpus_path):
-        ids.append(record.id if record.id is not None else len(ids))
-        sources.append(record.source)
-
-        normalized_lower, _ = normalize_and_subtokenize(record.label or "")
+    for (label, source), var_pairs in zip(headers, var_lists):
+        sources.append(source)
+        normalized_lower, _ = normalize_and_subtokenize(label)
         normalized_labels.append(normalized_lower)
-        if infer_method:
-            labels.append(label_vocab.add_label(record.label or ""))
-        else:
-            labels.append(-1)
-
-        contexts = np.asarray(record.path_contexts, dtype=np.int32).reshape(-1, 3)
-        starts_parts.append(contexts[:, 0] + QUESTION_TOKEN_INDEX)
-        paths_parts.append(contexts[:, 1])
-        ends_parts.append(contexts[:, 2] + QUESTION_TOKEN_INDEX)
-        counts.append(len(contexts))
-
+        labels.append(label_vocab.add_label(label) if infer_method else -1)
         alias_map: dict[str, str] = {}
-        for original, alias in record.aliases:
+        for original, alias in var_pairs:
             normalized_var, _ = normalize_and_subtokenize(original)
             alias_map[alias] = normalized_var.lower()
             if infer_variable and alias.startswith("@var_"):
                 label_vocab.add_label(original)
         aliases.append(alias_map)
 
-    row_splits = np.zeros(len(counts) + 1, dtype=np.int64)
-    np.cumsum(counts, out=row_splits[1:])
-
     data = CorpusData(
-        starts=np.concatenate(starts_parts) if starts_parts else np.zeros(0, np.int32),
-        paths=np.concatenate(paths_parts) if paths_parts else np.zeros(0, np.int32),
-        ends=np.concatenate(ends_parts) if ends_parts else np.zeros(0, np.int32),
+        starts=starts,
+        paths=paths,
+        ends=ends,
         row_splits=row_splits,
-        ids=np.asarray(ids, dtype=np.int64),
+        ids=ids_arr,
         labels=np.asarray(labels, dtype=np.int32),
         normalized_labels=normalized_labels,
         sources=sources,
@@ -307,7 +340,10 @@ def load_corpus(
         variable_indexes=variable_indexes,
     )
     logger.info("label vocab size: %d", len(label_vocab))
-    logger.info("corpus: %d items, %d contexts", data.n_items, data.n_contexts)
+    logger.info(
+        "corpus (%s): %d items, %d contexts",
+        parser_tag, data.n_items, data.n_contexts,
+    )
     if cache and fingerprint is not None:
         _write_cache(corpus_path, fingerprint, data)
     return data

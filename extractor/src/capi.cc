@@ -84,3 +84,212 @@ char* c2v_extract_source(const char* source, const char* method_name,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native corpus.txt parser: the numeric path-triple lines are ~98% of a
+// corpus file's bytes, and parsing them in Python dominates cold-start at
+// top11 scale (605k methods, SURVEY.md §6). This parses the whole file into
+// flat arrays with the exact record semantics of the Python state machine
+// (code2vec_tpu/formats/corpus_io.py, itself mirroring the reference's
+// model/dataset_reader.py:72-128). String fields come back in one packed
+// blob the Python side splits:
+//   headers: per record "<label>\x1f<flag><source>\x1e"  (flag '1' = class:
+//            line present, '0' = absent)
+//   vars:    per record ("<original>\x1f<alias>\x1d")* "\x1e"
+// Raw indices are returned unshifted; the caller applies the @question +1
+// shift (model/dataset_reader.py:113-115).
+
+#include <cstdint>
+#include <fstream>
+#include <vector>
+
+extern "C" {
+
+typedef struct {
+  int64_t n_records;
+  int64_t n_contexts;
+  int32_t* starts;
+  int32_t* paths;
+  int32_t* ends;
+  int64_t* row_splits;  // [n_records + 1]
+  int64_t* ids;         // [n_records], -1 when the record had no #id line
+  char* headers;
+  int64_t headers_len;
+  char* vars;
+  int64_t vars_len;
+} C2vCorpus;
+
+void c2v_free_corpus(C2vCorpus* c) {
+  if (!c) return;
+  std::free(c->starts);
+  std::free(c->paths);
+  std::free(c->ends);
+  std::free(c->row_splits);
+  std::free(c->ids);
+  std::free(c->headers);
+  std::free(c->vars);
+  std::free(c);
+}
+
+C2vCorpus* c2v_parse_corpus(const char* path) {
+  try {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) {
+      g_last_error = std::string("cannot open ") + path;
+      return nullptr;
+    }
+    f.seekg(0, std::ios::end);
+    const std::streamoff size = f.tellg();
+    f.seekg(0, std::ios::beg);
+    std::string buf;
+    buf.resize(static_cast<size_t>(size));
+    if (size > 0 && !f.read(buf.data(), size)) {
+      g_last_error = std::string("short read on ") + path;
+      return nullptr;
+    }
+
+    std::vector<int32_t> starts, paths, ends;
+    std::vector<int64_t> row_splits{0}, ids;
+    std::string headers, vars;
+
+    enum Mode { HEADER, PATHS, VARS };
+    Mode mode = HEADER;
+    bool in_record = false;
+    int64_t record_id = -1;
+    std::string label, source;
+    bool has_source = false;
+    std::string record_vars;
+
+    auto finalize = [&]() {
+      if (!in_record) return;
+      row_splits.push_back(static_cast<int64_t>(starts.size()));
+      ids.push_back(record_id);
+      headers += label;
+      headers += '\x1f';
+      headers += has_source ? '1' : '0';
+      headers += source;
+      headers += '\x1e';
+      vars += record_vars;
+      vars += '\x1e';
+      in_record = false;
+      record_id = -1;
+      label.clear();
+      source.clear();
+      has_source = false;
+      record_vars.clear();
+      mode = HEADER;
+    };
+
+    const char* p = buf.data();
+    const char* bufend = p + buf.size();
+    while (p < bufend) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', static_cast<size_t>(bufend - p)));
+      const char* line_end = nl ? nl : bufend;
+      // trim " \r\t" both ends (python: line.strip(" \r\n\t"))
+      const char* s = p;
+      const char* e = line_end;
+      while (s < e && (*s == ' ' || *s == '\r' || *s == '\t')) ++s;
+      while (e > s && (e[-1] == ' ' || e[-1] == '\r' || e[-1] == '\t')) --e;
+      size_t len = static_cast<size_t>(e - s);
+
+      if (len == 0) {
+        finalize();
+      } else {
+        if (!in_record) in_record = true;
+        if (s[0] == '#') {
+          char* q = nullptr;
+          record_id = std::strtoll(s + 1, &q, 10);
+          if (q == s + 1) {
+            g_last_error = "malformed record id line: " + std::string(s, len);
+            return nullptr;
+          }
+        } else if (len >= 6 && std::memcmp(s, "label:", 6) == 0) {
+          label.assign(s + 6, len - 6);
+        } else if (len >= 6 && std::memcmp(s, "class:", 6) == 0) {
+          source.assign(s + 6, len - 6);
+          has_source = true;
+        } else if (len >= 4 && std::memcmp(s, "doc:", 4) == 0) {
+          // parsed and discarded (reference: dataset_reader.py:109-110)
+        } else if (len >= 6 && std::memcmp(s, "paths:", 6) == 0) {
+          mode = PATHS;
+        } else if (len >= 5 && std::memcmp(s, "vars:", 5) == 0) {
+          mode = VARS;
+        } else if (mode == PATHS) {
+          // first three tab-separated ints; tolerate trailing columns but
+          // fail loudly on missing/non-numeric fields (the Python parser
+          // raises there too — corruption must not become silent zeros)
+          char* q1 = nullptr;
+          char* q2 = nullptr;
+          char* q3 = nullptr;
+          long a = std::strtol(s, &q1, 10);
+          long b = std::strtol(q1, &q2, 10);
+          long c = std::strtol(q2, &q3, 10);
+          if (q1 == s || q2 == q1 || q3 == q2) {
+            g_last_error = "malformed path-context line: " +
+                           std::string(s, len);
+            return nullptr;
+          }
+          starts.push_back(static_cast<int32_t>(a));
+          paths.push_back(static_cast<int32_t>(b));
+          ends.push_back(static_cast<int32_t>(c));
+        } else if (mode == VARS) {
+          const char* tab = static_cast<const char*>(
+              std::memchr(s, '\t', len));
+          if (!tab) {
+            // Python raises IndexError on a tab-less vars line
+            g_last_error = "malformed vars line: " + std::string(s, len);
+            return nullptr;
+          }
+          const char* v2 = tab + 1;
+          const char* tab2 = static_cast<const char*>(
+              std::memchr(v2, '\t', static_cast<size_t>(e - v2)));
+          const char* v2end = tab2 ? tab2 : e;
+          record_vars.append(s, static_cast<size_t>(tab - s));
+          record_vars += '\x1f';
+          record_vars.append(v2, static_cast<size_t>(v2end - v2));
+          record_vars += '\x1d';
+        }
+      }
+      if (!nl) break;
+      p = nl + 1;
+    }
+    finalize();  // trailing record without a final blank line
+
+    auto* out = static_cast<C2vCorpus*>(std::malloc(sizeof(C2vCorpus)));
+    if (!out) { g_last_error = "out of memory"; return nullptr; }
+    auto copy_i32 = [](const std::vector<int32_t>& v) {
+      auto* m = static_cast<int32_t*>(std::malloc(v.size() * 4 + 4));
+      if (m) std::memcpy(m, v.data(), v.size() * 4);
+      return m;
+    };
+    auto copy_i64 = [](const std::vector<int64_t>& v) {
+      auto* m = static_cast<int64_t*>(std::malloc(v.size() * 8 + 8));
+      if (m) std::memcpy(m, v.data(), v.size() * 8);
+      return m;
+    };
+    out->n_records = static_cast<int64_t>(ids.size());
+    out->n_contexts = static_cast<int64_t>(starts.size());
+    out->starts = copy_i32(starts);
+    out->paths = copy_i32(paths);
+    out->ends = copy_i32(ends);
+    out->row_splits = copy_i64(row_splits);
+    out->ids = copy_i64(ids);
+    out->headers = dup_string(headers);
+    out->headers_len = static_cast<int64_t>(headers.size());
+    out->vars = dup_string(vars);
+    out->vars_len = static_cast<int64_t>(vars.size());
+    if (!out->starts || !out->paths || !out->ends || !out->row_splits ||
+        !out->ids || !out->headers || !out->vars) {
+      g_last_error = "out of memory";
+      c2v_free_corpus(out);
+      return nullptr;
+    }
+    return out;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+}  // extern "C"

@@ -302,3 +302,91 @@ class TestCorpusCache:
         paths = generate_corpus_files(tmp_path, SPECS["tiny"])
         self._load(paths, cache=False)
         assert not os.path.exists(str(paths["corpus"]) + ".cache.npz")
+
+
+class TestNativeCorpusParse:
+    def test_native_parser_loads(self, tmp_path):
+        """parse_corpus_native must actually run (no silent fallback):
+        a build/ABI regression fails here instead of being masked by
+        load_corpus's Python-parser fallback."""
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        starts, cpaths, ends, row_splits, ids, headers, var_lists = (
+            parse_corpus_native(paths["corpus"])
+        )
+        assert len(row_splits) == SPECS["tiny"].n_methods + 1
+        assert len(headers) == len(var_lists) == SPECS["tiny"].n_methods
+        assert len(starts) == len(cpaths) == len(ends) == row_splits[-1]
+
+    def test_native_matches_python_parser(self, tmp_path, caplog):
+        """The C++ corpus parser and the Python state machine must agree
+        on every field, including label-vocab insertion order."""
+        import logging
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        kw = dict(infer_method=True, infer_variable=True, cache=False)
+        py = load_corpus(paths["corpus"], paths["path_idx"],
+                         paths["terminal_idx"], native=False, **kw)
+        with caplog.at_level(logging.WARNING):
+            nat = load_corpus(paths["corpus"], paths["path_idx"],
+                              paths["terminal_idx"], native=True, **kw)
+        assert "native corpus parser unavailable" not in caplog.text
+        np.testing.assert_array_equal(py.starts, nat.starts)
+        np.testing.assert_array_equal(py.paths, nat.paths)
+        np.testing.assert_array_equal(py.ends, nat.ends)
+        np.testing.assert_array_equal(py.row_splits, nat.row_splits)
+        np.testing.assert_array_equal(py.ids, nat.ids)
+        np.testing.assert_array_equal(py.labels, nat.labels)
+        assert py.sources == nat.sources
+        assert py.aliases == nat.aliases
+        assert py.normalized_labels == nat.normalized_labels
+        assert py.label_vocab.stoi == nat.label_vocab.stoi
+        assert py.label_vocab.itosubtokens == nat.label_vocab.itosubtokens
+
+    def test_native_handles_edge_records(self, tmp_path):
+        """Records with no #id, no class:, a doc: line, trailing columns in
+        path rows, and a missing final blank line."""
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_text(
+            "#7\nlabel:getFoo\nclass:A.java\ndoc:ignored\npaths:\n"
+            "1\t2\t3\n4\t5\t6\textra\nvars:\ncounter\t@var_0\n"
+            "\n"
+            "label:setBar\npaths:\n7\t8\t9"  # no id, no class, no final \n
+        )
+        term = tmp_path / "terminal_idxs.txt"
+        term.write_text("0\t<PAD/>\n1\t@var_0\n" + "".join(
+            f"{i}\tt{i}\n" for i in range(2, 11)))
+        pathv = tmp_path / "path_idxs.txt"
+        pathv.write_text("0\t<PAD/>\n" + "".join(
+            f"{i}\tp{i}\n" for i in range(1, 10)))
+        kw = dict(infer_method=True, infer_variable=True, cache=False)
+        py = load_corpus(corpus, pathv, term, native=False, **kw)
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        parse_corpus_native(corpus)  # direct: no fallback can mask failure
+        nat = load_corpus(corpus, pathv, term, native=True, **kw)
+        assert nat.n_items == 2 and nat.n_contexts == 3
+        np.testing.assert_array_equal(py.starts, nat.starts)
+        np.testing.assert_array_equal(py.ids, nat.ids)
+        assert py.sources == nat.sources == ["A.java", None]
+        assert py.aliases == nat.aliases
+
+    def test_native_rejects_malformed_paths(self, tmp_path):
+        """Corruption must fail the native parse loudly (then load_corpus
+        falls back to the Python parser, which raises too) — never silent
+        zeros in the context arrays."""
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        corpus = tmp_path / "bad.txt"
+        corpus.write_text("#0\nlabel:x\npaths:\n1\t2\n\n")  # 2 fields
+        with pytest.raises(RuntimeError, match="malformed path-context"):
+            parse_corpus_native(corpus)
+
+    def test_native_rejects_tabless_vars(self, tmp_path):
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        corpus = tmp_path / "bad2.txt"
+        corpus.write_text("#0\nlabel:x\npaths:\n1\t2\t3\nvars:\nnotab\n\n")
+        with pytest.raises(RuntimeError, match="malformed vars"):
+            parse_corpus_native(corpus)
