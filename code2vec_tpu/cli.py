@@ -115,7 +115,8 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=("float32", "bfloat16"),
                         help="matmul/activation dtype; bfloat16 for TPU MXU")
     parser.add_argument("--use_pallas", action="store_true", default=False,
-                        help="fused attention-pooling Pallas kernel (single-chip)")
+                        help="fused attention-pooling Pallas kernel (composes "
+                             "with data/model mesh axes)")
     from code2vec_tpu.ops.embed import GRAD_MODES
 
     parser.add_argument("--embed_grad", type=str, default="dense",

@@ -105,16 +105,70 @@ def _forward(contexts, mask, attn_param, *, block_b: int, interpret: bool):
     return cv[:b], weights[:b, :bag]
 
 
+_partitioned_forward_cache: dict = {}
+
+
+def _get_partitioned_forward(block_b: int, interpret: bool):
+    """The pallas forward wrapped in ``custom_partitioning`` so GSPMD can
+    shard it batch-wise over a mesh instead of replicating the Mosaic
+    custom call behind a full all-gather. The rule: batch follows the
+    operand sharding, bag/encode dims are forced replicated per shard (the
+    kernel's softmax needs the whole bag) — GSPMD inserts the resharding
+    if an upstream op sharded them."""
+    key = (block_b, interpret)
+    if key not in _partitioned_forward_cache:
+        from jax.experimental.custom_partitioning import custom_partitioning
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def fwd(contexts, mask, attn_param):
+            return _forward(
+                contexts, mask, attn_param, block_b=block_b, interpret=interpret
+            )
+
+        def _batch_spec(arg_shapes):
+            spec = arg_shapes[0].sharding.spec
+            return spec[0] if len(spec) else None
+
+        def infer_sharding(mesh, arg_shapes, result_shape):
+            b = _batch_spec(arg_shapes)
+            return (
+                NamedSharding(mesh, P(b, None)),
+                NamedSharding(mesh, P(b, None)),
+            )
+
+        def partition(mesh, arg_shapes, result_shape):
+            b = _batch_spec(arg_shapes)
+            arg_shardings = (
+                NamedSharding(mesh, P(b, None, None)),
+                NamedSharding(mesh, P(b, None)),
+                NamedSharding(mesh, P()),
+            )
+            out_shardings = (
+                NamedSharding(mesh, P(b, None)),
+                NamedSharding(mesh, P(b, None)),
+            )
+            return mesh, fwd, out_shardings, arg_shardings
+
+        p = custom_partitioning(fwd)
+        p.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer_sharding,
+            sharding_rule="b l e, b l, e -> b e, b l",
+        )
+        _partitioned_forward_cache[key] = p
+    return _partitioned_forward_cache[key]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _pool(contexts, mask, attn_param, block_b, interpret):
-    return _forward(
-        contexts, mask, attn_param, block_b=block_b, interpret=interpret
+    return _get_partitioned_forward(block_b, interpret)(
+        contexts, mask, attn_param
     )
 
 
 def _pool_fwd(contexts, mask, attn_param, block_b, interpret):
-    cv, weights = _forward(
-        contexts, mask, attn_param, block_b=block_b, interpret=interpret
+    cv, weights = _get_partitioned_forward(block_b, interpret)(
+        contexts, mask, attn_param
     )
     return (cv, weights), (contexts, mask, attn_param, weights)
 

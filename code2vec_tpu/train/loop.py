@@ -165,13 +165,15 @@ def train(
             make_parallel_train_step,
         )
 
-        if config.use_pallas:
-            # GSPMD has no partitioning rule for the Mosaic custom call; the
-            # kernel would be replicated with a full context all-gather
+        if config.use_pallas and config.context_axis > 1:
+            # batch/model sharding composes with the kernel (it carries a
+            # custom_partitioning rule that shards the batch dim), but a
+            # ctx-sharded bag needs the streaming-softmax decomposition
+            # (parallel.context) which the fused kernel doesn't implement
             raise ValueError(
-                "use_pallas with mesh axes > 1 is not supported yet: the "
-                "fused kernel is single-device; use the XLA path (default) "
-                "on meshes"
+                "use_pallas with context_axis > 1 is not supported: the "
+                "fused kernel pools the whole bag per device; use the XLA "
+                "path (default) for context parallelism"
             )
 
         if config.batch_size % config.data_axis:

@@ -92,7 +92,10 @@ class TestDegenerateRows:
 
 
 class TestMeshGate:
-    def test_pallas_plus_mesh_rejected(self, tmp_path):
+    def test_pallas_plus_ctx_axis_rejected(self, tmp_path):
+        # data/model mesh axes compose with the kernel (custom_partitioning
+        # shards the batch dim; TestPallasOnMesh), but a ctx-sharded bag
+        # needs the streaming-softmax path the kernel doesn't implement
         from code2vec_tpu.data.reader import load_corpus
         from code2vec_tpu.data.synth import SPECS, generate_corpus_files
         from code2vec_tpu.train.config import TrainConfig
@@ -100,9 +103,23 @@ class TestMeshGate:
 
         paths = generate_corpus_files(tmp_path, SPECS["tiny"])
         data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
-        cfg = TrainConfig(use_pallas=True, data_axis=2, max_epoch=1)
-        with pytest.raises(ValueError, match="use_pallas with mesh"):
+        cfg = TrainConfig(use_pallas=True, context_axis=2, max_epoch=1)
+        with pytest.raises(ValueError, match="use_pallas with context_axis"):
             train(cfg, data)
+
+    def test_pallas_plus_data_axis_trains(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        cfg = TrainConfig(use_pallas=True, data_axis=2, max_epoch=1,
+                          batch_size=32, max_path_length=16, encode_size=16,
+                          terminal_embed_size=8, path_embed_size=8)
+        res = train(cfg, data)
+        assert res.epochs_run == 1
 
 
 class TestEndToEnd:
@@ -127,3 +144,49 @@ class TestEndToEnd:
         res = train(cfg, data)
         assert np.isfinite(res.history[-1]["train_loss"])
         assert res.final_f1 > 0.0
+
+
+class TestPallasOnMesh:
+    """--use_pallas composed with data/model mesh axes: the kernel's
+    custom_partitioning rule shards the batch dim instead of replicating
+    the Mosaic call behind an all-gather."""
+
+    def test_matches_xla_path_on_mesh(self):
+        from code2vec_tpu.models.code2vec import Code2VecConfig
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_batch, shard_state
+        from code2vec_tpu.parallel.step import make_parallel_train_step
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state
+
+        mesh = make_mesh(data=4, model=2, ctx=1)
+        rng = np.random.default_rng(0)
+        B, L = 16, 24
+        base = dict(
+            terminal_count=60, path_count=50, label_count=9,
+            terminal_embed_size=8, path_embed_size=8, encode_size=16,
+            dropout_prob=0.0,
+        )
+        batch = {
+            "ids": np.arange(B, dtype=np.int64),
+            "starts": rng.integers(1, 60, (B, L)).astype(np.int32),
+            "paths": rng.integers(1, 50, (B, L)).astype(np.int32),
+            "ends": rng.integers(1, 60, (B, L)).astype(np.int32),
+            "labels": rng.integers(0, 9, B).astype(np.int32),
+            "example_mask": np.ones(B, np.float32),
+        }
+        batch["starts"][:, L // 2:] = 0
+
+        losses = {}
+        for use_pallas in (False, True):
+            mc = Code2VecConfig(**base, use_pallas=use_pallas)
+            tc = TrainConfig(batch_size=B, max_path_length=L)
+            state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+            state = shard_state(mesh, state)
+            cw = jnp.ones(mc.label_count, jnp.float32)
+            step = make_parallel_train_step(mc, cw, mesh, state)
+            device_batch = shard_batch(mesh, batch)
+            state, loss = step(state, device_batch)
+            state, loss2 = step(state, device_batch)
+            losses[use_pallas] = (float(loss), float(loss2))
+        np.testing.assert_allclose(losses[False], losses[True], rtol=2e-5)
