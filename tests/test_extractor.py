@@ -376,3 +376,54 @@ class TestConstructorChainingAndMethodRefs:
             "MethodReferenceExpr↓ArrayType" in p
             for p in result.path_vocab.values()
         )
+
+
+HARD_CASES = {
+    "generic_method_call": "class A { void f() { java.util.Collections.<String>emptyList(); } }",
+    "nested_generics": "class A { java.util.Map<String, java.util.List<int[]>> m; void f() { m = new java.util.HashMap<>(); } }",
+    "shift_vs_generics": "class A { int f(int x) { java.util.Map<String, java.util.List<String>> m = new java.util.HashMap<>(); int y = x >> 2; return m.size() + (y >>> 1); } }",
+    "relational_ops": "class A { boolean f(int a, int b) { return a < b && b > 3; } }",
+    "ternary_nest": "class A { int f(int x) { return x > 0 ? x < 10 ? 1 : 2 : 0; } }",
+    "anon_class": "class A { Runnable f() { return new Runnable() { public void run() { int x = 1; } }; } }",
+    "static_nested_enum": "class A { enum E { X, Y { void g() {} }; void g() {} } int f() { return E.X.ordinal(); } }",
+    "varargs": "class A { int f(int... xs) { int s = 0; for (int x : xs) s += x; return s; } }",
+    "try_with_resources": "class A { void f() { try (java.io.StringReader r = new java.io.StringReader(\"x\"); java.io.StringReader q = new java.io.StringReader(\"y\")) { r.read(); } catch (Exception e) { } finally { } } }",
+    "multi_catch": "class A { void f() { try { g(); } catch (IllegalStateException | IllegalArgumentException e) { throw e; } } void g() {} }",
+    "labeled_loops": "class A { void f() { outer: for (int i = 0; i < 3; i++) { for (int j = 0; j < 3; j++) { if (j > i) continue outer; if (i == 2) break outer; } } } }",
+    "lambda_block": "class A { java.util.function.Function<Integer,Integer> f() { return x -> { int y = x + 1; return y * 2; }; } }",
+    "method_ref_static": "class A { java.util.function.Function<String,Integer> f() { return Integer::parseInt; } }",
+    "array_of_arrays": "class A { int f() { int[][] g = new int[2][3]; g[0][1] = 5; return g[0][1]; } }",
+    "array_init": "class A { int[] f() { return new int[]{1, 2, 3}; } }",
+    "cast_chain": "class A { long f(Object o) { return ((Number) o).longValue(); } }",
+    "instanceof_": "class A { boolean f(Object o) { return o instanceof String; } }",
+    "switch_fallthrough": "class A { int f(int x) { switch (x) { case 1: case 2: return 1; default: return 0; } } }",
+    "synchronized_": "class A { void f() { synchronized (this) { int x = 1; } } }",
+    "inner_class_access": "class A { class B { int y; } int f() { B b = new B(); return b.y; } }",
+    "interface_default": "interface I { default int f(int x) { return x + 1; } static int g() { return 2; } }",
+    "annotations": "class A { @Deprecated @SuppressWarnings({\"unchecked\", \"raw\"}) int f() { return 1; } }",
+    "char_ops": "class A { boolean f(char c) { return c >= 'a' && c <= 'z'; } }",
+    "bit_ops": "class A { int f(int x) { return (x << 2) | (x >>> 1) ^ (x >> 3) & ~x; } }",
+    "hex_bin_literals": "class A { long f() { return 0xFFL + 0b1010 + 017 + 1_000_000 + 1e-3 > 0 ? 1L : 0L; } }",
+    "generic_bounds": "class A { <T extends Comparable<? super T>> T max(java.util.List<? extends T> xs) { T best = xs.get(0); for (T x : xs) if (x.compareTo(best) > 0) best = x; return best; } }",
+    "this_chain": "class A { int v; A set(int v) { this.v = v; return this; } int f() { return set(3).v; } }",
+    "super_call": "class B { int g() { return 1; } } class A extends B { int g() { return super.g() + 1; } }",
+    "static_init_field": "class A { static int X; static { X = 3; } int f() { return X; } }",
+    "do_while": "class A { int f(int x) { int n = 0; do { n++; x /= 2; } while (x > 0); return n; } }",
+    "assert_stmt": "class A { void f(int x) { assert x > 0 : \"bad\" + x; } }",
+    "constructor_this": "class A { int v; A() { this(5); } A(int v) { this.v = v; } int f() { return v; } }",
+    "unicode_ident": "class A { int f() { int café = 2; return café; } }",
+}
+
+
+class TestHardJavaConstructs:
+    """Parse-robustness corpus: every construct must parse and yield at
+    least one path-context (regression net for the hand-written parser)."""
+
+    @pytest.mark.parametrize("name", sorted(HARD_CASES))
+    def test_parses_and_extracts(self, name):
+        result = extract_source(HARD_CASES[name])
+        assert result.methods, f"{name}: no methods extracted"
+        # per-method, not aggregate: a regression that drops one method's
+        # body (the construct under test) must not be masked by siblings
+        for m in result.methods:
+            assert m.path_contexts, f"{name}: method {m.label!r} empty"
