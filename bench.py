@@ -11,9 +11,12 @@ scanned chunks of [1024, 200] train steps per dispatch
 (train/device_epoch.py). Accounting matches the reference's work per step:
 B x L context slots.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline compares against the newest BENCH_r*.json in the repo (1.0 on
-the first ever run).
+Output contract: a detail JSON line goes to stderr first, then the headline
+metric JSON {"metric", "value", "unit", "vs_baseline", "backend"} is the
+LAST line printed to stdout — the driver parses the final JSON line of the
+merged stream. On failure, a metric line with value=null and an "error"
+field is still emitted. vs_baseline compares against the newest successful
+BENCH_r*.json in the repo (1.0 on the first ever run).
 """
 
 from __future__ import annotations
@@ -28,6 +31,39 @@ import time
 import numpy as np
 
 
+def _extract_value(payload: dict) -> float | None:
+    """Pull the headline metric out of one BENCH_r*.json.
+
+    The driver writes {n, cmd, rc, tail, parsed}: `parsed` is whichever JSON
+    line it captured from the merged stdout/stderr stream, and `tail` holds
+    the raw last lines. Accept, in order: a bare {"value": ...} payload (the
+    schema this file documented before round 2's verdict corrected it),
+    parsed.value, and finally a scan of `tail` for the metric line.
+    """
+    for candidate in (payload, payload.get("parsed") or {}):
+        if isinstance(candidate, dict) and "value" in candidate:
+            try:
+                return float(candidate["value"])
+            except (TypeError, ValueError):
+                pass
+    tail = payload.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "value" in obj:
+                try:
+                    return float(obj["value"])
+                except (TypeError, ValueError):
+                    continue
+    return None
+
+
 def _previous_benchmark() -> float | None:
     best = None
     best_round = -1
@@ -38,17 +74,47 @@ def _previous_benchmark() -> float | None:
         try:
             with open(path) as f:
                 payload = json.load(f)
-            value = float(payload.get("value"))
-        except (ValueError, TypeError, json.JSONDecodeError, OSError):
+        except (json.JSONDecodeError, OSError):
             continue
-        if int(m.group(1)) > best_round:
+        if not isinstance(payload, dict) or payload.get("rc", 0) != 0:
+            continue
+        value = _extract_value(payload)
+        if value is not None and int(m.group(1)) > best_round:
             best_round = int(m.group(1))
             best = value
     return best
 
 
-def main() -> None:
+def _purge_jax_modules() -> None:
+    import importlib
+
+    for mod in [m for m in list(sys.modules) if m == "jax" or m.startswith("jax.")]:
+        sys.modules.pop(mod, None)
+    importlib.invalidate_caches()
+
+
+def _init_backend():
+    """Import jax and force backend init, retrying once and falling back to
+    CPU if the TPU tunnel is wedged (the BENCH_r01 failure mode: rc=1, zero
+    perf data). Returns (jax_module, backend_name)."""
+    for attempt in range(2):
+        try:
+            import jax
+
+            return jax, jax.default_backend()
+        except Exception as exc:  # noqa: BLE001 - backend init raises RuntimeError subclasses
+            print(f"bench: backend init failed (attempt {attempt + 1}): {exc}", file=sys.stderr)
+            _purge_jax_modules()
+            if attempt == 0:
+                time.sleep(2.0)
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+
+    return jax, jax.default_backend()
+
+
+def main() -> None:
+    jax, backend = _init_backend()
     import jax.numpy as jnp
 
     from code2vec_tpu.data.pipeline import iter_batches, build_method_epoch
@@ -110,7 +176,7 @@ def main() -> None:
         path_embed_size=100,
         encode_size=100,  # the reference top11 recipe (README.md:34)
         dropout_prob=0.25,
-        dtype=jnp.bfloat16 if jax.default_backend() != "cpu" else jnp.float32,
+        dtype=jnp.bfloat16 if backend != "cpu" else jnp.float32,
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
     )
     config = TrainConfig(
@@ -160,21 +226,14 @@ def main() -> None:
     previous = _previous_benchmark()
     vs_baseline = contexts_per_sec / previous if previous else 1.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "path_contexts_per_sec_per_chip",
-                "value": round(contexts_per_sec, 1),
-                "unit": "contexts/sec",
-                "vs_baseline": round(vs_baseline, 4),
-            }
-        )
-    )
+    # The driver captures the merged stdout/stderr stream and parses the LAST
+    # JSON line into BENCH_rN.json's `parsed` field — so the detail line goes
+    # first (stderr) and the headline metric is the final thing printed.
     print(
         json.dumps(
             {
                 "detail": {
-                    "backend": jax.default_backend(),
+                    "backend": backend,
                     "steps_per_sec": round(steps / elapsed, 3),
                     "batch": batch_size,
                     "bag": bag,
@@ -184,8 +243,39 @@ def main() -> None:
             }
         ),
         file=sys.stderr,
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "path_contexts_per_sec_per_chip",
+                "value": round(contexts_per_sec, 1),
+                "unit": "contexts/sec",
+                "vs_baseline": round(vs_baseline, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "path_contexts_per_sec_per_chip",
+                    "value": None,
+                    "unit": "contexts/sec",
+                    "vs_baseline": None,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(1)

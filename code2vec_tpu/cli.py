@@ -219,7 +219,17 @@ def main(argv: list[str] | None = None) -> None:
         import jax
 
         platforms = "cpu" if args.no_cuda else os.environ["JAX_PLATFORMS"]
-        if not getattr(jax._src.xla_bridge, "_backends", None):
+        # no public API answers "is any backend initialized yet?" without
+        # initializing one; prefer the named probe, fall back to the older
+        # private dict if a future jax renames it
+        from jax._src import xla_bridge as _xb
+
+        _initialized = getattr(
+            _xb,
+            "backends_are_initialized",
+            lambda: bool(getattr(_xb, "_backends", None)),
+        )()
+        if not _initialized:
             jax.config.update("jax_platforms", platforms)
         else:
             requested = {p.strip() for p in platforms.split(",") if p.strip()}

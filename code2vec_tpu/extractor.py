@@ -16,29 +16,97 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
 from dataclasses import dataclass, field
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-EXTRACTOR_DIR = os.path.join(REPO_ROOT, "extractor")
-BUILD_DIR = os.path.join(EXTRACTOR_DIR, "build")
-BINARY = os.path.join(BUILD_DIR, "c2v-extract")
-LIBRARY = os.path.join(BUILD_DIR, "libc2v.so")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+
+
+import functools
+
+
+def _source_digest(src_dir: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    candidates = [os.path.join(src_dir, "CMakeLists.txt")]
+    src_sub = os.path.join(src_dir, "src")
+    if os.path.isdir(src_sub):
+        candidates += [
+            os.path.join(src_sub, n) for n in sorted(os.listdir(src_sub))
+        ]
+    for path in candidates:
+        if os.path.isfile(path):
+            h.update(os.path.basename(path).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+@functools.lru_cache(maxsize=1)
+def _locate_sources() -> tuple[str, str]:
+    """(cmake source dir, build dir) for the current install layout.
+
+    A repo checkout builds in-tree (extractor/build). An installed wheel
+    carries the C++ sources as package data (code2vec_tpu/_native, copied by
+    setup.py's build_py) and builds once into the user cache dir, keyed by a
+    digest of the shipped sources so a package upgrade rebuilds instead of
+    reusing the previous version's binary. Computed lazily (first build/load),
+    not at import — the digest reads every shipped C++ source.
+    """
+    repo_src = os.path.join(REPO_ROOT, "extractor")
+    if os.path.exists(os.path.join(repo_src, "CMakeLists.txt")):
+        return repo_src, os.path.join(repo_src, "build")
+    pkg_src = os.path.join(_PKG_DIR, "_native")
+    cache_root = os.environ.get(
+        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+    )
+    return pkg_src, os.path.join(
+        cache_root, "code2vec-tpu", f"extractor-build-{_source_digest(pkg_src)}"
+    )
+
+
+def __getattr__(name: str) -> str:
+    # lazy module attributes: EXTRACTOR_DIR/BUILD_DIR/BINARY/LIBRARY resolve
+    # the install layout on first access instead of at import time
+    if name in ("EXTRACTOR_DIR", "BUILD_DIR", "BINARY", "LIBRARY"):
+        src, build = _locate_sources()
+        return {
+            "EXTRACTOR_DIR": src,
+            "BUILD_DIR": build,
+            "BINARY": os.path.join(build, "c2v-extract"),
+            "LIBRARY": os.path.join(build, "libc2v.so"),
+        }[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def build_extractor(force: bool = False) -> str:
     """Compile the extractor if needed; returns the binary path."""
-    if not force and os.path.exists(BINARY) and os.path.exists(LIBRARY):
-        return BINARY
+    src_dir, build_dir = _locate_sources()
+    binary = os.path.join(build_dir, "c2v-extract")
+    library = os.path.join(build_dir, "libc2v.so")
+    if not force and os.path.exists(binary) and os.path.exists(library):
+        return binary
+    if not os.path.exists(os.path.join(src_dir, "CMakeLists.txt")):
+        raise RuntimeError(
+            "extractor sources not found (looked in "
+            f"{os.path.join(REPO_ROOT, 'extractor')} and "
+            f"{os.path.join(_PKG_DIR, '_native')}); reinstall the package "
+            "from a wheel built with setup.py, or run from a repo checkout"
+        )
+    os.makedirs(build_dir, exist_ok=True)
+    generator = ["-G", "Ninja"] if shutil.which("ninja") else []
     subprocess.run(
-        ["cmake", "-S", EXTRACTOR_DIR, "-B", BUILD_DIR, "-G", "Ninja"],
+        ["cmake", "-S", src_dir, "-B", build_dir, *generator],
         check=True,
         capture_output=True,
     )
     subprocess.run(
-        ["cmake", "--build", BUILD_DIR], check=True, capture_output=True
+        ["cmake", "--build", build_dir], check=True, capture_output=True
     )
-    return BINARY
+    return binary
 
 
 @dataclass
@@ -61,8 +129,8 @@ _lib = None
 def _load_library():
     global _lib
     if _lib is None:
-        build_extractor()
-        _lib = ctypes.CDLL(LIBRARY)
+        binary = build_extractor()
+        _lib = ctypes.CDLL(os.path.join(os.path.dirname(binary), "libc2v.so"))
         _lib.c2v_extract_source.restype = ctypes.c_void_p
         _lib.c2v_extract_source.argtypes = [
             ctypes.c_char_p,
@@ -163,9 +231,8 @@ def extract_dataset(
     extra_args: list[str] = (),
 ) -> subprocess.CompletedProcess:
     """Run the CLI over <dataset_dir>/methods.txt (createDataset parity)."""
-    build_extractor()
     cmd = [
-        BINARY,
+        build_extractor(),
         dataset_dir,
         source_dir,
         "--max-length",

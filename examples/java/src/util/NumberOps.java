@@ -1,0 +1,57 @@
+package util;
+
+public class NumberOps {
+
+    public static double clamp(double value, double floor, double ceiling) {
+        return Math.min(Math.max(value, floor), ceiling);
+    }
+
+    public static long factorial(long n) {
+        if (n <= 1) {
+            return 1;
+        }
+        return n * factorial(n - 1);
+    }
+
+    public static long gcd(long first, long second) {
+        if (second == 0) {
+            return first;
+        }
+        return gcd(second, first % second);
+    }
+
+    public static boolean isPrime(long number) {
+        if (number < 2) {
+            return false;
+        }
+        if (number % 2 == 0) {
+            return number == 2;
+        }
+        long divisor = 3;
+        while (divisor * divisor <= number) {
+            if (number % divisor == 0) {
+                return false;
+            }
+            divisor += 2;
+        }
+        return true;
+    }
+
+    public static double mean(double[] samples) {
+        double total = 0.0;
+        for (double sample : samples) {
+            total += sample;
+        }
+        return total / samples.length;
+    }
+
+    public static int maxIndex(double[] values) {
+        int best = 0;
+        for (int i = 1; i < values.length; i++) {
+            if (values[i] > values[best]) {
+                best = i;
+            }
+        }
+        return best;
+    }
+}

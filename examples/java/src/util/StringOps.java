@@ -1,0 +1,66 @@
+package util;
+
+public class StringOps {
+
+    public static String capitalize(String word) {
+        char[] chars = word.toCharArray();
+        if (chars.length > 0) {
+            chars[0] = Character.toUpperCase(chars[0]);
+        }
+        return new String(chars);
+    }
+
+    public static String[] splitLines(String document) {
+        java.util.List<String> lines = new java.util.ArrayList<String>();
+        int start = 0;
+        for (int i = 0; i < document.length(); i++) {
+            if (document.charAt(i) == '\n') {
+                lines.add(document.substring(start, i));
+                start = i + 1;
+            }
+        }
+        lines.add(document.substring(start));
+        return lines.toArray(new String[0]);
+    }
+
+    public static int countOccurrences(String haystack, String needle) {
+        int total = 0;
+        int from = haystack.indexOf(needle);
+        while (from >= 0) {
+            total++;
+            from = haystack.indexOf(needle, from + needle.length());
+        }
+        return total;
+    }
+
+    public static String joinWith(String[] parts, String glue) {
+        StringBuilder out = new StringBuilder();
+        for (int i = 0; i < parts.length; i++) {
+            if (i > 0) {
+                out.append(glue);
+            }
+            out.append(parts[i]);
+        }
+        return out.toString();
+    }
+
+    public static boolean isBlank(String text) {
+        if (text == null) {
+            return true;
+        }
+        for (int i = 0; i < text.length(); i++) {
+            if (!Character.isWhitespace(text.charAt(i))) {
+                return false;
+            }
+        }
+        return true;
+    }
+
+    public static String reverse(String input) {
+        StringBuilder builder = new StringBuilder(input.length());
+        for (int i = input.length() - 1; i >= 0; i--) {
+            builder.append(input.charAt(i));
+        }
+        return builder.toString();
+    }
+}

@@ -198,9 +198,12 @@ C2vCorpus* c2v_parse_corpus(const char* path) {
       } else {
         if (!in_record) in_record = true;
         if (s[0] == '#') {
+          // python parity: int(line[1:]) — leading/trailing whitespace ok,
+          // trailing garbage is not (reader rejects "#12abc")
           char* q = nullptr;
           record_id = std::strtoll(s + 1, &q, 10);
-          if (q == s + 1) {
+          while (q < e && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+          if (q == s + 1 || q != e) {
             g_last_error = "malformed record id line: " + std::string(s, len);
             return nullptr;
           }
@@ -216,23 +219,39 @@ C2vCorpus* c2v_parse_corpus(const char* path) {
         } else if (len >= 5 && std::memcmp(s, "vars:", 5) == 0) {
           mode = VARS;
         } else if (mode == PATHS) {
-          // first three tab-separated ints; tolerate trailing columns but
-          // fail loudly on missing/non-numeric fields (the Python parser
-          // raises there too — corruption must not become silent zeros)
-          char* q1 = nullptr;
-          char* q2 = nullptr;
-          char* q3 = nullptr;
-          long a = std::strtol(s, &q1, 10);
-          long b = std::strtol(q1, &q2, 10);
-          long c = std::strtol(q2, &q3, 10);
-          if (q1 == s || q2 == q1 || q3 == q2) {
+          // python parity: int(line.split("\t")[k]) for k in 0..2 — the
+          // separator must be a tab and each field a complete integer;
+          // trailing columns are tolerated, space-separated or intra-field
+          // garbage is not (corruption must not become silent zeros)
+          long vals[3];
+          const char* fs = s;
+          bool ok = true;
+          for (int k = 0; k < 3; ++k) {
+            const char* fe = static_cast<const char*>(
+                std::memchr(fs, '\t', static_cast<size_t>(e - fs)));
+            if (!fe) fe = e;
+            if (k < 2 && fe == e) {  // fewer than 3 columns: IndexError
+              ok = false;
+              break;
+            }
+            char* q = nullptr;
+            vals[k] = std::strtol(fs, &q, 10);
+            const char* qe = q;
+            while (qe < fe && (*qe == ' ' || *qe == '\r')) ++qe;
+            if (q == fs || q > fe || qe != fe) {
+              ok = false;
+              break;
+            }
+            fs = fe + 1;
+          }
+          if (!ok) {
             g_last_error = "malformed path-context line: " +
                            std::string(s, len);
             return nullptr;
           }
-          starts.push_back(static_cast<int32_t>(a));
-          paths.push_back(static_cast<int32_t>(b));
-          ends.push_back(static_cast<int32_t>(c));
+          starts.push_back(static_cast<int32_t>(vals[0]));
+          paths.push_back(static_cast<int32_t>(vals[1]));
+          ends.push_back(static_cast<int32_t>(vals[2]));
         } else if (mode == VARS) {
           const char* tab = static_cast<const char*>(
               std::memchr(s, '\t', len));

@@ -1,0 +1,33 @@
+"""Wheel build hook: ship the native extractor's C++ sources as package data.
+
+The extractor (extractor/src, dependency-free C++17) lives outside the
+package tree in a checkout, so plain [tool.setuptools] package-data can't
+reach it. This build_py override copies CMakeLists.txt + src/ into
+code2vec_tpu/_native inside the wheel; code2vec_tpu.extractor builds it on
+first use into the user cache dir (see extractor._locate_sources).
+"""
+
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native_sources(build_py):
+    def run(self):
+        super().run()
+        root = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(root, "extractor")
+        dest = os.path.join(self.build_lib, "code2vec_tpu", "_native")
+        os.makedirs(os.path.join(dest, "src"), exist_ok=True)
+        shutil.copy2(os.path.join(src, "CMakeLists.txt"), dest)
+        for name in os.listdir(os.path.join(src, "src")):
+            if name.endswith((".cc", ".h")):
+                shutil.copy2(
+                    os.path.join(src, "src", name),
+                    os.path.join(dest, "src", name),
+                )
+
+
+setup(cmdclass={"build_py": build_py_with_native_sources})

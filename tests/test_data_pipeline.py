@@ -297,11 +297,12 @@ class TestCorpusCache:
         np.testing.assert_array_equal(cold.starts, recovered.starts)
 
     def test_cache_off(self, tmp_path):
-        import os
+        import glob
 
         paths = generate_corpus_files(tmp_path, SPECS["tiny"])
         self._load(paths, cache=False)
-        assert not os.path.exists(str(paths["corpus"]) + ".cache.npz")
+        # no sidecar of any naming scheme may appear (digest-keyed included)
+        assert glob.glob(str(paths["corpus"]) + ".cache*") == []
 
 
 class TestNativeCorpusParse:
@@ -381,6 +382,41 @@ class TestNativeCorpusParse:
         corpus = tmp_path / "bad.txt"
         corpus.write_text("#0\nlabel:x\npaths:\n1\t2\n\n")  # 2 fields
         with pytest.raises(RuntimeError, match="malformed path-context"):
+            parse_corpus_native(corpus)
+
+    @pytest.mark.parametrize(
+        "line",
+        [
+            "1 2 3",  # space-separated: split("\t") leaves one field
+            "1x\t2\t3",  # intra-field garbage: int("1x") raises
+            "1\t2\t3x",  # garbage in the last counted field
+        ],
+    )
+    def test_native_rejects_nonint_path_fields(self, tmp_path, line):
+        """Python-parser parity: int(line.split('\\t')[k]) rejects anything
+        but a complete tab-separated integer per field."""
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        corpus = tmp_path / "bad.txt"
+        corpus.write_text(f"#0\nlabel:x\npaths:\n{line}\n\n")
+        with pytest.raises(RuntimeError, match="malformed path-context"):
+            parse_corpus_native(corpus)
+
+    def test_native_accepts_trailing_path_columns(self, tmp_path):
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        corpus = tmp_path / "ok.txt"
+        corpus.write_text("#0\nlabel:x\npaths:\n1\t2\t3\tweight=0.5\n\n")
+        starts, cpaths, ends, *_ = parse_corpus_native(corpus)
+        assert (starts[0], cpaths[0], ends[0]) == (1, 2, 3)
+
+    def test_native_rejects_malformed_id(self, tmp_path):
+        """int(line[1:]) parity: '#12abc' must fail, not parse as 12."""
+        from code2vec_tpu.extractor import parse_corpus_native
+
+        corpus = tmp_path / "bad_id.txt"
+        corpus.write_text("#12abc\nlabel:x\npaths:\n1\t2\t3\n\n")
+        with pytest.raises(RuntimeError, match="malformed record id"):
             parse_corpus_native(corpus)
 
     def test_native_rejects_tabless_vars(self, tmp_path):
