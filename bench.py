@@ -101,6 +101,10 @@ def _init_backend():
         try:
             import jax
 
+            # the experimental axon device plugin can pre-empt the
+            # JAX_PLATFORMS env var; the config API route is reliable
+            if os.environ.get("JAX_PLATFORMS", "").strip():
+                jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
             return jax, jax.default_backend()
         except Exception as exc:  # noqa: BLE001 - backend init raises RuntimeError subclasses
             print(f"bench: backend init failed (attempt {attempt + 1}): {exc}", file=sys.stderr)
@@ -110,6 +114,7 @@ def _init_backend():
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
+    jax.config.update("jax_platforms", "cpu")
     return jax, jax.default_backend()
 
 
@@ -118,9 +123,11 @@ def main() -> None:
     import jax.numpy as jnp
 
     from code2vec_tpu.data.pipeline import iter_batches, build_method_epoch
-    from code2vec_tpu.data.reader import CorpusData
-    from code2vec_tpu.data.synth import SynthSpec, generate_corpus_data
-    from code2vec_tpu.data.vocab import Vocab
+    from code2vec_tpu.data.synth import (
+        SynthSpec,
+        corpus_data_from_raw,
+        generate_corpus_data,
+    )
     from code2vec_tpu.models.code2vec import Code2VecConfig
     from code2vec_tpu.train.config import TrainConfig
     from code2vec_tpu.train.device_epoch import EpochRunner, stage_method_corpus
@@ -130,6 +137,8 @@ def main() -> None:
     bag = int(os.environ.get("BENCH_BAG", 200))
     steps = int(os.environ.get("BENCH_STEPS", 60))
     warmup = int(os.environ.get("BENCH_WARMUP_CHUNKS", 5))
+    data_axis = int(os.environ.get("BENCH_DATA_AXIS", 1))
+    model_axis = int(os.environ.get("BENCH_MODEL_AXIS", 1))
 
     # top11-scale synthetic corpus, shrunk in method count (the throughput
     # metric depends on vocab/model/batch shape, not corpus length); vocab
@@ -144,40 +153,21 @@ def main() -> None:
         seed=0,
     )
     raw = generate_corpus_data(spec)
-
-    label_vocab = Vocab()
-    for name in raw.label_names:
-        label_vocab.add_label(name)
-
-    data = CorpusData(
-        starts=raw.starts + 1,  # @question shift
-        paths=raw.paths,
-        ends=raw.ends + 1,
-        row_splits=raw.row_splits,
-        ids=np.arange(spec.n_methods, dtype=np.int64),
-        labels=raw.label_ids.astype(np.int32),
-        normalized_labels=[],
-        sources=[None] * spec.n_methods,
-        aliases=[{} for _ in range(spec.n_methods)],
-        terminal_vocab=Vocab(),
-        path_vocab=Vocab(),
-        label_vocab=label_vocab,
-    )
-    # method-token substitution indices (synth puts @method_0 at raw 1 -> 2)
-    data.terminal_vocab.add("<PAD/>", 0)
-    data.terminal_vocab.add("@question", 1)
-    data.terminal_vocab.add("@method_0", 2)
+    data = corpus_data_from_raw(raw)
 
     model_config = Code2VecConfig(
         terminal_count=spec.n_terminals + 2,
         path_count=spec.n_paths + 1,
-        label_count=len(label_vocab),
+        label_count=len(data.label_vocab),
         terminal_embed_size=100,
         path_embed_size=100,
         encode_size=100,  # the reference top11 recipe (README.md:34)
         dropout_prob=0.25,
         dtype=jnp.bfloat16 if backend != "cpu" else jnp.float32,
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
+        # pad the tables so a model axis actually shards them instead of
+        # silently replicating (parallel.shardings divisibility rule)
+        vocab_pad_multiple=max(model_axis, 1),
     )
     config = TrainConfig(
         batch_size=batch_size,
@@ -193,10 +183,27 @@ def main() -> None:
 
     # the measured path is the flagship one: corpus staged to device memory
     # once, per-epoch context sampling on device, scanned chunks of batches
-    # per dispatch (train/device_epoch.py)
+    # per dispatch (train/device_epoch.py). BENCH_DATA_AXIS/BENCH_MODEL_AXIS
+    # > 1 runs the same path SPMD over a mesh (corpus replicated, batches
+    # sharded) — the multi-chip scale-out configuration.
     chunk = int(os.environ.get("BENCH_CHUNK", 16))
-    runner = EpochRunner(model_config, class_weights, batch_size, bag, chunk)
-    staged = stage_method_corpus(data, np.arange(data.n_items), rng)
+    mesh = None
+    corpus_placement = None
+    if data_axis * model_axis > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_state
+
+        mesh = make_mesh(data=data_axis, model=model_axis)
+        state = shard_state(mesh, state)
+        corpus_placement = NamedSharding(mesh, PartitionSpec())
+    runner = EpochRunner(
+        model_config, class_weights, batch_size, bag, chunk, mesh=mesh
+    )
+    staged = stage_method_corpus(
+        data, np.arange(data.n_items), rng, device=corpus_placement
+    )
     run_chunk = runner._train_chunk(chunk)
     n_valid = chunk * batch_size
 
@@ -222,7 +229,10 @@ def main() -> None:
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
 
-    contexts_per_sec = batch_size * bag * steps / elapsed
+    # per-chip normalization keeps the metric comparable across mesh sizes
+    # (a meshed run measures aggregate throughput over mesh.size chips)
+    n_chips = 1 if mesh is None else mesh.size
+    contexts_per_sec = batch_size * bag * steps / elapsed / n_chips
     previous = _previous_benchmark()
     vs_baseline = contexts_per_sec / previous if previous else 1.0
 
@@ -237,6 +247,7 @@ def main() -> None:
                     "steps_per_sec": round(steps / elapsed, 3),
                     "batch": batch_size,
                     "bag": bag,
+                    "mesh": None if mesh is None else dict(mesh.shape),
                     "final_chunk_loss_sum": float(loss),  # sum over BENCH_CHUNK batch losses
                     "compute_dtype": str(model_config.dtype.__name__ if hasattr(model_config.dtype, "__name__") else model_config.dtype),
                 }

@@ -37,6 +37,10 @@ class TrainMeta:
     # PRNG impl of the saved dropout key — validated on restore so an
     # --rng_impl mismatch fails with guidance, not an orbax shape error
     rng_impl: str | None = None
+    # vocab pad multiple the params were built with (table/head shapes
+    # depend on it) — validated on restore so resuming under a different
+    # model_axis fails with guidance, not an orbax shape error
+    vocab_pad_multiple: int | None = None
 
 
 def _rng_impl_name(dropout_rng) -> str:
@@ -137,7 +141,9 @@ def clear_checkpoints(out_dir: str, slot: str = "last") -> None:
             shutil.rmtree(os.path.join(base, name), ignore_errors=True)
 
 
-def restore_checkpoint(out_dir: str, state) -> tuple[object, TrainMeta] | None:
+def restore_checkpoint(
+    out_dir: str, state, vocab_pad_multiple: int | None = None
+) -> tuple[object, TrainMeta] | None:
     """Restore into the shape of ``state``; returns None if no checkpoint.
 
     Resumes from the newest save across both slots (the ``last`` periodic
@@ -164,6 +170,19 @@ def restore_checkpoint(out_dir: str, state) -> tuple[object, TrainMeta] | None:
             f"checkpoint in {base} was saved with --rng_impl "
             f"{saved_impl} but this run uses {want_impl}; pass "
             f"--rng_impl {saved_impl} to resume it"
+        )
+    saved_pad = saved_meta.vocab_pad_multiple
+    if (
+        vocab_pad_multiple is not None
+        and saved_pad is not None
+        and saved_pad != vocab_pad_multiple
+    ):
+        raise ValueError(
+            f"checkpoint in {base} was saved with vocab tables padded to a "
+            f"multiple of {saved_pad} but this run pads to "
+            f"{vocab_pad_multiple} (it follows model_axis unless pinned); "
+            f"pass --vocab_pad_multiple {saved_pad} to resume it under a "
+            "different mesh"
         )
     template = _state_pytree(state)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)

@@ -143,6 +143,11 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=("threefry2x32", "rbg", "unsafe_rbg"),
                         help="dropout-stream PRNG (rbg/unsafe_rbg are "
                              "faster on TPU)")
+    parser.add_argument("--vocab_pad_multiple", type=int, default=0,
+                        help="pad vocab/label table dims to this multiple "
+                             "for even model-axis sharding (0 = follow "
+                             "--model_axis); pin it to resume a checkpoint "
+                             "under a different mesh")
     parser.add_argument("--checkpoint_cycle", type=int, default=0,
                         help="also checkpoint every N epochs (0 = best-F1 "
                              "only) — preemption safety for pod runs")
@@ -187,6 +192,7 @@ def config_from_args(args: argparse.Namespace):
         use_pallas=args.use_pallas,
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
+        vocab_pad_multiple=args.vocab_pad_multiple,
         resume=args.resume,
         checkpoint_cycle=args.checkpoint_cycle,
         device_epoch=args.device_epoch,

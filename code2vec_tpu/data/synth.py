@@ -118,6 +118,39 @@ class RawCorpus:
     spec: SynthSpec
 
 
+def corpus_data_from_raw(raw: RawCorpus):
+    """Assemble a :class:`~code2vec_tpu.data.reader.CorpusData` directly from
+    a :class:`RawCorpus`, skipping the text round-trip: apply the ``@question``
+    index shift (+1) and register the special terminals so
+    ``method_token_index`` resolves and the answer-leak substitution is
+    exercised (synth sprinkles ``@method_0`` at raw index 1)."""
+    from code2vec_tpu.data.reader import CorpusData
+    from code2vec_tpu.data.vocab import Vocab
+
+    n_methods = len(raw.row_splits) - 1
+    label_vocab = Vocab()
+    for name in raw.label_names:
+        label_vocab.add_label(name)
+    terminal_vocab = Vocab()
+    terminal_vocab.add("<PAD/>", 0)
+    terminal_vocab.add("@question", 1)
+    terminal_vocab.add("@method_0", 2)  # raw idx 1 -> shifted idx 2
+    return CorpusData(
+        starts=raw.starts + 1,
+        paths=raw.paths,
+        ends=raw.ends + 1,
+        row_splits=raw.row_splits,
+        ids=np.arange(n_methods, dtype=np.int64),
+        labels=raw.label_ids.astype(np.int32),
+        normalized_labels=[],
+        sources=[None] * n_methods,
+        aliases=[{} for _ in range(n_methods)],
+        terminal_vocab=terminal_vocab,
+        path_vocab=Vocab(),
+        label_vocab=label_vocab,
+    )
+
+
 def generate_corpus_data(spec: SynthSpec) -> RawCorpus:
     rng = np.random.default_rng(spec.seed)
     label_names = _label_names(spec.n_labels, rng)

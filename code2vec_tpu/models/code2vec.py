@@ -48,9 +48,19 @@ class Code2VecConfig:
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
     use_pallas: bool = False  # fused attention-pooling kernel (ops.pallas_attention)
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
+    # round table/head vocab dims up to this multiple so they shard evenly
+    # over the model mesh axis (parallel.shardings.pad_to_multiple); padded
+    # embedding rows are never gathered and padded label columns are sliced
+    # off before loss/argmax, so the math is identical to the unpadded model
+    vocab_pad_multiple: int = 1
 
     def with_updates(self, **kw) -> "Code2VecConfig":
         return replace(self, **kw)
+
+    def padded(self, count: int) -> int:
+        from code2vec_tpu.parallel.shardings import pad_to_multiple
+
+        return pad_to_multiple(count, max(self.vocab_pad_multiple, 1))
 
 
 class _EmbedTable(nn.Module):
@@ -90,10 +100,11 @@ class Code2Vec(nn.Module):
         # is selectable (c.embed_grad); tables init per torch nn.Embedding
         # defaults (std-normal, model/model.py:21-22)
         terminal_table = _EmbedTable(
-            c.terminal_count, c.terminal_embed_size, name="terminal_embedding"
+            c.padded(c.terminal_count), c.terminal_embed_size,
+            name="terminal_embedding",
         )()
         path_table = _EmbedTable(
-            c.path_count, c.path_embed_size, name="path_embedding"
+            c.padded(c.path_count), c.path_embed_size, name="path_embedding"
         )()
 
         # shared table for start & end terminals (model/model.py:21,48-50);
@@ -152,13 +163,14 @@ class Code2Vec(nn.Module):
             logits = self._angular_margin_head(code_vector_f32, labels)
         else:
             logits = nn.Dense(
-                c.label_count,
+                c.padded(c.label_count),
                 use_bias=True,
                 dtype=jnp.float32,
                 param_dtype=jnp.float32,
                 bias_init=zeros,  # explicit zero bias (model/model.py:42)
                 name="output_dense",
             )(code_vector_f32)
+            logits = logits[:, : c.label_count]  # drop sharding-pad columns
 
         return logits, code_vector_f32, attention
 
@@ -174,7 +186,7 @@ class Code2Vec(nn.Module):
         weight = self.param(
             "output_margin_weight",
             nn.initializers.xavier_uniform(),
-            (c.label_count, c.encode_size),
+            (c.padded(c.label_count), c.encode_size),
             jnp.float32,
         )
         normalized_cv = code_vector / (
@@ -183,7 +195,7 @@ class Code2Vec(nn.Module):
         normalized_w = weight / (
             jnp.linalg.norm(weight, axis=-1, keepdims=True) + 1e-12
         )
-        cosine = normalized_cv @ normalized_w.T
+        cosine = (normalized_cv @ normalized_w.T)[:, : c.label_count]
         sine = jnp.sqrt(jnp.clip(1.0 - cosine**2, 0.0, 1.0))
         cos_m = math.cos(c.angular_margin)
         sin_m = math.sin(c.angular_margin)

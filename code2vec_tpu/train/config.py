@@ -61,6 +61,11 @@ class TrainConfig:
     # reproducible everywhere) | rbg | unsafe_rbg (faster on TPU; different
     # stream, still seeded-deterministic per backend)
     rng_impl: str = "threefry2x32"
+    # pad table/head vocab dims to this multiple so they shard evenly over
+    # the model axis; 0 = auto (use model_axis). Checkpoint param shapes
+    # depend on it — pin it explicitly to resume a run under a different
+    # model_axis (the restore validates and explains a mismatch)
+    vocab_pad_multiple: int = 0
 
     # checkpoint/resume (framework extension; the reference cannot resume,
     # SURVEY.md §5.4)

@@ -73,6 +73,11 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
         dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
         use_pallas=config.use_pallas,
         embed_grad=config.embed_grad,
+        # pad table/head vocab dims so they shard evenly over the model axis
+        # (a few dummy rows on a 360k-row table cost nothing; indivisible
+        # dims would otherwise silently replicate — parallel.shardings);
+        # explicit --vocab_pad_multiple pins shapes across mesh reconfigs
+        vocab_pad_multiple=config.vocab_pad_multiple or max(config.model_axis, 1),
     )
 
 
@@ -226,13 +231,15 @@ def train(
             return batch  # jit in_shardings place host arrays directly
 
     # device-resident epochs: corpus staged to HBM once, whole chunks of
-    # batches per dispatch (train/device_epoch.py). Method task, single
-    # process, no mesh; anything else falls back to the host pipeline.
+    # batches per dispatch (train/device_epoch.py). Composes with the mesh:
+    # the corpus is replicated over the devices and each scanned batch is
+    # sharding-constrained to the data/ctx layout, so the flagship fast path
+    # scales out (SURVEY §7.4-7.5). Method task, single process; variable
+    # task and multi-host fall back to the host pipeline.
     device_runner = None
     if config.device_epoch:
         if (
-            mesh is None
-            and data.infer_method
+            data.infer_method
             and not data.infer_variable
             and jax.process_count() == 1
         ):
@@ -247,9 +254,19 @@ def train(
                 config.batch_size,
                 config.max_path_length,
                 config.device_chunk_batches,
+                mesh=mesh,
             )
-            staged_train = stage_method_corpus(data, train_idx, np_rng)
-            staged_test = stage_method_corpus(data, test_idx, np_rng)
+            corpus_placement = None
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                corpus_placement = NamedSharding(mesh, PartitionSpec())
+            staged_train = stage_method_corpus(
+                data, train_idx, np_rng, device=corpus_placement
+            )
+            staged_test = stage_method_corpus(
+                data, test_idx, np_rng, device=corpus_placement
+            )
             logger.info(
                 "device epochs: staged %d train / %d test contexts to %s",
                 staged_train.n_contexts,
@@ -258,13 +275,15 @@ def train(
             )
         else:
             logger.warning(
-                "device_epoch requested but unsupported here (mesh axes, "
-                "variable task, or multi-host); using the host pipeline"
+                "device_epoch requested but unsupported here (variable task "
+                "or multi-host); using the host pipeline"
             )
 
     meta = TrainMeta()
     if config.resume and out_dir is not None:
-        restored = restore_checkpoint(out_dir, state)
+        restored = restore_checkpoint(
+            out_dir, state, vocab_pad_multiple=model_config.vocab_pad_multiple
+        )
         if restored is not None:
             state, meta = restored
             logger.info("resumed from epoch %d (best_f1=%s)", meta.epoch, meta.best_f1)
@@ -274,6 +293,10 @@ def train(
         # main.py:231) — otherwise a stale periodic `last_N` save could
         # outrank this run's `step_N` saves at a later --resume
         clear_checkpoints(out_dir)
+
+    # recorded with every save so restore can validate table shapes; also
+    # refreshes metas from checkpoints that predate the field
+    meta.vocab_pad_multiple = model_config.vocab_pad_multiple
 
     f1 = 0.0
     start_epoch = meta.epoch

@@ -209,6 +209,22 @@ class TestEndToEnd:
         with pytest.raises(ValueError, match="--rng_impl rbg"):
             train(cfg2, data, out_dir=str(out))
 
+    def test_vocab_pad_mismatch_rejected(self, tiny, tmp_path):
+        """Resuming under a different model_axis (so a different implicit
+        pad multiple, hence different table shapes) must fail with guidance,
+        not an orbax shape error; pinning --vocab_pad_multiple resumes."""
+        paths, data = tiny
+        out = tmp_path / "padmismatch"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(max_epoch=1, model_axis=2)
+        train(cfg, data, out_dir=str(out))
+        cfg2 = cfg.with_updates(max_epoch=2, resume=True, model_axis=1)
+        with pytest.raises(ValueError, match="--vocab_pad_multiple 2"):
+            train(cfg2, data, out_dir=str(out))
+        cfg3 = cfg2.with_updates(vocab_pad_multiple=2)
+        result = train(cfg3, data, out_dir=str(out))
+        assert result.epochs_run == 1  # epoch 0 restored, epoch 1 runs
+
     def test_rbg_rng_trains_and_resumes(self, tiny, tmp_path):
         # rbg dropout stream: trains, checkpoints, and restores (key-data
         # shape [4] differs from threefry's [2])
