@@ -98,6 +98,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     # HPO (main.py:70-71)
     parser.add_argument("--find_hyperparams", action="store_true", default=False)
+    parser.add_argument("--hpo_sampler", type=str, default="tpe",
+                        choices=("tpe", "random"),
+                        help="hyperparameter search sampler (tpe matches "
+                             "the reference's optuna default)")
     parser.add_argument("--num_trials", type=int, default=100)
 
     # angular-margin head (main.py:73-75)
@@ -131,7 +135,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--device_epoch", action="store_true", default=False,
                         help="stage the corpus in device memory and run "
                         "scanned chunks of batches per dispatch "
-                        "(method task, single device)")
+                        "(method task; composes with the mesh axes)")
+    parser.add_argument("--host_shard_corpus", action="store_true",
+                        default=False,
+                        help="each process loads only its round-robin share "
+                        "of the corpus (multi-host pods; context arrays "
+                        "are held 1/n_hosts per host)")
+    parser.add_argument("--stream_chunk_items", type=int, default=0,
+                        help="stream epochs in chunks of this many rows "
+                        "instead of materializing [N, L] tensors (bounds "
+                        "host RSS at java-large scale; 0 = materialize)")
     parser.add_argument("--device_chunk_batches", type=int, default=16,
                         help="batches per device-epoch dispatch")
     parser.add_argument("--class_weighting", type=str, default="reference",
@@ -196,6 +209,7 @@ def config_from_args(args: argparse.Namespace):
         resume=args.resume,
         checkpoint_cycle=args.checkpoint_cycle,
         device_epoch=args.device_epoch,
+        stream_chunk_items=args.stream_chunk_items,
         device_chunk_batches=args.device_chunk_batches,
     )
 
@@ -268,6 +282,25 @@ def main(argv: list[str] | None = None) -> None:
         args.corpus_path = paths["corpus"]
         args.path_idx_path = paths["path_idx"]
         args.terminal_idx_path = paths["terminal_idx"]
+    shard = None
+    if args.host_shard_corpus:
+        import jax
+
+        # form the process group first (no-op without coordinator env vars)
+        # — otherwise process_count() is 1 and sharding silently degrades
+        # to every host loading the full corpus
+        from code2vec_tpu.parallel.distributed import initialize_from_env
+
+        initialize_from_env()
+        if jax.process_count() == 1:
+            logger.warning(
+                "--host_shard_corpus with a single process: set "
+                "COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID (or "
+                "JAX_AUTO_DISTRIBUTED=1 on a TPU pod) to form the process "
+                "group; loading the full corpus"
+            )
+        shard = (jax.process_index(), jax.process_count())
+        logger.info("loading corpus shard %d/%d", shard[0], shard[1])
     data = load_corpus(
         args.corpus_path,
         args.path_idx_path,
@@ -275,13 +308,15 @@ def main(argv: list[str] | None = None) -> None:
         infer_method=args.infer_method_name,
         infer_variable=args.infer_variable_name,
         cache=not args.no_corpus_cache,
+        shard=shard,
     )
 
     if args.find_hyperparams:
         from code2vec_tpu.hpo import find_optimal_hyperparams
 
         study = find_optimal_hyperparams(
-            data, config, n_trials=args.num_trials, seed=args.random_seed)
+            data, config, n_trials=args.num_trials, seed=args.random_seed,
+            sampler=args.hpo_sampler)
         best = study.best_trial
         logger.info("Number of finished trials: %d", len(study.trials))
         logger.info("Best trial value: %s", best.value)

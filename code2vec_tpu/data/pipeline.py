@@ -310,6 +310,110 @@ def iter_batches(
         }
 
 
+def iter_streaming_batches(
+    epoch_builder,
+    item_idx: np.ndarray,
+    batch_size: int,
+    rng: np.random.Generator,
+    chunk_items: int = 65536,
+    pad_final: bool = True,
+    shuffle: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stream an epoch as static-shape batches without materializing [N, L].
+
+    ``build_epoch`` allocates 3 x [N, L] int32 — ~38 GB host RAM at
+    java-large scale (16M methods x bag 200, BASELINE.json config 3). This
+    generator shuffles the *item order* globally, then materializes only
+    ``chunk_items`` rows at a time (3 x chunk x L int32, ~157 MB at the
+    default chunk and bag 200), carrying sub-batch remainders across chunk
+    boundaries so emitted batches are identical in shape/semantics to
+    ``iter_batches`` over a full epoch.
+
+    ``epoch_builder(chunk_idx)`` -> :class:`EpochArrays` for those items —
+    pass a closure over :func:`build_epoch` (the per-method context
+    subsample is independent per item, so chunked construction draws the
+    same distribution as a whole-epoch build). Variable-task expansion may
+    return more examples than items; the carry buffer absorbs that.
+    """
+    order = rng.permutation(len(item_idx)) if shuffle else np.arange(len(item_idx))
+    carry: EpochArrays | None = None
+
+    def emit(epoch: EpochArrays, final: bool):
+        # batch assembly delegates to iter_batches so the layout/padding
+        # semantics exist in exactly one place
+        n_full = len(epoch) // batch_size * batch_size
+        yield from iter_batches(
+            _slice_epoch(epoch, 0, n_full), batch_size, rng=None,
+            pad_final=False,
+        )
+        rest = _slice_epoch(epoch, n_full, len(epoch))
+        if final and len(rest) and pad_final:
+            yield from iter_batches(rest, batch_size, rng=None, pad_final=True)
+            rest = None
+        return rest
+
+    for lo in range(0, len(order), chunk_items):
+        chunk = epoch_builder(item_idx[order[lo : lo + chunk_items]])
+        if carry is not None and len(carry):
+            chunk = _concat_epochs([carry, chunk])
+        final = lo + chunk_items >= len(order)
+        # ``yield from`` hands back emit()'s return value: the sub-batch
+        # remainder carried into the next chunk (None once padded/emitted)
+        carry = yield from emit(chunk, final)
+
+
+def _slice_epoch(epoch: EpochArrays, lo: int, hi: int) -> EpochArrays:
+    return EpochArrays(
+        ids=epoch.ids[lo:hi],
+        starts=epoch.starts[lo:hi],
+        paths=epoch.paths[lo:hi],
+        ends=epoch.ends[lo:hi],
+        labels=epoch.labels[lo:hi],
+    )
+
+
+def _concat_epochs(parts: list[EpochArrays]) -> EpochArrays:
+    return EpochArrays(
+        ids=np.concatenate([p.ids for p in parts]),
+        starts=np.concatenate([p.starts for p in parts]),
+        paths=np.concatenate([p.paths for p in parts]),
+        ends=np.concatenate([p.ends for p in parts]),
+        labels=np.concatenate([p.labels for p in parts]),
+    )
+
+
+def empty_batch(batch_size: int, max_contexts: int) -> dict[str, np.ndarray]:
+    """A fully-masked all-PAD batch (the no-op collective step)."""
+    bag = (batch_size, max_contexts)
+    return {
+        "ids": np.zeros(batch_size, np.int64),
+        "starts": np.full(bag, PAD_INDEX, np.int32),
+        "paths": np.full(bag, PAD_INDEX, np.int32),
+        "ends": np.full(bag, PAD_INDEX, np.int32),
+        "labels": np.zeros(batch_size, np.int32),
+        "example_mask": np.zeros(batch_size, np.float32),
+    }
+
+
+def pad_batch_stream(
+    batches: Iterator[dict[str, np.ndarray]],
+    n_steps: int,
+    template: dict[str, np.ndarray],
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield exactly ``n_steps`` batches, extending with fully-masked
+    ``template`` batches (:func:`empty_batch`). Multi-host feeding: every
+    host must dispatch the same number of collective steps even when its
+    local shard runs out of rows first — including the degenerate case of a
+    host with zero local rows, which yields only templates."""
+    count = 0
+    for batch in batches:
+        count += 1
+        yield batch
+    while count < n_steps:
+        count += 1
+        yield template
+
+
 def oov_rate(
     data: CorpusData,
     train_idx: np.ndarray,

@@ -62,9 +62,35 @@ class CorpusData:
     # (reference: model/dataset_reader.py:54-56)
     variable_indexes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
 
+    # host-shard bookkeeping (multi-host pods, SURVEY §7.4): when loaded
+    # with load_corpus(..., shard=(index, count)), this CorpusData holds
+    # only records assigned round-robin to this host (record i is local iff
+    # i % count == index) and these fields map between the global and local
+    # index spaces. Vocabs (including the label vocab, whose indices are
+    # insertion-ordered) are always GLOBAL so every host agrees on them.
+    shard: tuple[int, int] | None = None
+    global_n_items: int = -1
+
     @property
     def n_items(self) -> int:
         return len(self.row_splits) - 1
+
+    def local_rows_of_global(self, global_idx: np.ndarray) -> np.ndarray:
+        """Filter a GLOBAL item-index array (e.g. a seeded split computed
+        identically on every host) down to this shard, in the same relative
+        order, returned as LOCAL row indices."""
+        if self.shard is None:
+            return np.asarray(global_idx)
+        index, count = self.shard
+        g = np.asarray(global_idx)
+        mine = g[g % count == index]
+        return mine // count
+
+    def global_of_local(self, local_idx: np.ndarray) -> np.ndarray:
+        if self.shard is None:
+            return np.asarray(local_idx)
+        index, count = self.shard
+        return np.asarray(local_idx) * count + index
 
     @property
     def n_contexts(self) -> int:
@@ -81,7 +107,8 @@ class CorpusData:
 
 
 def _cache_fingerprint(
-    corpus_path, path_idx_path, terminal_idx_path, infer_method, infer_variable
+    corpus_path, path_idx_path, terminal_idx_path, infer_method, infer_variable,
+    shard=None,
 ) -> dict:
     def stat(p):
         s = os.stat(p)
@@ -94,6 +121,7 @@ def _cache_fingerprint(
         "terminal_idx": stat(terminal_idx_path),
         "infer_method": infer_method,
         "infer_variable": infer_variable,
+        "shard": list(shard) if shard is not None else None,
     }
 
 
@@ -167,6 +195,8 @@ def _write_cache(corpus_path, fingerprint, data: "CorpusData") -> None:
                     "normalized_labels": data.normalized_labels,
                     "sources": data.sources,
                     "aliases": data.aliases,
+                    "shard": list(data.shard) if data.shard else None,
+                    "global_n_items": data.global_n_items,
                 },
                 f,
             )
@@ -187,8 +217,19 @@ def load_corpus(
     infer_variable: bool = False,
     cache: bool = True,
     native: bool = True,
+    shard: tuple[int, int] | None = None,
 ) -> CorpusData:
     """Load vocabs + corpus into a CorpusData.
+
+    ``shard=(index, count)`` loads only this host's round-robin share of the
+    records (record i is local iff ``i % count == index``) — the multi-host
+    pod feeding path (SURVEY §7.4): context arrays, the dominant memory
+    cost, are held 1/count per host. Labels/aliases of ALL records are still
+    scanned so the label vocab (insertion-ordered) is identical on every
+    host. The Python parser skips non-local context rows while reading
+    (bounded peak RSS); the native parser parses fully, then slices (peak
+    RSS is one full CSR copy — use the Python parser or pre-split corpora
+    when even the parse doesn't fit).
 
     Mirrors DatasetReader (reference: model/dataset_reader.py:44-128):
     terminal vocab read with ``@question`` injected at 1, raw corpus
@@ -207,7 +248,7 @@ def load_corpus(
     if cache:
         fingerprint = _cache_fingerprint(
             corpus_path, path_idx_path, terminal_idx_path, infer_method,
-            infer_variable,
+            infer_variable, shard,
         )
         cached = _try_load_cache(corpus_path, fingerprint)
     else:
@@ -236,6 +277,8 @@ def load_corpus(
             infer_method=infer_method,
             infer_variable=infer_variable,
             variable_indexes=arrays["variable_indexes"],
+            shard=tuple(meta["shard"]) if meta.get("shard") else None,
+            global_n_items=meta.get("global_n_items", -1),
         )
         logger.info("label vocab size: %d", len(data.label_vocab))
         logger.info(
@@ -262,6 +305,9 @@ def load_corpus(
                 "native corpus parser unavailable (%s); using Python parser", e
             )
 
+    def is_local(i: int) -> bool:
+        return shard is None or i % shard[1] == shard[0]
+
     if native_arrays is not None:
         raw_starts, raw_paths, raw_ends, row_splits, ids_arr, headers, var_lists = (
             native_arrays
@@ -273,6 +319,19 @@ def load_corpus(
         if missing_id.any():
             ids_arr = ids_arr.copy()
             ids_arr[missing_id] = np.nonzero(missing_id)[0]
+        if shard is not None:
+            # keep only this host's rows (vectorized CSR row gather); the
+            # full parse was materialized by the C++ side — see docstring
+            local = np.arange(shard[0], len(row_splits) - 1, shard[1])
+            counts = np.diff(row_splits)[local]
+            new_splits = np.zeros(len(local) + 1, np.int64)
+            np.cumsum(counts, out=new_splits[1:])
+            flat = np.repeat(
+                row_splits[local] - new_splits[:-1], counts
+            ) + np.arange(int(counts.sum()))
+            starts, paths, ends = starts[flat], paths[flat], ends[flat]
+            row_splits = new_splits
+            ids_arr = ids_arr[local]
         parser_tag = "native parse"
     else:
         starts_parts: list[np.ndarray] = []
@@ -283,9 +342,12 @@ def load_corpus(
         headers = []
         var_lists = []
         for record in iter_corpus_records(corpus_path):
-            id_list.append(record.id if record.id is not None else len(id_list))
+            record_index = len(headers)
+            id_list.append(record.id if record.id is not None else record_index)
             headers.append((record.label or "", record.source))
             var_lists.append(record.aliases)
+            if not is_local(record_index):
+                continue  # context arrays stay 1/count per host
             contexts = np.asarray(record.path_contexts, dtype=np.int32).reshape(-1, 3)
             starts_parts.append(contexts[:, 0] + QUESTION_TOKEN_INDEX)
             paths_parts.append(contexts[:, 1])
@@ -299,11 +361,14 @@ def load_corpus(
         paths = np.concatenate(paths_parts) if paths_parts else np.zeros(0, np.int32)
         ends = np.concatenate(ends_parts) if ends_parts else np.zeros(0, np.int32)
         ids_arr = np.asarray(id_list, dtype=np.int64)
+        if shard is not None:
+            ids_arr = ids_arr[shard[0] :: shard[1]]
         parser_tag = "python parse"
 
     # per-record label/alias processing — ONE implementation for both
     # parsers, so label-vocab insertion order (and hence label indices)
-    # cannot drift between them (reference: model/dataset_reader.py:94-125)
+    # cannot drift between them (reference: model/dataset_reader.py:94-125).
+    # ALWAYS over every record, even when sharded: the vocab must be global.
     label_vocab = Vocab()
     labels: list[int] = []
     normalized_labels: list[str] = []
@@ -322,6 +387,14 @@ def load_corpus(
                 label_vocab.add_label(original)
         aliases.append(alias_map)
 
+    global_n_items = len(headers)
+    if shard is not None:
+        index, count = shard
+        labels = labels[index::count]
+        normalized_labels = normalized_labels[index::count]
+        sources = sources[index::count]
+        aliases = aliases[index::count]
+
     data = CorpusData(
         starts=starts,
         paths=paths,
@@ -338,6 +411,8 @@ def load_corpus(
         infer_method=infer_method,
         infer_variable=infer_variable,
         variable_indexes=variable_indexes,
+        shard=shard,
+        global_n_items=global_n_items,
     )
     logger.info("label vocab size: %d", len(label_vocab))
     logger.info(

@@ -5,11 +5,16 @@ The reference drives ``optuna.create_study(MedianPruner()).optimize``
 (main.py:207-211). Optuna is not available in this image, so this module
 implements the same surface natively:
 
-- a :class:`Study` with random sampling over the same distributions the
-  reference's objective draws from (main.py:447-449, 477-483):
-  ``encode_size`` log-int 100..300, ``dropout_prob`` 0.5..0.9,
-  ``batch_size`` log-int 256..2048, Adam ``lr`` log 1e-5..1e-1 and
-  ``weight_decay`` log 1e-10..1e-3;
+- a :class:`Study` sampling over the same distributions the reference's
+  objective draws from (main.py:447-449, 477-483): ``encode_size`` log-int
+  100..300, ``dropout_prob`` 0.5..0.9, ``batch_size`` log-int 256..2048,
+  Adam ``lr`` log 1e-5..1e-1 and ``weight_decay`` log 1e-10..1e-3;
+- a :class:`TPESampler` — optuna's default sampler
+  (``optuna.create_study`` with no sampler argument is TPE, main.py:460)
+  re-implemented from the published algorithm (Bergstra et al., NeurIPS
+  2011): per-parameter Parzen estimators over the best/rest split, with
+  candidate selection by the l(x)/g(x) density ratio. A
+  :class:`RandomSampler` remains as the fallback (``sampler="random"``);
 - a :class:`MedianPruner` with optuna's semantics: after
   ``n_startup_trials`` finished trials, prune when the trial's best
   intermediate value so far is worse than the median of prior trials'
@@ -93,14 +98,154 @@ class MedianPruner:
         return best_so_far > float(np.median(at_step))
 
 
+@dataclass(frozen=True)
+class _Distribution:
+    """Search-space descriptor for one parameter."""
+
+    low: float
+    high: float
+    log: bool = False
+    is_int: bool = False
+
+    def to_internal(self, value: float) -> float:
+        return math.log(value) if self.log else float(value)
+
+    def from_internal(self, x: float) -> float | int:
+        value = math.exp(x) if self.log else x
+        if self.is_int:
+            value = min(max(int(round(value)), int(self.low)), int(self.high))
+        return value
+
+    @property
+    def internal_low(self) -> float:
+        return math.log(self.low) if self.log else self.low
+
+    @property
+    def internal_high(self) -> float:
+        return math.log(self.high) if self.log else self.high
+
+
+class RandomSampler:
+    """Independent uniform (or log-uniform) draws — the pre-TPE behavior."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+
+    def suggest(self, study: "Study", trial: FrozenTrial, name: str,
+                dist: _Distribution) -> float | int:
+        x = self._rng.uniform(dist.internal_low, dist.internal_high)
+        return dist.from_internal(x)
+
+
+class _ParzenEstimator:
+    """1-D mixture of truncated Gaussians over the internal domain
+    (Bergstra et al. 2011 §4: per-point bandwidths from neighbor spacing,
+    plus a wide uniform-ish prior component at the domain midpoint)."""
+
+    def __init__(self, xs: np.ndarray, low: float, high: float):
+        span = max(high - low, 1e-12)
+        mid = 0.5 * (low + high)
+        mus = np.sort(np.append(xs, mid))
+        if len(mus) > 1:
+            neighbor = np.empty_like(mus)
+            gaps = np.diff(mus)
+            neighbor[0] = gaps[0]
+            neighbor[-1] = gaps[-1]
+            if len(mus) > 2:
+                neighbor[1:-1] = np.maximum(gaps[:-1], gaps[1:])
+            sigmas = np.clip(neighbor, span / min(100.0, len(mus) + 1.0), span)
+        else:
+            sigmas = np.full_like(mus, span)
+        # the prior component (at mid) always keeps full-range bandwidth
+        sigmas[np.argmin(np.abs(mus - mid))] = span
+        self.mus, self.sigmas = mus, sigmas
+        self.low, self.high = low, high
+        # truncation mass of each component on [low, high]
+        self._z = self._cdf((high - mus) / sigmas) - self._cdf((low - mus) / sigmas)
+        self._z = np.maximum(self._z, 1e-12)
+
+    @staticmethod
+    def _cdf(z: np.ndarray) -> np.ndarray:
+        # vectorized standard-normal CDF via erf (math.erf is scalar-only)
+        return 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+
+    def sample(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        idx = rng.integers(0, len(self.mus), n)
+        draws = rng.normal(self.mus[idx], self.sigmas[idx])
+        return np.clip(draws, self.low, self.high)
+
+    def log_pdf(self, xs: np.ndarray) -> np.ndarray:
+        z = (xs[:, None] - self.mus[None, :]) / self.sigmas[None, :]
+        comp = (
+            np.exp(-0.5 * z**2)
+            / (self.sigmas[None, :] * math.sqrt(2.0 * math.pi))
+            / self._z[None, :]
+        )
+        return np.log(np.maximum(comp.mean(axis=1), 1e-300))
+
+
+class TPESampler:
+    """Tree-structured Parzen Estimator, sampling each parameter
+    independently (optuna's default mode): split prior trials into the
+    gamma-best ("good") and the rest ("bad"), fit Parzen estimators l(x)
+    and g(x), draw ``n_ei_candidates`` from l and keep the candidate
+    maximizing l(x)/g(x). Falls back to random until ``n_startup_trials``
+    scored trials exist (optuna defaults: 10 startup, 24 candidates,
+    gamma(n) = min(ceil(0.1 n), 25))."""
+
+    def __init__(self, n_startup_trials: int = 10, n_ei_candidates: int = 24,
+                 seed: int = 0):
+        self.n_startup_trials = n_startup_trials
+        self.n_ei_candidates = n_ei_candidates
+        self._rng = np.random.default_rng(seed)
+
+    @staticmethod
+    def _gamma(n: int) -> int:
+        return min(int(np.ceil(0.1 * n)), 25)
+
+    def _scored_observations(
+        self, study: "Study", trial: FrozenTrial, name: str
+    ) -> list[tuple[float, float]]:
+        """(objective value, param value) for prior trials that drew
+        ``name``; pruned trials count by their best intermediate, like
+        optuna's TPE does."""
+        out = []
+        for t in study.trials:
+            if t.number == trial.number or name not in t.params:
+                continue
+            if t.state == "complete" and t.value is not None:
+                out.append((t.value, t.params[name]))
+            elif t.state == "pruned" and t.intermediates:
+                out.append((min(t.intermediates.values()), t.params[name]))
+        return out
+
+    def suggest(self, study: "Study", trial: FrozenTrial, name: str,
+                dist: _Distribution) -> float | int:
+        obs = self._scored_observations(study, trial, name)
+        if len(obs) < self.n_startup_trials:
+            x = self._rng.uniform(dist.internal_low, dist.internal_high)
+            return dist.from_internal(x)
+
+        obs.sort(key=lambda pair: pair[0])
+        n_good = self._gamma(len(obs))
+        xs = np.array([dist.to_internal(v) for _, v in obs])
+        good = _ParzenEstimator(
+            xs[:n_good], dist.internal_low, dist.internal_high
+        )
+        bad = _ParzenEstimator(
+            xs[n_good:], dist.internal_low, dist.internal_high
+        )
+        candidates = good.sample(self._rng, self.n_ei_candidates)
+        score = good.log_pdf(candidates) - bad.log_pdf(candidates)
+        return dist.from_internal(float(candidates[int(np.argmax(score))]))
+
+
 class Trial:
     """Sampling + reporting handle passed to the objective."""
 
-    def __init__(self, study: "Study", record: FrozenTrial,
-                 rng: np.random.Generator):
+    def __init__(self, study: "Study", record: FrozenTrial):
         self._study = study
         self._record = record
-        self._rng = rng
 
     @property
     def number(self) -> int:
@@ -110,25 +255,20 @@ class Trial:
     def params(self) -> dict[str, float | int]:
         return self._record.params
 
-    def suggest_float(self, name: str, low: float, high: float,
-                      log: bool = False) -> float:
-        if log:
-            value = math.exp(self._rng.uniform(math.log(low), math.log(high)))
-        else:
-            value = float(self._rng.uniform(low, high))
+    def _suggest(self, name: str, dist: _Distribution) -> float | int:
+        value = self._study.sampler.suggest(self._study, self._record, name, dist)
         self._record.params[name] = value
         return value
 
+    def suggest_float(self, name: str, low: float, high: float,
+                      log: bool = False) -> float:
+        return float(self._suggest(name, _Distribution(low, high, log=log)))
+
     def suggest_int(self, name: str, low: int, high: int,
                     log: bool = False) -> int:
-        if log:
-            value = int(round(math.exp(
-                self._rng.uniform(math.log(low), math.log(high)))))
-            value = min(max(value, low), high)
-        else:
-            value = int(self._rng.integers(low, high + 1))
-        self._record.params[name] = value
-        return value
+        return int(self._suggest(
+            name, _Distribution(low, high, log=log, is_int=True)
+        ))
 
     def report(self, value: float, step: int) -> None:
         self._record.intermediates[step] = float(value)
@@ -138,19 +278,25 @@ class Trial:
 
 
 class Study:
-    """Minimizing random-search study with pruning."""
+    """Minimizing study with pruning; TPE sampling by default (the
+    reference's ``optuna.create_study`` default, main.py:460)."""
 
-    def __init__(self, pruner: MedianPruner | None = None, seed: int = 0):
+    def __init__(self, pruner: MedianPruner | None = None, seed: int = 0,
+                 sampler: "TPESampler | RandomSampler | str | None" = None):
         self.pruner = pruner if pruner is not None else MedianPruner()
+        if sampler is None or sampler == "tpe":
+            sampler = TPESampler(seed=seed)
+        elif sampler == "random":
+            sampler = RandomSampler(seed=seed)
+        self.sampler = sampler
         self.trials: list[FrozenTrial] = []
-        self._rng = np.random.default_rng(seed)
 
     def optimize(self, objective: Callable[[Trial], float],
                  n_trials: int) -> None:
         for _ in range(n_trials):
             record = FrozenTrial(number=len(self.trials), params={})
             self.trials.append(record)
-            trial = Trial(self, record, self._rng)
+            trial = Trial(self, record)
             try:
                 record.value = float(objective(trial))
                 record.state = "complete"
@@ -202,6 +348,7 @@ def find_optimal_hyperparams(
     n_trials: int = 100,
     seed: int = 0,
     pruner: MedianPruner | None = None,
+    sampler: TPESampler | RandomSampler | str | None = None,
 ) -> Study:
     """The ``--find_hyperparams`` entry (reference: main.py:429-488).
 
@@ -230,7 +377,7 @@ def find_optimal_hyperparams(
             raise TrialPruned
         return 1.0 - result.best_f1
 
-    study = Study(pruner=pruner, seed=seed)
+    study = Study(pruner=pruner, seed=seed, sampler=sampler)
     study.optimize(objective, n_trials)
     best = study.best_trial
     logger.info("best trial: #%d value=%s params=%s", best.number, best.value,

@@ -79,6 +79,25 @@ def global_batch(mesh: Mesh, full_batch: dict[str, np.ndarray]) -> dict[str, jax
     }
 
 
+def local_to_global_batch(
+    mesh: Mesh, local_batch: dict[str, np.ndarray]
+) -> dict[str, jax.Array]:
+    """Assemble a global device batch from HOST-LOCAL sub-batches (the
+    host-sharded corpus path, SURVEY §7.4): each process supplies its
+    ``batch/n_hosts`` rows and ``make_array_from_process_local_data``
+    stitches them along the data-sharded dimension. Rows land in process
+    order (a host's devices are contiguous in jax device order), so process
+    p owns global rows [p*feed, (p+1)*feed).
+    """
+    shardings = batch_shardings(mesh)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, shardings[k]) for k, v in local_batch.items()}
+    return {
+        k: jax.make_array_from_process_local_data(shardings[k], v)
+        for k, v in local_batch.items()
+    }
+
+
 def allgather_to_host(x: jax.Array) -> np.ndarray:
     """Fetch a possibly cross-process-sharded array to host numpy.
 

@@ -66,6 +66,10 @@ class TrainConfig:
     # depend on it — pin it explicitly to resume a run under a different
     # model_axis (the restore validates and explains a mismatch)
     vocab_pad_multiple: int = 0
+    # streaming epochs: build at most this many epoch rows at a time instead
+    # of materializing the whole [N, L] epoch (0 = materialize). Bounds host
+    # RSS at java-large scale — see docs/ARCHITECTURE.md memory budget
+    stream_chunk_items: int = 0
 
     # checkpoint/resume (framework extension; the reference cannot resume,
     # SURVEY.md §5.4)

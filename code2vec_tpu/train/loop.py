@@ -29,7 +29,15 @@ from code2vec_tpu.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
-from code2vec_tpu.data.pipeline import build_epoch, iter_batches, oov_rate, split_items
+from code2vec_tpu.data.pipeline import (
+    build_epoch,
+    empty_batch,
+    iter_batches,
+    iter_streaming_batches,
+    oov_rate,
+    pad_batch_stream,
+    split_items,
+)
 from code2vec_tpu.data.reader import CorpusData
 from code2vec_tpu.metrics import evaluate
 from code2vec_tpu.models.code2vec import Code2VecConfig
@@ -134,7 +142,16 @@ def train(
     np_rng = np.random.default_rng(config.random_seed)
     jax_rng = jax.random.PRNGKey(config.random_seed)
 
-    train_idx, test_idx = split_items(data.n_items, np_rng)
+    if data.shard is None:
+        train_idx, test_idx = split_items(data.n_items, np_rng)
+        global_train = global_test = None
+    else:
+        # host-sharded corpus: every host computes the identical seeded
+        # GLOBAL split, then keeps its round-robin share as local rows —
+        # so the train/test membership of any method is host-independent
+        global_train, global_test = split_items(data.global_n_items, np_rng)
+        train_idx = data.local_rows_of_global(global_train)
+        test_idx = data.local_rows_of_global(global_test)
     logger.info("train item size: %d", len(train_idx))
     logger.info("test item size: %d", len(test_idx))
     logger.info(
@@ -219,9 +236,43 @@ def train(
     if eval_step is None:
         eval_step = make_eval_step(model_config, class_weights)
 
-    # multi-host: every process builds the same full batch (epochs are
-    # seeded identically) and serves the slices its devices own
-    if mesh is not None and jax.process_count() > 1:
+    # multi-host feeding:
+    # - replicated corpus (data.shard is None): every process builds the
+    #   same full batch (epochs are seeded identically) and serves the
+    #   slices its devices own;
+    # - host-sharded corpus: each process builds only its local sub-batch
+    #   of batch_size/n_hosts rows from its own shard, assembled into the
+    #   global array (stratified-by-host sampling, standard DDP semantics)
+    n_hosts = jax.process_count()
+    sharded_feed = data.shard is not None and n_hosts > 1
+    feed_batch = config.batch_size
+    if sharded_feed:
+        if mesh is None:
+            raise ValueError("a host-sharded corpus requires mesh axes")
+        if data.shard[1] != n_hosts:
+            raise ValueError(
+                f"corpus was sharded over {data.shard[1]} hosts but "
+                f"{n_hosts} processes are running"
+            )
+        if config.batch_size % n_hosts:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"{n_hosts} processes"
+            )
+        if data.infer_variable:
+            # the variable task expands each method into a data-dependent
+            # number of examples, so per-host step counts cannot be derived
+            # from the global split alone — unsupported under sharded feed
+            raise ValueError(
+                "host-sharded feeding supports the method task only; load "
+                "the corpus unsharded for infer_variable runs"
+            )
+        feed_batch = config.batch_size // n_hosts
+        from code2vec_tpu.parallel.distributed import local_to_global_batch
+
+        def to_device(batch):
+            return local_to_global_batch(mesh, batch)
+    elif mesh is not None and n_hosts > 1:
         from code2vec_tpu.parallel.distributed import global_batch
 
         def to_device(batch):
@@ -229,6 +280,16 @@ def train(
     else:
         def to_device(batch):
             return batch  # jit in_shardings place host arrays directly
+
+    # every host must run the same number of (collective) steps; the split
+    # is a random permutation, so per-host membership is hypergeometric —
+    # compute the true max share from the global split (identical on every
+    # host), and short hosts pad with fully-masked batches up to it
+    def synced_steps(global_idx: np.ndarray) -> int:
+        shares = np.bincount(
+            np.asarray(global_idx) % n_hosts, minlength=n_hosts
+        )
+        return max(-(-int(shares.max()) // feed_batch), 1)
 
     # device-resident epochs: corpus staged to HBM once, whole chunks of
     # batches per dispatch (train/device_epoch.py). Composes with the mesh:
@@ -324,6 +385,42 @@ def train(
                     preds,
                     data.label_vocab,
                 )
+            elif config.stream_chunk_items:
+                # streaming epochs: java-large-scale corpora (BASELINE
+                # config 3, 16M methods) cannot materialize [N, L] epoch
+                # tensors (~38 GB at bag 200); build chunk_items rows at a
+                # time. Exports still materialize on demand (host_epoch) —
+                # disable per-epoch export for bounded-RSS runs.
+                def chunk_builder(idx):
+                    return build_epoch(
+                        data, idx, config.max_path_length, np_rng,
+                        config.shuffle_variable_indexes,
+                    )
+
+                train_batches = iter_streaming_batches(
+                    chunk_builder, train_idx, feed_batch, np_rng,
+                    chunk_items=config.stream_chunk_items,
+                )
+                test_batches = iter_streaming_batches(
+                    chunk_builder, test_idx, feed_batch, np_rng,
+                    chunk_items=config.stream_chunk_items, shuffle=False,
+                )
+                if sharded_feed:
+                    template = empty_batch(feed_batch, config.max_path_length)
+                    train_batches = pad_batch_stream(
+                        train_batches, synced_steps(global_train), template
+                    )
+                    test_batches = pad_batch_stream(
+                        test_batches, synced_steps(global_test), template
+                    )
+                train_loss = 0.0
+                for batch in train_batches:
+                    state, loss = train_step(state, to_device(batch))
+                    train_loss += float(loss)
+                test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
+                    config, data, state, eval_step, test_batches, to_device,
+                    gather_processes=sharded_feed,
+                )
             else:
                 train_epoch = build_epoch(
                     data,
@@ -332,10 +429,17 @@ def train(
                     np_rng,
                     config.shuffle_variable_indexes,
                 )
+                train_batches = iter_batches(
+                    train_epoch, feed_batch, rng=np_rng, pad_final=True
+                )
+                if sharded_feed:
+                    train_batches = pad_batch_stream(
+                        train_batches,
+                        synced_steps(global_train),
+                        empty_batch(feed_batch, config.max_path_length),
+                    )
                 train_loss = 0.0
-                for batch in iter_batches(
-                    train_epoch, config.batch_size, rng=np_rng, pad_final=True
-                ):
+                for batch in train_batches:
                     state, loss = train_step(state, to_device(batch))
                     train_loss += float(loss)
 
@@ -346,8 +450,18 @@ def train(
                     np_rng,
                     config.shuffle_variable_indexes,
                 )
-                test_loss, accuracy, precision, recall, f1 = _evaluate_epoch(
-                    config, data, state, eval_step, test_epoch, to_device
+                test_batches = iter_batches(
+                    test_epoch, feed_batch, rng=None, pad_final=True
+                )
+                if sharded_feed:
+                    test_batches = pad_batch_stream(
+                        test_batches,
+                        synced_steps(global_test),
+                        empty_batch(feed_batch, config.max_path_length),
+                    )
+                test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
+                    config, data, state, eval_step, test_batches, to_device,
+                    gather_processes=sharded_feed,
                 )
 
             metrics = {
@@ -386,6 +500,7 @@ def train(
                 and config.print_sample_cycle
                 and epoch % config.print_sample_cycle == 0
                 and report_fn is None
+                and not sharded_feed  # samples need full-batch epochs
             ):
                 if test_epoch is None:
                     test_epoch = host_epoch(test_idx)
@@ -398,7 +513,14 @@ def train(
                 for sink in sinks:
                     sink(epoch, {"best_f1": f1})
                 meta.best_f1 = f1
-                if report_fn is None and vectors_path is not None:
+                if sharded_feed and vectors_path is not None:
+                    logger.warning(
+                        "vector export is not supported with host-sharded "
+                        "feeding (each host holds 1/%d of the corpus); run "
+                        "a single-host export pass on the saved checkpoint",
+                        n_hosts,
+                    )
+                elif report_fn is None and vectors_path is not None:
                     if train_epoch is None:
                         train_epoch = host_epoch(train_idx)
                     if test_epoch is None:
@@ -454,12 +576,13 @@ def train(
                 logger.info(
                     "early stop loss:%s, bad:%d", train_loss, meta.bad_count
                 )
-                if test_epoch is None:
-                    test_epoch = host_epoch(test_idx)
-                export_mod.print_sample(
-                    data, state, eval_step, test_epoch, config.batch_size,
-                    to_device,
-                )
+                if not sharded_feed:
+                    if test_epoch is None:
+                        test_epoch = host_epoch(test_idx)
+                    export_mod.print_sample(
+                        data, state, eval_step, test_epoch,
+                        config.batch_size, to_device,
+                    )
                 break
     except StopTraining:
         pass
@@ -487,22 +610,74 @@ def _evaluate_epoch(
     test_epoch,
     to_device=lambda batch: batch,
 ) -> tuple[float, float, float, float, float]:
+    return _evaluate_batches(
+        config,
+        data,
+        state,
+        eval_step,
+        iter_batches(test_epoch, config.batch_size, rng=None, pad_final=True),
+        to_device,
+    )
+
+
+def _evaluate_batches(
+    config: TrainConfig,
+    data: CorpusData,
+    state,
+    eval_step,
+    batches,
+    to_device=lambda batch: batch,
+    gather_processes: bool = False,
+) -> tuple[float, float, float, float, float]:
     """Test pass: accumulate per-batch mean losses (reference semantics,
-    main.py:283-284) and pooled predictions, then dispatch the matcher."""
+    main.py:283-284) and pooled predictions, then dispatch the matcher.
+
+    ``gather_processes``: host-sharded feeding — each process saw only its
+    own sub-batch rows, so expected/actual are all-gathered across
+    processes before computing the (global) metrics. The host's rows sit at
+    ``[process_index * feed, (process_index + 1) * feed)`` of the global
+    prediction vector (jax device order groups a host's devices
+    contiguously, which is how local_to_global_batch laid the rows out).
+    """
+    import jax as _jax
+
     from code2vec_tpu.parallel.distributed import allgather_to_host
 
     test_loss = 0.0
     expected, actual = [], []
-    for batch in iter_batches(
-        test_epoch, config.batch_size, rng=None, pad_final=True
-    ):
+    for batch in batches:
         out = eval_step(state, to_device(batch))
         test_loss += float(out["loss"])
         valid = batch["example_mask"].astype(bool)
+        preds = allgather_to_host(out["preds"])
+        if gather_processes and len(preds) != len(valid):
+            feed = len(valid)
+            lo = _jax.process_index() * feed
+            preds = preds[lo : lo + feed]
         expected.append(batch["labels"][valid])
-        actual.append(allgather_to_host(out["preds"])[valid])
+        actual.append(preds[valid])
     expected = np.concatenate(expected) if expected else np.zeros(0, np.int32)
     actual = np.concatenate(actual) if actual else np.zeros(0, np.int32)
+    if gather_processes and _jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # per-process row counts differ (round-robin shards); pad to the
+        # max with a -1 sentinel so the allgather shapes agree, then drop
+        n = len(expected)
+        max_n = int(multihost_utils.process_allgather(np.asarray(n)).max())
+        pad = np.full(max_n - n, -1, expected.dtype)
+        expected = np.asarray(
+            multihost_utils.process_allgather(
+                np.concatenate([expected, pad]), tiled=True
+            )
+        )
+        actual = np.asarray(
+            multihost_utils.process_allgather(
+                np.concatenate([actual, pad.astype(actual.dtype)]), tiled=True
+            )
+        )
+        keep = expected >= 0
+        expected, actual = expected[keep], actual[keep]
     accuracy, precision, recall, f1 = evaluate(
         config.eval_method, expected, actual, data.label_vocab
     )

@@ -29,7 +29,7 @@ def tiny_corpus(tmp_path_factory):
 def _trial(study: Study, seed: int = 0) -> Trial:
     record = FrozenTrial(number=len(study.trials), params={})
     study.trials.append(record)
-    return Trial(study, record, np.random.default_rng(seed))
+    return Trial(study, record)
 
 
 class TestSampling:
@@ -146,6 +146,75 @@ class TestStudy:
         assert study.trials[0].state == "pruned"
         assert study.trials[0].value == pytest.approx(0.9)
         assert study.best_trial.number == 1
+
+
+class TestTPESampler:
+    """The reference's optuna default is TPE (main.py:460); the sampler
+    must actually exploit structure, not just re-label random search."""
+
+    @staticmethod
+    def _bowl(trial):
+        import math
+
+        lr = trial.suggest_float("lr", 1e-5, 1e-1, log=True)
+        drop = trial.suggest_float("drop", 0.0, 1.0)
+        # smooth bowl: optimum at lr=1e-3, drop=0.3, min value 0
+        return (math.log10(lr) + 3.0) ** 2 + 4 * (drop - 0.3) ** 2
+
+    def _best(self, sampler, seed, n_trials=60):
+        study = Study(seed=seed, sampler=sampler)
+        study.optimize(self._bowl, n_trials=n_trials)
+        return study.best_value
+
+    def test_tpe_beats_random_on_synthetic_objective(self):
+        seeds = range(5)
+        tpe = [self._best("tpe", s) for s in seeds]
+        rnd = [self._best("random", s) for s in seeds]
+        # measured margins are ~8x (mean 0.004 vs 0.031 over seeds 0..7);
+        # the assertions leave generous slack
+        assert np.mean(tpe) < 0.5 * np.mean(rnd)
+        assert np.mean(tpe) < 0.02
+
+    def test_tpe_respects_bounds_and_int_domain(self):
+        study = Study(seed=0, sampler="tpe")
+
+        def objective(trial):
+            size = trial.suggest_int("encode_size", 100, 300, log=True)
+            assert isinstance(size, int) and 100 <= size <= 300
+            return abs(size - 200) / 100.0
+
+        study.optimize(objective, n_trials=30)
+        assert all(100 <= t.params["encode_size"] <= 300 for t in study.trials)
+
+    def test_tpe_concentrates_after_startup(self):
+        study = Study(seed=1, sampler="tpe")
+        study.optimize(self._bowl, n_trials=50)
+        import math
+
+        startup = [math.log10(t.params["lr"]) for t in study.trials[:10]]
+        guided = [math.log10(t.params["lr"]) for t in study.trials[-20:]]
+        # guided draws hug the optimum (-3) tighter than the startup draws
+        assert np.mean(np.abs(np.array(guided) + 3.0)) < np.mean(
+            np.abs(np.array(startup) + 3.0)
+        )
+
+    def test_pruned_trials_feed_observations(self):
+        from code2vec_tpu.hpo import TPESampler, _Distribution
+
+        study = Study(seed=0, sampler="tpe")
+
+        def objective(trial):
+            trial.suggest_float("x", 0.0, 1.0)
+            if trial.number % 2 == 0:
+                trial.report(0.5, 0)
+                raise TrialPruned
+            return 0.4
+
+        study.optimize(objective, n_trials=12)
+        sampler: TPESampler = study.sampler
+        record = FrozenTrial(number=99, params={})
+        obs = sampler._scored_observations(study, record, "x")
+        assert len(obs) == 12  # pruned trials count by best intermediate
 
 
 class TestEndToEnd:

@@ -1,0 +1,264 @@
+"""Scale-out data paths: streaming epochs (bounded host RSS) and
+host-sharded corpus loading (multi-host pods, SURVEY §7.4 / BASELINE
+config 3-4). Multi-process behavior is exercised by simulating hosts with
+explicit (index, count) shards in one process — the pure mapping and
+assembly logic is identical.
+"""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.data import pipeline as pipeline_mod
+from code2vec_tpu.data.pipeline import (
+    build_epoch,
+    iter_batches,
+    iter_streaming_batches,
+    pad_batch_stream,
+    split_items,
+)
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.loop import train
+
+
+@pytest.fixture(scope="module")
+def tiny(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tiny_scale")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    data = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"], cache=False
+    )
+    return paths, data
+
+
+class TestStreamingEpochs:
+    def _builder(self, data, bag, rng):
+        def build(idx):
+            return build_epoch(data, idx, bag, rng)
+
+        return build
+
+    def test_covers_every_item_exactly_once(self, tiny):
+        _, data = tiny
+        rng = np.random.default_rng(0)
+        idx = np.arange(data.n_items)
+        seen = []
+        for batch in iter_streaming_batches(
+            self._builder(data, 16, rng), idx, batch_size=8, rng=rng,
+            chunk_items=10,
+        ):
+            valid = batch["example_mask"].astype(bool)
+            seen.extend(batch["ids"][valid].tolist())
+        assert sorted(seen) == sorted(data.ids[idx].tolist())
+
+    def test_static_shapes_and_padding(self, tiny):
+        _, data = tiny
+        rng = np.random.default_rng(1)
+        idx = np.arange(data.n_items)
+        batches = list(
+            iter_streaming_batches(
+                self._builder(data, 16, rng), idx, batch_size=8, rng=rng,
+                chunk_items=7,
+            )
+        )
+        assert all(b["starts"].shape == (8, 16) for b in batches)
+        n_valid = int(sum(b["example_mask"].sum() for b in batches))
+        assert n_valid == len(idx)
+        # every batch except possibly the last is full
+        assert all(
+            b["example_mask"].all() for b in batches[:-1]
+        )
+
+    def test_chunks_bound_materialization(self, tiny, monkeypatch):
+        """No epoch_builder call may see more items than chunk_items — the
+        memory bound the streaming path exists to provide."""
+        _, data = tiny
+        rng = np.random.default_rng(2)
+        idx = np.arange(data.n_items)
+        sizes = []
+
+        def spy_builder(chunk_idx):
+            sizes.append(len(chunk_idx))
+            return build_epoch(data, chunk_idx, 16, rng)
+
+        for _ in iter_streaming_batches(
+            spy_builder, idx, batch_size=8, rng=rng, chunk_items=10
+        ):
+            pass
+        assert sizes and max(sizes) <= 10
+
+    def test_matches_iter_batches_multiset(self, tiny):
+        """Same item set, same static shapes, same number of valid rows as
+        the materializing path (orders differ: the stream shuffles items,
+        iter_batches shuffles rows)."""
+        _, data = tiny
+        idx = np.arange(data.n_items)
+        bag = int(np.diff(data.row_splits).max())  # no subsampling
+        rng_a = np.random.default_rng(3)
+        epoch = build_epoch(data, idx, bag, rng_a)
+        mat = list(iter_batches(epoch, 8, rng=rng_a, pad_final=True))
+
+        rng_b = np.random.default_rng(3)
+        stream = list(
+            iter_streaming_batches(
+                lambda i: build_epoch(data, i, bag, rng_b), idx, 8, rng_b,
+                chunk_items=9,
+            )
+        )
+        assert len(mat) == len(stream)
+
+        def signature(batches):
+            # multiset of (label, sorted context triples) over valid rows
+            out = []
+            for b in batches:
+                for r in np.nonzero(b["example_mask"])[0]:
+                    trip = sorted(
+                        zip(
+                            b["starts"][r].tolist(),
+                            b["paths"][r].tolist(),
+                            b["ends"][r].tolist(),
+                        )
+                    )
+                    out.append((int(b["labels"][r]), tuple(trip)))
+            return sorted(out)
+
+        assert signature(mat) == signature(stream)
+
+    def test_end_to_end_training(self, tiny):
+        _, data = tiny
+        config = TrainConfig(
+            max_epoch=2,
+            batch_size=16,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=16,
+            print_sample_cycle=0,
+            stream_chunk_items=16,
+        )
+        result = train(config, data)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
+
+
+class TestPadBatchStream:
+    def test_pads_to_step_count_with_masked_templates(self):
+        from code2vec_tpu.data.pipeline import empty_batch
+
+        template = empty_batch(2, 4)
+        batches = [
+            {"labels": np.array([1, 2]), "example_mask": np.ones(2, np.float32)}
+        ]
+        out = list(pad_batch_stream(iter(batches), 3, template))
+        assert len(out) == 3
+        assert out[0]["example_mask"].sum() == 2
+        assert out[1]["example_mask"].sum() == 0
+        assert out[2]["example_mask"].sum() == 0
+
+    def test_empty_stream_yields_only_templates(self):
+        from code2vec_tpu.data.pipeline import empty_batch
+
+        template = empty_batch(2, 4)
+        out = list(pad_batch_stream(iter([]), 2, template))
+        assert len(out) == 2
+        assert all(b["example_mask"].sum() == 0 for b in out)
+        assert all(b["starts"].shape == (2, 4) for b in out)
+
+
+class TestHostShardedLoading:
+    N_HOSTS = 4
+
+    def _load_shards(self, paths):
+        return [
+            load_corpus(
+                paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+                cache=False, shard=(i, self.N_HOSTS),
+            )
+            for i in range(self.N_HOSTS)
+        ]
+
+    @pytest.mark.parametrize("native", [True, False])
+    def test_shards_partition_the_corpus(self, tiny, native):
+        paths, full = tiny
+        shards = [
+            load_corpus(
+                paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+                cache=False, shard=(i, self.N_HOSTS), native=native,
+            )
+            for i in range(self.N_HOSTS)
+        ]
+        assert sum(s.n_items for s in shards) == full.n_items
+        assert sum(s.n_contexts for s in shards) == full.n_contexts
+        for i, s in enumerate(shards):
+            assert s.global_n_items == full.n_items
+            # round-robin: shard i holds global rows i, i+4, i+8, ...
+            np.testing.assert_array_equal(s.ids, full.ids[i :: self.N_HOSTS])
+            np.testing.assert_array_equal(
+                s.labels, full.labels[i :: self.N_HOSTS]
+            )
+            # context rows intact per method
+            for local in range(min(s.n_items, 5)):
+                g = i + local * self.N_HOSTS
+                np.testing.assert_array_equal(
+                    s.starts[s.row_splits[local] : s.row_splits[local + 1]],
+                    full.starts[full.row_splits[g] : full.row_splits[g + 1]],
+                )
+
+    def test_label_vocab_is_global_and_identical(self, tiny):
+        paths, full = tiny
+        shards = self._load_shards(paths)
+        for s in shards:
+            assert s.label_vocab.stoi == full.label_vocab.stoi
+
+    def test_global_local_mapping_roundtrip(self, tiny):
+        paths, full = tiny
+        shards = self._load_shards(paths)
+        rng = np.random.default_rng(0)
+        global_train, global_test = split_items(full.n_items, rng)
+        covered = []
+        for s in shards:
+            local = s.local_rows_of_global(global_train)
+            covered.extend(s.global_of_local(local).tolist())
+        assert sorted(covered) == sorted(global_train.tolist())
+
+    def test_sharded_training_runs(self, tiny):
+        """Single-process sanity: a shard-loaded corpus trains end to end
+        (the degenerate 1-process case of pod feeding)."""
+        paths, _ = tiny
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            cache=False, shard=(1, 2),
+        )
+        config = TrainConfig(
+            max_epoch=2,
+            batch_size=8,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=16,
+            print_sample_cycle=0,
+        )
+        result = train(config, data)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
+
+    def test_sharded_cache_roundtrip(self, tiny, tmp_path):
+        import shutil
+
+        paths, _ = tiny
+        local = {
+            k: shutil.copy(str(v), tmp_path / f"{k}.txt")
+            for k, v in paths.items()
+        }
+        kw = dict(cache=True, shard=(2, self.N_HOSTS))
+        cold = load_corpus(
+            local["corpus"], local["path_idx"], local["terminal_idx"], **kw
+        )
+        warm = load_corpus(
+            local["corpus"], local["path_idx"], local["terminal_idx"], **kw
+        )
+        assert warm.shard == (2, self.N_HOSTS)
+        assert warm.global_n_items == cold.global_n_items
+        np.testing.assert_array_equal(cold.starts, warm.starts)
+        np.testing.assert_array_equal(cold.row_splits, warm.row_splits)

@@ -1,0 +1,88 @@
+"""Measure host memory of the epoch paths at top11 scale (605k methods) and
+print the documented java-large budget (BASELINE config 3, 16M methods).
+
+Usage: python tools/memory_budget.py [--materialize]
+
+Default: stream a partial epoch (first N chunks) with
+``iter_streaming_batches`` and report peak RSS delta. ``--materialize``
+builds the full ``[N, L]`` epoch instead (the path streaming replaces) for
+comparison. Run each mode in a fresh process; RSS is process-wide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import resource
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from code2vec_tpu.data.pipeline import build_epoch, iter_streaming_batches  # noqa: E402
+from code2vec_tpu.data.synth import (  # noqa: E402
+    SynthSpec,
+    corpus_data_from_raw,
+    generate_corpus_data,
+)
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--materialize", action="store_true")
+    ap.add_argument("--n_methods", type=int, default=605_945)  # top11 scale
+    ap.add_argument("--bag", type=int, default=200)
+    ap.add_argument("--chunk_items", type=int, default=65_536)
+    ap.add_argument("--batches", type=int, default=96, help="stream this many")
+    args = ap.parse_args()
+
+    spec = SynthSpec(
+        n_methods=args.n_methods,
+        n_terminals=360_631,
+        n_paths=342_845,
+        n_labels=8_000,
+        mean_contexts=120.0,
+        max_contexts=400,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+    base = rss_mb()
+    rng = np.random.default_rng(0)
+    idx = np.arange(data.n_items)
+
+    if args.materialize:
+        epoch = build_epoch(data, idx, args.bag, rng)
+        mode = "materialize"
+        touched = len(epoch)
+    else:
+        builder = lambda i: build_epoch(data, i, args.bag, rng)  # noqa: E731
+        it = iter_streaming_batches(
+            builder, idx, batch_size=1024, rng=rng, chunk_items=args.chunk_items
+        )
+        touched = 0
+        for _ in range(args.batches):
+            next(it)
+            touched += 1024
+        mode = "stream"
+
+    print(
+        json.dumps(
+            {
+                "mode": mode,
+                "n_methods": args.n_methods,
+                "bag": args.bag,
+                "corpus_rss_mb": round(base, 1),
+                "epoch_peak_delta_mb": round(rss_mb() - base, 1),
+                "rows_touched": touched,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
