@@ -126,15 +126,27 @@ def corpus_data_from_raw(raw: RawCorpus):
     exercised (synth sprinkles ``@method_0`` at raw index 1)."""
     from code2vec_tpu.data.reader import CorpusData
     from code2vec_tpu.data.vocab import Vocab
+    from code2vec_tpu.text import normalize_and_subtokenize
 
     n_methods = len(raw.row_splits) - 1
     label_vocab = Vocab()
     for name in raw.label_names:
         label_vocab.add_label(name)
+    normalized = [
+        normalize_and_subtokenize(raw.label_names[i])[0]
+        for i in raw.label_ids
+    ]
     terminal_vocab = Vocab()
     terminal_vocab.add("<PAD/>", 0)
     terminal_vocab.add("@question", 1)
-    terminal_vocab.add("@method_0", 2)  # raw idx 1 -> shifted idx 2
+    # raw terminal idx i+1 -> shifted idx i+2; terminal_names[0] is
+    # "@method_0", so method_token_index resolves to 2
+    for i, name in enumerate(raw.terminal_names):
+        terminal_vocab.add(name, i + 2)
+    path_vocab = Vocab()
+    path_vocab.add("<PAD/>", 0)
+    for i, name in enumerate(raw.path_names):
+        path_vocab.add(name, i + 1)
     return CorpusData(
         starts=raw.starts + 1,
         paths=raw.paths,
@@ -142,11 +154,11 @@ def corpus_data_from_raw(raw: RawCorpus):
         row_splits=raw.row_splits,
         ids=np.arange(n_methods, dtype=np.int64),
         labels=raw.label_ids.astype(np.int32),
-        normalized_labels=[],
+        normalized_labels=normalized,
         sources=[None] * n_methods,
         aliases=[{} for _ in range(n_methods)],
         terminal_vocab=terminal_vocab,
-        path_vocab=Vocab(),
+        path_vocab=path_vocab,
         label_vocab=label_vocab,
     )
 

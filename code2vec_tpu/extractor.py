@@ -18,6 +18,7 @@ import ctypes
 import os
 import shutil
 import subprocess
+import sys
 from dataclasses import dataclass, field
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -321,17 +322,119 @@ def parse_corpus_native(path: str):
     return starts, paths, ends, row_splits, ids, headers, var_lists
 
 
+def _read_method_rows(dataset_dir: str) -> list[tuple[str, str]]:
+    # surrogateescape keeps non-UTF-8 path bytes lossless through the
+    # Python detour (the C++ leg reads methods.txt as raw bytes itself)
+    rows = []
+    path = os.path.join(dataset_dir, "methods.txt")
+    with open(path, encoding="utf-8", errors="surrogateescape") as f:
+        for line in f:
+            line = line.strip()
+            if not line or "\t" not in line:
+                continue
+            src, method = line.split("\t", 1)
+            rows.append((src, method))
+    return rows
+
+
+def _py_config_from_flags(args, extra):
+    """The Java leg's passthrough normalization flags, applied to the
+    Python leg too — both legs intern literals into ONE vocab, so they
+    must agree on what a literal normalizes to."""
+    from code2vec_tpu.pyextract import PyExtractConfig
+
+    config = PyExtractConfig(
+        max_length=args.max_length, max_width=args.max_width
+    )
+    for flag in extra:
+        if flag == "--no-normalize-string":
+            config.normalize_string_literal = False
+        elif flag == "--no-normalize-char":
+            config.normalize_char_literal = False
+        elif flag == "--normalize-int":
+            config.normalize_int_literal = True
+        elif flag == "--normalize-double":
+            config.normalize_double_literal = True
+        elif flag == "--no-normalize-double":
+            config.normalize_double_literal = False
+    return config
+
+
+def _extract_mixed(args, extra, rows) -> None:
+    """Multi-language dataset (BASELINE config 5): .java rows go through
+    the native CLI, .py rows through code2vec_tpu.pyextract in merge mode,
+    both interning into ONE shared vocab space (the Python leg preloads the
+    Java leg's terminal/path vocab files and appends records)."""
+    import tempfile
+
+    from code2vec_tpu.formats.params_io import read_params
+    from code2vec_tpu.pyextract import extract_python_dataset
+
+    java_rows = [r for r in rows if not r[0].endswith(".py")]
+    py_rows = [r for r in rows if r[0].endswith(".py")]
+
+    start_id = 0
+    merge = False
+    if java_rows:
+        with tempfile.TemporaryDirectory() as tmp:
+            with open(
+                os.path.join(tmp, "methods.txt"), "w", encoding="utf-8",
+                errors="surrogateescape",
+            ) as f:
+                for src, method in java_rows:
+                    f.write(f"{src}\t{method}\n")
+            result = extract_dataset(
+                tmp,
+                args.source_dir,
+                max_length=args.max_length,
+                max_width=args.max_width,
+                method_declarations=args.method_declarations,
+                extra_args=extra,
+            )
+            sys.stderr.write(result.stderr)
+            copy_names = [
+                "corpus.txt", "actual_methods.txt", "terminal_idxs.txt",
+                "path_idxs.txt", "params.txt",
+            ]
+            if args.method_declarations and os.path.exists(
+                os.path.join(tmp, args.method_declarations)
+            ):
+                copy_names.append(args.method_declarations)
+            for name in copy_names:
+                shutil.copy2(
+                    os.path.join(tmp, name),
+                    os.path.join(args.dataset_dir, name),
+                )
+            start_id = int(
+                read_params(os.path.join(tmp, "params.txt"))["method_count"]
+            )
+        merge = True
+
+    n, vocabs = extract_python_dataset(
+        args.dataset_dir, args.source_dir, py_rows,
+        config=_py_config_from_flags(args, extra),
+        merge=merge, start_id=start_id,
+        method_declarations=args.method_declarations,
+    )
+    print(
+        f"extracted {n} methods ({start_id} java + {n - start_id} python), "
+        f"{len(vocabs.terminals)} terminals, {len(vocabs.paths)} paths",
+        file=sys.stderr,
+    )
+
+
 def main(argv: list[str] | None = None) -> None:
     """``python -m code2vec_tpu.extractor <dataset_dir> <source_dir> …`` —
     builds the native extractor on first use and forwards to ``c2v-extract``
-    (createDataset parity, ipynb cell11)."""
+    (createDataset parity, ipynb cell11). methods.txt rows naming .py files
+    route through the Python-language extractor (pyextract), merging into
+    the same vocab space as the Java rows."""
     import argparse
-    import sys
 
     parser = argparse.ArgumentParser(
         prog="code2vec_tpu.extractor",
-        description="Java sources -> path-context corpus artifacts "
-        "(reads <dataset_dir>/methods.txt, writes corpus.txt, "
+        description="Java and/or Python sources -> path-context corpus "
+        "artifacts (reads <dataset_dir>/methods.txt, writes corpus.txt, "
         "terminal_idxs.txt, path_idxs.txt, params.txt, actual_methods.txt)",
     )
     parser.add_argument("dataset_dir")
@@ -340,7 +443,16 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--max-width", type=int, default=3)
     parser.add_argument("--method-declarations", default=None)
     args, extra = parser.parse_known_args(argv)
+
     try:
+        rows = _read_method_rows(args.dataset_dir)
+    except OSError as e:
+        print(f"ERROR: cannot open methods.txt: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    try:
+        if any(src.endswith(".py") for src, _ in rows):
+            _extract_mixed(args, extra, rows)
+            return
         result = extract_dataset(
             args.dataset_dir,
             args.source_dir,

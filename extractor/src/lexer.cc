@@ -80,6 +80,11 @@ void Lexer::run(const std::string& src) {
       continue;
     }
     if (c == '"') {
+      if (i + 2 < n && src[i + 1] == '"' && src[i + 2] == '"') {
+        throw LexError("line " + std::to_string(line) +
+                       ": Java 15 text blocks (\"\"\") are not supported; "
+                       "use a plain string or exclude the file");
+      }
       size_t start = i++;
       while (i < n && src[i] != '"') {
         if (src[i] == '\\' && i + 1 < n) ++i;

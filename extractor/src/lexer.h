@@ -4,10 +4,18 @@
 
 #pragma once
 
+#include <stdexcept>
 #include <string>
 #include <vector>
 
 namespace c2v {
+
+// Unsupported lexical construct (e.g. Java 15 text blocks) — fails the
+// file loudly with a construct-specific message, like the parser's
+// ParseError does for unsupported grammar.
+struct LexError : std::runtime_error {
+  explicit LexError(const std::string& message) : std::runtime_error(message) {}
+};
 
 enum class Tok {
   kEnd,

@@ -130,6 +130,11 @@ int main(int argc, char** argv) {
     } catch (const c2v::ParseError& e) {
       std::cerr << "ERROR: parse error. " << line << " (" << e.what() << ")\n";
       last_file.clear();  // do not reuse a broken unit
+    } catch (const c2v::LexError& e) {
+      // same actionable ERROR-with-row form as ParseError (which file to
+      // exclude), e.g. the Java 15 text-block rejection
+      std::cerr << "ERROR: parse error. " << line << " (" << e.what() << ")\n";
+      last_file.clear();
     } catch (const std::exception& e) {
       std::cerr << "WARNING: " << e.what() << "\n";
       last_file.clear();

@@ -96,6 +96,9 @@ class Parser {
   bool at_ident(const std::string& name) const {
     return cur().kind == Tok::kIdent && cur().text == name;
   }
+  bool at_offset_is(int k, const std::string& p) const {
+    return peek(k).kind == Tok::kPunct && peek(k).text == p;
+  }
   void next() { if (!at_end()) ++pos_; }
   void expect(const std::string& p) {
     if (!at(p)) fail("expected '" + p + "'");
@@ -406,6 +409,14 @@ class Parser {
       expect("}");
       return decl;
     }
+    // explicit diagnostics for recognizable modern constructs, so corpus
+    // builders see WHAT is unsupported instead of a generic parse error
+    if (at_ident("record") && peek().kind == Tok::kIdent)
+      fail("Java 16 'record' declarations are not supported; rewrite as a "
+           "class or exclude the file");
+    if (at_ident("sealed") || (at_ident("non") && at_offset_is(1, "-")))
+      fail("Java 17 sealed types ('sealed'/'non-sealed'/'permits') are not "
+           "supported; remove the sealing modifiers or exclude the file");
     fail("expected type declaration");
   }
 
@@ -1266,6 +1277,9 @@ class Parser {
       te->add(std::move(type));
       return te;
     }
+    if (at_ident("switch"))
+      fail("Java 14 switch *expressions* are not supported (switch "
+           "statements are); rewrite as a statement or exclude the file");
     if (cur().kind == Tok::kIdent && !kReservedNonType.count(cur().text)) {
       std::string name = expect_ident();
       if (at("(")) {

@@ -1,0 +1,240 @@
+"""Python-language extractor (code2vec_tpu/pyextract.py) — the
+multi-language leg of BASELINE config 5. Conventions must match the C++
+Java extractor so both legs intern into one vocab space."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.pyextract import (
+    DOWN,
+    UP,
+    PyExtractConfig,
+    extract_python_dataset,
+    extract_python_source,
+)
+
+
+def contexts_of(src, name):
+    methods = extract_python_source(src)
+    for m in methods:
+        if m.label == name:
+            return m
+    raise AssertionError(f"{name} not extracted; got {[m.label for m in methods]}")
+
+
+class TestAnonymization:
+    def test_params_become_var_aliases(self):
+        m = contexts_of("def add(a, b):\n    return a + b\n", "add")
+        assert ("a", "@var_0") in m.variables
+        assert ("b", "@var_1") in m.variables
+        terms = {t for s, _, e in m.contexts for t in (s, e)}
+        assert "@var_0" in terms and "@var_1" in terms
+        assert "a" not in terms and "b" not in terms
+
+    def test_own_name_becomes_method_alias(self):
+        m = contexts_of("def fib(n):\n    return fib(n - 1) + n\n", "fib")
+        assert ("fib", "@method_0") in m.methods
+        terms = {t for s, _, e in m.contexts for t in (s, e)}
+        assert "@method_0" in terms
+        assert "fib" not in terms  # the label must never leak as a terminal
+
+    def test_locals_bind_at_first_store(self):
+        src = (
+            "def f(xs):\n"
+            "    total = 0\n"
+            "    for x in xs:\n"
+            "        total += x\n"
+            "    return total\n"
+        )
+        m = contexts_of(src, "f")
+        originals = [o for o, _ in m.variables]
+        assert originals == ["xs", "total", "x"]
+
+    def test_unbound_names_keep_text(self):
+        m = contexts_of("def f(x):\n    return len(x) + GLOBAL\n", "f")
+        terms = {t for s, _, e in m.contexts for t in (s, e)}
+        assert "len" in terms  # builtins/globals pass through, like Java
+        assert "GLOBAL" in terms  # case-preserved here; interning lowercases
+
+
+class TestLiterals:
+    def test_string_and_float_normalized_int_kept(self):
+        src = 'def f():\n    a = "hi"\n    b = 2.5\n    c = 7\n    return a\n'
+        m = contexts_of(src, "f")
+        terms = {t for s, _, e in m.contexts for t in (s, e)}
+        assert "@string_literal" in terms
+        assert "@double_literal" in terms
+        assert "7" in terms  # normalize_int_literal=False default (parity)
+
+    def test_int_normalization_opt_in(self):
+        src = "def f():\n    c = 7\n    return c\n"
+        methods = extract_python_source(
+            src, config=PyExtractConfig(normalize_int_literal=True)
+        )
+        terms = {t for s, _, e in methods[0].contexts for t in (s, e)}
+        assert "@int_literal" in terms
+
+
+class TestPaths:
+    def test_path_format_uses_reference_arrows(self):
+        m = contexts_of("def f(a):\n    return a\n", "f")
+        assert all(UP in p or DOWN in p for _, p, _ in m.contexts)
+        # hinge form: ups before the single hinge, then downs
+        for _, p, _ in m.contexts:
+            assert p.index(DOWN) > -1
+            up_part = p.split(DOWN)[0]
+            assert UP in up_part or up_part  # terminal-side names first
+
+    def test_length_cap_prunes(self):
+        src = "def f(a):\n    return ((((a + 1) + 2) + 3) + 4)\n"
+        wide = extract_python_source(src, config=PyExtractConfig(max_length=20))
+        tight = extract_python_source(src, config=PyExtractConfig(max_length=4))
+        assert len(wide[0].contexts) > len(tight[0].contexts) > 0
+        for _, p, _ in tight[0].contexts:
+            assert p.count(UP) + p.count(DOWN) + 1 <= 4 + 1
+
+    def test_operator_suffixed_nodes(self):
+        m = contexts_of("def f(a, b):\n    return a * b\n", "f")
+        assert any("BinOp:*" in p for _, p, _ in m.contexts)
+        m = contexts_of("def f(a, b):\n    return a < b\n", "f")
+        assert any("Compare:<" in p for _, p, _ in m.contexts)
+
+
+class TestMethodFilter:
+    def test_dunders_and_trivial_accessors_skipped(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.x = 1\n"
+            "    def __repr__(self):\n"
+            "        return str(self.x)\n"
+            "    def get_x(self):\n"
+            "        return self.x\n"
+            "    def set_x(self, v):\n"
+            "        self.x = v\n"
+            "    def busy(self, v):\n"
+            "        w = v * 2\n"
+            "        return w + 1\n"
+        )
+        labels = [m.label for m in extract_python_source(src)]
+        assert labels == ["busy"]
+
+    def test_docstring_only_skipped(self):
+        src = 'def doc_only():\n    """just a doc"""\n'
+        assert extract_python_source(src) == []
+
+    def test_nested_defs_extracted_separately(self):
+        src = (
+            "def outer(a):\n"
+            "    def inner(b):\n"
+            "        return b * 2\n"
+            "    return inner(a) + a\n"
+        )
+        labels = sorted(m.label for m in extract_python_source(src))
+        assert labels == ["inner", "outer"]
+
+
+class TestMergedDataset:
+    def _write_sources(self, root):
+        (root / "src").mkdir()
+        (root / "src" / "MathOps.java").write_text(
+            "public class MathOps {\n"
+            "    public static int add(int a, int b) { return a + b; }\n"
+            "}\n"
+        )
+        (root / "src" / "math_ops.py").write_text(
+            "def add(a, b):\n    return a + b\n\n"
+            "def scale(v, k):\n    return v * k\n"
+        )
+        (root / "dataset").mkdir()
+
+    def test_mixed_cli_merges_vocab_and_loads(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+
+        self._write_sources(tmp_path)
+        (tmp_path / "dataset" / "methods.txt").write_text(
+            "src/MathOps.java\t*\nsrc/math_ops.py\t*\n"
+        )
+        result = subprocess.run(
+            [sys.executable, "-m", "code2vec_tpu.extractor",
+             str(tmp_path / "dataset"), str(tmp_path)],
+            capture_output=True, text=True, check=True,
+        )
+        assert "1 java" in result.stderr and "python" in result.stderr
+
+        data = load_corpus(
+            tmp_path / "dataset" / "corpus.txt",
+            tmp_path / "dataset" / "path_idxs.txt",
+            tmp_path / "dataset" / "terminal_idxs.txt",
+            cache=False,
+        )
+        assert data.n_items == 3  # java add + python add + python scale
+        np.testing.assert_array_equal(data.ids, [0, 1, 2])
+        # both languages' add anonymize to the same terminals -> both rows
+        # reference the SAME @var vocab entries (the merged-vocab property)
+        assert data.labels[0] == data.labels[1]  # same label "add"
+        java_terms = set(data.starts[: data.row_splits[1]])
+        py_terms = set(
+            data.starts[data.row_splits[1] : data.row_splits[2]]
+        )
+        assert java_terms & py_terms  # shared vocab ids across languages
+
+    def test_missing_file_warns_and_continues(self, tmp_path):
+        """One bad row must not abort mid-write and orphan vocab ids (the
+        C++ leg's warn-and-continue policy)."""
+        self._write_sources(tmp_path)
+        rows = [("src/gone.py", "*"), ("src/math_ops.py", "*")]
+        n, vocabs = extract_python_dataset(
+            str(tmp_path / "dataset"), str(tmp_path), rows
+        )
+        assert n == 2  # both math_ops methods extracted
+        assert (tmp_path / "dataset" / "terminal_idxs.txt").exists()
+
+    def test_normalization_flags_reach_python_leg(self, tmp_path):
+        """--normalize-int must apply to BOTH legs or the merged vocab
+        interns literals inconsistently."""
+        self._write_sources(tmp_path)
+        (tmp_path / "src" / "nums.py").write_text(
+            "def pick(a):\n    return a + 42\n"
+        )
+        (tmp_path / "dataset" / "methods.txt").write_text(
+            "src/nums.py\t*\n"
+        )
+        subprocess.run(
+            [sys.executable, "-m", "code2vec_tpu.extractor",
+             str(tmp_path / "dataset"), str(tmp_path), "--normalize-int"],
+            capture_output=True, text=True, check=True,
+        )
+        terms = (tmp_path / "dataset" / "terminal_idxs.txt").read_text()
+        assert "@int_literal" in terms and "\t42\n" not in terms
+        params = (tmp_path / "dataset" / "params.txt").read_text()
+        assert "nomalize_int_literal:true" in params
+
+    def test_method_declarations_cover_python_leg(self, tmp_path):
+        self._write_sources(tmp_path)
+        (tmp_path / "dataset" / "methods.txt").write_text(
+            "src/MathOps.java\t*\nsrc/math_ops.py\t*\n"
+        )
+        subprocess.run(
+            [sys.executable, "-m", "code2vec_tpu.extractor",
+             str(tmp_path / "dataset"), str(tmp_path),
+             "--method-declarations", "decls.txt"],
+            capture_output=True, text=True, check=True,
+        )
+        decls = (tmp_path / "dataset" / "decls.txt").read_text()
+        assert "src/MathOps.java#add" in decls
+        assert "src/math_ops.py#scale" in decls  # python methods included
+
+    def test_python_only_dataset(self, tmp_path):
+        self._write_sources(tmp_path)
+        rows = [("src/math_ops.py", "*")]
+        n, vocabs = extract_python_dataset(
+            str(tmp_path / "dataset"), str(tmp_path), rows
+        )
+        assert n == 2
+        assert (tmp_path / "dataset" / "params.txt").exists()
+        corpus = (tmp_path / "dataset" / "corpus.txt").read_text()
+        assert corpus.startswith("#0\nlabel:add\n")

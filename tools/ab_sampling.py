@@ -1,0 +1,74 @@
+"""A/B the two per-epoch context-sampling schemes at a scale where they
+differ (methods with more contexts than the bag):
+
+- A (host pipeline, reference parity): fresh uniform subsample WITHOUT
+  replacement each epoch (model/dataset_builder.py:134-135 semantics);
+- B (device epochs): rotation WINDOW over a once-shuffled context order
+  (train/device_epoch.py module docstring).
+
+Trains the same model/recipe on the same synthetic corpus with both and
+prints one JSON line with the F1 trajectories. CPU-friendly (~2 min).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from code2vec_tpu.data.synth import SynthSpec, corpus_data_from_raw, generate_corpus_data
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.loop import train
+
+    # oversized bags: mean 60 contexts vs bag 24, so ~90% of methods
+    # actually subsample and the schemes can diverge
+    spec = SynthSpec(
+        n_methods=2500,
+        n_terminals=1200,
+        n_paths=900,
+        n_labels=40,
+        mean_contexts=60.0,
+        max_contexts=150,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+    base = dict(
+        max_epoch=10,
+        batch_size=64,
+        encode_size=64,
+        terminal_embed_size=32,
+        path_embed_size=32,
+        max_path_length=24,
+        print_sample_cycle=0,
+        early_stop_patience=100,
+    )
+
+    host = train(TrainConfig(**base), data)
+    dev = train(TrainConfig(**base, device_epoch=True, device_chunk_batches=8), data)
+
+    print(
+        json.dumps(
+            {
+                "subsample_fraction": float(
+                    np.mean(np.diff(data.row_splits) > base["max_path_length"])
+                ),
+                "host_uniform_f1": [round(h["f1"], 4) for h in host.history],
+                "device_window_f1": [round(h["f1"], 4) for h in dev.history],
+                "host_best_f1": round(host.best_f1, 4),
+                "device_best_f1": round(dev.best_f1, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
