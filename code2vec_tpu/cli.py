@@ -134,8 +134,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="mesh context-parallel axis size (shards the bag)")
     parser.add_argument("--device_epoch", action="store_true", default=False,
                         help="stage the corpus in device memory and run "
-                        "scanned chunks of batches per dispatch "
-                        "(method task; composes with the mesh axes)")
+                        "scanned chunks of batches per dispatch (method "
+                        "and/or variable task; composes with the mesh axes)")
     parser.add_argument("--host_shard_corpus", action="store_true",
                         default=False,
                         help="each process loads only its round-robin share "

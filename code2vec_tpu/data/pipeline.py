@@ -137,6 +137,29 @@ def build_method_epoch(
     )
 
 
+def variable_items(data: CorpusData, item_idx: np.ndarray):
+    """The variable-task expansion core, shared by the host epoch builder
+    and device staging (train/device_epoch.py): per item, the ``@var_*``
+    aliases and the contexts touching ANY of them
+    (model/dataset_builder.py:152-177). Yields
+    ``(item, alias_names, alias_idx, starts, paths, ends)``; the caller
+    applies its own shuffling/selection/renaming so rng draw order stays
+    exactly the reference's."""
+    terminal_stoi = data.terminal_vocab.stoi
+    for i in item_idx:
+        alias_map = data.aliases[i]
+        alias_names = [a for a in alias_map if a.startswith("@var_")]
+        if not alias_names:
+            continue
+        alias_idx = np.asarray(
+            [terminal_stoi[a] for a in alias_names], dtype=np.int32
+        )
+        lo, hi = data.row_splits[i], data.row_splits[i + 1]
+        s, p, e = data.starts[lo:hi], data.paths[lo:hi], data.ends[lo:hi]
+        touches = np.isin(s, alias_idx) | np.isin(e, alias_idx)
+        yield i, alias_names, alias_idx, s[touches], p[touches], e[touches]
+
+
 def build_variable_epoch(
     data: CorpusData,
     item_idx: np.ndarray,
@@ -171,26 +194,14 @@ def build_variable_epoch(
     rows_e: list[np.ndarray] = []
 
     label_stoi = data.label_vocab.stoi
-    terminal_stoi = data.terminal_vocab.stoi
 
-    for i in item_idx:
+    for i, alias_names, alias_idx, s, p, e in variable_items(data, item_idx):
         alias_map = data.aliases[i]
-        alias_names = [a for a in alias_map if a.startswith("@var_")]
-        if not alias_names:
-            continue
-        alias_idx = np.asarray(
-            [terminal_stoi[a] for a in alias_names], dtype=np.int32
-        )
-
         if shuffle_variable_indexes:
             shuffled = variable_indexes.copy()
             rng.shuffle(shuffled)
             perm_map = _index_remap(variable_indexes, shuffled)
 
-        lo, hi = data.row_splits[i], data.row_splits[i + 1]
-        s, p, e = data.starts[lo:hi], data.paths[lo:hi], data.ends[lo:hi]
-        touches = np.isin(s, alias_idx) | np.isin(e, alias_idx)
-        s, p, e = s[touches], p[touches], e[touches]
         order = rng.permutation(len(s))
         s, p, e = s[order], p[order], e[order]
 

@@ -552,6 +552,16 @@ class PyVocabs:
 
     def terminal_index(self, name: str) -> int:
         name = name.lower()  # vocab-size reduction (ipynb cell7)
+        # unlike Java, Python string literals can contain raw newlines and
+        # tabs (triple-quoted strings); with --no-normalize-string those
+        # become terminal NAMES, which would corrupt the line/tab-delimited
+        # terminal_idxs.txt — escape the delimiters before interning
+        name = (
+            name.replace("\\", "\\\\")
+            .replace("\n", "\\n")
+            .replace("\r", "\\r")
+            .replace("\t", "\\t")
+        )
         if name not in self.terminals:
             self.terminals[name] = len(self.terminals) + 1
         return self.terminals[name]

@@ -52,12 +52,21 @@ from code2vec_tpu.train.step import build_eval_step_fn, build_train_step_fn
 
 @dataclass
 class StagedCorpus:
-    """Device-resident method-task corpus (CSR, interleaved contexts)."""
+    """Device-resident corpus (CSR, interleaved contexts). Rows are training
+    EXAMPLES: one per method (method task, ``stage_method_corpus``) and/or
+    one per ``@var_*`` alias (variable task, ``stage_variable_corpus`` —
+    the expansion is corpus-static, so it happens once at staging)."""
 
     contexts: jax.Array  # int32 [total, 3] — (start, path, end), @question applied
     row_splits: jax.Array  # int32 [n_items + 1]
     labels: jax.Array  # int32 [n_items]
     n_items: int
+    # variable-task remap support (None/absent for pure method corpora):
+    # the per-epoch @var-index shuffle (model/dataset_builder.py:155-195)
+    # runs on device as a per-row permutation over these ids, applied only
+    # to rows flagged as variable examples
+    remap_ids: jax.Array | None = None  # int32 [V] sorted @var terminal ids
+    remap_flags: jax.Array | None = None  # int32 [n_items] 1 = variable row
 
     @property
     def n_contexts(self) -> int:
@@ -117,12 +126,127 @@ def stage_method_corpus(
 
     contexts = contexts[_per_row_shuffle(total, new_splits, rng)]
 
-    put = partial(jax.device_put, device=device)
+    put = _putter(device)
     return StagedCorpus(
         contexts=put(contexts),
         row_splits=put(new_splits.astype(np.int32)),
         labels=put(data.labels[item_idx].astype(np.int32)),
         n_items=len(item_idx),
+    )
+
+
+def _putter(device):
+    """device="host" keeps numpy arrays (for concat_staged before a single
+    place_staged transfer); anything else is a jax.device_put target."""
+    if device == "host":
+        return lambda x: x
+    return partial(jax.device_put, device=device)
+
+
+def stage_variable_corpus(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    rng: np.random.Generator,
+    device: Any | None = None,
+) -> StagedCorpus:
+    """Stage the variable task: one row per ``@var_*`` alias of each item.
+
+    Mirrors ``build_variable_epoch`` (model/dataset_builder.py:152-204):
+    keep contexts touching the target variable, rename the target to
+    ``@question`` (static per row, pre-applied here), shuffle once. The
+    per-epoch index REMAP (shuffle_variable_indexes) cannot be pre-applied —
+    it redraws each epoch — so the staged corpus carries ``remap_ids`` /
+    ``remap_flags`` and the sampler permutes on device.
+    """
+    from code2vec_tpu.data.pipeline import variable_items
+
+    label_stoi = data.label_vocab.stoi
+    parts: list[np.ndarray] = []
+    counts: list[int] = []
+    labels: list[int] = []
+    for i, alias_names, alias_idx, s, p, e in variable_items(data, item_idx):
+        alias_map = data.aliases[i]
+        for alias_name, var_idx in zip(alias_names, alias_idx):
+            mine = (s == var_idx) | (e == var_idx)
+            row = np.stack(
+                [
+                    np.where(s[mine] == var_idx, QUESTION_TOKEN_INDEX, s[mine]),
+                    p[mine],
+                    np.where(e[mine] == var_idx, QUESTION_TOKEN_INDEX, e[mine]),
+                ],
+                axis=1,
+            ).astype(np.int32)
+            parts.append(row[rng.permutation(len(row))])
+            counts.append(len(row))
+            labels.append(label_stoi[alias_map[alias_name]])
+
+    contexts = (
+        np.concatenate(parts) if parts else np.zeros((0, 3), np.int32)
+    )
+    row_splits = np.zeros(len(counts) + 1, np.int64)
+    np.cumsum(counts, out=row_splits[1:])
+    if int(row_splits[-1]) >= 2**31:
+        raise ValueError("staged variable corpus exceeds int32 row_splits")
+
+    put = _putter(device)
+    return StagedCorpus(
+        contexts=put(contexts),
+        row_splits=put(row_splits.astype(np.int32)),
+        labels=put(np.asarray(labels, np.int32)),
+        n_items=len(labels),
+        remap_ids=put(data.variable_indexes.astype(np.int32)),
+        remap_flags=put(np.ones(len(labels), np.int32)),
+    )
+
+
+def concat_staged(a: StagedCorpus, b: StagedCorpus) -> StagedCorpus:
+    """Method rows + variable rows in one staged corpus (the combined-task
+    epoch, build_epoch's concatenation order). Host-side numpy concat; call
+    before device_put-ing (stage with device="host", then place_staged)."""
+    a_ctx, b_ctx = np.asarray(a.contexts), np.asarray(b.contexts)
+    a_rs, b_rs = np.asarray(a.row_splits), np.asarray(b.row_splits)
+    # int64 math + re-check: both parts can pass their own 2**31 guard
+    # while the combined total overflows int32 row_splits
+    row_splits = np.concatenate(
+        [a_rs.astype(np.int64), b_rs[1:].astype(np.int64) + int(a_rs[-1])]
+    )
+    if int(row_splits[-1]) >= 2**31:
+        raise ValueError(
+            f"combined staged corpus has {int(row_splits[-1])} contexts; "
+            "device row_splits are int32 — stage a subset"
+        )
+    flags_a = (
+        np.asarray(a.remap_flags)
+        if a.remap_flags is not None
+        else np.zeros(a.n_items, np.int32)
+    )
+    flags_b = (
+        np.asarray(b.remap_flags)
+        if b.remap_flags is not None
+        else np.zeros(b.n_items, np.int32)
+    )
+    remap_ids = a.remap_ids if a.remap_ids is not None else b.remap_ids
+    return StagedCorpus(
+        contexts=np.concatenate([a_ctx, b_ctx]),
+        row_splits=row_splits.astype(np.int32),
+        labels=np.concatenate([np.asarray(a.labels), np.asarray(b.labels)]),
+        n_items=a.n_items + b.n_items,
+        remap_ids=remap_ids,
+        remap_flags=np.concatenate([flags_a, flags_b]),
+    )
+
+
+def place_staged(staged: StagedCorpus, device: Any | None = None) -> StagedCorpus:
+    put = partial(jax.device_put, device=device)
+    return StagedCorpus(
+        contexts=put(staged.contexts),
+        row_splits=put(staged.row_splits),
+        labels=put(staged.labels),
+        n_items=staged.n_items,
+        remap_ids=None if staged.remap_ids is None else put(staged.remap_ids),
+        remap_flags=(
+            None if staged.remap_flags is None else put(staged.remap_flags)
+        ),
     )
 
 
@@ -134,8 +258,14 @@ def _sample_batch(
     row_valid: jax.Array,  # f32 [B] example mask
     bag: int,
     key: jax.Array,
+    remap_ids: jax.Array | None = None,  # int32 [V] sorted; [0] = remap off
+    remap_flags: jax.Array | None = None,  # int32 [n_items]
 ) -> dict[str, jax.Array]:
-    """Assemble one [B, bag] batch on device: rotation-window subsample."""
+    """Assemble one [B, bag] batch on device: rotation-window subsample,
+    plus (variable task, shuffle_variable_indexes) a per-row random
+    permutation of the ``@var_*`` terminal ids — the on-device equivalent
+    of the host remap (model/dataset_builder.py:155-195; drawn per example
+    rather than per method, same marginal distribution)."""
     batch_size = rows.shape[0]
     off = row_splits[rows]  # [B]
     n = row_splits[rows + 1] - off  # [B]
@@ -148,10 +278,27 @@ def _sample_batch(
 
     trip = corpus_contexts[jnp.where(valid, off[:, None] + idx, 0)]  # [B, bag, 3]
     pad = jnp.int32(PAD_INDEX)
+    starts = jnp.where(valid, trip[..., 0], pad)
+    ends = jnp.where(valid, trip[..., 2], pad)
+
+    n_var = 0 if remap_ids is None else remap_ids.shape[0]
+    if n_var > 0:  # static: traced only for corpora that carry remap ids
+        u = jax.random.uniform(jax.random.fold_in(key, 1), (batch_size, n_var))
+        mapped = remap_ids[jnp.argsort(u, axis=1)]  # [B, V] id -> permuted id
+        apply_row = (remap_flags[rows] > 0)[:, None]  # variable rows only
+
+        def remap(t: jax.Array) -> jax.Array:
+            pos = jnp.clip(jnp.searchsorted(remap_ids, t), 0, n_var - 1)
+            is_var = remap_ids[pos] == t
+            permuted = jnp.take_along_axis(mapped, pos, axis=1)
+            return jnp.where(is_var & apply_row, permuted, t)
+
+        starts, ends = remap(starts), remap(ends)
+
     return {
-        "starts": jnp.where(valid, trip[..., 0], pad),
+        "starts": starts,
         "paths": jnp.where(valid, trip[..., 1], pad),
-        "ends": jnp.where(valid, trip[..., 2], pad),
+        "ends": ends,
         "labels": labels[rows],
         "example_mask": row_valid,
     }
@@ -183,11 +330,13 @@ class EpochRunner:
         bag: int,
         chunk_batches: int = 16,
         mesh=None,
+        shuffle_variable_ids: bool = False,
     ):
         self.batch_size = batch_size
         self.bag = bag
         self.chunk_batches = chunk_batches
         self.mesh = mesh
+        self.shuffle_variable_ids = shuffle_variable_ids
         if mesh is not None:
             from code2vec_tpu.parallel.shardings import batch_shardings
 
@@ -196,6 +345,21 @@ class EpochRunner:
         self._raw_eval = build_eval_step_fn(model_config, class_weights)
         self._train_chunks: dict[int, Callable] = {}
         self._eval_chunks: dict[int, Callable] = {}
+
+    def _remap_args(self, corpus: StagedCorpus) -> tuple[jax.Array, jax.Array]:
+        """(remap_ids, remap_flags) for the chunk call — empty ids disable
+        the remap at trace time (shape-static), so method-task corpora and
+        no-shuffle runs compile the plain sampler."""
+        if (
+            not self.shuffle_variable_ids
+            or corpus.remap_ids is None
+            or int(corpus.remap_ids.shape[0]) == 0
+        ):
+            return (
+                jnp.zeros(0, jnp.int32),
+                jnp.zeros(max(corpus.n_items, 1), jnp.int32),
+            )
+        return corpus.remap_ids, corpus.remap_flags
 
     def _constrain(self, batch: dict[str, jax.Array]) -> dict[str, jax.Array]:
         if self.mesh is None:
@@ -212,7 +376,8 @@ class EpochRunner:
             batch_size, bag = self.batch_size, self.bag
 
             @partial(jax.jit, donate_argnums=(0,), static_argnums=(5,))
-            def run(state, contexts, row_splits, labels, perm_rows, n_valid, key):
+            def run(state, contexts, row_splits, labels, perm_rows, n_valid,
+                    key, remap_ids=None, remap_flags=None):
                 perm_valid = (
                     jnp.arange(n_batches * batch_size) < n_valid
                 ).astype(jnp.float32)
@@ -226,6 +391,7 @@ class EpochRunner:
                     batch = self._constrain(_sample_batch(
                         contexts, row_splits, labels,
                         sl(perm_rows), sl(perm_valid), bag, sample_key,
+                        remap_ids, remap_flags,
                     ))
                     state, loss = self._raw_train(state, batch)
                     return (state, key), loss
@@ -243,7 +409,8 @@ class EpochRunner:
             batch_size, bag = self.batch_size, self.bag
 
             @partial(jax.jit, static_argnums=(5,))
-            def run(state, contexts, row_splits, labels, perm_rows, n_valid, key):
+            def run(state, contexts, row_splits, labels, perm_rows, n_valid,
+                    key, remap_ids=None, remap_flags=None):
                 perm_valid = (
                     jnp.arange(n_batches * batch_size) < n_valid
                 ).astype(jnp.float32)
@@ -256,6 +423,7 @@ class EpochRunner:
                     batch = self._constrain(_sample_batch(
                         contexts, row_splits, labels,
                         sl(perm_rows), sl(perm_valid), bag, sample_key,
+                        remap_ids, remap_flags,
                     ))
                     out = self._raw_eval(state, batch)
                     return key, (out["loss"], out["preds"], out["max_logit"])
@@ -304,6 +472,7 @@ class EpochRunner:
         loop's seeded shuffle); ``key`` drives on-device context sampling.
         """
         order = rng.permutation(corpus.n_items)
+        remap_ids, remap_flags = self._remap_args(corpus)
         chunk_losses = []  # device scalars; summed after the last dispatch
         n_batches = 0
         for row_lo, nb, n_valid in self._chunk_plan(corpus.n_items):
@@ -311,6 +480,7 @@ class EpochRunner:
             state, loss = self._train_chunk(nb)(
                 state, corpus.contexts, corpus.row_splits, corpus.labels,
                 self._padded_rows(order, row_lo, nb), n_valid, chunk_key,
+                remap_ids, remap_flags,
             )
             chunk_losses.append(loss)
             n_batches += nb
@@ -325,6 +495,7 @@ class EpochRunner:
         """One eval pass in corpus order; returns (summed per-batch mean
         loss, preds [n_items], max_logits [n_items])."""
         order = np.arange(corpus.n_items)
+        remap_ids, remap_flags = self._remap_args(corpus)
         total_loss = 0.0
         preds: list[np.ndarray] = []
         max_logits: list[np.ndarray] = []
@@ -333,6 +504,7 @@ class EpochRunner:
             loss, p, m = self._eval_chunk(nb)(
                 state, corpus.contexts, corpus.row_splits, corpus.labels,
                 self._padded_rows(order, row_lo, nb), n_valid, chunk_key,
+                remap_ids, remap_flags,
             )
             total_loss += float(loss)
             preds.append(np.asarray(p[:n_valid]))

@@ -295,18 +295,19 @@ def train(
     # batches per dispatch (train/device_epoch.py). Composes with the mesh:
     # the corpus is replicated over the devices and each scanned batch is
     # sharding-constrained to the data/ctx layout, so the flagship fast path
-    # scales out (SURVEY §7.4-7.5). Method task, single process; variable
-    # task and multi-host fall back to the host pipeline.
+    # scales out (SURVEY §7.4-7.5). Method and/or variable task (the
+    # variable expansion is corpus-static, so it stages as rows; the
+    # per-epoch @var remap runs on device), single process; multi-host
+    # falls back to the host pipeline.
     device_runner = None
     if config.device_epoch:
-        if (
-            data.infer_method
-            and not data.infer_variable
-            and jax.process_count() == 1
-        ):
+        if jax.process_count() == 1:
             from code2vec_tpu.train.device_epoch import (
                 EpochRunner,
+                concat_staged,
+                place_staged,
                 stage_method_corpus,
+                stage_variable_corpus,
             )
 
             device_runner = EpochRunner(
@@ -316,18 +317,32 @@ def train(
                 config.max_path_length,
                 config.device_chunk_batches,
                 mesh=mesh,
+                shuffle_variable_ids=config.shuffle_variable_indexes,
             )
             corpus_placement = None
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 corpus_placement = NamedSharding(mesh, PartitionSpec())
-            staged_train = stage_method_corpus(
-                data, train_idx, np_rng, device=corpus_placement
-            )
-            staged_test = stage_method_corpus(
-                data, test_idx, np_rng, device=corpus_placement
-            )
+
+            def stage(item_idx):
+                # parts stay host-side; ONE device transfer at the end
+                parts = []
+                if data.infer_method:
+                    parts.append(
+                        stage_method_corpus(data, item_idx, np_rng, device="host")
+                    )
+                if data.infer_variable:
+                    parts.append(
+                        stage_variable_corpus(data, item_idx, np_rng, device="host")
+                    )
+                staged = parts[0]
+                for p in parts[1:]:
+                    staged = concat_staged(staged, p)
+                return place_staged(staged, device=corpus_placement)
+
+            staged_train = stage(train_idx)
+            staged_test = stage(test_idx)
             logger.info(
                 "device epochs: staged %d train / %d test contexts to %s",
                 staged_train.n_contexts,
@@ -336,8 +351,8 @@ def train(
             )
         else:
             logger.warning(
-                "device_epoch requested but unsupported here (variable task "
-                "or multi-host); using the host pipeline"
+                "device_epoch requested but unsupported here (multi-host); "
+                "using the host pipeline"
             )
 
     meta = TrainMeta()
@@ -381,7 +396,9 @@ def train(
                 )
                 accuracy, precision, recall, f1 = evaluate(
                     config.eval_method,
-                    data.labels[test_idx],
+                    # staged labels: per-EXAMPLE (one per @var alias in the
+                    # variable task), not per-item
+                    np.asarray(staged_test.labels),
                     preds,
                     data.label_vocab,
                 )

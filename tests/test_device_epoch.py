@@ -265,6 +265,169 @@ class TestRunnerEquivalence:
         assert np.array_equal(host_preds, dev_preds)
 
 
+class TestVariableTask:
+    """Device epochs for the variable task: corpus-static expansion staged
+    as rows, per-epoch @var remap on device."""
+
+    @pytest.fixture(scope="class")
+    def vdata(self, tmp_path_factory):
+        out = tmp_path_factory.mktemp("var_device_epoch")
+        paths = generate_corpus_files(out, SPECS["tiny"])
+        return load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            infer_method=False, infer_variable=True, cache=False,
+        )
+
+    def test_staging_matches_host_expansion(self, vdata):
+        from code2vec_tpu.data.pipeline import build_variable_epoch
+        from code2vec_tpu.train.device_epoch import stage_variable_corpus
+
+        idx = np.arange(vdata.n_items)
+        bag = 64  # >= any per-variable context count in tiny
+        epoch = build_variable_epoch(vdata, idx, bag, np.random.default_rng(0))
+        staged = stage_variable_corpus(vdata, idx, np.random.default_rng(1))
+        assert staged.n_items == len(epoch)
+        np.testing.assert_array_equal(np.asarray(staged.labels), epoch.labels)
+        splits = np.asarray(staged.row_splits)
+        ctx = np.asarray(staged.contexts)
+        for r in range(staged.n_items):
+            got = sorted(map(tuple, ctx[splits[r] : splits[r + 1]]))
+            valid = epoch.starts[r] != PAD_INDEX
+            want = sorted(
+                zip(
+                    epoch.starts[r][valid].tolist(),
+                    epoch.paths[r][valid].tolist(),
+                    epoch.ends[r][valid].tolist(),
+                )
+            )
+            assert got == want, f"row {r} context multiset mismatch"
+
+    def test_eval_prediction_parity_no_shuffle(self, vdata):
+        from code2vec_tpu.data.pipeline import build_variable_epoch, iter_batches
+        from code2vec_tpu.train.device_epoch import stage_variable_corpus
+        from code2vec_tpu.train.step import make_eval_step
+
+        idx = np.arange(vdata.n_items)
+        bag = 64
+        model_config = Code2VecConfig(
+            terminal_count=len(vdata.terminal_vocab),
+            path_count=len(vdata.path_vocab),
+            label_count=len(vdata.label_vocab),
+            terminal_embed_size=16,
+            path_embed_size=16,
+            encode_size=32,
+            dropout_prob=0.0,
+        )
+        config = TrainConfig(batch_size=16, max_path_length=bag, dropout_prob=0.0)
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        state = create_train_state(
+            config, model_config, jax.random.PRNGKey(3), example
+        )
+        epoch = build_variable_epoch(vdata, idx, bag, np.random.default_rng(0))
+        eval_step = make_eval_step(model_config, cw)
+        host_preds = []
+        for batch in iter_batches(epoch, 16, rng=None, pad_final=True):
+            out = eval_step(state, batch)
+            valid = batch["example_mask"].astype(bool)
+            host_preds.append(np.asarray(out["preds"])[valid])
+        host_preds = np.concatenate(host_preds)
+
+        runner = EpochRunner(model_config, cw, 16, bag, chunk_batches=4)
+        staged = stage_variable_corpus(vdata, idx, np.random.default_rng(0))
+        _, dev_preds, _ = runner.run_eval_epoch(
+            state, staged, jax.random.PRNGKey(9)
+        )
+        assert np.array_equal(host_preds, dev_preds)
+
+    def test_remap_permutes_var_ids_only(self, vdata):
+        from code2vec_tpu.train.device_epoch import (
+            _sample_batch,
+            stage_variable_corpus,
+        )
+
+        idx = np.arange(vdata.n_items)
+        staged = stage_variable_corpus(vdata, idx, np.random.default_rng(0))
+        var_ids = set(np.asarray(staged.remap_ids).tolist())
+        rows = jnp.arange(min(8, staged.n_items), dtype=jnp.int32)
+        plain = _sample_batch(
+            staged.contexts, staged.row_splits, staged.labels, rows,
+            jnp.ones(len(rows)), 32, jax.random.PRNGKey(0),
+        )
+        remapped = _sample_batch(
+            staged.contexts, staged.row_splits, staged.labels, rows,
+            jnp.ones(len(rows)), 32, jax.random.PRNGKey(0),
+            staged.remap_ids, staged.remap_flags,
+        )
+        p_starts = np.asarray(plain["starts"])
+        r_starts = np.asarray(remapped["starts"])
+        # identical sampling -> non-var positions unchanged; var positions
+        # stay inside the var-id set (a permutation, not arbitrary ids)
+        non_var = ~np.isin(p_starts, list(var_ids))
+        np.testing.assert_array_equal(p_starts[non_var], r_starts[non_var])
+        is_var = np.isin(p_starts, list(var_ids))
+        if is_var.any():
+            assert set(r_starts[is_var].tolist()) <= var_ids
+        # per-row bijectivity: within one row, equal originals map equal,
+        # distinct originals map distinct
+        for r in range(len(rows)):
+            mapping = {}
+            for o, m in zip(p_starts[r][is_var[r]], r_starts[r][is_var[r]]):
+                assert mapping.setdefault(int(o), int(m)) == int(m)
+            assert len(set(mapping.values())) == len(mapping)
+
+    @pytest.mark.parametrize("shuffle", [False, True])
+    def test_end_to_end_variable_training(self, vdata, shuffle):
+        config = TrainConfig(
+            max_epoch=2,
+            batch_size=16,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=32,
+            print_sample_cycle=0,
+            device_epoch=True,
+            device_chunk_batches=4,
+            infer_method_name=False,
+            infer_variable_name=True,
+            shuffle_variable_indexes=shuffle,
+        )
+        result = train(config, vdata)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
+
+    def test_end_to_end_combined_tasks(self, tmp_path_factory):
+        out = tmp_path_factory.mktemp("combined_device_epoch")
+        paths = generate_corpus_files(out, SPECS["tiny"])
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            infer_method=True, infer_variable=True, cache=False,
+        )
+        config = TrainConfig(
+            max_epoch=2,
+            batch_size=16,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=32,
+            print_sample_cycle=0,
+            device_epoch=True,
+            device_chunk_batches=4,
+            infer_method_name=True,
+            infer_variable_name=True,
+            shuffle_variable_indexes=True,
+        )
+        result = train(config, data)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
+
+
 class TestMeshComposition:
     """Device epochs × mesh (VERDICT r2 #1): the staged fast path must run
     SPMD over the data/ctx axes with loss parity vs the unmeshed runner."""

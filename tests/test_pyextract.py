@@ -69,6 +69,30 @@ class TestLiterals:
         assert "@double_literal" in terms
         assert "7" in terms  # normalize_int_literal=False default (parity)
 
+    def test_raw_multiline_strings_cannot_corrupt_vocab(self, tmp_path):
+        """--no-normalize-string + a triple-quoted literal: the newline/tab
+        must be escaped in terminal_idxs.txt or load_corpus breaks."""
+        from code2vec_tpu.data.reader import load_corpus
+
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "m.py").write_text(
+            'def banner(n):\n    text = """a\nb\tc"""\n    return text * n\n'
+        )
+        (tmp_path / "dataset").mkdir()
+        rows = [("src/m.py", "*")]
+        extract_python_dataset(
+            str(tmp_path / "dataset"), str(tmp_path), rows,
+            config=PyExtractConfig(normalize_string_literal=False),
+        )
+        data = load_corpus(
+            tmp_path / "dataset" / "corpus.txt",
+            tmp_path / "dataset" / "path_idxs.txt",
+            tmp_path / "dataset" / "terminal_idxs.txt",
+            cache=False,
+        )
+        assert data.n_items == 1
+        assert any("\\n" in name for name in data.terminal_vocab.stoi)
+
     def test_int_normalization_opt_in(self):
         src = "def f():\n    c = 7\n    return c\n"
         methods = extract_python_source(

@@ -1,0 +1,169 @@
+"""One-shot TPU ablation over the flagship device-epoch path: embed_grad x
+rng_impl x dtype, pallas vs XLA attention at two bag sizes, and chunk
+length. Prints one JSON line per measurement plus a final markdown table
+(for docs/ARCHITECTURE.md). Designed to survive a flaky TPU tunnel: each
+measurement is independent, results stream as they land, and a crash still
+leaves the lines printed so far.
+
+Usage: python tools/run_tpu_ablation.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def measure_step(
+    jax,
+    embed_grad: str,
+    rng_impl: str,
+    dtype_name: str,
+    use_pallas: bool = False,
+    batch: int = 1024,
+    bag: int = 200,
+    chunk: int = 16,
+    steps: int = 48,
+) -> float:
+    """ms/step on the EpochRunner scanned-chunk path (what bench.py runs)."""
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.synth import SynthSpec, corpus_data_from_raw, generate_corpus_data
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.device_epoch import EpochRunner, stage_method_corpus
+    from code2vec_tpu.train.step import create_train_state
+
+    spec = SynthSpec(
+        n_methods=max(batch * 8, 8192),
+        n_terminals=360_631,
+        n_paths=342_845,
+        n_labels=8_000,
+        mean_contexts=120.0,
+        max_contexts=400,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(data.label_vocab),
+        terminal_embed_size=100,
+        path_embed_size=100,
+        encode_size=100,
+        dropout_prob=0.25,
+        dtype=jnp.bfloat16 if dtype_name == "bf16" else jnp.float32,
+        embed_grad=embed_grad,
+        use_pallas=use_pallas,
+    )
+    config = TrainConfig(batch_size=batch, max_path_length=bag, rng_impl=rng_impl)
+    rng = np.random.default_rng(0)
+    example = {
+        "starts": np.zeros((batch, bag), np.int32),
+        "paths": np.zeros((batch, bag), np.int32),
+        "ends": np.zeros((batch, bag), np.int32),
+        "labels": np.zeros(batch, np.int32),
+        "example_mask": np.ones(batch, np.float32),
+    }
+    state = create_train_state(config, model_config, jax.random.PRNGKey(0), example)
+    cw = jnp.ones(model_config.label_count, jnp.float32)
+    runner = EpochRunner(model_config, cw, batch, bag, chunk)
+    staged = stage_method_corpus(data, np.arange(data.n_items), rng)
+    run_chunk = runner._train_chunk(chunk)
+    n_valid = chunk * batch
+
+    key = jax.random.PRNGKey(1)
+
+    def run(state, key):
+        rows = rng.integers(0, data.n_items, n_valid).astype(np.int32)
+        key, sub = jax.random.split(key)
+        state, loss = run_chunk(
+            state, staged.contexts, staged.row_splits, staged.labels,
+            rows, n_valid, sub,
+        )
+        return state, loss, key
+
+    for _ in range(2):  # compile + warm
+        state, loss, key = run(state, key)
+    jax.block_until_ready(loss)
+
+    n_chunks = -(-steps // chunk)
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        state, loss, key = run(state, key)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / (n_chunks * chunk) * 1e3
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="fewer configs")
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    print(json.dumps({"backend": backend}), flush=True)
+
+    results: list[dict] = []
+
+    def record(name: str, **kw):
+        try:
+            ms = measure_step(jax, **kw)
+        except Exception as e:  # noqa: BLE001 - stream what we have
+            print(json.dumps({"config": name, "error": str(e)[:300]}), flush=True)
+            return
+        ctx_s = kw.get("batch", 1024) * kw.get("bag", 200) / ms * 1e3
+        row = {"config": name, **kw, "ms_per_step": round(ms, 3),
+               "contexts_per_sec": round(ctx_s, 0)}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # --- embed_grad x rng_impl (bf16, the production recipe) -------------
+    grads = ["dense", "segment", "segment_sorted"]
+    rngs = ["threefry2x32", "unsafe_rbg"] if not args.quick else ["threefry2x32"]
+    for eg in grads:
+        for ri in rngs:
+            record(f"{eg}/{ri}/bf16", embed_grad=eg, rng_impl=ri,
+                   dtype_name="bf16")
+
+    # --- dtype check on the winner-so-far --------------------------------
+    best = min(results, key=lambda r: r["ms_per_step"]) if results else None
+    if best is not None:
+        record(
+            f"{best['embed_grad']}/{best['rng_impl']}/f32",
+            embed_grad=best["embed_grad"], rng_impl=best["rng_impl"],
+            dtype_name="f32",
+        )
+
+    # --- pallas vs XLA attention at two bag sizes ------------------------
+    for bag, batch in ((200, 1024), (1024, 256)):
+        for pallas in (False, True):
+            record(
+                f"attn:{'pallas' if pallas else 'xla'}/bag{bag}",
+                embed_grad="dense", rng_impl="threefry2x32",
+                dtype_name="bf16", use_pallas=pallas, bag=bag, batch=batch,
+            )
+
+    # --- chunk length ----------------------------------------------------
+    if not args.quick:
+        for chunk in (8, 32):
+            record(
+                f"chunk{chunk}", embed_grad="dense", rng_impl="threefry2x32",
+                dtype_name="bf16", chunk=chunk,
+            )
+
+    print("\n| config | ms/step | contexts/sec |")
+    print("|---|---|---|")
+    for r in sorted(results, key=lambda r: r["ms_per_step"]):
+        print(f"| {r['config']} | {r['ms_per_step']} | {int(r['contexts_per_sec']):,} |")
+
+
+if __name__ == "__main__":
+    main()
