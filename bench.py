@@ -133,12 +133,21 @@ def main() -> None:
     from code2vec_tpu.train.device_epoch import EpochRunner, stage_method_corpus
     from code2vec_tpu.train.step import create_train_state
 
+    # persistent compilation cache: repeat runs (and retries after tunnel
+    # resets) skip the ~30s XLA compile
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     batch_size = int(os.environ.get("BENCH_BATCH", 1024))
     bag = int(os.environ.get("BENCH_BAG", 200))
     steps = int(os.environ.get("BENCH_STEPS", 60))
     warmup = int(os.environ.get("BENCH_WARMUP_CHUNKS", 5))
     data_axis = int(os.environ.get("BENCH_DATA_AXIS", 1))
     model_axis = int(os.environ.get("BENCH_MODEL_AXIS", 1))
+    # dims: default is the reference top11 recipe; BENCH_EMBED/BENCH_ENCODE
+    # override for e.g. the wide-model config (BASELINE config 4: 512/512)
+    embed_size = int(os.environ.get("BENCH_EMBED", 100))
+    encode_size = int(os.environ.get("BENCH_ENCODE", 100))
 
     # top11-scale synthetic corpus, shrunk in method count (the throughput
     # metric depends on vocab/model/batch shape, not corpus length); vocab
@@ -159,9 +168,9 @@ def main() -> None:
         terminal_count=spec.n_terminals + 2,
         path_count=spec.n_paths + 1,
         label_count=len(data.label_vocab),
-        terminal_embed_size=100,
-        path_embed_size=100,
-        encode_size=100,  # the reference top11 recipe (README.md:34)
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,  # the reference top11 recipe (README.md:34)
         dropout_prob=0.25,
         dtype=jnp.bfloat16 if backend != "cpu" else jnp.float32,
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),

@@ -142,24 +142,34 @@ def clear_checkpoints(out_dir: str, slot: str = "last") -> None:
 
 
 def restore_checkpoint(
-    out_dir: str, state, vocab_pad_multiple: int | None = None
+    out_dir: str,
+    state,
+    vocab_pad_multiple: int | None = None,
+    prefer_best: bool = False,
 ) -> tuple[object, TrainMeta] | None:
     """Restore into the shape of ``state``; returns None if no checkpoint.
 
-    Resumes from the newest save across both slots (the ``last`` periodic
-    save when it is fresher than the ``best`` one); ``step`` counts
-    optimizer steps monotonically, so the larger suffix is the later save.
+    Default (``--resume``): the newest save across both slots (the ``last``
+    periodic save when it is fresher than the ``best`` one); ``step``
+    counts optimizer steps monotonically, so the larger suffix is the
+    later save. ``prefer_best`` (the export path): the best-F1 ``step``
+    slot when present — a fresher periodic save is NOT the model the
+    in-training export would have written. Note the meta sidecar is a
+    single file owned by the newest save regardless of slot; with
+    ``prefer_best`` only the restored arrays are slot-specific.
     """
     base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
     meta_path = os.path.join(out_dir, META_FILE)
+    best_path = _latest_step_dir(base, "step")
     candidates = [
-        p
-        for p in (_latest_step_dir(base, "step"), _latest_step_dir(base, "last"))
-        if p is not None
+        p for p in (best_path, _latest_step_dir(base, "last")) if p is not None
     ]
     if not candidates or not os.path.exists(meta_path):
         return None
-    path = max(candidates, key=lambda p: int(p.rsplit("_", 1)[1]))
+    if prefer_best and best_path is not None:
+        path = best_path
+    else:
+        path = max(candidates, key=lambda p: int(p.rsplit("_", 1)[1]))
     with open(meta_path) as f:
         saved_meta = TrainMeta(**json.load(f))
     want_impl = _rng_impl_name(state.dropout_rng)

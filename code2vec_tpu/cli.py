@@ -136,6 +136,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="stage the corpus in device memory and run "
                         "scanned chunks of batches per dispatch (method "
                         "and/or variable task; composes with the mesh axes)")
+    parser.add_argument("--export_only", action="store_true", default=False,
+                        help="skip training: restore the checkpoint in "
+                        "--model_path and rewrite --vectors_path (+ the "
+                        "test TSV). The post-hoc export pass for "
+                        "host-sharded pod runs")
     parser.add_argument("--host_shard_corpus", action="store_true",
                         default=False,
                         help="each process loads only its round-robin share "
@@ -330,6 +335,17 @@ def main(argv: list[str] | None = None) -> None:
     for out_file in (args.vectors_path, args.test_result_path):
         if out_file and os.path.dirname(out_file):
             os.makedirs(os.path.dirname(out_file), exist_ok=True)
+    if args.export_only:
+        from code2vec_tpu.export import export_from_checkpoint
+
+        if not args.vectors_path:
+            raise SystemExit("--export_only requires --vectors_path")
+        f1 = export_from_checkpoint(
+            config, data, args.model_path, args.vectors_path,
+            args.test_result_path,
+        )
+        logger.info("done: exported (test f1=%s)", f1)
+        return
     result = train(
         config,
         data,

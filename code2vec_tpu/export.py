@@ -60,7 +60,7 @@ def write_code_vectors(
     encode_size: int,
     test_result_path: str | None = None,
     to_device=lambda b: b,
-) -> None:
+) -> tuple[np.ndarray, np.ndarray]:
     """Rewrite code.vec (train rows then test rows, reference
     main.py:226-230) and optionally the test-result TSV (main.py:418-420).
 
@@ -71,6 +71,9 @@ def write_code_vectors(
 
     Multi-host: every process runs the forward passes (they participate in
     the collectives) but only process 0 touches the files.
+
+    Returns the test split's ``(labels, preds)`` so callers that need a
+    metric afterwards (export_from_checkpoint) don't repeat the forward.
     """
     import jax
 
@@ -81,10 +84,13 @@ def write_code_vectors(
         )
     itos = data.label_vocab.itos
 
+    test_labels = test_preds = np.zeros(0, np.int32)
     for split_epoch, is_test in ((train_epoch, False), (test_epoch, True)):
         labels, ids, preds, max_logit, vectors = _forward_all(
             eval_step, state, split_epoch, batch_size, to_device
         )
+        if is_test:
+            test_labels, test_preds = labels, preds
         if not write_files:
             continue
         label_names = [itos[int(label)] for label in labels]
@@ -94,6 +100,7 @@ def write_code_vectors(
             with open(test_result_path, "w", encoding="utf-8") as f:
                 write_test_results(f, ids.tolist(), label_names, pred_names,
                                    max_logit.tolist())
+    return test_labels, test_preds
 
 
 def print_sample(
@@ -134,3 +141,92 @@ def print_sample(
         logger.info("expected label: %s", label_itos[int(batch["labels"][i])])
         logger.info("actual label:   %s", label_itos[int(preds[i])])
         return
+
+
+def export_from_checkpoint(
+    config,
+    data: CorpusData,
+    out_dir: str,
+    vectors_path: str,
+    test_result_path: str | None = None,
+) -> float:
+    """Standalone export pass: restore the checkpoint in ``out_dir`` and
+    rewrite code.vec (+ optional test TSV) without training — the
+    ``--export_only`` mode. Needed after host-sharded pod runs (the loop
+    skips in-training export there) or to re-export any finished run.
+    Returns the test F1 of the restored model.
+    """
+    import jax
+
+    from code2vec_tpu.checkpoint import restore_checkpoint
+    from code2vec_tpu.data.pipeline import build_epoch, split_items
+    from code2vec_tpu.metrics import evaluate
+    from code2vec_tpu.train.loop import (
+        build_mesh,
+        class_weights_from,
+        dummy_batch,
+        model_config_from,
+    )
+    from code2vec_tpu.train.step import create_train_state, make_eval_step
+
+    if data.shard is not None:
+        raise ValueError(
+            "export needs the full corpus on this host; load it unsharded"
+        )
+
+    np_rng = np.random.default_rng(config.random_seed)
+    train_idx, test_idx = split_items(data.n_items, np_rng)
+    model_config = model_config_from(config, data)
+    class_weights = class_weights_from(config, data)
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(config.random_seed),
+        dummy_batch(config),
+    )
+
+    # same mesh layout as train() so model_axis-sharded tables restore
+    # sharded instead of OOMing one device
+    mesh = build_mesh(config)
+    if mesh is not None:
+        from code2vec_tpu.parallel.shardings import shard_batch, shard_state
+        from code2vec_tpu.parallel.step import make_parallel_eval_step
+
+        state = shard_state(mesh, state)
+
+    # the best-F1 slot, NOT the newest save: with --checkpoint_cycle a
+    # fresher periodic "last" snapshot may exist, but the export contract
+    # is the model the in-training export would have written
+    restored = restore_checkpoint(
+        out_dir, state, vocab_pad_multiple=model_config.vocab_pad_multiple,
+        prefer_best=True,
+    )
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint found under {out_dir}")
+    state, meta = restored
+    logger.info(
+        "restored checkpoint (epoch %d, best_f1=%s)", meta.epoch, meta.best_f1
+    )
+
+    if mesh is not None:
+        eval_step = make_parallel_eval_step(
+            model_config, class_weights, mesh, state
+        )
+        to_device = lambda b: shard_batch(mesh, b)  # noqa: E731
+    else:
+        eval_step = make_eval_step(model_config, class_weights)
+        to_device = lambda b: b  # noqa: E731
+
+    train_epoch = build_epoch(
+        data, train_idx, config.max_path_length, np_rng,
+        config.shuffle_variable_indexes,
+    )
+    test_epoch = build_epoch(
+        data, test_idx, config.max_path_length, np_rng,
+        config.shuffle_variable_indexes,
+    )
+    labels, preds = write_code_vectors(
+        data, state, eval_step, train_epoch, test_epoch, config.batch_size,
+        vectors_path, config.encode_size, test_result_path, to_device,
+    )
+    _, _, _, f1 = evaluate(config.eval_method, labels, preds, data.label_vocab)
+    logger.info("exported %s (test f1=%s)", vectors_path, f1)
+    return f1

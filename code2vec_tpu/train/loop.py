@@ -89,6 +89,62 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
     )
 
 
+def dummy_batch(config: TrainConfig) -> dict[str, np.ndarray]:
+    """Shape-only batch for model init; avoids building a real epoch (which
+    can be empty, e.g. a variable-task item with no @var aliases)."""
+    return {
+        "ids": np.zeros(config.batch_size, np.int64),
+        "starts": np.zeros((config.batch_size, config.max_path_length), np.int32),
+        "paths": np.zeros((config.batch_size, config.max_path_length), np.int32),
+        "ends": np.zeros((config.batch_size, config.max_path_length), np.int32),
+        "labels": np.zeros(config.batch_size, np.int32),
+        "example_mask": np.ones(config.batch_size, np.float32),
+    }
+
+
+def build_mesh(config: TrainConfig):
+    """The (data, model, ctx) mesh from the config axes, with the validity
+    checks; None when every axis is 1. Shared by train() and the export
+    pass so both build identical layouts."""
+    if config.data_axis * config.model_axis * config.context_axis <= 1:
+        return None
+    from code2vec_tpu.parallel.mesh import make_mesh
+
+    if config.use_pallas and config.context_axis > 1:
+        # batch/model sharding composes with the kernel (it carries a
+        # custom_partitioning rule that shards the batch dim), but a
+        # ctx-sharded bag needs the streaming-softmax decomposition
+        # (parallel.context) which the fused kernel doesn't implement
+        raise ValueError(
+            "use_pallas with context_axis > 1 is not supported: the "
+            "fused kernel pools the whole bag per device; use the XLA "
+            "path (default) for context parallelism"
+        )
+    if config.batch_size % config.data_axis:
+        raise ValueError(
+            f"batch_size {config.batch_size} not divisible by "
+            f"data_axis {config.data_axis}"
+        )
+    if config.max_path_length % config.context_axis:
+        raise ValueError(
+            f"max_path_length {config.max_path_length} not divisible by "
+            f"context_axis {config.context_axis}"
+        )
+    mesh = make_mesh(
+        data=config.data_axis,
+        model=config.model_axis,
+        ctx=config.context_axis,
+    )
+    if mesh.size < jax.device_count():
+        logger.warning(
+            "mesh uses %d of %d devices — raise data_axis/model_axis/"
+            "context_axis to use the whole slice",
+            mesh.size,
+            jax.device_count(),
+        )
+    return mesh
+
+
 def class_weights_from(config: TrainConfig, data: CorpusData) -> jnp.ndarray:
     """1/freq over the de-facto-uniform freq table by default (reference
     behavior, main.py:129-130 + SURVEY.md §2.2); true inverse-occurrence or
@@ -162,64 +218,22 @@ def train(
     model_config = model_config_from(config, data)
     class_weights = class_weights_from(config, data)
 
-    # shape-only dummy batch for init; avoids building a real epoch (which
-    # can be empty, e.g. a variable-task item with no @var aliases)
-    example_batch = {
-        "ids": np.zeros(config.batch_size, np.int64),
-        "starts": np.zeros((config.batch_size, config.max_path_length), np.int32),
-        "paths": np.zeros((config.batch_size, config.max_path_length), np.int32),
-        "ends": np.zeros((config.batch_size, config.max_path_length), np.int32),
-        "labels": np.zeros(config.batch_size, np.int32),
-        "example_mask": np.ones(config.batch_size, np.float32),
-    }
     state = initial_state
     if state is None:
-        state = create_train_state(config, model_config, jax_rng, example_batch)
+        state = create_train_state(
+            config, model_config, jax_rng, dummy_batch(config)
+        )
 
     # mesh parallelism: any axis > 1 switches to sharded steps; the step
     # math is identical (see parallel.step), XLA inserts the collectives
-    mesh = None
-    if config.data_axis * config.model_axis * config.context_axis > 1:
-        from code2vec_tpu.parallel.mesh import make_mesh
+    mesh = build_mesh(config)
+    if mesh is not None:
         from code2vec_tpu.parallel.shardings import shard_state
         from code2vec_tpu.parallel.step import (
             make_parallel_eval_step,
             make_parallel_train_step,
         )
 
-        if config.use_pallas and config.context_axis > 1:
-            # batch/model sharding composes with the kernel (it carries a
-            # custom_partitioning rule that shards the batch dim), but a
-            # ctx-sharded bag needs the streaming-softmax decomposition
-            # (parallel.context) which the fused kernel doesn't implement
-            raise ValueError(
-                "use_pallas with context_axis > 1 is not supported: the "
-                "fused kernel pools the whole bag per device; use the XLA "
-                "path (default) for context parallelism"
-            )
-
-        if config.batch_size % config.data_axis:
-            raise ValueError(
-                f"batch_size {config.batch_size} not divisible by "
-                f"data_axis {config.data_axis}"
-            )
-        if config.max_path_length % config.context_axis:
-            raise ValueError(
-                f"max_path_length {config.max_path_length} not divisible by "
-                f"context_axis {config.context_axis}"
-            )
-        mesh = make_mesh(
-            data=config.data_axis,
-            model=config.model_axis,
-            ctx=config.context_axis,
-        )
-        if mesh.size < jax.device_count():
-            logger.warning(
-                "mesh uses %d of %d devices — raise data_axis/model_axis/"
-                "context_axis to use the whole slice",
-                mesh.size,
-                jax.device_count(),
-            )
         state = shard_state(mesh, state)
         if train_step is None:
             train_step = make_parallel_train_step(

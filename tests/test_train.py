@@ -184,6 +184,12 @@ class TestEndToEnd:
         new_state, meta = restored
         assert int(new_state.step) == 5 and meta.epoch == 3  # newer slot wins
 
+        # the export path asks for the best-F1 slot even when a fresher
+        # periodic "last" save exists (the meta sidecar is single-file and
+        # tracks the newest save; only the restored arrays matter here)
+        best_state, _ = restore_checkpoint(str(out), state, prefer_best=True)
+        assert int(best_state.step) == 0
+
         clear_checkpoints(str(out))  # fresh-run reset: "last" slot only
         names = sorted(d.name for d in (out / "code2vec_ckpt").iterdir())
         assert names == ["step_0"], names  # best model survives
@@ -208,6 +214,42 @@ class TestEndToEnd:
         )
         with pytest.raises(ValueError, match="--rng_impl rbg"):
             train(cfg2, data, out_dir=str(out))
+
+    def test_export_from_checkpoint(self, tiny, tmp_path):
+        """The standalone --export_only pass: restore and rewrite code.vec
+        without training (the post-hoc export for sharded pod runs)."""
+        from code2vec_tpu.export import export_from_checkpoint
+
+        paths, data = tiny
+        out = tmp_path / "exp"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(max_epoch=2)
+        vectors = out / "code.vec"
+        train(cfg, data, out_dir=str(out), vectors_path=str(vectors))
+        first = vectors.read_text()
+        vectors.unlink()
+        f1 = export_from_checkpoint(cfg, data, str(out), str(vectors))
+        assert vectors.exists()
+        assert f1 >= 0.0
+        # same header (rows x dims); vector bytes may differ only if the
+        # best checkpoint predates the final epoch
+        assert vectors.read_text().splitlines()[0] == first.splitlines()[0]
+
+    def test_export_from_checkpoint_meshed(self, tiny, tmp_path):
+        """Export honors the mesh config: a model_axis-sharded checkpoint
+        restores sharded and exports through the parallel eval step."""
+        from code2vec_tpu.export import export_from_checkpoint
+
+        paths, data = tiny
+        out = tmp_path / "expm"
+        os.makedirs(out)
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=1, data_axis=2, model_axis=2
+        )
+        train(cfg, data, out_dir=str(out))
+        vectors = out / "code.vec"
+        f1 = export_from_checkpoint(cfg, data, str(out), str(vectors))
+        assert vectors.exists() and f1 >= 0.0
 
     def test_vocab_pad_mismatch_rejected(self, tiny, tmp_path):
         """Resuming under a different model_axis (so a different implicit

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Poll the TPU tunnel; when it answers, immediately run the ablation matrix
+# and the headline bench, streaming results to log files. Detach with:
+#   setsid nohup bash tools/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[tpu_watch] tunnel up after probe $i: $(date)"
+    timeout 2400 python tools/run_tpu_ablation.py > /tmp/ablation_results.txt 2>&1
+    echo "[tpu_watch] ablation rc=$? $(date)"
+    timeout 600 python bench.py > /tmp/bench_tpu.txt 2>&1
+    echo "[tpu_watch] bench rc=$? $(date)"
+    exit 0
+  fi
+  echo "[tpu_watch] probe $i: tunnel still down $(date)"
+  sleep 120
+done
+echo "[tpu_watch] gave up"
