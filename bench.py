@@ -174,6 +174,8 @@ def main() -> None:
         dropout_prob=0.25,
         dtype=jnp.bfloat16 if backend != "cpu" else jnp.float32,
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
+        use_pallas=os.environ.get("BENCH_USE_PALLAS", "0").strip().lower()
+        in ("1", "true", "yes", "on"),
         # pad the tables so a model axis actually shards them instead of
         # silently replicating (parallel.shardings divisibility rule)
         vocab_pad_multiple=max(model_axis, 1),

@@ -47,6 +47,7 @@ class Code2VecConfig:
     inverse_temp: float = 30.0
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
     use_pallas: bool = False  # fused attention-pooling kernel (ops.pallas_attention)
+    pallas_block_b: int = 8  # batch-tile size of the fused kernel
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
     # round table/head vocab dims up to this multiple so they shard evenly
     # over the model mesh axis (parallel.shardings.pad_to_multiple); padded
@@ -151,7 +152,8 @@ class Code2Vec(nn.Module):
             from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
 
             code_vector, attention = pallas_attention_pool(
-                contexts, mask, attention_param.astype(c.dtype)
+                contexts, mask, attention_param.astype(c.dtype),
+                block_b=c.pallas_block_b,
             )
         else:
             code_vector, attention = attention_pool(

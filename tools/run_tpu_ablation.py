@@ -26,6 +26,7 @@ def measure_step(
     rng_impl: str,
     dtype_name: str,
     use_pallas: bool = False,
+    pallas_block_b: int = 8,
     batch: int = 1024,
     bag: int = 200,
     chunk: int = 16,
@@ -61,6 +62,7 @@ def measure_step(
         dtype=jnp.bfloat16 if dtype_name == "bf16" else jnp.float32,
         embed_grad=embed_grad,
         use_pallas=use_pallas,
+        pallas_block_b=pallas_block_b,
     )
     config = TrainConfig(batch_size=batch, max_path_length=bag, rng_impl=rng_impl)
     rng = np.random.default_rng(0)
@@ -142,13 +144,20 @@ def main() -> None:
             dtype_name="f32",
         )
 
-    # --- pallas vs XLA attention at two bag sizes ------------------------
+    # --- pallas vs XLA attention at two bag sizes + block_b tuning -------
     for bag, batch in ((200, 1024), (1024, 256)):
-        for pallas in (False, True):
+        record(
+            f"attn:xla/bag{bag}",
+            embed_grad="dense", rng_impl="threefry2x32",
+            dtype_name="bf16", bag=bag, batch=batch,
+        )
+        blocks = (8,) if args.quick else (8, 16, 32)
+        for block_b in blocks:
             record(
-                f"attn:{'pallas' if pallas else 'xla'}/bag{bag}",
+                f"attn:pallas-b{block_b}/bag{bag}",
                 embed_grad="dense", rng_impl="threefry2x32",
-                dtype_name="bf16", use_pallas=pallas, bag=bag, batch=batch,
+                dtype_name="bf16", use_pallas=True, pallas_block_b=block_b,
+                bag=bag, batch=batch,
             )
 
     # --- chunk length ----------------------------------------------------
