@@ -176,6 +176,7 @@ def main() -> None:
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
         use_pallas=os.environ.get("BENCH_USE_PALLAS", "0").strip().lower()
         in ("1", "true", "yes", "on"),
+        pallas_block_b=int(os.environ.get("BENCH_PALLAS_BLOCK_B", 8)),
         # pad the tables so a model axis actually shards them instead of
         # silently replicating (parallel.shardings divisibility rule)
         vocab_pad_multiple=max(model_axis, 1),

@@ -121,6 +121,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--use_pallas", action="store_true", default=False,
                         help="fused attention-pooling Pallas kernel (composes "
                              "with data/model mesh axes)")
+    parser.add_argument("--pallas_block_b", type=int, default=8,
+                        help="batch-tile size of the fused kernel (tune via "
+                             "tools/run_tpu_ablation.py)")
     from code2vec_tpu.ops.embed import GRAD_MODES
 
     parser.add_argument("--embed_grad", type=str, default="dense",
@@ -208,6 +211,7 @@ def config_from_args(args: argparse.Namespace):
         model_axis=args.model_axis,
         context_axis=args.context_axis,
         use_pallas=args.use_pallas,
+        pallas_block_b=args.pallas_block_b,
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
         vocab_pad_multiple=args.vocab_pad_multiple,

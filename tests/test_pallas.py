@@ -145,6 +145,32 @@ class TestEndToEnd:
         assert np.isfinite(res.history[-1]["train_loss"])
         assert res.final_f1 > 0.0
 
+    def test_training_with_pallas_device_epoch(self, tmp_path):
+        """The kernel inside the scanned device-epoch chunk (donated state,
+        lax.scan) — the configuration the TPU benchmark exercises with
+        BENCH_USE_PALLAS=1."""
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        cfg = TrainConfig(
+            max_epoch=1,
+            batch_size=32,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=16,
+            print_sample_cycle=0,
+            use_pallas=True,
+            device_epoch=True,
+            device_chunk_batches=2,
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+
 
 class TestPallasOnMesh:
     """--use_pallas composed with data/model mesh axes: the kernel's
