@@ -125,6 +125,32 @@ class TestStreamingEpochs:
 
         assert signature(mat) == signature(stream)
 
+    def test_variable_task_expansion_across_chunks(self, tiny):
+        """The carry buffer must absorb variable-task expansion (chunks
+        yield MORE examples than items) without dropping or duplicating."""
+        paths, _ = tiny
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            infer_method=True, infer_variable=True, cache=False,
+        )
+        rng = np.random.default_rng(0)
+        idx = np.arange(data.n_items)
+        full = build_epoch(data, idx, 16, np.random.default_rng(1))
+        assert len(full) > data.n_items  # expansion really happened
+        rng2 = np.random.default_rng(2)
+        stream_valid = 0
+        labels = []
+        for batch in iter_streaming_batches(
+            lambda i: build_epoch(data, i, 16, rng2), idx, batch_size=8,
+            rng=rng, chunk_items=5,
+        ):
+            valid = batch["example_mask"].astype(bool)
+            stream_valid += int(valid.sum())
+            labels.extend(batch["labels"][valid].tolist())
+        assert stream_valid == len(full)  # method + variable examples
+        # same multiset of labels as the materialized epoch
+        assert sorted(labels) == sorted(full.labels.tolist())
+
     def test_end_to_end_training(self, tiny):
         _, data = tiny
         config = TrainConfig(

@@ -5,7 +5,11 @@
 set -u
 cd "$(dirname "$0")/.."
 
-for i in $(seq 1 200); do
+# each KILLED probe can itself re-wedge the tunnel (see the verify skill's
+# gotcha), so: a long initial quiet period, then infrequent probes
+echo "[tpu_watch] quiet period $(date)"
+sleep 900
+for i in $(seq 1 60); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[tpu_watch] tunnel up after probe $i: $(date)"
     timeout 2400 python tools/run_tpu_ablation.py > /tmp/ablation_results.txt 2>&1
@@ -15,6 +19,6 @@ for i in $(seq 1 200); do
     exit 0
   fi
   echo "[tpu_watch] probe $i: tunnel still down $(date)"
-  sleep 120
+  sleep 600
 done
 echo "[tpu_watch] gave up"
