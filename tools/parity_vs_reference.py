@@ -1,0 +1,113 @@
+"""Head-to-head parity run: the actual reference implementation (torch CPU)
+vs this framework, on the SAME synthetic corpus through the SAME artifact
+files. Demonstrates (1) artifact-format interop — the reference's
+DatasetReader consumes our writers' output unmodified — and (2) F1 parity
+on an identical recipe.
+
+Usage: python tools/parity_vs_reference.py [--reference /root/reference]
+Prints one JSON line: both F1 trajectories and bests.
+
+Notes: --eval_method exact (the reference's subtoken evaluator crashes on
+current numpy — `int.item()` in main.py:subtoken_match — an upstream bug,
+not a format issue). The reference's train/test split is unseeded
+(SURVEY §2.6), so trajectories are comparable, not identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_reference(ref_dir: str, paths: dict, out_dir: str, epochs: int) -> list[float]:
+    result = subprocess.run(
+        [
+            sys.executable, "main.py",
+            "--corpus_path", str(paths["corpus"]),
+            "--path_idx_path", str(paths["path_idx"]),
+            "--terminal_idx_path", str(paths["terminal_idx"]),
+            "--batch_size", "64", "--encode_size", "100",
+            "--max_epoch", str(epochs), "--no_cuda",
+            "--eval_method", "exact",
+            "--model_path", out_dir,
+            "--vectors_path", os.path.join(out_dir, "code.vec"),
+        ],
+        cwd=ref_dir,
+        capture_output=True,
+        text=True,
+        timeout=3600,
+    )
+    f1s = [
+        float(m.group(1))
+        for m in re.finditer(
+            r'\{"metric": "f1", "value": ([0-9.eE+-]+)\}', result.stdout + result.stderr
+        )
+    ]
+    if not f1s:
+        print(result.stdout[-2000:], file=sys.stderr)
+        print(result.stderr[-2000:], file=sys.stderr)
+        raise RuntimeError("reference run produced no f1 metrics")
+    return f1s
+
+
+def run_ours(paths: dict, epochs: int) -> list[float]:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.loop import train
+
+    data = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"], cache=False
+    )
+    config = TrainConfig(
+        batch_size=64,
+        encode_size=100,
+        max_epoch=epochs,
+        eval_method="exact",
+        print_sample_cycle=0,
+    )
+    result = train(config, data)
+    return [h["f1"] for h in result.history]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--epochs", type=int, default=6)
+    args = ap.parse_args()
+
+    from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = generate_corpus_files(tmp, SPECS["small"])
+        ref_out = os.path.join(tmp, "ref_out")
+        os.makedirs(ref_out)
+        ref_f1 = run_reference(args.reference, paths, ref_out, args.epochs)
+        ours_f1 = run_ours(paths, args.epochs)
+
+    print(
+        json.dumps(
+            {
+                "corpus": "synth small (2000 methods), identical artifact files",
+                "eval_method": "exact",
+                "reference_f1": [round(v, 4) for v in ref_f1],
+                "ours_f1": [round(v, 4) for v in ours_f1],
+                "reference_best": round(max(ref_f1), 4),
+                "ours_best": round(max(ours_f1), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -5,8 +5,9 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# each KILLED probe can itself re-wedge the tunnel (see the verify skill's
-# gotcha), so: a long initial quiet period, then infrequent probes
+# a probe killed by timeout can itself leave the tunnel wedged
+# (.claude/skills/verify/SKILL.md gotchas), so: a long initial quiet
+# period, then infrequent probes
 echo "[tpu_watch] quiet period $(date)"
 sleep 900
 for i in $(seq 1 60); do
