@@ -6,8 +6,10 @@ process group, XLA compiles the collectives, ICI carries intra-slice traffic
 and DCN carries inter-slice.
 
 Host-local batches become global arrays via
-``jax.make_array_from_process_local_data`` — each host loads only its shard
-of the corpus (``host_shard`` below gives the standard contiguous split).
+``jax.make_array_from_process_local_data`` — each host loads only its
+round-robin share of the corpus (``load_corpus(shard=(index, count))``;
+record i is local iff ``i % count == index``, see
+``data.reader.CorpusData.local_rows_of_global``).
 """
 
 from __future__ import annotations
@@ -48,16 +50,6 @@ def initialize_from_env() -> bool:
         jax.distributed.initialize()  # TPU pod autodetection
         return True
     return False
-
-
-def host_shard(n: int) -> slice:
-    """Contiguous slice of [0, n) owned by this host process."""
-    count = jax.process_count()
-    index = jax.process_index()
-    per = n // count
-    lo = index * per
-    hi = n if index == count - 1 else lo + per
-    return slice(lo, hi)
 
 
 def global_batch(mesh: Mesh, full_batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:

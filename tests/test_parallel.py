@@ -10,7 +10,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
 from code2vec_tpu.ops.attention import attention_pool
 from code2vec_tpu.parallel.context import context_parallel_attention_pool
-from code2vec_tpu.parallel.distributed import global_batch, host_shard
+from code2vec_tpu.parallel.distributed import global_batch
 from code2vec_tpu.parallel.mesh import AXIS_MODEL, make_mesh, single_device_mesh
 from code2vec_tpu.parallel.shardings import (
     batch_shardings,
@@ -228,9 +228,16 @@ class TestShardBatchAndState:
 
 
 class TestDistributedHelpers:
-    def test_host_shard_single_process(self):
-        s = host_shard(100)
-        assert (s.start, s.stop) == (0, 100)
+    def test_local_to_global_batch_single_process(self):
+        from code2vec_tpu.parallel.distributed import local_to_global_batch
+
+        mesh = make_mesh(data=8, model=1, ctx=1)
+        mc = tiny_model_config()
+        batch = make_batch(mc, B=8, L=8)
+        out = local_to_global_batch(mesh, batch)
+        assert out["starts"].shape == (8, 8)
+        # placed with the data-axis layout
+        assert str(out["starts"].sharding.spec[0]) == "data"
 
     def test_global_batch_single_process(self):
         mesh = make_mesh(data=8, model=1, ctx=1)

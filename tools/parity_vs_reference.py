@@ -50,10 +50,16 @@ def run_reference(ref_dir: str, paths: dict, out_dir: str, epochs: int) -> list[
             r'\{"metric": "f1", "value": ([0-9.eE+-]+)\}', result.stdout + result.stderr
         )
     ]
-    if not f1s:
+    # a partial trajectory from a crashed run would be a misleading parity
+    # claim — demand a clean exit AND all epochs (the reference's early
+    # stop needs bad_count > 10, unreachable at the epoch counts used here)
+    if result.returncode != 0 or len(f1s) < min(epochs, 11):
         print(result.stdout[-2000:], file=sys.stderr)
         print(result.stderr[-2000:], file=sys.stderr)
-        raise RuntimeError("reference run produced no f1 metrics")
+        raise RuntimeError(
+            f"reference run incomplete: rc={result.returncode}, "
+            f"{len(f1s)}/{epochs} epoch metrics"
+        )
     return f1s
 
 
