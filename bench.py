@@ -4,8 +4,10 @@ establishes/extends the baseline).
 
 Setup mirrors the reference's top11 recipe (README.md:34 — batch 1024,
 embed 100/100, encode 100) at the top11 corpus scale (605,945 methods,
-360,631 terminals, 342,845 paths — top11_dataset/params.txt), with bf16
-compute on TPU. The measured path is the flagship one: the corpus staged to
+360,631 terminals, 342,845 paths — top11_dataset/params.txt), with the
+TPU-ablation-winning recipe (f32 compute, unsafe_rbg dropout bits, dense
+embedding backward — tools/run_tpu_ablation.py, docs/ARCHITECTURE.md;
+override via BENCH_DTYPE / BENCH_RNG_IMPL / BENCH_EMBED_GRAD). The measured path is the flagship one: the corpus staged to
 device memory once (CSR), per-epoch context subsampling on device, and
 scanned chunks of [1024, 200] train steps per dispatch
 (train/device_epoch.py). Accounting matches the reference's work per step:
@@ -172,7 +174,13 @@ def main() -> None:
         path_embed_size=embed_size,
         encode_size=encode_size,  # the reference top11 recipe (README.md:34)
         dropout_prob=0.25,
-        dtype=jnp.bfloat16 if backend != "cpu" else jnp.float32,
+        # f32 measured faster than bf16 at the top11 recipe (dims 100) —
+        # the step is scatter/HBM-bound, and bf16 only adds casts around
+        # f32 accumulations (tools/run_tpu_ablation.py, docs/ARCHITECTURE.md)
+        dtype=jnp.bfloat16
+        if os.environ.get("BENCH_DTYPE", "float32").strip().lower()
+        in ("bfloat16", "bf16")
+        else jnp.float32,
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
         use_pallas=os.environ.get("BENCH_USE_PALLAS", "0").strip().lower()
         in ("1", "true", "yes", "on"),
@@ -184,7 +192,9 @@ def main() -> None:
     config = TrainConfig(
         batch_size=batch_size,
         max_path_length=bag,
-        rng_impl=os.environ.get("BENCH_RNG_IMPL", "threefry2x32"),
+        # unsafe_rbg: ~2 ms/step cheaper dropout bits (ablation winner);
+        # fine for a throughput benchmark, selectable for training runs
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
     )
 
     rng = np.random.default_rng(0)
