@@ -86,6 +86,13 @@ def write_code_vectors(
 
     test_labels = test_preds = np.zeros(0, np.int32)
     for split_epoch, is_test in ((train_epoch, False), (test_epoch, True)):
+        if len(split_epoch) == 0:
+            # a tiny corpus can leave the 20% test split empty; the header
+            # already counts zero rows for it, and a requested TSV is still
+            # created (with zero rows) so callers find the file they asked for
+            if is_test and test_result_path is not None and write_files:
+                open(test_result_path, "w", encoding="utf-8").close()
+            continue
         labels, ids, preds, max_logit, vectors = _forward_all(
             eval_step, state, split_epoch, batch_size, to_device
         )

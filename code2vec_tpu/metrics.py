@@ -93,6 +93,10 @@ def evaluate(
 ) -> tuple[float, float, float, float]:
     """Dispatch mirroring main.py:291-296. Returns
     (accuracy, precision, recall, f1)."""
+    if len(expected) == 0:
+        # empty eval split (tiny corpus): all-zero metrics instead of a
+        # sklearn ValueError (exact) or NaN (ave_subtoken)
+        return 0.0, 0.0, 0.0, 0.0
     if eval_method == "exact":
         return exact_match(expected, actual)
     if eval_method == "subtoken":

@@ -61,21 +61,25 @@ const std::set<std::string> kExpressionKinds = {
     "MarkerAnnotationExpr", "SingleMemberAnnotationExpr",
     "NormalAnnotationExpr", "StringLiteralExpr", "CharLiteralExpr",
     "IntegerLiteralExpr", "LongLiteralExpr", "DoubleLiteralExpr",
-    "BooleanLiteralExpr", "NullLiteralExpr", "ThisExpr", "SuperExpr"};
+    "BooleanLiteralExpr", "NullLiteralExpr", "ThisExpr", "SuperExpr",
+    "SwitchExpr", "PatternExpr"};
 const std::set<std::string> kTypeKinds = {
     "PrimitiveType", "VoidType", "ClassOrInterfaceType", "ArrayType",
-    "WildcardType", "UnionType", "IntersectionType", "TypeParameter"};
+    "WildcardType", "UnionType", "IntersectionType", "TypeParameter",
+    "VarType"};  // Java 10 'var' — a leaf type whose terminal is "var"
 const std::set<std::string> kNameKinds = {"Name", "SimpleName"};
 const std::set<std::string> kLeafStatementKinds = {
     "BreakStmt", "ReturnStmt", "ContinueStmt", "SwitchEntryStmt", "EmptyStmt",
     "ExplicitConstructorInvocationStmt"};  // zero-arg this()/super()
 
-// scope-closing node types (cell6's big isInstanceOf disjunction)
+// scope-closing node types (cell6's big isInstanceOf disjunction, extended
+// with the modern-Java declarations the reference's javaparser predates)
 const std::set<std::string> kScopeClosers = {
     "BlockStmt", "LambdaExpr", "MethodDeclaration", "ConstructorDeclaration",
     "ClassOrInterfaceDeclaration", "EnumDeclaration",
     "EnumConstantDeclaration", "AnnotationDeclaration",
-    "AnnotationMemberDeclaration", "TryStmt", "CatchClause"};
+    "AnnotationMemberDeclaration", "TryStmt", "CatchClause",
+    "RecordDeclaration", "CompactConstructorDeclaration"};
 
 ENodePtr enode(std::string name) {
   auto n = std::make_unique<ENode>();
@@ -173,6 +177,26 @@ struct Extractor {
           // initializer (a later sibling) sees the fresh binding — Java
           // self-reference semantics
           return {enode_terminal("SimpleName", alias.id), new_ctx};
+        return extract(c, cur);
+      });
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), new_ctx};
+    }
+
+    // ---- pattern binding ('x instanceof Type t', 'case Type t ->') ----
+    // anonymized like a declarator; the new binding flows to later siblings
+    // through the default case's context chaining, which approximates
+    // Java's flow scoping ('cond && t.f()' and the guarded entry body see
+    // the alias)
+    if (t == "PatternExpr") {
+      const JNode* name_node = find_child(n, "SimpleName");
+      std::string original = name_node ? name_node->text : "";
+      Variable alias = env.vars.fresh(original);
+      Ctx new_ctx = bind(ctx, "var", alias);
+      auto [children, _] = eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
+        if (c.type == "SimpleName")
+          return {enode_terminal("SimpleName", alias.id), cur};
         return extract(c, cur);
       });
       auto ast = enode(t);

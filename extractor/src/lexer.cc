@@ -81,9 +81,30 @@ void Lexer::run(const std::string& src) {
     }
     if (c == '"') {
       if (i + 2 < n && src[i + 1] == '"' && src[i + 2] == '"') {
-        throw LexError("line " + std::to_string(line) +
-                       ": Java 15 text blocks (\"\"\") are not supported; "
-                       "use a plain string or exclude the file");
+        // Java 15 text block: """ ... """ — one kString token, so it flows
+        // into StringLiteralExpr and the @string_literal normalization
+        size_t start = i;
+        i += 3;
+        while (i + 2 < n &&
+               !(src[i] == '"' && src[i + 1] == '"' && src[i + 2] == '"')) {
+          if (src[i] == '\\' && i + 1 < n) ++i;
+          if (src[i] == '\n') ++line;
+          ++i;
+        }
+        if (i + 2 >= n)
+          throw LexError("line " + std::to_string(line) +
+                         ": unterminated text block");
+        i += 3;
+        // terminals flow to line-oriented surfaces (terminal_idxs.txt, the
+        // ctypes blob) — keep the lexeme single-line by escaping newlines
+        std::string flat;
+        flat.reserve(i - start);
+        for (size_t k = start; k < i; ++k) {
+          if (src[k] == '\n') flat += "\\n";
+          else if (src[k] != '\r') flat += src[k];
+        }
+        tokens_.push_back({Tok::kString, std::move(flat), line, start, i});
+        continue;
       }
       size_t start = i++;
       while (i < n && src[i] != '"') {

@@ -11,7 +11,11 @@ const std::set<std::string> kPrimitives = {
 
 const std::set<std::string> kModifiers = {
     "public", "protected", "private", "static",   "final",    "abstract",
-    "native", "synchronized", "transient", "volatile", "strictfp", "default"};
+    "native", "synchronized", "transient", "volatile", "strictfp", "default",
+    // Java 17 sealing modifier; contextual, but it can only head a
+    // declaration where a modifier is legal ("non-sealed" is handled as a
+    // token triple in skip_modifiers)
+    "sealed"};
 
 // javaparser operator enum names (BinaryExpr.Operator etc.)
 std::string binary_op_name(const std::string& op) {
@@ -135,7 +139,19 @@ class Parser {
   }
 
   void skip_modifiers() {
-    while (cur().kind == Tok::kIdent && kModifiers.count(cur().text)) next();
+    while (true) {
+      if (cur().kind == Tok::kIdent && kModifiers.count(cur().text)) {
+        next();
+        continue;
+      }
+      // Java 17 'non-sealed' lexes as Ident('non') '-' Ident('sealed')
+      if (at_ident("non") && at_offset_is(1, "-") &&
+          peek(2).kind == Tok::kIdent && peek(2).text == "sealed") {
+        next(); next(); next();
+        continue;
+      }
+      break;
+    }
   }
 
   // ---- names & annotations -------------------------------------------
@@ -217,6 +233,14 @@ class Parser {
 
   JNodePtr parse_type() {
     JNodePtr base;
+    // Java 10 'var' (local-variable type inference): only when used where a
+    // declared name follows, so a pre-Java-10 class actually NAMED var
+    // ('var.foo()', 'new var()') still parses as a type name
+    if (at_ident("var") && peek().kind == Tok::kIdent &&
+        !kReservedNonType.count(peek().text)) {
+      next();
+      return make("VarType", "var");
+    }
     if (cur().kind == Tok::kIdent && kPrimitives.count(cur().text)) {
       base = make("PrimitiveType", cur().text);
       next();
@@ -358,6 +382,14 @@ class Parser {
         decl->add(parse_class_type());
         while (at(",")) { next(); decl->add(parse_class_type()); }
       }
+      if (at_ident("permits")) {
+        // Java 17 permitted-subtype list: parsed but not kept — extraction
+        // is per-method, and class-level children never enter a method's
+        // AST, so recording them would only churn node shapes
+        next();
+        parse_class_type();
+        while (at(",")) { next(); parse_class_type(); }
+      }
       parse_class_body_into(decl.get(), is_interface);
       return decl;
     }
@@ -409,14 +441,23 @@ class Parser {
       expect("}");
       return decl;
     }
-    // explicit diagnostics for recognizable modern constructs, so corpus
-    // builders see WHAT is unsupported instead of a generic parse error
-    if (at_ident("record") && peek().kind == Tok::kIdent)
-      fail("Java 16 'record' declarations are not supported; rewrite as a "
-           "class or exclude the file");
-    if (at_ident("sealed") || (at_ident("non") && at_offset_is(1, "-")))
-      fail("Java 17 sealed types ('sealed'/'non-sealed'/'permits') are not "
-           "supported; remove the sealing modifiers or exclude the file");
+    // Java 16 record: components parse as Parameter nodes (javaparser's
+    // RecordDeclaration shape); members extract like any class body
+    if (at_ident("record") && peek().kind == Tok::kIdent) {
+      next();
+      auto decl = make("RecordDeclaration");
+      for (auto& a : pending_annotations->children) decl->add(std::move(a));
+      decl->add(make("SimpleName", expect_ident()));
+      if (at("<")) parse_type_parameters_into(decl.get());
+      parse_parameters_into(decl.get());
+      if (at_ident("implements")) {
+        next();
+        decl->add(parse_class_type());
+        while (at(",")) { next(); decl->add(parse_class_type()); }
+      }
+      parse_class_body_into(decl.get(), false);
+      return decl;
+    }
     fail("expected type declaration");
   }
 
@@ -457,6 +498,7 @@ class Parser {
     skip_modifiers();
 
     if (at_ident("class") || at_ident("interface") || at_ident("enum") ||
+        at_record_decl() ||
         (at("@") && peek().kind == Tok::kIdent && peek().text == "interface")) {
       decl->add(parse_type_declaration());
       return;
@@ -469,6 +511,17 @@ class Parser {
     }
 
     size_t decl_begin = cur().begin;
+
+    // record compact constructor: Ident '{' (no parameter list)
+    if (cur().kind == Tok::kIdent && peek().kind == Tok::kPunct &&
+        peek().text == "{" && !kPrimitives.count(cur().text)) {
+      auto ctor = make("CompactConstructorDeclaration");
+      for (auto& a : annotations->children) ctor->add(std::move(a));
+      ctor->add(make("SimpleName", expect_ident()));
+      ctor->add(parse_block());
+      decl->add(std::move(ctor));
+      return;
+    }
 
     // constructor: Ident '(' with Ident == enclosing simple name shape
     auto type_params = make("__tps__");
@@ -674,8 +727,26 @@ class Parser {
       expect(";");
       return s;
     }
-    if (at_ident("switch")) return parse_switch();
+    if (at_ident("switch")) return parse_switch(/*as_expr=*/false);
     if (at_ident("try")) return parse_try();
+    // Java 14 'yield expr;' — contextual keyword, only live inside a
+    // switch *expression* body (switch_expr_depth_): there JLS 14.8
+    // forbids an expression statement from starting with 'yield', so any
+    // expression-starter after it — including '(', '++', '--' — reads as a
+    // yield. Outside, pre-14 code using yield as a method/variable name
+    // ('yield();', 'yield = 1;') keeps its expression reading. (Known
+    // approximation: a lambda body nested in a switch expression
+    // re-enables the expression reading in real Java; not tracked.)
+    if (switch_expr_depth_ > 0 && at_ident("yield") &&
+        !(peek().kind == Tok::kPunct &&
+          (peek().text == ";" || peek().text == "=" || peek().text == "." ||
+           peek().text == "[" || peek().text == "::"))) {
+      next();
+      auto s = make("YieldStmt");
+      s->add(parse_expression());
+      expect(";");
+      return s;
+    }
     if (at_ident("synchronized") && peek().kind == Tok::kPunct && peek().text == "(") {
       next();
       auto s = make("SynchronizedStmt");
@@ -693,7 +764,7 @@ class Parser {
       expect(";");
       return s;
     }
-    if (at_ident("class") || leads_to_local_class()) {
+    if (at_ident("class") || leads_to_local_class() || at_record_decl()) {
       auto s = make("LocalClassDeclarationStmt");
       s->add(parse_type_declaration());
       return s;
@@ -730,6 +801,14 @@ class Parser {
     s->add(parse_expression());
     expect(";");
     return s;
+  }
+
+  // 'record Ident (' / 'record Ident <' is a record declaration, not an
+  // identifier that happens to be named record
+  bool at_record_decl() const {
+    return cur().kind == Tok::kIdent && cur().text == "record" &&
+           peek().kind == Tok::kIdent &&
+           (at_offset_is(2, "(") || at_offset_is(2, "<"));
   }
 
   // 'final'/'abstract'/'static' (possibly stacked) directly before 'class'
@@ -892,29 +971,91 @@ class Parser {
     return s;
   }
 
-  JNodePtr parse_switch() {
+  // one label of a case: a constant expression, or a Java 16+ type pattern
+  // 'Type ident' (PatternExpr), or 'null'
+  JNodePtr parse_case_label() {
+    size_t save = pos_;
+    if (cur().kind == Tok::kIdent && !kReservedNonType.count(cur().text)) {
+      try {
+        auto type = parse_type();
+        if (cur().kind == Tok::kIdent &&
+            !kReservedNonType.count(cur().text) && cur().text != "when") {
+          std::string name = expect_ident();
+          if (at("->") || at(":") || at(",") || at_ident("when")) {
+            auto pat = make("PatternExpr");
+            pat->add(std::move(type));
+            pat->add(make("SimpleName", name));
+            return pat;
+          }
+        }
+      } catch (const ParseError&) {}
+      pos_ = save;
+    }
+    // bare enum-constant arrow label ('case FOO ->'): the primary
+    // expression's lambda rule would otherwise eat 'FOO -> body'
+    if (cur().kind == Tok::kIdent && !kReservedNonType.count(cur().text) &&
+        peek().kind == Tok::kPunct && peek().text == "->") {
+      auto ne = make("NameExpr");
+      ne->add(make("SimpleName", expect_ident()));
+      return ne;
+    }
+    return parse_expression();
+  }
+
+  // both statement and expression switches, classic ':' and arrow '->'
+  // entries; javaparser 3.6's entry name is kept for both so classic-corpus
+  // path vocab stays stable
+  JNodePtr parse_switch(bool as_expr) {
     next();  // switch
-    auto s = make("SwitchStmt");
+    auto s = make(as_expr ? "SwitchExpr" : "SwitchStmt");
     expect("(");
     s->add(parse_expression());
     expect(")");
     expect("{");
+    if (as_expr) ++switch_expr_depth_;
     while (!at("}")) {
       auto entry = make("SwitchEntryStmt");  // javaparser 3.6 name
       if (at_ident("case")) {
         next();
-        entry->add(parse_expression());
-        expect(":");
+        entry->add(parse_case_label());
+        while (at(",")) {
+          next();
+          // Java 21 'case null, default ->': the default marker adds no
+          // label node (matching its label-less 'default:' spelling)
+          if (at_ident("default")) { next(); continue; }
+          entry->add(parse_case_label());
+        }
+        if (at_ident("when")) {  // Java 21 guarded pattern
+          next();
+          auto guard = make("Guard");  // wrapper, like ternary's Condition
+          guard->add(parse_expression());
+          entry->add(std::move(guard));
+        }
       } else if (at_ident("default")) {
         next();
-        expect(":");
       } else {
         fail("expected 'case' or 'default'");
       }
-      while (!at("}") && !at_ident("case") && !at_ident("default"))
-        entry->add(parse_statement());
+      if (at("->")) {  // Java 14 arrow rule: expr ';' | block | throw
+        next();
+        if (at("{")) {
+          entry->add(parse_block());
+        } else if (at_ident("throw")) {
+          entry->add(parse_statement());
+        } else {
+          auto stmt = make("ExpressionStmt");
+          stmt->add(parse_expression());
+          expect(";");
+          entry->add(std::move(stmt));
+        }
+      } else {
+        expect(":");
+        while (!at("}") && !at_ident("case") && !at_ident("default"))
+          entry->add(parse_statement());
+      }
       s->add(std::move(entry));
     }
+    if (as_expr) --switch_expr_depth_;
     expect("}");
     return s;
   }
@@ -1023,7 +1164,17 @@ class Parser {
         next();
         auto e = make("InstanceOfExpr");
         e->add(std::move(lhs));
-        e->add(parse_type());
+        auto type = parse_type();
+        if (cur().kind == Tok::kIdent &&
+            !kReservedNonType.count(cur().text)) {
+          // Java 16 pattern: 'x instanceof Type name' binds a variable
+          auto pat = make("PatternExpr");
+          pat->add(std::move(type));
+          pat->add(make("SimpleName", expect_ident()));
+          e->add(std::move(pat));
+        } else {
+          e->add(std::move(type));
+        }
         lhs = std::move(e);
         continue;
       }
@@ -1277,9 +1428,9 @@ class Parser {
       te->add(std::move(type));
       return te;
     }
-    if (at_ident("switch"))
-      fail("Java 14 switch *expressions* are not supported (switch "
-           "statements are); rewrite as a statement or exclude the file");
+    if (at_ident("switch") && peek().kind == Tok::kPunct &&
+        peek().text == "(")
+      return parse_switch(/*as_expr=*/true);
     if (cur().kind == Tok::kIdent && !kReservedNonType.count(cur().text)) {
       std::string name = expect_ident();
       if (at("(")) {
@@ -1353,6 +1504,7 @@ class Parser {
   Lexer lexer_;
   std::vector<Token> toks_;
   size_t pos_ = 0;
+  int switch_expr_depth_ = 0;
 };
 
 const std::set<std::string> Parser::kReservedNonType = {

@@ -427,3 +427,95 @@ class TestHardJavaConstructs:
         # body (the construct under test) must not be masked by siblings
         for m in result.methods:
             assert m.path_contexts, f"{name}: method {m.label!r} empty"
+
+
+class TestModernJava:
+    """Exact-semantics goldens for post-javaparser-3.6 constructs (Java
+    10-21): var, records/compact constructors, switch expressions with
+    arrow entries + yield + (guarded) type patterns, instanceof patterns,
+    text blocks. The reference cannot parse any of these (its javaparser
+    is 3.6.17); semantics here extend ipynb cell6's rules: VarType is a
+    leaf type terminal, PatternExpr anonymizes its binding like a
+    declarator, record bodies close scope like class bodies."""
+
+    def test_var_paths(self):
+        r = extract_source("class A { int f(int a) { var b = a; return b; } }", "f")
+        m = r.methods[0]
+        assert sorted(m.aliases) == [("a", "@var_0"), ("b", "@var_1")]
+        assert set(r.terminal_vocab.values()) == {
+            "int", "@method_0", "@var_0", "@var_1", "var"}
+        got = {(r.terminal_vocab[s], r.path_vocab[p], r.terminal_vocab[e])
+               for s, p, e in m.path_contexts}
+        # declarator name <-> inferred type; initializer resolves to @var_0
+        assert ("@var_1", f"SimpleName{UP}VariableDeclarator{DOWN}VarType", "var") in got
+        assert ("var", f"VarType{UP}VariableDeclarator{DOWN}NameExpr{DOWN}SimpleName", "@var_0") in got
+
+    def test_switch_expression_shape(self):
+        r = extract_source(
+            "class A { int f(int d) { return switch (d) "
+            "{ case 1 -> 10; default -> 0; }; } }", "f")
+        m = r.methods[0]
+        got = {(r.terminal_vocab[s], r.path_vocab[p], r.terminal_vocab[e])
+               for s, p, e in m.path_contexts}
+        # selector and an arrow-entry body hang off SwitchExpr under ReturnStmt,
+        # entry node keeps the 3.6 name SwitchEntryStmt
+        assert ("@var_0",
+                f"SimpleName{UP}NameExpr{UP}SwitchExpr{DOWN}SwitchEntryStmt{DOWN}IntegerLiteralExpr",
+                "1") in got
+
+    def test_yield_statement(self):
+        r = extract_source(
+            "class A { int f(int d) { return switch (d) "
+            "{ default: yield d + 1; }; } }", "f")
+        m = r.methods[0]
+        got = {(r.terminal_vocab[s], r.path_vocab[p], r.terminal_vocab[e])
+               for s, p, e in m.path_contexts}
+        assert ("@var_0",
+                f"SimpleName{UP}NameExpr{UP}BinaryExpr:PLUS{DOWN}IntegerLiteralExpr",
+                "1") in got
+        assert any("YieldStmt" in r.path_vocab[p] for _, p, _ in m.path_contexts)
+
+    def test_instanceof_pattern_binding_resolves(self):
+        r = extract_source(
+            "class A { int f(Object o) { if (o instanceof Integer n && n > 0) "
+            "return n; return 0; } }", "f")
+        m = r.methods[0]
+        assert ("n", "@var_1") in m.aliases
+        got = {(r.terminal_vocab[s], r.path_vocab[p], r.terminal_vocab[e])
+               for s, p, e in m.path_contexts}
+        # the guard's right operand sees the binding introduced on the left
+        assert ("@var_1",
+                f"SimpleName{UP}PatternExpr{UP}InstanceOfExpr{UP}BinaryExpr:AND{DOWN}BinaryExpr:GREATER{DOWN}NameExpr{DOWN}SimpleName",
+                "@var_1") in got
+
+    def test_record_component_and_method(self):
+        r = extract_source(
+            "record Point(int x, int y) { int dist(Point o) "
+            "{ return x * o.x; } }", "dist")
+        m = r.methods[0]
+        # o is the method's own parameter; record components x/y sit outside
+        # the method subtree and are untouched (field-reference semantics)
+        assert m.aliases == [("o", "@var_0")]
+        used = {r.terminal_vocab[i] for s, _, e in m.path_contexts for i in (s, e)}
+        assert "x" in used and "@var_0" in used
+
+    def test_compact_constructor_not_a_method(self):
+        r = extract_source(
+            "record R(int x) { R { x = Math.abs(x); } int f() { return x; } }",
+            "*")
+        assert [m.label for m in r.methods] == ["f"]
+
+    def test_text_block_normalizes_to_string_literal(self):
+        r = extract_source(
+            'class A { String f(String p) { return p + """\n  a "b"\n  c"""; } }',
+            "f")
+        m = r.methods[0]
+        used = {r.terminal_vocab[i] for s, _, e in m.path_contexts for i in (s, e)}
+        assert "@string_literal" in used
+
+    def test_sealed_and_permits_stripped(self):
+        r = extract_source(
+            "sealed class A permits B { int f(int v) { return v; } } "
+            "final class B extends A { }", "f")
+        assert [m.label for m in r.methods] == ["f"]
+        assert "sealed" not in set(r.terminal_vocab.values())

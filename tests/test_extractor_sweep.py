@@ -194,20 +194,41 @@ class TestGeneratedSweep:
                 assert m.path_contexts, f"no contexts for {m.label}\n{src}"
 
 
-class TestModernConstructRejects:
+class TestModernConstructSupport:
+    """Modern Java (10-21) constructs the reference's javaparser 3.6.17
+    predates — parsed and extracted, not rejected (detailed path-set golden
+    tests live in test_extractor.py::TestModernJava)."""
+
     CASES = {
-        "record Point(int x, int y) { }": "record",
-        "sealed class A permits B { }": "sealed",
-        "non-sealed class A extends B { }": "sealed",
-        "class A { int f(int d) { int n = switch (d) { case 1 -> 1; default -> 0; }; return n; } }": "switch *expressions*",
-        'class A { String f() { return """\nx\n"""; } }': "text blocks",
+        "record": "record Point(int x, int y) { int dist() { return x * x + y * y; } }",
+        "sealed": "sealed class A permits B { int f(int x) { return x; } }",
+        "non_sealed": "non-sealed class A extends B { int f(int x) { return x; } }",
+        "switch_expr": "class A { int f(int d) { int n = switch (d) { case 1 -> 1; default -> 0; }; return n; } }",
+        "text_block": 'class A { String f(String p) { return p + """\nx "quoted"\n"""; } }',
+        "yield": "class A { int f(int d) { return switch (d) { case 1: yield 10; default: yield 0; }; } }",
+        "instanceof_pattern": "class A { int f(Object o) { if (o instanceof Integer n && n > 0) return n; return 0; } }",
+        "guarded_pattern": 'class A { int f(Object o) { return switch (o) { case String s when s.isEmpty() -> 1; default -> 0; }; } }',
+        "local_record": "class A { int f(int x) { record P(int v) { } return new P(x).v(); } }",
+        "compact_ctor": "record R(int x) { R { if (x < 0) throw new IllegalArgumentException(); } int f() { return x; } }",
+        # review regressions: enum-constant arrow labels must not parse as
+        # lambdas; 'case null, default' is the JLS 21 null idiom; a
+        # parenthesized yield operand is a YieldStmt, not a call (JLS 14.8)
+        "enum_arrow_label": "class A { enum E { FOO, BAR } int f(E c) { return switch (c) { case FOO -> 1; case BAR -> 2; default -> 0; }; } }",
+        "case_null_default": "class A { int f(Object o) { return switch (o) { case String s -> 1; case null, default -> 0; }; } }",
+        "yield_paren_cast": "class A { int f(int d) { return switch (d) { default: yield (Integer) d; }; } }",
+        "yield_paren_expr": "class A { int f(int d) { return switch (d) { default: yield (d + 1) * 2; }; } }",
+        "yield_prefix_incr": "class A { int f(int d) { return switch (d) { default: yield ++d; }; } }",
+        # pre-Java-14 readings survive outside switch expressions
+        "yield_method_call": "class T { void f() { yield(); } }",
+        "yield_variable": "class A { int f(int yield) { yield = 3; yield++; return yield; } }",
     }
 
-    @pytest.mark.parametrize("src,needle", CASES.items())
-    def test_rejected_with_construct_name(self, src, needle):
-        with pytest.raises(ValueError, match="not supported") as err:
-            extract_source(src)
-        assert needle in str(err.value)
+    @pytest.mark.parametrize("name", CASES)
+    def test_parses_and_extracts(self, name):
+        res = extract_source(self.CASES[name], "f" if "f(" in self.CASES[name] else "*")
+        assert res.methods, f"no methods extracted for {name}"
+        for m in res.methods:
+            assert m.path_contexts, f"no contexts for {m.label} in {name}"
 
     def test_var_and_switch_statement_still_supported(self):
         res = extract_source(
@@ -215,3 +236,28 @@ class TestModernConstructRejects:
             "switch (x) { case 1: return 1; default: break; } return 0; } }"
         )
         assert [m.label for m in res.methods] == ["f"]
+
+    def test_var_is_vartype_leaf_terminal(self):
+        res = extract_source("class A { int f(int d) { var x = d; return x; } }")
+        assert "var" in res.terminal_vocab.values()
+
+    def test_text_block_stays_single_line_unnormalized(self):
+        # terminals are emitted on line-oriented surfaces; raw newlines in
+        # a text block lexeme would corrupt terminal_idxs.txt / the ctypes
+        # blob when --no-normalize-string is set
+        res = extract_source(
+            'class A { String f() { return """\nab "c"\nd"""; } }',
+            "f", normalize_string=False,
+        )
+        terms = set(res.terminal_vocab.values())
+        assert not [t for t in terms if "\n" in t]
+        assert any("ab" in t and "\\n" in t for t in terms)
+
+    def test_pattern_bindings_are_anonymized(self):
+        res = extract_source(self.CASES["guarded_pattern"], "f")
+        m = res.methods[0]
+        assert ("s", "@var_1") in m.aliases
+        used = {res.terminal_vocab[s] for s, _, e in m.path_contexts} | {
+            res.terminal_vocab[e] for _, _, e in m.path_contexts
+        }
+        assert "s" not in used  # never leaks the raw binding name

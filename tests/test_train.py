@@ -215,6 +215,43 @@ class TestEndToEnd:
         with pytest.raises(ValueError, match="--rng_impl rbg"):
             train(cfg2, data, out_dir=str(out))
 
+    def test_empty_test_split_trains_and_exports(self, tmp_path, tmp_path_factory):
+        """3 methods -> the 20% test split is empty; training and the
+        best-F1 export must still complete (regression: np.concatenate
+        of zero batches in export._forward_all)."""
+        from code2vec_tpu.data.synth import SynthSpec
+
+        src = tmp_path_factory.mktemp("tiny3")
+        paths = generate_corpus_files(
+            src, SynthSpec(n_methods=3, n_terminals=40, n_paths=30,
+                           n_labels=3, mean_contexts=6.0, max_contexts=10,
+                           seed=7),
+        )
+        data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+        out = tmp_path / "e3"
+        os.makedirs(out)
+        vectors = out / "code.vec"
+        # 'exact' is the eval method that hard-errors in sklearn on empty
+        # input — evaluate() must short-circuit to zeros
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=2, batch_size=2, eval_method="exact"
+        )
+        train(cfg, data, out_dir=str(out), vectors_path=str(vectors))
+        labels, rows = read_code_vectors(str(vectors))
+        assert len(labels) == 3 and rows.shape[0] == 3  # all rows are train rows
+
+        # standalone export: same empty split, plus the requested TSV must
+        # exist (zero rows) rather than silently never being written
+        from code2vec_tpu.export import export_from_checkpoint
+
+        tsv = out / "test_result.tsv"
+        vectors.unlink()
+        f1 = export_from_checkpoint(
+            cfg, data, str(out), str(vectors), test_result_path=str(tsv)
+        )
+        assert f1 == 0.0
+        assert vectors.exists() and tsv.exists() and tsv.read_text() == ""
+
     def test_export_from_checkpoint(self, tiny, tmp_path):
         """The standalone --export_only pass: restore and rewrite code.vec
         without training (the post-hoc export for sharded pod runs)."""
