@@ -37,11 +37,14 @@ struct Binding {
   std::string name;
   std::string id;
   std::shared_ptr<const Binding> next;
+  bool from_pattern = false;  // introduced by a PatternExpr (arm-scoped)
 };
 using Ctx = std::shared_ptr<const Binding>;
 
-Ctx bind(const Ctx& ctx, const std::string& space, const Variable& v) {
-  return std::make_shared<const Binding>(Binding{space, v.name, v.id, ctx});
+Ctx bind(const Ctx& ctx, const std::string& space, const Variable& v,
+         bool from_pattern = false) {
+  return std::make_shared<const Binding>(
+      Binding{space, v.name, v.id, ctx, from_pattern});
 }
 
 std::string lookup(const Ctx& ctx, const std::string& space,
@@ -193,7 +196,7 @@ struct Extractor {
       const JNode* name_node = find_child(n, "SimpleName");
       std::string original = name_node ? name_node->text : "";
       Variable alias = env.vars.fresh(original);
-      Ctx new_ctx = bind(ctx, "var", alias);
+      Ctx new_ctx = bind(ctx, "var", alias, /*from_pattern=*/true);
       auto [children, _] = eval_list(n, ctx, [&](const JNode& c, Ctx cur) -> Result {
         if (c.type == "SimpleName")
           return {enode_terminal("SimpleName", alias.id), cur};
@@ -293,6 +296,27 @@ struct Extractor {
       ast->children.push_back(extract(*n.children[1], ctx).first);
       ast->children.push_back(extract(*n.children[2], ctx).first);
       return {std::move(ast), ctx};
+    }
+
+    // ---- switch entry: pattern bindings are arm-scoped ----------------
+    // a 'case Type t ->' binding must not leak into sibling arms or past
+    // the switch (it would capture same-named fields there). Ordinary
+    // declarations still flow across classic ':' entries, matching the
+    // reference's statement-group scoping (SwitchEntryStmt is not a
+    // cell6 scope closer).
+    if (t == "SwitchEntryStmt") {
+      auto [children, final_ctx] = eval_children(n, ctx);
+      std::vector<const Binding*> kept;
+      for (const Binding* b = final_ctx.get(); b != ctx.get();
+           b = b->next.get())
+        if (!b->from_pattern) kept.push_back(b);
+      Ctx out = ctx;
+      for (auto it = kept.rbegin(); it != kept.rend(); ++it)
+        out = std::make_shared<const Binding>(
+            Binding{(*it)->space, (*it)->name, (*it)->id, out, false});
+      auto ast = enode(t);
+      ast->children = std::move(children);
+      return {std::move(ast), out};
     }
 
     // ---- scope-closing containers (cell6) -----------------------------

@@ -11,11 +11,7 @@ const std::set<std::string> kPrimitives = {
 
 const std::set<std::string> kModifiers = {
     "public", "protected", "private", "static",   "final",    "abstract",
-    "native", "synchronized", "transient", "volatile", "strictfp", "default",
-    // Java 17 sealing modifier; contextual, but it can only head a
-    // declaration where a modifier is legal ("non-sealed" is handled as a
-    // token triple in skip_modifiers)
-    "sealed"};
+    "native", "synchronized", "transient", "volatile", "strictfp", "default"};
 
 // javaparser operator enum names (BinaryExpr.Operator etc.)
 std::string binary_op_name(const std::string& op) {
@@ -141,6 +137,17 @@ class Parser {
   void skip_modifiers() {
     while (true) {
       if (cur().kind == Tok::kIdent && kModifiers.count(cur().text)) {
+        next();
+        continue;
+      }
+      // Java 17 'sealed' — contextual: a modifier only when a declaration
+      // head follows, so a pre-17 class actually NAMED sealed ('sealed s;')
+      // keeps its type reading (same lookahead discipline as var/record)
+      if (at_ident("sealed") && peek().kind == Tok::kIdent &&
+          (kModifiers.count(peek().text) || peek().text == "class" ||
+           peek().text == "interface" || peek().text == "enum" ||
+           peek().text == "non" ||
+           (peek().text == "record" && peek(2).kind == Tok::kIdent))) {
         next();
         continue;
       }
