@@ -1,0 +1,82 @@
+"""Worker process for the real multi-process integration test
+(test_multiprocess.py). Not a pytest module.
+
+Forms a 2-process jax.distributed group over the CPU backend (2 local
+devices each -> 4 global), loads a host-sharded corpus (this process's
+round-robin half), and drives the PRODUCTION host-sharded feed path:
+``train()`` with a data axis spanning both processes, batches assembled
+via ``make_array_from_process_local_data``. Prints one final JSON line
+with the per-epoch losses/f1 so the parent can assert cross-process
+agreement.
+
+Usage: mp_worker.py <dataset_dir> <out_dir>
+Env:   COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID (distributed.py)
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import faulthandler
+
+# diagnostics only: dump stacks if a run ever stalls (orbax's multihost
+# commit barrier deadlocks if processes are given different checkpoint
+# dirs — they must share one, like a pod's shared filesystem)
+faulthandler.dump_traceback_later(400, exit=False)
+
+import jax
+
+from code2vec_tpu.parallel.distributed import initialize_from_env
+
+
+def main() -> None:
+    dataset_dir, out_dir = sys.argv[1], sys.argv[2]
+    assert initialize_from_env(), "worker needs the distributed env vars"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.data.synth import SynthSpec, generate_corpus_files
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.loop import train
+
+    # out_dir is SHARED between processes (orbax's commit protocol needs
+    # one checkpoint dir visible to all, as on a pod); dataset dirs are
+    # per-process: both generate identical corpus files (seeded) in their
+    # own dir, then each loads only its round-robin half
+    spec = SynthSpec(
+        n_methods=96, n_terminals=120, n_paths=100, n_labels=6,
+        mean_contexts=10.0, max_contexts=16, seed=11,
+    )
+    paths = generate_corpus_files(dataset_dir, spec)
+    shard = (jax.process_index(), jax.process_count())
+    data = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"], shard=shard
+    )
+    assert data.shard == shard
+
+    cfg = TrainConfig(
+        max_epoch=3,
+        batch_size=16,
+        encode_size=32,
+        terminal_embed_size=16,
+        path_embed_size=16,
+        max_path_length=16,
+        data_axis=4,  # spans both processes' devices
+        print_sample_cycle=0,
+    )
+    result = train(cfg, data, out_dir=out_dir)
+    # full-precision floats: the parent asserts bit-for-bit agreement
+    print(json.dumps({
+        "process": jax.process_index(),
+        "best_f1": result.best_f1,
+        "losses": [h["train_loss"] for h in result.history],
+        "f1s": [h["f1"] for h in result.history],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

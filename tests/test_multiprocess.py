@@ -1,0 +1,91 @@
+"""Real multi-process jax.distributed integration (SURVEY.md §5.8, §7.4).
+
+Everything else in the suite exercises the multi-host code paths inside ONE
+process (jax.process_count() == 1 shortcuts). This test spawns two actual
+processes that form a distributed group over the CPU backend and drive the
+production host-sharded feed: each loads half the corpus, training runs
+with the batch data-sharded across both processes' devices, and the final
+metrics must agree bit-for-bit between processes (they observe the same
+global computation).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_host_sharded_training(tmp_path):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = os.environ.copy()
+        env.update(
+            COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            NUM_PROCESSES="2",
+            PROCESS_ID=str(pid),
+            PYTHONPATH=REPO,
+        )
+        # the worker pins its own XLA_FLAGS/JAX_PLATFORMS before importing jax
+        env.pop("XLA_FLAGS", None)
+        ds = tmp_path / f"ds{pid}"
+        out = tmp_path / "out"  # shared: orbax multihost commit needs one dir
+        ds.mkdir()
+        out.mkdir(exist_ok=True)
+        # file-backed output: pipes would (a) lose the worker's faulthandler
+        # stall dump when the parent times out and (b) risk a pipe-buffer
+        # stall coupling back into the workers' lockstep collectives
+        log = open(tmp_path / f"worker{pid}.log", "w+", encoding="utf-8")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [sys.executable, WORKER, str(ds), str(out)],
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    cwd=REPO,
+                    env=env,
+                ),
+                log,
+            )
+        )
+    try:
+        for p, _ in procs:
+            try:
+                p.wait(timeout=600)
+            except subprocess.TimeoutExpired:
+                pass
+    finally:
+        for p, _ in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    results = {}
+    for p, log in procs:
+        log.flush()
+        log.seek(0)
+        out = log.read()
+        log.close()
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+        last = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        r = json.loads(last)
+        results[r["process"]] = r
+    assert set(results) == {0, 1}
+    # both processes ran the same global computation: identical trajectories
+    assert results[0]["losses"] == results[1]["losses"]
+    assert results[0]["f1s"] == results[1]["f1s"]
+    assert results[0]["best_f1"] == results[1]["best_f1"]
+    assert len(results[0]["losses"]) == 3
+    assert all(l > 0 for l in results[0]["losses"])
