@@ -1,0 +1,384 @@
+"""Single-source inference: predict method names for new Java/Python code
+from a trained checkpoint.
+
+The reference has no inference surface at all — its closest facility is
+``print_sample`` (main.py:362-390), which replays attention on a *training*
+example. This module closes the loop for a real user: point it at a trained
+``--model_path`` and a source file, and it extracts path-contexts natively,
+maps them into the training vocabulary (the ``@question`` index shift of
+dataset_reader.py:29-41 included), applies the same answer-leak framing the
+trainer uses (``@method_0 -> @question``, dataset_builder.py:122-144), runs
+the jitted forward, and returns the top-k label names with probabilities
+and the per-context attention.
+
+Inference needs three things the checkpoint alone doesn't carry — model
+dims, the label vocabulary (insertion-ordered at corpus-load time), and the
+task flags. ``save_inference_meta`` persists them next to the checkpoint
+(``model_meta.json`` + ``label_vocab.txt``) at train start, so prediction
+requires only the model dir and the extraction vocab files.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX, QUESTION_TOKEN_NAME
+
+logger = logging.getLogger(__name__)
+
+MODEL_META = "model_meta.json"
+LABEL_VOCAB = "label_vocab.txt"
+
+
+def save_inference_meta(out_dir: str, config, model_config, data) -> None:
+    """Persist what prediction needs beyond the checkpoint (called by the
+    train loop on process 0): model dims/flags and the label vocab."""
+    meta = {
+        "rng_impl": config.rng_impl,
+        "terminal_count": model_config.terminal_count,
+        "path_count": model_config.path_count,
+        "label_count": model_config.label_count,
+        "terminal_embed_size": model_config.terminal_embed_size,
+        "path_embed_size": model_config.path_embed_size,
+        "encode_size": model_config.encode_size,
+        "angular_margin_loss": model_config.angular_margin_loss,
+        "angular_margin": model_config.angular_margin,
+        "inverse_temp": model_config.inverse_temp,
+        "vocab_pad_multiple": model_config.vocab_pad_multiple,
+        "max_path_length": config.max_path_length,
+        "infer_method_name": config.infer_method_name,
+        "infer_variable_name": config.infer_variable_name,
+    }
+    with open(os.path.join(out_dir, MODEL_META), "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=1)
+    from code2vec_tpu.formats.vocab_io import write_vocab
+
+    write_vocab(
+        os.path.join(out_dir, LABEL_VOCAB),
+        sorted(data.label_vocab.itos.items()),
+    )
+
+
+@dataclass
+class Prediction:
+    name: str
+    prob: float
+
+
+@dataclass
+class MethodPrediction:
+    method_name: str  # the actual name found in the source
+    predictions: list[Prediction]  # top-k, most probable first
+    n_contexts: int  # contexts fed to the model (after OOV drop)
+    n_oov: int  # contexts dropped: path or terminal unseen in training
+    attention: list[tuple[str, str, str, float]]  # (start, path, end, weight)
+
+
+class Predictor:
+    """Loads checkpoint + metadata once; predicts per source string/file."""
+
+    def __init__(
+        self,
+        model_path: str,
+        terminal_idx_path: str,
+        path_idx_path: str,
+    ) -> None:
+        import jax
+
+        from code2vec_tpu.checkpoint import restore_checkpoint
+        from code2vec_tpu.formats.vocab_io import read_vocab
+        from code2vec_tpu.models.code2vec import Code2VecConfig
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state
+
+        meta_path = os.path.join(model_path, MODEL_META)
+        if not os.path.exists(meta_path):
+            raise FileNotFoundError(
+                f"{meta_path} not found — the model dir must come from a "
+                "train run of this framework (which persists inference "
+                "metadata next to the checkpoint)"
+            )
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        if not meta.get("infer_method_name", True):
+            raise ValueError(
+                "this checkpoint was trained for the variable-name task; "
+                "method-name prediction needs an infer_method_name run"
+            )
+        self.meta = meta
+        # same loading rules as training: @question injected into the
+        # terminal vocab at index 1, raw indices shifted up
+        self.terminal_vocab = read_vocab(
+            terminal_idx_path, extra_tokens=[QUESTION_TOKEN_NAME]
+        )
+        self.path_vocab = read_vocab(path_idx_path)
+        self.label_vocab = read_vocab(os.path.join(model_path, LABEL_VOCAB))
+
+        self.bag = int(meta["max_path_length"])
+        # extraction hyperparameters: the corpus records them in params.txt
+        # next to the vocab files (reference format, typo'd 'nomalize_' keys
+        # included) — new sources must be extracted identically or their
+        # path strings silently diverge from the training vocab
+        self.extract_params = self._load_extract_params(
+            os.path.join(os.path.dirname(os.path.abspath(path_idx_path)),
+                         "params.txt")
+        )
+        model_config = Code2VecConfig(
+            terminal_count=meta["terminal_count"],
+            path_count=meta["path_count"],
+            label_count=meta["label_count"],
+            terminal_embed_size=meta["terminal_embed_size"],
+            path_embed_size=meta["path_embed_size"],
+            encode_size=meta["encode_size"],
+            dropout_prob=0.0,
+            angular_margin_loss=meta["angular_margin_loss"],
+            angular_margin=meta["angular_margin"],
+            inverse_temp=meta["inverse_temp"],
+            vocab_pad_multiple=meta.get("vocab_pad_multiple", 1) or 1,
+        )
+        config = TrainConfig(
+            batch_size=1, max_path_length=self.bag,
+            infer_method_name=True, infer_variable_name=False,
+            # the checkpoint's dropout key carries its PRNG impl; restore
+            # validates it, so reconstruct with the impl trained with
+            rng_impl=meta.get("rng_impl", "threefry2x32"),
+        )
+        example = {
+            "starts": np.zeros((1, self.bag), np.int32),
+            "paths": np.zeros((1, self.bag), np.int32),
+            "ends": np.zeros((1, self.bag), np.int32),
+            "labels": np.zeros(1, np.int32),
+            "example_mask": np.ones(1, np.float32),
+        }
+        state = create_train_state(
+            config, model_config, jax.random.PRNGKey(0), example
+        )
+        restored = restore_checkpoint(model_path, state, prefer_best=True)
+        if restored is None:
+            raise FileNotFoundError(f"no checkpoint found under {model_path}")
+        self.state = restored[0]
+
+        # the training eval step deliberately omits full logits (they would
+        # be [B, labels] of device->host traffic per batch); inference
+        # wants them, so jit a dedicated forward
+        def forward(state, batch):
+            logits, _, attention = state.apply_fn(
+                {"params": state.params},
+                batch["starts"], batch["paths"], batch["ends"],
+                labels=None, deterministic=True,
+            )
+            return logits, attention
+
+        self._forward = jax.jit(forward)
+
+    # ---- extraction-param matching --------------------------------------
+    @staticmethod
+    def _load_extract_params(params_path: str) -> dict:
+        """Extraction kwargs matching the training corpus's params.txt
+        (length/width caps + literal normalization). Falls back to the
+        reference defaults with a warning when the file is absent."""
+        defaults = dict(
+            max_length=8, max_width=3, normalize_string=True,
+            normalize_char=True, normalize_int=False, normalize_double=True,
+        )
+        if not os.path.exists(params_path):
+            logger.warning(
+                "%s not found — extracting with the default caps; if the "
+                "corpus used custom extraction params, predictions degrade",
+                params_path,
+            )
+            return defaults
+        from code2vec_tpu.formats.params_io import read_params
+
+        p = read_params(params_path)
+
+        def flag(key: str, default: bool) -> bool:
+            return p.get(key, str(default).lower()).strip() == "true"
+
+        return dict(
+            max_length=int(p.get("max_length", 8)),
+            max_width=int(p.get("max_width", 3)),
+            # the reference writes (and we keep) the 'nomalize_' spelling
+            normalize_string=flag("nomalize_string_literal", True),
+            normalize_char=flag("nomalize_char_literal", True),
+            normalize_int=flag("nomalize_int_literal", False),
+            normalize_double=flag("nomalize_double_literal", True),
+        )
+
+    # ---- vocab mapping ---------------------------------------------------
+    def _map_contexts(
+        self, contexts: list[tuple[str, str, str]]
+    ) -> tuple[list[tuple[int, int, int]], int]:
+        """(start, path, end) NAME triples -> training vocab ids. Names are
+        the join key across extractor runs. Contexts whose path or either
+        terminal never occurred in training are dropped (counted as OOV).
+        ``@method_0`` maps to ``@question`` — the trainer's answer-leak
+        substitution. Terminals are lowercased like the vocab writers'."""
+        t_stoi = self.terminal_vocab.stoi
+        p_stoi = self.path_vocab.stoi
+
+        def term_id(name: str) -> int | None:
+            if name == "@method_0":
+                return QUESTION_TOKEN_INDEX
+            return t_stoi.get(name.lower())
+
+        mapped, oov = [], 0
+        for s, p, e in contexts:
+            ts, te = term_id(s), term_id(e)
+            tp = p_stoi.get(p)
+            if ts is None or te is None or tp is None:
+                oov += 1
+                continue
+            mapped.append((ts, tp, te))
+        return mapped, oov
+
+    # ---- prediction ------------------------------------------------------
+    def predict_source(
+        self,
+        source: str,
+        method_name: str = "*",
+        language: str = "java",
+        top_k: int = 5,
+        rng: np.random.Generator | None = None,
+    ) -> list[MethodPrediction]:
+        """Extract + predict every matching method in ``source``.
+
+        Both extractors are normalized to (start, path, end) NAME triples:
+        the Java one returns run-local int ids + vocab dicts, the Python
+        one returns string triples directly.
+        """
+        methods: list[tuple[str, list[tuple[str, str, str]]]] = []
+        if language == "java":
+            from code2vec_tpu.extractor import extract_source
+
+            result = extract_source(source, method_name, **self.extract_params)
+            for m in result.methods:
+                methods.append((
+                    m.label,
+                    [(result.terminal_vocab[s], result.path_vocab[p],
+                      result.terminal_vocab[e]) for s, p, e in m.path_contexts],
+                ))
+        elif language == "python":
+            from code2vec_tpu.pyextract import PyExtractConfig, extract_python_source
+
+            ep = self.extract_params
+            py_config = PyExtractConfig(
+                normalize_string_literal=ep["normalize_string"],
+                normalize_char_literal=ep["normalize_char"],
+                normalize_int_literal=ep["normalize_int"],
+                normalize_double_literal=ep["normalize_double"],
+                max_length=ep["max_length"],
+                max_width=ep["max_width"],
+            )
+            for m in extract_python_source(source, method_name, py_config):
+                methods.append((m.label, list(m.contexts)))
+        else:
+            raise ValueError(f"unknown language: {language!r}")
+
+        out = []
+        for label, contexts in methods:
+            mapped, oov = self._map_contexts(contexts)
+            if not mapped:
+                logger.warning(
+                    "%s: every context is OOV against the training vocab — "
+                    "prediction will be the label prior",
+                    label,
+                )
+            out.append(self._predict_contexts(label, mapped, oov, top_k, rng))
+        return out
+
+    def _predict_contexts(
+        self, label: str, contexts, n_oov: int, top_k: int, rng
+    ) -> MethodPrediction:
+        # over-long bags: random subsample, matching the trainer's per-epoch
+        # truncation (dataset_builder.py:134-135) but seeded for inference
+        if len(contexts) > self.bag:
+            r = rng if rng is not None else np.random.default_rng(0)
+            keep = r.choice(len(contexts), self.bag, replace=False)
+            contexts = [contexts[i] for i in sorted(keep)]
+        arr = np.asarray(contexts, np.int32).reshape(-1, 3)
+        n = arr.shape[0]
+        starts = np.full((1, self.bag), PAD_INDEX, np.int32)
+        paths = np.full((1, self.bag), PAD_INDEX, np.int32)
+        ends = np.full((1, self.bag), PAD_INDEX, np.int32)
+        starts[0, :n], paths[0, :n], ends[0, :n] = arr[:, 0], arr[:, 1], arr[:, 2]
+        batch = {"starts": starts, "paths": paths, "ends": ends}
+        logits, attn = self._forward(self.state, batch)
+        # the head may be vocab-padded for even model-axis sharding; the
+        # dummy rows are meaningless — slice to the real label count
+        logits = np.asarray(logits, np.float64)[0, : len(self.label_vocab)]
+        z = np.exp(logits - logits.max())
+        probs = z / z.sum()
+        order = np.argsort(-probs)[:top_k]
+        preds = [
+            Prediction(self.label_vocab.itos[int(i)], float(probs[i]))
+            for i in order
+        ]
+        attn = np.asarray(attn)[0]
+        t_itos, p_itos = self.terminal_vocab.itos, self.path_vocab.itos
+        attention = [
+            (t_itos[int(s)], p_itos[int(p)], t_itos[int(e)], float(a))
+            for s, p, e, a in zip(
+                starts[0, :n], paths[0, :n], ends[0, :n], attn[:n]
+            )
+        ]
+        attention.sort(key=lambda row: -row[3])
+        return MethodPrediction(
+            method_name=label,
+            predictions=preds,
+            n_contexts=n,
+            n_oov=n_oov,
+            attention=attention,
+        )
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Predict method names for a source file from a trained "
+        "checkpoint."
+    )
+    parser.add_argument("source_file", help=".java or .py file")
+    parser.add_argument("--model_path", required=True)
+    parser.add_argument("--terminal_idx_path", required=True)
+    parser.add_argument("--path_idx_path", required=True)
+    parser.add_argument("--method_name", default="*", help="* = all methods")
+    parser.add_argument("--top_k", type=int, default=5)
+    parser.add_argument(
+        "--show_attention", type=int, default=0, metavar="N",
+        help="also print the N highest-attention path-contexts per method",
+    )
+    args = parser.parse_args(argv)
+
+    predictor = Predictor(
+        args.model_path, args.terminal_idx_path, args.path_idx_path
+    )
+    with open(args.source_file, encoding="utf-8") as f:
+        source = f.read()
+    language = "python" if args.source_file.endswith(".py") else "java"
+    results = predictor.predict_source(
+        source, args.method_name, language=language, top_k=args.top_k
+    )
+    if not results:
+        print("no matching methods found")
+        return
+    for m in results:
+        print(
+            f"{m.method_name}  ({m.n_contexts} contexts"
+            + (f", {m.n_oov} OOV dropped" if m.n_oov else "")
+            + ")"
+        )
+        for p in m.predictions:
+            print(f"  {p.prob:6.3f}  {p.name}")
+        for s, pth, e, a in m.attention[: args.show_attention]:
+            print(f"    [{a:.3f}] {s} {pth} {e}")
+
+
+if __name__ == "__main__":
+    main()

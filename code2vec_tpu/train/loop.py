@@ -219,6 +219,13 @@ def train(
     model_config = model_config_from(config, data)
     class_weights = class_weights_from(config, data)
 
+    if out_dir is not None and jax.process_index() == 0:
+        # persist what single-source inference (code2vec_tpu.predict)
+        # needs beyond the checkpoint: model dims/flags + the label vocab
+        from code2vec_tpu.predict import save_inference_meta
+
+        save_inference_meta(out_dir, config, model_config, data)
+
     state = initial_state
     if state is None:
         state = create_train_state(

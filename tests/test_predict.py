@@ -1,0 +1,220 @@
+"""Single-source inference (code2vec_tpu.predict): checkpoint + vocab
+metadata -> top-k method-name predictions for new source."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.extractor import build_extractor, extract_dataset
+from code2vec_tpu.predict import Predictor, main as predict_main
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.loop import train
+
+JAVA = """
+class Util {
+  int add(int a, int b) { int total = a + b; return total; }
+  int mul(int a, int b) { int product = a * b; return product; }
+  boolean isEven(int n) { boolean even = n % 2 == 0; return even; }
+  int addChecked(int a, int b) { if (a > 0 && b > 0) { return a + b; } return 0; }
+  int mulTwice(int a, int b) { int product = a * b * 2; return product; }
+  boolean isEvenOrZero(int n) { boolean even = n % 2 == 0 || n == 0; return even; }
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    build_extractor()
+    root = tmp_path_factory.mktemp("predict")
+    src = root / "src"
+    ds = root / "ds"
+    out = root / "out"
+    for d in (src, ds, out):
+        d.mkdir()
+    (src / "Util.java").write_text(JAVA)
+    (ds / "methods.txt").write_text("Util.java\t*\n")
+    extract_dataset(str(ds), str(src))
+    data = load_corpus(
+        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+    )
+    cfg = TrainConfig(
+        max_epoch=25, batch_size=4, encode_size=48, terminal_embed_size=24,
+        path_embed_size=24, max_path_length=64, lr=0.01,
+        print_sample_cycle=0,
+    )
+    train(cfg, data, out_dir=str(out))
+    return ds, out
+
+
+def test_meta_persisted(trained):
+    ds, out = trained
+    meta = json.loads((out / "model_meta.json").read_text())
+    assert meta["max_path_length"] == 64 and meta["infer_method_name"]
+    assert (out / "label_vocab.txt").exists()
+
+
+def test_predicts_memorized_methods(trained):
+    ds, out = trained
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    results = p.predict_source(JAVA, "*", top_k=3)
+    assert len(results) == 6
+    hits = 0
+    for m in results:
+        names = [pr.name for pr in m.predictions]
+        expected = m.method_name.lower()
+        # labels are normalized+lowercased; memorized methods should rank
+        # their own (normalized) name highly
+        hits += any(expected.startswith(n) or n == expected for n in names)
+        assert m.n_contexts > 0 and m.n_oov == 0
+        assert m.attention and m.attention[0][3] >= m.attention[-1][3]
+        probs = [pr.prob for pr in m.predictions]
+        assert probs == sorted(probs, reverse=True)
+        assert 0 < sum(probs) <= 1.0 + 1e-6
+    assert hits >= 4  # memorization: most train methods rank themselves
+
+
+def test_oov_source_degrades_gracefully(trained):
+    ds, out = trained
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    # try/catch + strings never occurred in training: most contexts OOV
+    results = p.predict_source(
+        "class X { String weird(String s) { try { return s.trim(); } "
+        'catch (RuntimeException e) { return "x"; } } }',
+        "weird", top_k=2,
+    )
+    assert len(results) == 1
+    m = results[0]
+    assert m.n_oov > 0
+    assert len(m.predictions) == 2  # still returns ranked predictions
+
+
+def test_variable_task_checkpoint_rejected(trained, tmp_path):
+    ds, out = trained
+    meta_path = out / "model_meta.json"
+    original = meta_path.read_text()
+    meta = json.loads(original)
+    meta["infer_method_name"] = False
+    try:
+        meta_path.write_text(json.dumps(meta))
+        with pytest.raises(ValueError, match="variable-name task"):
+            Predictor(str(out), str(ds / "terminal_idxs.txt"),
+                      str(ds / "path_idxs.txt"))
+    finally:
+        meta_path.write_text(original)
+
+
+def test_missing_meta_explains(trained, tmp_path):
+    ds, _ = trained
+    with pytest.raises(FileNotFoundError, match="model_meta.json"):
+        Predictor(str(tmp_path), str(ds / "terminal_idxs.txt"),
+                  str(ds / "path_idxs.txt"))
+
+
+PY = """
+def add(a, b):
+    total = a + b
+    return total
+
+
+def mul(a, b):
+    product = a * b
+    return product
+
+
+def is_even(n):
+    even = n % 2 == 0
+    return even
+"""
+
+
+@pytest.fixture(scope="module")
+def trained_py(tmp_path_factory):
+    from code2vec_tpu.pyextract import extract_python_dataset
+
+    root = tmp_path_factory.mktemp("predict_py")
+    src = root / "src"
+    ds = root / "ds"
+    out = root / "out"
+    for d in (src, ds, out):
+        d.mkdir()
+    (src / "util.py").write_text(PY)
+    extract_python_dataset(str(ds), str(src), [("util.py", "*")])
+    data = load_corpus(
+        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+    )
+    cfg = TrainConfig(
+        max_epoch=25, batch_size=2, encode_size=32, terminal_embed_size=16,
+        path_embed_size=16, max_path_length=64, lr=0.01,
+        print_sample_cycle=0,
+    )
+    train(cfg, data, out_dir=str(out))
+    return ds, out
+
+
+def test_predicts_python_source(trained_py):
+    ds, out = trained_py
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    results = p.predict_source(PY, "*", language="python", top_k=3)
+    assert len(results) == 3
+    for m in results:
+        assert m.n_contexts > 0
+        assert len(m.predictions) == 3
+
+
+def test_rng_impl_round_trips(tmp_path):
+    """A checkpoint trained with --rng_impl rbg must load for inference
+    (meta carries the impl; the restore validates it)."""
+    from code2vec_tpu.data.synth import SynthSpec, generate_corpus_files
+
+    paths = generate_corpus_files(
+        tmp_path / "ds",
+        SynthSpec(n_methods=8, n_terminals=40, n_paths=30, n_labels=4,
+                  mean_contexts=6.0, max_contexts=10, seed=3),
+    )
+    data = load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+    out = tmp_path / "out"
+    out.mkdir()
+    cfg = TrainConfig(max_epoch=1, batch_size=4, encode_size=16,
+                      terminal_embed_size=8, path_embed_size=8,
+                      max_path_length=8, rng_impl="rbg",
+                      print_sample_cycle=0)
+    train(cfg, data, out_dir=str(out))
+    p = Predictor(str(out), paths["terminal_idx"], paths["path_idx"])
+    assert p.meta["rng_impl"] == "rbg"
+
+
+def test_extraction_params_follow_corpus(trained, tmp_path_factory):
+    """predict must re-extract with the corpus's recorded caps, not the
+    defaults — otherwise path strings silently diverge."""
+    root = tmp_path_factory.mktemp("predict_caps")
+    src = root / "src"
+    ds = root / "ds"
+    src.mkdir(), ds.mkdir()
+    (src / "Util.java").write_text(JAVA)
+    (ds / "methods.txt").write_text("Util.java\t*\n")
+    extract_dataset(str(ds), str(src), max_length=12, max_width=4)
+    _, out = trained  # any checkpoint; extraction params come from the ds
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    assert p.extract_params["max_length"] == 12
+    assert p.extract_params["max_width"] == 4
+
+
+def test_cli(trained, tmp_path, capsys):
+    ds, out = trained
+    f = tmp_path / "Util.java"
+    f.write_text(JAVA)
+    predict_main([
+        str(f),
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--method_name", "add",
+        "--top_k", "2",
+        "--show_attention", "1",
+    ])
+    printed = capsys.readouterr().out
+    assert "add" in printed and "contexts" in printed
+    assert "[" in printed  # an attention row
