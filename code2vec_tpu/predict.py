@@ -77,6 +77,31 @@ class MethodPrediction:
     n_contexts: int  # contexts fed to the model (after OOV drop)
     n_oov: int  # contexts dropped: path or terminal unseen in training
     attention: list[tuple[str, str, str, float]]  # (start, path, end, weight)
+    code_vector: np.ndarray | None = None  # [encode_size] embedding
+
+
+def nearest_from_rows(
+    labels: list[str], rows: np.ndarray, vector: np.ndarray, top_k: int = 5
+) -> list[tuple[str, float]]:
+    """Cosine-nearest rows of a preloaded code.vec matrix to ``vector``."""
+    norms = np.linalg.norm(rows, axis=1) * max(np.linalg.norm(vector), 1e-12)
+    sims = rows @ vector / np.maximum(norms, 1e-12)
+    order = np.argsort(-sims)[:top_k]
+    return [(labels[int(i)], float(sims[i])) for i in order]
+
+
+def nearest_neighbors(
+    code_vec_path: str, vector: np.ndarray, top_k: int = 5
+) -> list[tuple[str, float]]:
+    """Cosine-nearest rows of an exported code.vec to ``vector`` —
+    'which training methods does this new method embed next to'. The
+    reference only ships vectors to the TensorBoard projector for manual
+    inspection (visualize_code_vec.py); this is the programmatic lookup.
+    Querying many vectors? ``read_code_vectors`` once + ``nearest_from_rows``."""
+    from code2vec_tpu.formats.vectors_io import read_code_vectors
+
+    labels, rows = read_code_vectors(code_vec_path)
+    return nearest_from_rows(labels, rows, vector, top_k)
 
 
 class Predictor:
@@ -167,12 +192,12 @@ class Predictor:
         # be [B, labels] of device->host traffic per batch); inference
         # wants them, so jit a dedicated forward
         def forward(state, batch):
-            logits, _, attention = state.apply_fn(
+            logits, code_vector, attention = state.apply_fn(
                 {"params": state.params},
                 batch["starts"], batch["paths"], batch["ends"],
                 labels=None, deterministic=True,
             )
-            return logits, attention
+            return logits, code_vector, attention
 
         self._forward = jax.jit(forward)
 
@@ -308,7 +333,7 @@ class Predictor:
         ends = np.full((1, self.bag), PAD_INDEX, np.int32)
         starts[0, :n], paths[0, :n], ends[0, :n] = arr[:, 0], arr[:, 1], arr[:, 2]
         batch = {"starts": starts, "paths": paths, "ends": ends}
-        logits, attn = self._forward(self.state, batch)
+        logits, code_vector, attn = self._forward(self.state, batch)
         # the head may be vocab-padded for even model-axis sharding; the
         # dummy rows are meaningless — slice to the real label count
         logits = np.asarray(logits, np.float64)[0, : len(self.label_vocab)]
@@ -334,6 +359,7 @@ class Predictor:
             n_contexts=n,
             n_oov=n_oov,
             attention=attention,
+            code_vector=np.asarray(code_vector)[0],
         )
 
 
@@ -354,7 +380,31 @@ def main(argv: list[str] | None = None) -> None:
         "--show_attention", type=int, default=0, metavar="N",
         help="also print the N highest-attention path-contexts per method",
     )
+    parser.add_argument(
+        "--neighbors", type=int, default=0, metavar="N",
+        help="also print the N cosine-nearest methods from --code_vec_path",
+    )
+    parser.add_argument(
+        "--code_vec_path", default=None,
+        help="exported code.vec for --neighbors (default: "
+        "<model_path>/code.vec if present)",
+    )
     args = parser.parse_args(argv)
+
+    # resolve/validate the neighbors source BEFORE the expensive model
+    # load, and load the vector file once for all predicted methods
+    neighbor_index = None
+    if args.neighbors:
+        code_vec_path = args.code_vec_path
+        if code_vec_path is None:
+            default = os.path.join(args.model_path, "code.vec")
+            if not os.path.exists(default):
+                parser.error("--neighbors needs --code_vec_path (no "
+                             f"{default} found)")
+            code_vec_path = default
+        from code2vec_tpu.formats.vectors_io import read_code_vectors
+
+        neighbor_index = read_code_vectors(code_vec_path)
 
     predictor = Predictor(
         args.model_path, args.terminal_idx_path, args.path_idx_path
@@ -378,6 +428,11 @@ def main(argv: list[str] | None = None) -> None:
             print(f"  {p.prob:6.3f}  {p.name}")
         for s, pth, e, a in m.attention[: args.show_attention]:
             print(f"    [{a:.3f}] {s} {pth} {e}")
+        if neighbor_index is not None:
+            for name, sim in nearest_from_rows(
+                *neighbor_index, m.code_vector, args.neighbors
+            ):
+                print(f"    ~{sim:.3f}  {name}")
 
 
 if __name__ == "__main__":

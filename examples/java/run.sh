@@ -24,5 +24,15 @@ python "$REPO_ROOT/main.py" \
 # 3. Inspect the exported vectors (one "label\tfloats" row per method).
 head -3 output/code.vec
 echo "---"
+
+# 4. Predict method names for source code from the trained checkpoint
+#    (the inference surface the reference lacks): top-k labels with
+#    probabilities and the highest-attention path-contexts.
+python -m code2vec_tpu.predict src/util/MathUtils.java \
+  --model_path output \
+  --terminal_idx_path dataset/terminal_idxs.txt \
+  --path_idx_path dataset/path_idxs.txt \
+  --top_k 3 --show_attention 1
+echo "---"
 echo "artifacts: dataset/{corpus,terminal_idxs,path_idxs,params}.txt, output/code.vec"
 echo "visualize: python $REPO_ROOT/visualize_code_vec.py --code_vec_path output/code.vec"

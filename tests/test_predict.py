@@ -218,3 +218,44 @@ def test_cli(trained, tmp_path, capsys):
     printed = capsys.readouterr().out
     assert "add" in printed and "contexts" in printed
     assert "[" in printed  # an attention row
+
+
+def test_nearest_neighbors(trained, tmp_path, capsys):
+    from code2vec_tpu.export import export_from_checkpoint
+    from code2vec_tpu.predict import nearest_neighbors
+
+    ds, out = trained
+    vectors = tmp_path / "code.vec"
+    cfg = TrainConfig(
+        max_epoch=1, batch_size=4, encode_size=48, terminal_embed_size=24,
+        path_embed_size=24, max_path_length=64, print_sample_cycle=0,
+    )
+    data = load_corpus(
+        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+    )
+    export_from_checkpoint(cfg, data, str(out), str(vectors))
+
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    (m,) = p.predict_source(JAVA, "add", top_k=1)
+    assert m.code_vector is not None and m.code_vector.ndim == 1
+    nn = nearest_neighbors(str(vectors), m.code_vector, top_k=3)
+    assert len(nn) == 3
+    # 'add' itself was exported; its own vector should rank at the top
+    # with cosine ~1 (same model, same contexts up to per-epoch sampling)
+    assert nn[0][1] > 0.9
+    sims = [s for _, s in nn]
+    assert sims == sorted(sims, reverse=True)
+
+    # CLI path with explicit code.vec
+    f = tmp_path / "Util.java"
+    f.write_text(JAVA)
+    predict_main([
+        str(f),
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--method_name", "add",
+        "--neighbors", "2",
+        "--code_vec_path", str(vectors),
+    ])
+    assert "~" in capsys.readouterr().out
