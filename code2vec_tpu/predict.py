@@ -81,10 +81,18 @@ class MethodPrediction:
 
 
 def nearest_from_rows(
-    labels: list[str], rows: np.ndarray, vector: np.ndarray, top_k: int = 5
+    labels: list[str],
+    rows: np.ndarray,
+    vector: np.ndarray,
+    top_k: int = 5,
+    row_norms: np.ndarray | None = None,
 ) -> list[tuple[str, float]]:
-    """Cosine-nearest rows of a preloaded code.vec matrix to ``vector``."""
-    norms = np.linalg.norm(rows, axis=1) * max(np.linalg.norm(vector), 1e-12)
+    """Cosine-nearest rows of a preloaded code.vec matrix to ``vector``.
+    Pass precomputed ``row_norms`` when querying many vectors so each
+    query is a single matvec."""
+    if row_norms is None:
+        row_norms = np.linalg.norm(rows, axis=1)
+    norms = row_norms * max(np.linalg.norm(vector), 1e-12)
     sims = rows @ vector / np.maximum(norms, 1e-12)
     order = np.argsort(-sims)[:top_k]
     return [(labels[int(i)], float(sims[i])) for i in order]
@@ -223,16 +231,21 @@ class Predictor:
         p = read_params(params_path)
 
         def flag(key: str, default: bool) -> bool:
-            return p.get(key, str(default).lower()).strip() == "true"
+            # the reference writes the typo'd 'nomalize_' keys (kept for
+            # byte parity); tolerate the correct spelling from hand-written
+            # params files too
+            raw = p.get("nomalize_" + key, p.get("normalize_" + key))
+            if raw is None:
+                return default
+            return raw.strip() == "true"
 
         return dict(
             max_length=int(p.get("max_length", 8)),
             max_width=int(p.get("max_width", 3)),
-            # the reference writes (and we keep) the 'nomalize_' spelling
-            normalize_string=flag("nomalize_string_literal", True),
-            normalize_char=flag("nomalize_char_literal", True),
-            normalize_int=flag("nomalize_int_literal", False),
-            normalize_double=flag("nomalize_double_literal", True),
+            normalize_string=flag("string_literal", True),
+            normalize_char=flag("char_literal", True),
+            normalize_int=flag("int_literal", False),
+            normalize_double=flag("double_literal", True),
         )
 
     # ---- vocab mapping ---------------------------------------------------
@@ -392,7 +405,8 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
 
     # resolve/validate the neighbors source BEFORE the expensive model
-    # load, and load the vector file once for all predicted methods
+    # load: file present, dims matching the checkpoint, loaded once with
+    # row norms precomputed so each per-method query is one matvec
     neighbor_index = None
     if args.neighbors:
         code_vec_path = args.code_vec_path
@@ -404,7 +418,18 @@ def main(argv: list[str] | None = None) -> None:
             code_vec_path = default
         from code2vec_tpu.formats.vectors_io import read_code_vectors
 
-        neighbor_index = read_code_vectors(code_vec_path)
+        nn_labels, nn_rows = read_code_vectors(code_vec_path)
+        meta_file = os.path.join(args.model_path, MODEL_META)
+        if os.path.exists(meta_file):
+            with open(meta_file, encoding="utf-8") as f:
+                encode_size = json.load(f).get("encode_size")
+            if encode_size and nn_rows.ndim == 2 and nn_rows.shape[1] != encode_size:
+                parser.error(
+                    f"{code_vec_path} holds {nn_rows.shape[1]}-dim vectors "
+                    f"but the checkpoint's encode_size is {encode_size} — "
+                    "it was exported from a different model"
+                )
+        neighbor_index = (nn_labels, nn_rows, np.linalg.norm(nn_rows, axis=1))
 
     predictor = Predictor(
         args.model_path, args.terminal_idx_path, args.path_idx_path
@@ -429,8 +454,10 @@ def main(argv: list[str] | None = None) -> None:
         for s, pth, e, a in m.attention[: args.show_attention]:
             print(f"    [{a:.3f}] {s} {pth} {e}")
         if neighbor_index is not None:
+            nn_labels, nn_rows, nn_norms = neighbor_index
             for name, sim in nearest_from_rows(
-                *neighbor_index, m.code_vector, args.neighbors
+                nn_labels, nn_rows, m.code_vector, args.neighbors,
+                row_norms=nn_norms,
             ):
                 print(f"    ~{sim:.3f}  {name}")
 
