@@ -220,23 +220,57 @@ def main() -> None:
         mesh = make_mesh(data=data_axis, model=model_axis)
         state = shard_state(mesh, state)
         corpus_placement = NamedSharding(mesh, PartitionSpec())
-    runner = EpochRunner(
-        model_config, class_weights, batch_size, bag, chunk, mesh=mesh
-    )
-    staged = stage_method_corpus(
-        data, np.arange(data.n_items), rng, device=corpus_placement
-    )
-    run_chunk = runner._train_chunk(chunk)
-    n_valid = chunk * batch_size
 
-    def run(state, key):
-        rows = rng.integers(0, data.n_items, n_valid).astype(np.int32)
-        key, sub = jax.random.split(key)
-        state, loss = run_chunk(
-            state, staged.contexts, staged.row_splits, staged.labels,
-            rows, n_valid, sub,
+    # BENCH_SHARD_STAGED=1 (+ BENCH_DATA_AXIS>1): corpus partitioned over
+    # the data axis (per-device HBM ~1/data_axis) with shard_map sampling
+    shard_staged = mesh is not None and os.environ.get(
+        "BENCH_SHARD_STAGED", "0"
+    ).strip().lower() in ("1", "true", "yes", "on")
+    if shard_staged:
+        from code2vec_tpu.train.device_epoch import (
+            ShardedEpochRunner,
+            stage_method_corpus_sharded,
         )
-        return state, loss, key
+
+        runner = ShardedEpochRunner(
+            model_config, class_weights, batch_size, bag, chunk, mesh=mesh
+        )
+        staged = stage_method_corpus_sharded(
+            data, np.arange(data.n_items), rng, mesh
+        )
+        run_chunk = runner._train_chunk(chunk)
+        span = chunk * runner.per_shard
+        valid = np.ones((runner.n_shards, span), np.float32)
+
+        def run(state, key):
+            rows = rng.integers(
+                0, staged.shard_counts[:, None],
+                (runner.n_shards, span),
+            ).astype(np.int32)
+            key, sub = jax.random.split(key)
+            state, loss = run_chunk(
+                state, staged.contexts, staged.row_splits, staged.labels,
+                rows, valid, chunk, sub,
+            )
+            return state, loss, key
+    else:
+        runner = EpochRunner(
+            model_config, class_weights, batch_size, bag, chunk, mesh=mesh
+        )
+        staged = stage_method_corpus(
+            data, np.arange(data.n_items), rng, device=corpus_placement
+        )
+        run_chunk = runner._train_chunk(chunk)
+        n_valid = chunk * batch_size
+
+        def run(state, key):
+            rows = rng.integers(0, data.n_items, n_valid).astype(np.int32)
+            key, sub = jax.random.split(key)
+            state, loss = run_chunk(
+                state, staged.contexts, staged.row_splits, staged.labels,
+                rows, n_valid, sub,
+            )
+            return state, loss, key
 
     key = jax.random.PRNGKey(1)
     for _ in range(max(warmup, 2)):  # chunks, not steps; includes compile
@@ -270,6 +304,7 @@ def main() -> None:
                     "batch": batch_size,
                     "bag": bag,
                     "mesh": None if mesh is None else dict(mesh.shape),
+                    "shard_staged": shard_staged,
                     "final_chunk_loss_sum": float(loss),  # sum over BENCH_CHUNK batch losses
                     "compute_dtype": str(model_config.dtype.__name__ if hasattr(model_config.dtype, "__name__") else model_config.dtype),
                 }

@@ -155,6 +155,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "host RSS at java-large scale; 0 = materialize)")
     parser.add_argument("--device_chunk_batches", type=int, default=16,
                         help="batches per device-epoch dispatch")
+    parser.add_argument("--shard_staged_corpus", action="store_true",
+                        default=False,
+                        help="partition the staged train corpus over the "
+                        "data axis instead of replicating it (per-device "
+                        "HBM ~1/data_axis; method task, ctx_axis 1)")
     parser.add_argument("--class_weighting", type=str, default="reference",
                         choices=("reference", "occurrence", "none"))
     parser.add_argument("--no_corpus_cache", action="store_true", default=False,
@@ -218,6 +223,7 @@ def config_from_args(args: argparse.Namespace):
         resume=args.resume,
         checkpoint_cycle=args.checkpoint_cycle,
         device_epoch=args.device_epoch,
+        shard_staged_corpus=args.shard_staged_corpus,
         stream_chunk_items=args.stream_chunk_items,
         device_chunk_batches=args.device_chunk_batches,
     )

@@ -250,6 +250,97 @@ def place_staged(staged: StagedCorpus, device: Any | None = None) -> StagedCorpu
     )
 
 
+@dataclass
+class ShardedStagedCorpus:
+    """Train corpus partitioned over the ``data`` mesh axis: device HBM per
+    shard is ~1/D of the replicated staging, the designed scaling path for
+    corpora that don't fit one device (ARCHITECTURE.md "memory budget").
+
+    Each data shard holds its own CSR block, padded to the uniform
+    ``[D, ctx_cap, 3]`` / ``[D, items_cap(+1)]`` shapes GSPMD needs; the
+    sampler runs under ``shard_map`` so every device gathers only from its
+    local block — sampling adds no cross-device traffic. Batches come out
+    stratified-by-shard (each shard contributes ``B/D`` rows), the same
+    DDP semantics as host-sharded multi-host feeding.
+    """
+
+    contexts: jax.Array  # int32 [D, ctx_cap, 3], sharded P("data") on axis 0
+    row_splits: jax.Array  # int32 [D, items_cap + 1]
+    labels: jax.Array  # int32 [D, items_cap]
+    n_items: int  # total real items across shards
+    shard_counts: np.ndarray  # int64 [D] real items per shard (host)
+    items_cap: int  # padded per-shard row count
+    total_contexts: int  # real (unpadded) context count across shards
+
+    @property
+    def n_contexts(self) -> int:
+        return self.total_contexts
+
+
+def partition_items_balanced(
+    counts: np.ndarray, n_shards: int
+) -> list[np.ndarray]:
+    """Deal item positions to shards in a snake over descending context
+    counts. Two balance criteria matter and this hits both: per-shard ITEM
+    counts are equal ±1 (the largest shard's item count sets the epoch's
+    step count — an item-imbalanced partition would pad every other shard
+    with masked batches), and per-shard CONTEXT loads stay close (the
+    uniform ``ctx_cap`` padding cost). Vectorized O(n log n)."""
+    n = len(counts)
+    order = np.argsort(-np.asarray(counts), kind="stable")
+    pos_in_round = np.arange(n) % (2 * n_shards)
+    shard = np.where(
+        pos_in_round < n_shards, pos_in_round, 2 * n_shards - 1 - pos_in_round
+    )
+    return [np.sort(order[shard == s]).astype(np.int64) for s in range(n_shards)]
+
+
+def stage_method_corpus_sharded(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    rng: np.random.Generator,
+    mesh,
+) -> ShardedStagedCorpus:
+    """Stage the method-task train corpus sharded over the mesh's ``data``
+    axis. Reuses :func:`stage_method_corpus` per shard (host mode), then
+    pads to uniform shapes and places with a ``P("data")`` sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_shards = mesh.shape["data"]
+    counts = np.diff(data.row_splits)[item_idx]
+    groups = partition_items_balanced(counts, n_shards)
+
+    parts = [
+        stage_method_corpus(data, item_idx[g], rng, device="host")
+        for g in groups
+    ]
+    items_cap = max(p.n_items for p in parts)
+    ctx_cap = max(int(p.contexts.shape[0]) for p in parts)
+
+    contexts = np.zeros((n_shards, ctx_cap, 3), np.int32)
+    row_splits = np.zeros((n_shards, items_cap + 1), np.int32)
+    labels = np.zeros((n_shards, items_cap), np.int32)
+    for s, p in enumerate(parts):
+        contexts[s, : p.contexts.shape[0]] = p.contexts
+        rs = np.asarray(p.row_splits)
+        row_splits[s, : len(rs)] = rs
+        row_splits[s, len(rs):] = rs[-1]  # pad rows are empty (n = 0)
+        labels[s, : p.n_items] = p.labels
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return ShardedStagedCorpus(
+        contexts=put(contexts, P("data", None, None)),
+        row_splits=put(row_splits, P("data", None)),
+        labels=put(labels, P("data", None)),
+        n_items=len(item_idx),
+        shard_counts=np.asarray([p.n_items for p in parts], np.int64),
+        items_cap=items_cap,
+        total_contexts=sum(int(p.contexts.shape[0]) for p in parts),
+    )
+
+
 def _sample_batch(
     corpus_contexts: jax.Array,  # [total, 3]
     row_splits: jax.Array,  # [n_items + 1]
@@ -486,6 +577,8 @@ class EpochRunner:
             n_batches += nb
         return state, float(np.sum(jax.device_get(chunk_losses))), n_batches
 
+    # (ShardedEpochRunner below handles the data-axis-sharded staging)
+
     def run_eval_epoch(
         self,
         state,
@@ -514,3 +607,146 @@ class EpochRunner:
             np.concatenate(preds) if preds else np.zeros(0, np.int64),
             np.concatenate(max_logits) if max_logits else np.zeros(0, np.float32),
         )
+
+
+class ShardedEpochRunner:
+    """Scanned train epochs over a :class:`ShardedStagedCorpus`.
+
+    The corpus lives partitioned over the ``data`` axis; batch assembly
+    runs under ``shard_map`` so each device gathers exactly ``B/D`` rows
+    from its OWN corpus block — per-device HBM is ~1/D of replicated
+    staging and sampling adds no cross-device traffic. The assembled
+    global batch (batch dim sharded over ``data``) then feeds the same raw
+    train step as everywhere else; XLA inserts the gradient all-reduce.
+
+    Sampling semantics: stratified-by-shard (each shard draws from its own
+    item partition every batch) — the same DDP sampling the host-sharded
+    multi-host feed uses, vs the replicated runner's global shuffle.
+    Method task only (the variable-task remap would need the remap tables
+    per shard; use replicated staging or the host pipeline for it).
+    """
+
+    def __init__(
+        self,
+        model_config: Code2VecConfig,
+        class_weights: jnp.ndarray,
+        batch_size: int,
+        bag: int,
+        chunk_batches: int = 16,
+        mesh=None,
+    ):
+        if mesh is None:
+            raise ValueError("ShardedEpochRunner needs a mesh")
+        if mesh.shape.get("ctx", 1) > 1:
+            raise ValueError(
+                "sharded corpus staging composes with data/model axes; a "
+                "ctx-sharded bag needs replicated staging or the host "
+                "pipeline"
+            )
+        self.n_shards = int(mesh.shape["data"])
+        if batch_size % self.n_shards:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by data axis "
+                f"{self.n_shards}"
+            )
+        self.per_shard = batch_size // self.n_shards
+        self.bag = bag
+        self.chunk_batches = chunk_batches
+        self.mesh = mesh
+        self._raw_train = build_train_step_fn(model_config, class_weights)
+        self._train_chunks: dict[int, Callable] = {}
+
+    def _train_chunk(self, n_batches: int) -> Callable:
+        if n_batches not in self._train_chunks:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            per_shard, bag, mesh = self.per_shard, self.bag, self.mesh
+
+            def sample_shard(contexts, row_splits, labels, rows, valid, key):
+                # blocks carry a leading shard axis of length 1
+                k = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                return _sample_batch(
+                    contexts[0], row_splits[0], labels[0],
+                    rows[0], valid[0], bag, k,
+                )
+
+            batch_specs = {
+                "starts": P("data", None),
+                "paths": P("data", None),
+                "ends": P("data", None),
+                "labels": P("data"),
+                "example_mask": P("data"),
+            }
+            sampler = shard_map(
+                sample_shard,
+                mesh=mesh,
+                in_specs=(P("data"), P("data"), P("data"),
+                          P("data"), P("data"), P()),
+                out_specs=batch_specs,
+            )
+
+            @partial(jax.jit, donate_argnums=(0,), static_argnums=(6,))
+            def run(state, contexts, row_splits, labels, perm_rows,
+                    perm_valid, n_batches_, key):
+                def body(carry, i):
+                    state, key = carry
+                    key, sample_key = jax.random.split(key)
+                    sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, i * per_shard, per_shard, 1
+                    )
+                    batch = sampler(
+                        contexts, row_splits, labels,
+                        sl(perm_rows), sl(perm_valid), sample_key,
+                    )
+                    state, loss = self._raw_train(state, batch)
+                    return (state, key), loss
+
+                (state, _), losses = jax.lax.scan(
+                    body, (state, key), jnp.arange(n_batches_)
+                )
+                return state, jnp.sum(losses)
+
+            self._train_chunks[n_batches] = run
+        return self._train_chunks[n_batches]
+
+    def run_train_epoch(
+        self,
+        state,
+        corpus: ShardedStagedCorpus,
+        rng: np.random.Generator,
+        key: jax.Array,
+    ) -> tuple[Any, float, int]:
+        """One stratified training epoch; returns (state, loss sum,
+        n_batches). Epoch length covers the LARGEST shard; smaller shards
+        pad with masked repeats at the tail (same masking rule as
+        ``iter_batches``)."""
+        D, per_shard = self.n_shards, self.per_shard
+        counts = corpus.shard_counts
+        orders = [rng.permutation(int(c)) for c in counts]
+        nb_total = max(-(-int(counts.max()) // per_shard), 1)
+
+        chunk_losses = []
+        n_batches = 0
+        lo = 0
+        while lo < nb_total:
+            nb = min(self.chunk_batches, nb_total - lo)
+            span = nb * per_shard
+            rows = np.zeros((D, span), np.int32)
+            valid = np.zeros((D, span), np.float32)
+            for s in range(D):
+                start = lo * per_shard
+                take = orders[s][start : start + span]
+                rows[s, : len(take)] = take
+                if len(take) < span:
+                    rows[s, len(take):] = orders[s][0] if len(orders[s]) else 0
+                valid[s, : max(min(int(counts[s]) - start, span), 0)] = 1.0
+            key, chunk_key = jax.random.split(key)
+            state, loss = self._train_chunk(nb)(
+                state, corpus.contexts, corpus.row_splits, corpus.labels,
+                rows, valid, nb, chunk_key,
+            )
+            chunk_losses.append(loss)
+            n_batches += nb
+            lo += nb
+        return state, float(np.sum(jax.device_get(chunk_losses))), n_batches

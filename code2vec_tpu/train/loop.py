@@ -321,14 +321,22 @@ def train(
     # variable expansion is corpus-static, so it stages as rows; the
     # per-epoch @var remap runs on device), single process; multi-host
     # falls back to the host pipeline.
+    if config.shard_staged_corpus and not config.device_epoch:
+        raise ValueError(
+            "--shard_staged_corpus shards the device-staged corpus; it "
+            "requires --device_epoch"
+        )
     device_runner = None
+    sharded_train_runner = None  # (ShardedEpochRunner, ShardedStagedCorpus)
     if config.device_epoch:
         if jax.process_count() == 1:
             from code2vec_tpu.train.device_epoch import (
                 EpochRunner,
+                ShardedEpochRunner,
                 concat_staged,
                 place_staged,
                 stage_method_corpus,
+                stage_method_corpus_sharded,
                 stage_variable_corpus,
             )
 
@@ -363,13 +371,43 @@ def train(
                     staged = concat_staged(staged, p)
                 return place_staged(staged, device=corpus_placement)
 
-            staged_train = stage(train_idx)
+            if config.shard_staged_corpus:
+                # train corpus partitioned over `data` (per-device HBM
+                # ~1/data_axis); the small test staging stays replicated
+                # so eval keeps exact row-order predictions
+                if mesh is None:
+                    raise ValueError(
+                        "--shard_staged_corpus needs mesh axes "
+                        "(--data_axis > 1)"
+                    )
+                if data.infer_variable:
+                    raise ValueError(
+                        "--shard_staged_corpus supports the method task "
+                        "only; use replicated staging (default) or the "
+                        "host pipeline for infer_variable runs"
+                    )
+                sharded_train_runner = (
+                    ShardedEpochRunner(
+                        model_config,
+                        class_weights,
+                        config.batch_size,
+                        config.max_path_length,
+                        config.device_chunk_batches,
+                        mesh=mesh,
+                    ),
+                    stage_method_corpus_sharded(data, train_idx, np_rng, mesh),
+                )
+                staged_train = None
+            else:
+                staged_train = stage(train_idx)
             staged_test = stage(test_idx)
             logger.info(
                 "device epochs: staged %d train / %d test contexts to %s",
-                staged_train.n_contexts,
+                sharded_train_runner[1].n_contexts
+                if sharded_train_runner
+                else staged_train.n_contexts,
                 staged_test.n_contexts,
-                staged_train.contexts.devices(),
+                staged_test.contexts.devices(),
             )
         else:
             logger.warning(
@@ -410,9 +448,15 @@ def train(
             test_epoch = None
             if device_runner is not None:
                 jax_rng, train_key, eval_key = jax.random.split(jax_rng, 3)
-                state, train_loss, _ = device_runner.run_train_epoch(
-                    state, staged_train, np_rng, train_key
-                )
+                if sharded_train_runner is not None:
+                    runner, staged = sharded_train_runner
+                    state, train_loss, _ = runner.run_train_epoch(
+                        state, staged, np_rng, train_key
+                    )
+                else:
+                    state, train_loss, _ = device_runner.run_train_epoch(
+                        state, staged_train, np_rng, train_key
+                    )
                 test_loss, preds, _ = device_runner.run_eval_epoch(
                     state, staged_test, eval_key
                 )
