@@ -243,14 +243,16 @@ def main() -> None:
         valid = np.ones((runner.n_shards, span), np.float32)
 
         def run(state, key):
+            # max(counts, 1): an empty shard (n_items < data_axis) still
+            # needs a valid row bound; its rows are all-PAD row 0
             rows = rng.integers(
-                0, staged.shard_counts[:, None],
+                0, np.maximum(staged.shard_counts[:, None], 1),
                 (runner.n_shards, span),
             ).astype(np.int32)
             key, sub = jax.random.split(key)
             state, loss = run_chunk(
                 state, staged.contexts, staged.row_splits, staged.labels,
-                rows, valid, chunk, sub,
+                rows, valid, sub,
             )
             return state, loss, key
     else:

@@ -159,7 +159,8 @@ def build_parser() -> argparse.ArgumentParser:
                         default=False,
                         help="partition the staged train corpus over the "
                         "data axis instead of replicating it (per-device "
-                        "HBM ~1/data_axis; method task, ctx_axis 1)")
+                        "HBM ~1/data_axis; method and/or variable task, "
+                        "ctx_axis 1)")
     parser.add_argument("--class_weighting", type=str, default="reference",
                         choices=("reference", "occurrence", "none"))
     parser.add_argument("--no_corpus_cache", action="store_true", default=False,

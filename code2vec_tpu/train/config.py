@@ -88,7 +88,7 @@ class TrainConfig:
     device_chunk_batches: int = 16
     # shard the staged TRAIN corpus over the data axis instead of
     # replicating it (per-device HBM ~1/data_axis; stratified-by-shard
-    # sampling via shard_map). Method task, ctx_axis == 1.
+    # sampling via shard_map). Method and/or variable task; ctx_axis == 1.
     shard_staged_corpus: bool = False
 
     def with_updates(self, **kw) -> "TrainConfig":

@@ -271,6 +271,9 @@ class ShardedStagedCorpus:
     shard_counts: np.ndarray  # int64 [D] real items per shard (host)
     items_cap: int  # padded per-shard row count
     total_contexts: int  # real (unpadded) context count across shards
+    # variable-task remap (see StagedCorpus): ids replicated, flags sharded
+    remap_ids: jax.Array | None = None  # int32 [V]
+    remap_flags: jax.Array | None = None  # int32 [D, items_cap]
 
     @property
     def n_contexts(self) -> int:
@@ -295,49 +298,75 @@ def partition_items_balanced(
     return [np.sort(order[shard == s]).astype(np.int64) for s in range(n_shards)]
 
 
+def shard_staged(staged: StagedCorpus, mesh) -> ShardedStagedCorpus:
+    """Partition a HOST-staged corpus (method, variable, or concat — any
+    :class:`StagedCorpus` still holding numpy arrays, i.e. staged with
+    ``device="host"``) over the mesh's ``data`` axis: snake-dealt row
+    partition, per-shard CSR blocks padded to uniform shapes, placed with
+    ``P("data")`` shardings (remap ids replicated)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_shards = mesh.shape["data"]
+    ctx_all = np.asarray(staged.contexts)
+    rs_all = np.asarray(staged.row_splits).astype(np.int64)
+    labels_all = np.asarray(staged.labels)
+    flags_all = (
+        None if staged.remap_flags is None else np.asarray(staged.remap_flags)
+    )
+    counts = np.diff(rs_all)
+    groups = partition_items_balanced(counts, n_shards)
+
+    items_cap = max((len(g) for g in groups), default=1)
+    ctx_cap = max((int(counts[g].sum()) for g in groups), default=1)
+    items_cap, ctx_cap = max(items_cap, 1), max(ctx_cap, 1)
+
+    contexts = np.zeros((n_shards, ctx_cap, 3), np.int32)
+    row_splits = np.zeros((n_shards, items_cap + 1), np.int32)
+    labels = np.zeros((n_shards, items_cap), np.int32)
+    flags = np.zeros((n_shards, items_cap), np.int32)
+    for s, g in enumerate(groups):
+        flat, _, _ = flat_context_indices(rs_all, g)
+        block = ctx_all[flat]
+        contexts[s, : block.shape[0]] = block
+        splits = np.zeros(len(g) + 1, np.int64)
+        np.cumsum(counts[g], out=splits[1:])
+        row_splits[s, : len(splits)] = splits
+        row_splits[s, len(splits):] = splits[-1]  # pad rows are empty
+        labels[s, : len(g)] = labels_all[g]
+        if flags_all is not None:
+            flags[s, : len(g)] = flags_all[g]
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    has_remap = staged.remap_ids is not None and len(
+        np.asarray(staged.remap_ids)
+    ) > 0
+    return ShardedStagedCorpus(
+        contexts=put(contexts, P("data", None, None)),
+        row_splits=put(row_splits, P("data", None)),
+        labels=put(labels, P("data", None)),
+        n_items=staged.n_items,
+        shard_counts=np.asarray([len(g) for g in groups], np.int64),
+        items_cap=items_cap,
+        total_contexts=int(counts.sum()),
+        remap_ids=(
+            put(np.asarray(staged.remap_ids, np.int32), P())
+            if has_remap else None
+        ),
+        remap_flags=put(flags, P("data", None)) if has_remap else None,
+    )
+
+
 def stage_method_corpus_sharded(
     data: CorpusData,
     item_idx: np.ndarray,
     rng: np.random.Generator,
     mesh,
 ) -> ShardedStagedCorpus:
-    """Stage the method-task train corpus sharded over the mesh's ``data``
-    axis. Reuses :func:`stage_method_corpus` per shard (host mode), then
-    pads to uniform shapes and places with a ``P("data")`` sharding."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    n_shards = mesh.shape["data"]
-    counts = np.diff(data.row_splits)[item_idx]
-    groups = partition_items_balanced(counts, n_shards)
-
-    parts = [
-        stage_method_corpus(data, item_idx[g], rng, device="host")
-        for g in groups
-    ]
-    items_cap = max(p.n_items for p in parts)
-    ctx_cap = max(int(p.contexts.shape[0]) for p in parts)
-
-    contexts = np.zeros((n_shards, ctx_cap, 3), np.int32)
-    row_splits = np.zeros((n_shards, items_cap + 1), np.int32)
-    labels = np.zeros((n_shards, items_cap), np.int32)
-    for s, p in enumerate(parts):
-        contexts[s, : p.contexts.shape[0]] = p.contexts
-        rs = np.asarray(p.row_splits)
-        row_splits[s, : len(rs)] = rs
-        row_splits[s, len(rs):] = rs[-1]  # pad rows are empty (n = 0)
-        labels[s, : p.n_items] = p.labels
-
-    def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    return ShardedStagedCorpus(
-        contexts=put(contexts, P("data", None, None)),
-        row_splits=put(row_splits, P("data", None)),
-        labels=put(labels, P("data", None)),
-        n_items=len(item_idx),
-        shard_counts=np.asarray([p.n_items for p in parts], np.int64),
-        items_cap=items_cap,
-        total_contexts=sum(int(p.contexts.shape[0]) for p in parts),
+    """Method-task convenience wrapper: host staging + :func:`shard_staged`."""
+    return shard_staged(
+        stage_method_corpus(data, item_idx, rng, device="host"), mesh
     )
 
 
@@ -622,8 +651,8 @@ class ShardedEpochRunner:
     Sampling semantics: stratified-by-shard (each shard draws from its own
     item partition every batch) — the same DDP sampling the host-sharded
     multi-host feed uses, vs the replicated runner's global shuffle.
-    Method task only (the variable-task remap would need the remap tables
-    per shard; use replicated staging or the host pipeline for it).
+    Method and/or variable task (remap ids replicated, flags sharded with
+    the rows); ``ctx_axis`` must be 1.
     """
 
     def __init__(
@@ -634,9 +663,11 @@ class ShardedEpochRunner:
         bag: int,
         chunk_batches: int = 16,
         mesh=None,
+        shuffle_variable_ids: bool = False,
     ):
         if mesh is None:
             raise ValueError("ShardedEpochRunner needs a mesh")
+        self.shuffle_variable_ids = shuffle_variable_ids
         if mesh.shape.get("ctx", 1) > 1:
             raise ValueError(
                 "sharded corpus staging composes with data/model axes; a "
@@ -663,12 +694,14 @@ class ShardedEpochRunner:
 
             per_shard, bag, mesh = self.per_shard, self.bag, self.mesh
 
-            def sample_shard(contexts, row_splits, labels, rows, valid, key):
+            def sample_shard(contexts, row_splits, labels, rows, valid, key,
+                             remap_ids, remap_flags):
                 # blocks carry a leading shard axis of length 1
                 k = jax.random.fold_in(key, jax.lax.axis_index("data"))
                 return _sample_batch(
                     contexts[0], row_splits[0], labels[0],
                     rows[0], valid[0], bag, k,
+                    remap_ids, remap_flags[0],
                 )
 
             batch_specs = {
@@ -682,13 +715,21 @@ class ShardedEpochRunner:
                 sample_shard,
                 mesh=mesh,
                 in_specs=(P("data"), P("data"), P("data"),
-                          P("data"), P("data"), P()),
+                          P("data"), P("data"), P(), P(), P("data")),
                 out_specs=batch_specs,
             )
 
-            @partial(jax.jit, donate_argnums=(0,), static_argnums=(6,))
+            @partial(jax.jit, donate_argnums=(0,))
             def run(state, contexts, row_splits, labels, perm_rows,
-                    perm_valid, n_batches_, key):
+                    perm_valid, key, remap_ids=None, remap_flags=None):
+                if remap_ids is None:  # trace-time: remap compiled out
+                    remap_ids = jnp.zeros(0, jnp.int32)
+                if remap_flags is None:
+                    remap_flags = jnp.zeros(
+                        (row_splits.shape[0], row_splits.shape[1] - 1),
+                        jnp.int32,
+                    )
+
                 def body(carry, i):
                     state, key = carry
                     key, sample_key = jax.random.split(key)
@@ -698,12 +739,13 @@ class ShardedEpochRunner:
                     batch = sampler(
                         contexts, row_splits, labels,
                         sl(perm_rows), sl(perm_valid), sample_key,
+                        remap_ids, remap_flags,
                     )
                     state, loss = self._raw_train(state, batch)
                     return (state, key), loss
 
                 (state, _), losses = jax.lax.scan(
-                    body, (state, key), jnp.arange(n_batches_)
+                    body, (state, key), jnp.arange(n_batches)
                 )
                 return state, jnp.sum(losses)
 
@@ -725,6 +767,11 @@ class ShardedEpochRunner:
         counts = corpus.shard_counts
         orders = [rng.permutation(int(c)) for c in counts]
         nb_total = max(-(-int(counts.max()) // per_shard), 1)
+        use_remap = (
+            self.shuffle_variable_ids and corpus.remap_ids is not None
+        )
+        remap_ids = corpus.remap_ids if use_remap else None
+        remap_flags = corpus.remap_flags if use_remap else None
 
         chunk_losses = []
         n_batches = 0
@@ -744,7 +791,7 @@ class ShardedEpochRunner:
             key, chunk_key = jax.random.split(key)
             state, loss = self._train_chunk(nb)(
                 state, corpus.contexts, corpus.row_splits, corpus.labels,
-                rows, valid, nb, chunk_key,
+                rows, valid, chunk_key, remap_ids, remap_flags,
             )
             chunk_losses.append(loss)
             n_batches += nb

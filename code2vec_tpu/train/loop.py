@@ -335,8 +335,8 @@ def train(
                 ShardedEpochRunner,
                 concat_staged,
                 place_staged,
+                shard_staged,
                 stage_method_corpus,
-                stage_method_corpus_sharded,
                 stage_variable_corpus,
             )
 
@@ -355,7 +355,7 @@ def train(
 
                 corpus_placement = NamedSharding(mesh, PartitionSpec())
 
-            def stage(item_idx):
+            def stage_host(item_idx):
                 # parts stay host-side; ONE device transfer at the end
                 parts = []
                 if data.infer_method:
@@ -369,7 +369,10 @@ def train(
                 staged = parts[0]
                 for p in parts[1:]:
                     staged = concat_staged(staged, p)
-                return place_staged(staged, device=corpus_placement)
+                return staged
+
+            def stage(item_idx):
+                return place_staged(stage_host(item_idx), device=corpus_placement)
 
             if config.shard_staged_corpus:
                 # train corpus partitioned over `data` (per-device HBM
@@ -380,12 +383,6 @@ def train(
                         "--shard_staged_corpus needs mesh axes "
                         "(--data_axis > 1)"
                     )
-                if data.infer_variable:
-                    raise ValueError(
-                        "--shard_staged_corpus supports the method task "
-                        "only; use replicated staging (default) or the "
-                        "host pipeline for infer_variable runs"
-                    )
                 sharded_train_runner = (
                     ShardedEpochRunner(
                         model_config,
@@ -394,8 +391,9 @@ def train(
                         config.max_path_length,
                         config.device_chunk_batches,
                         mesh=mesh,
+                        shuffle_variable_ids=config.shuffle_variable_indexes,
                     ),
-                    stage_method_corpus_sharded(data, train_idx, np_rng, mesh),
+                    shard_staged(stage_host(train_idx), mesh),
                 )
                 staged_train = None
             else:

@@ -734,6 +734,33 @@ class TestShardedStaging:
         with pytest.raises(ValueError, match="shard_staged_corpus needs"):
             train(cfg, data)
 
+    def test_train_loop_shard_staged_variable_task(self, tiny):
+        # the variable task shards too: remap ids replicated, flags
+        # partitioned with the rows, per-epoch @var remap on device
+        paths, _ = tiny
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            infer_method=False, infer_variable=True, cache=False,
+        )
+        cfg = TrainConfig(
+            max_epoch=2,
+            batch_size=16,
+            encode_size=32,
+            terminal_embed_size=16,
+            path_embed_size=16,
+            max_path_length=16,
+            print_sample_cycle=0,
+            device_epoch=True,
+            shard_staged_corpus=True,
+            data_axis=4,
+            infer_method_name=False,
+            infer_variable_name=True,
+            shuffle_variable_indexes=True,
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+        assert res.final_f1 > 0.0
+
     def test_shard_staged_requires_device_epoch(self, tiny):
         # without --device_epoch the flag would otherwise be silently
         # ignored (the HBM reduction the user asked for never happens)
