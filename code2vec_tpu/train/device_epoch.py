@@ -279,6 +279,14 @@ class ShardedStagedCorpus:
     def n_contexts(self) -> int:
         return self.total_contexts
 
+    def flat_labels(self) -> np.ndarray:
+        """Valid labels in shard-concatenation order — the ``expected``
+        array matching ``ShardedEpochRunner.run_eval_epoch``'s preds."""
+        lab = np.asarray(self.labels)
+        return np.concatenate(
+            [lab[s, : int(c)] for s, c in enumerate(self.shard_counts)]
+        )
+
 
 def partition_items_balanced(
     counts: np.ndarray, n_shards: int
@@ -685,14 +693,20 @@ class ShardedEpochRunner:
         self.chunk_batches = chunk_batches
         self.mesh = mesh
         self._raw_train = build_train_step_fn(model_config, class_weights)
+        self._raw_eval = build_eval_step_fn(model_config, class_weights)
         self._train_chunks: dict[int, Callable] = {}
+        self._eval_chunks: dict[int, Callable] = {}
+        self._sampler_cache = None
 
-    def _train_chunk(self, n_batches: int) -> Callable:
-        if n_batches not in self._train_chunks:
+    def _sampler(self) -> Callable:
+        """The shard_map batch assembler (independent of chunk length):
+        each shard's block samples its own rows, outputs concatenate over
+        the data axis into the global [B, bag] batch."""
+        if self._sampler_cache is None:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            per_shard, bag, mesh = self.per_shard, self.bag, self.mesh
+            bag, mesh = self.bag, self.mesh
 
             def sample_shard(contexts, row_splits, labels, rows, valid, key,
                              remap_ids, remap_flags):
@@ -711,13 +725,19 @@ class ShardedEpochRunner:
                 "labels": P("data"),
                 "example_mask": P("data"),
             }
-            sampler = shard_map(
+            self._sampler_cache = shard_map(
                 sample_shard,
                 mesh=mesh,
                 in_specs=(P("data"), P("data"), P("data"),
                           P("data"), P("data"), P(), P(), P("data")),
                 out_specs=batch_specs,
             )
+        return self._sampler_cache
+
+    def _train_chunk(self, n_batches: int) -> Callable:
+        if n_batches not in self._train_chunks:
+            per_shard = self.per_shard
+            sampler = self._sampler()
 
             @partial(jax.jit, donate_argnums=(0,))
             def run(state, contexts, row_splits, labels, perm_rows,
@@ -751,6 +771,99 @@ class ShardedEpochRunner:
 
             self._train_chunks[n_batches] = run
         return self._train_chunks[n_batches]
+
+    def _eval_chunk(self, n_batches: int) -> Callable:
+        if n_batches not in self._eval_chunks:
+            sampler = self._sampler()
+            per_shard = self.per_shard
+
+            @jax.jit
+            def run(state, contexts, row_splits, labels, perm_rows,
+                    perm_valid, key, remap_ids=None, remap_flags=None):
+                if remap_ids is None:
+                    remap_ids = jnp.zeros(0, jnp.int32)
+                if remap_flags is None:
+                    remap_flags = jnp.zeros(
+                        (row_splits.shape[0], row_splits.shape[1] - 1),
+                        jnp.int32,
+                    )
+
+                def body(key, i):
+                    key, sample_key = jax.random.split(key)
+                    sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, i * per_shard, per_shard, 1
+                    )
+                    batch = sampler(
+                        contexts, row_splits, labels,
+                        sl(perm_rows), sl(perm_valid), sample_key,
+                        remap_ids, remap_flags,
+                    )
+                    out = self._raw_eval(state, batch)
+                    return key, (out["loss"], out["preds"], out["max_logit"])
+
+                _, (losses, preds, max_logits) = jax.lax.scan(
+                    body, key, jnp.arange(n_batches)
+                )
+                return jnp.sum(losses), preds, max_logits  # [nb, B] each
+
+            self._eval_chunks[n_batches] = run
+        return self._eval_chunks[n_batches]
+
+    def run_eval_epoch(
+        self,
+        state,
+        corpus: ShardedStagedCorpus,
+        key: jax.Array,
+    ) -> tuple[float, np.ndarray, np.ndarray]:
+        """One eval pass, each shard in its natural row order. Returns
+        (summed per-batch mean loss, preds, max_logits) where preds align
+        with ``corpus.flat_labels()`` (shard-concatenation order)."""
+        D, per_shard = self.n_shards, self.per_shard
+        counts = corpus.shard_counts
+        nb_total = max(-(-int(counts.max()) // per_shard), 1)
+        # same remap gating as training: the replicated runner and the host
+        # pipeline both apply the per-epoch @var remap at eval too
+        use_remap = self.shuffle_variable_ids and corpus.remap_ids is not None
+        remap_ids = corpus.remap_ids if use_remap else None
+        remap_flags = corpus.remap_flags if use_remap else None
+
+        total_loss = 0.0
+        shard_preds: list[list[np.ndarray]] = [[] for _ in range(D)]
+        shard_logits: list[list[np.ndarray]] = [[] for _ in range(D)]
+        lo = 0
+        while lo < nb_total:
+            nb = min(self.chunk_batches, nb_total - lo)
+            span = nb * per_shard
+            rows = np.zeros((D, span), np.int32)
+            valid = np.zeros((D, span), np.float32)
+            for s in range(D):
+                start = lo * per_shard
+                take = np.arange(start, min(start + span, int(counts[s])))
+                rows[s, : len(take)] = take
+                valid[s, : len(take)] = 1.0
+            key, chunk_key = jax.random.split(key)
+            loss, p, ml = self._eval_chunk(nb)(
+                state, corpus.contexts, corpus.row_splits, corpus.labels,
+                rows, valid, chunk_key, remap_ids, remap_flags,
+            )
+            total_loss += float(loss)
+            p = np.asarray(p).reshape(nb, D, per_shard)
+            ml = np.asarray(ml).reshape(nb, D, per_shard)
+            for s in range(D):
+                remaining = int(counts[s]) - lo * per_shard
+                for i in range(nb):
+                    take = min(max(remaining - i * per_shard, 0), per_shard)
+                    if take:
+                        shard_preds[s].append(p[i, s, :take])
+                        shard_logits[s].append(ml[i, s, :take])
+            lo += nb
+        preds = np.concatenate(
+            [np.concatenate(x) if x else np.zeros(0, np.int64) for x in shard_preds]
+        )
+        max_logits = np.concatenate(
+            [np.concatenate(x) if x else np.zeros(0, np.float32) for x in shard_logits]
+        )
+        return total_loss, preds, max_logits
 
     def run_train_epoch(
         self,

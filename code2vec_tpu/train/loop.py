@@ -375,9 +375,9 @@ def train(
                 return place_staged(stage_host(item_idx), device=corpus_placement)
 
             if config.shard_staged_corpus:
-                # train corpus partitioned over `data` (per-device HBM
-                # ~1/data_axis); the small test staging stays replicated
-                # so eval keeps exact row-order predictions
+                # train AND test corpora partitioned over `data` (per-
+                # device HBM ~1/data_axis); eval preds come back in
+                # shard-concatenation order, aligned with flat_labels()
                 if mesh is None:
                     raise ValueError(
                         "--shard_staged_corpus needs mesh axes "
@@ -396,9 +396,16 @@ def train(
                     shard_staged(stage_host(train_idx), mesh),
                 )
                 staged_train = None
+                # the test split shards too (it's 20% of the corpus — at
+                # the scales this flag targets, replicating it would undo
+                # much of the HBM win)
+                staged_test = shard_staged(stage_host(test_idx), mesh)
+                # static for the run: fetch the shard-order labels once,
+                # not once per epoch
+                sharded_test_expected = staged_test.flat_labels()
             else:
                 staged_train = stage(train_idx)
-            staged_test = stage(test_idx)
+                staged_test = stage(test_idx)
             logger.info(
                 "device epochs: staged %d train / %d test contexts to %s",
                 sharded_train_runner[1].n_contexts
@@ -451,20 +458,22 @@ def train(
                     state, train_loss, _ = runner.run_train_epoch(
                         state, staged, np_rng, train_key
                     )
+                    test_loss, preds, _ = runner.run_eval_epoch(
+                        state, staged_test, eval_key
+                    )
+                    expected = sharded_test_expected
                 else:
                     state, train_loss, _ = device_runner.run_train_epoch(
                         state, staged_train, np_rng, train_key
                     )
-                test_loss, preds, _ = device_runner.run_eval_epoch(
-                    state, staged_test, eval_key
-                )
+                    test_loss, preds, _ = device_runner.run_eval_epoch(
+                        state, staged_test, eval_key
+                    )
+                    # staged labels: per-EXAMPLE (one per @var alias in
+                    # the variable task), not per-item
+                    expected = np.asarray(staged_test.labels)
                 accuracy, precision, recall, f1 = evaluate(
-                    config.eval_method,
-                    # staged labels: per-EXAMPLE (one per @var alias in the
-                    # variable task), not per-item
-                    np.asarray(staged_test.labels),
-                    preds,
-                    data.label_vocab,
+                    config.eval_method, expected, preds, data.label_vocab
                 )
             elif config.stream_chunk_items:
                 # streaming epochs: java-large-scale corpora (BASELINE

@@ -771,3 +771,53 @@ class TestShardedStaging:
         )
         with pytest.raises(ValueError, match="requires --device_epoch"):
             train(cfg, data)
+
+    def test_sharded_eval_matches_replicated_multiset(self, tiny):
+        # bag >= every method's context count makes eval deterministic
+        # (sampling takes everything; pooling is permutation-invariant), so
+        # the (label, pred) pair multiset must match the replicated
+        # runner's exactly, just in shard-concatenation order
+        from collections import Counter
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_state
+        from code2vec_tpu.train.device_epoch import (
+            ShardedEpochRunner,
+            stage_method_corpus_sharded,
+        )
+
+        _, data = tiny
+        bag = int(np.diff(data.row_splits).max())
+        helper = TestMeshComposition()
+        model_config, cw, state = helper._setup(data, bag=bag)
+        mesh = make_mesh(data=4, model=2)
+        idx = np.arange(data.n_items)
+
+        replicated = EpochRunner(model_config, cw, 16, bag, chunk_batches=4,
+                                 mesh=mesh)
+        staged_r = stage_method_corpus(
+            data, idx, np.random.default_rng(0),
+            device=NamedSharding(mesh, P()),
+        )
+        state_m = shard_state(mesh, state)
+        _, preds_r, _ = replicated.run_eval_epoch(
+            state_m, staged_r, jax.random.PRNGKey(9)
+        )
+        pairs_r = Counter(zip(np.asarray(staged_r.labels).tolist(),
+                              preds_r.tolist()))
+
+        sharded = ShardedEpochRunner(model_config, cw, 16, bag,
+                                     chunk_batches=4, mesh=mesh)
+        staged_s = stage_method_corpus_sharded(
+            data, idx, np.random.default_rng(0), mesh
+        )
+        loss_s, preds_s, logits_s = sharded.run_eval_epoch(
+            state_m, staged_s, jax.random.PRNGKey(9)
+        )
+        expected = staged_s.flat_labels()
+        assert len(preds_s) == len(expected) == data.n_items
+        pairs_s = Counter(zip(expected.tolist(), preds_s.tolist()))
+        assert pairs_s == pairs_r
+        assert np.isfinite(loss_s) and len(logits_s) == data.n_items
