@@ -241,40 +241,45 @@ def sinks_from_args(args: argparse.Namespace):
     return tuple(sinks)
 
 
+def pin_platform(no_cuda: bool) -> None:
+    """Honor --no_cuda / JAX_PLATFORMS through the config API: experimental
+    device plugins can pre-empt the env var, so the env route alone is
+    unreliable. --no_cuda keeps the reference's semantics (main.py:62,83 —
+    don't use the accelerator) by pinning the CPU backend. Works as long as
+    no backend is initialized yet. Shared with the predict CLI."""
+    if not (no_cuda or os.environ.get("JAX_PLATFORMS", "").strip()):
+        return
+    import jax
+
+    platforms = "cpu" if no_cuda else os.environ["JAX_PLATFORMS"]
+    # no public API answers "is any backend initialized yet?" without
+    # initializing one; prefer the named probe, fall back to the older
+    # private dict if a future jax renames it
+    from jax._src import xla_bridge as _xb
+
+    _initialized = getattr(
+        _xb,
+        "backends_are_initialized",
+        lambda: bool(getattr(_xb, "_backends", None)),
+    )()
+    if not _initialized:
+        jax.config.update("jax_platforms", platforms)
+    else:
+        requested = {p.strip() for p in platforms.split(",") if p.strip()}
+        if "cuda" in requested or "rocm" in requested:
+            requested.add("gpu")  # default_backend() reports the alias
+        if jax.default_backend() not in requested:
+            logger.warning(
+                "cannot honor platform request %r: the %s backend is "
+                "already initialized", platforms, jax.default_backend())
+
+
 def main(argv: list[str] | None = None) -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s: %(message)s",
                         datefmt="%m/%d/%Y %I:%M:%S %p")
     args = build_parser().parse_args(argv)
-    if args.no_cuda or os.environ.get("JAX_PLATFORMS", "").strip():
-        # Force the platform through the config API: experimental device
-        # plugins can pre-empt the JAX_PLATFORMS env var, so the env route
-        # alone is unreliable. --no_cuda keeps the reference's semantics
-        # (reference: main.py:62,83 — don't use the accelerator) by pinning
-        # the CPU backend. Works as long as no backend is initialized yet.
-        import jax
-
-        platforms = "cpu" if args.no_cuda else os.environ["JAX_PLATFORMS"]
-        # no public API answers "is any backend initialized yet?" without
-        # initializing one; prefer the named probe, fall back to the older
-        # private dict if a future jax renames it
-        from jax._src import xla_bridge as _xb
-
-        _initialized = getattr(
-            _xb,
-            "backends_are_initialized",
-            lambda: bool(getattr(_xb, "_backends", None)),
-        )()
-        if not _initialized:
-            jax.config.update("jax_platforms", platforms)
-        else:
-            requested = {p.strip() for p in platforms.split(",") if p.strip()}
-            if "cuda" in requested or "rocm" in requested:
-                requested.add("gpu")  # default_backend() reports the alias
-            if jax.default_backend() not in requested:
-                logger.warning(
-                    "cannot honor platform request %r: the %s backend is "
-                    "already initialized", platforms, jax.default_backend())
+    pin_platform(args.no_cuda)
     if args.gpu is not None or args.num_workers is not None:
         logger.info("--gpu/--num_workers are no-ops on this framework: "
                     "JAX selects the device (current: %s)", _backend_name())

@@ -78,6 +78,7 @@ class MethodPrediction:
     n_oov: int  # contexts dropped: path or terminal unseen in training
     attention: list[tuple[str, str, str, float]]  # (start, path, end, weight)
     code_vector: np.ndarray | None = None  # [encode_size] embedding
+    target_variable: str | None = None  # set for variable-name predictions
 
 
 def nearest_from_rows(
@@ -138,11 +139,6 @@ class Predictor:
             )
         with open(meta_path, encoding="utf-8") as f:
             meta = json.load(f)
-        if not meta.get("infer_method_name", True):
-            raise ValueError(
-                "this checkpoint was trained for the variable-name task; "
-                "method-name prediction needs an infer_method_name run"
-            )
         self.meta = meta
         # same loading rules as training: @question injected into the
         # terminal vocab at index 1, raw indices shifted up
@@ -250,18 +246,22 @@ class Predictor:
 
     # ---- vocab mapping ---------------------------------------------------
     def _map_contexts(
-        self, contexts: list[tuple[str, str, str]]
+        self,
+        contexts: list[tuple[str, str, str]],
+        question_token: str = "@method_0",
     ) -> tuple[list[tuple[int, int, int]], int]:
         """(start, path, end) NAME triples -> training vocab ids. Names are
         the join key across extractor runs. Contexts whose path or either
         terminal never occurred in training are dropped (counted as OOV).
-        ``@method_0`` maps to ``@question`` — the trainer's answer-leak
-        substitution. Terminals are lowercased like the vocab writers'."""
+        ``question_token`` maps to ``@question`` — the trainer's answer-leak
+        substitution (the method's own alias for the method task, the
+        target variable's alias for the variable task). Terminals are
+        lowercased like the vocab writers'."""
         t_stoi = self.terminal_vocab.stoi
         p_stoi = self.path_vocab.stoi
 
         def term_id(name: str) -> int | None:
-            if name == "@method_0":
+            if name == question_token:
                 return QUESTION_TOKEN_INDEX
             return t_stoi.get(name.lower())
 
@@ -275,22 +275,14 @@ class Predictor:
             mapped.append((ts, tp, te))
         return mapped, oov
 
-    # ---- prediction ------------------------------------------------------
-    def predict_source(
-        self,
-        source: str,
-        method_name: str = "*",
-        language: str = "java",
-        top_k: int = 5,
-        rng: np.random.Generator | None = None,
-    ) -> list[MethodPrediction]:
-        """Extract + predict every matching method in ``source``.
-
-        Both extractors are normalized to (start, path, end) NAME triples:
-        the Java one returns run-local int ids + vocab dicts, the Python
-        one returns string triples directly.
-        """
-        methods: list[tuple[str, list[tuple[str, str, str]]]] = []
+    # ---- extraction (shared by both tasks) -------------------------------
+    def _extract(
+        self, source: str, method_name: str, language: str
+    ) -> list[tuple[str, list[tuple[str, str, str]], list[tuple[str, str]]]]:
+        """Extract to (label, NAME triples, (original, alias) pairs) per
+        method. Both extractors are normalized: the Java one returns
+        run-local int ids + vocab dicts, the Python one string triples."""
+        methods = []
         if language == "java":
             from code2vec_tpu.extractor import extract_source
 
@@ -300,6 +292,7 @@ class Predictor:
                     m.label,
                     [(result.terminal_vocab[s], result.path_vocab[p],
                       result.terminal_vocab[e]) for s, p, e in m.path_contexts],
+                    list(m.aliases),
                 ))
         elif language == "python":
             from code2vec_tpu.pyextract import PyExtractConfig, extract_python_source
@@ -314,12 +307,28 @@ class Predictor:
                 max_width=ep["max_width"],
             )
             for m in extract_python_source(source, method_name, py_config):
-                methods.append((m.label, list(m.contexts)))
+                methods.append((m.label, list(m.contexts), list(m.variables)))
         else:
             raise ValueError(f"unknown language: {language!r}")
+        return methods
 
+    # ---- prediction ------------------------------------------------------
+    def predict_source(
+        self,
+        source: str,
+        method_name: str = "*",
+        language: str = "java",
+        top_k: int = 5,
+        rng: np.random.Generator | None = None,
+    ) -> list[MethodPrediction]:
+        """Method-name predictions for every matching method in ``source``."""
+        if not self.meta.get("infer_method_name", True):
+            raise ValueError(
+                "this checkpoint was trained for the variable-name task "
+                "only; use predict_variables (CLI: --task variable)"
+            )
         out = []
-        for label, contexts in methods:
+        for label, contexts, _ in self._extract(source, method_name, language):
             mapped, oov = self._map_contexts(contexts)
             if not mapped:
                 logger.warning(
@@ -328,6 +337,49 @@ class Predictor:
                     label,
                 )
             out.append(self._predict_contexts(label, mapped, oov, top_k, rng))
+        return out
+
+    def predict_variables(
+        self,
+        source: str,
+        method_name: str = "*",
+        language: str = "java",
+        top_k: int = 5,
+        rng: np.random.Generator | None = None,
+    ) -> list[MethodPrediction]:
+        """Variable-name predictions: one per ``@var_*`` alias of each
+        matching method, with the trainer's framing (keep only the target
+        variable's contexts, its alias becomes ``@question`` —
+        model/dataset_builder.py:152-204)."""
+        if not self.meta.get("infer_variable_name", False):
+            raise ValueError(
+                "this checkpoint was not trained for the variable-name "
+                "task; use predict_source (CLI: --task method)"
+            )
+        out = []
+        for label, contexts, aliases in self._extract(
+            source, method_name, language
+        ):
+            # extractor encounter order is deterministic — keep it
+            for original, alias in aliases:
+                if not alias.startswith("@var_"):
+                    continue  # @method_/@label_ aliases are not variables
+                mine = [
+                    (s, p, e) for s, p, e in contexts
+                    if s == alias or e == alias
+                ]
+                mapped, oov = self._map_contexts(mine, question_token=alias)
+                if not mapped:
+                    logger.warning(
+                        "%s.%s: every context is OOV against the training "
+                        "vocab — prediction will be the label prior",
+                        label, original,
+                    )
+                m = self._predict_contexts(
+                    f"{label}.{original}", mapped, oov, top_k, rng
+                )
+                m.target_variable = original
+                out.append(m)
         return out
 
     def _predict_contexts(
@@ -388,6 +440,14 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--terminal_idx_path", required=True)
     parser.add_argument("--path_idx_path", required=True)
     parser.add_argument("--method_name", default="*", help="* = all methods")
+    parser.add_argument("--no_cuda", action="store_true", default=False,
+                        help="run on CPU (pins the cpu JAX backend; a "
+                        "single-example forward doesn't need the TPU)")
+    parser.add_argument(
+        "--task", default="auto", choices=("auto", "method", "variable"),
+        help="what to predict; auto follows the checkpoint's training task "
+        "(method wins for dual-task checkpoints)",
+    )
     parser.add_argument("--top_k", type=int, default=5)
     parser.add_argument(
         "--show_attention", type=int, default=0, metavar="N",
@@ -403,6 +463,10 @@ def main(argv: list[str] | None = None) -> None:
         "<model_path>/code.vec if present)",
     )
     args = parser.parse_args(argv)
+
+    from code2vec_tpu.cli import pin_platform
+
+    pin_platform(args.no_cuda)
 
     # resolve/validate the neighbors source BEFORE the expensive model
     # load: file present, dims matching the checkpoint, loaded once with
@@ -437,7 +501,18 @@ def main(argv: list[str] | None = None) -> None:
     with open(args.source_file, encoding="utf-8") as f:
         source = f.read()
     language = "python" if args.source_file.endswith(".py") else "java"
-    results = predictor.predict_source(
+    task = args.task
+    if task == "auto":
+        task = (
+            "method"
+            if predictor.meta.get("infer_method_name", True)
+            else "variable"
+        )
+    predict = (
+        predictor.predict_source if task == "method"
+        else predictor.predict_variables
+    )
+    results = predict(
         source, args.method_name, language=language, top_k=args.top_k
     )
     if not results:

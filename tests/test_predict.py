@@ -25,10 +25,10 @@ class Util {
 """
 
 
-@pytest.fixture(scope="module")
-def trained(tmp_path_factory):
+def _train_on_util(tmp_path_factory, name, infer_variable=False, epochs=25):
+    """Extract JAVA into a fresh dataset dir and train the given task."""
     build_extractor()
-    root = tmp_path_factory.mktemp("predict")
+    root = tmp_path_factory.mktemp(name)
     src = root / "src"
     ds = root / "ds"
     out = root / "out"
@@ -38,15 +38,23 @@ def trained(tmp_path_factory):
     (ds / "methods.txt").write_text("Util.java\t*\n")
     extract_dataset(str(ds), str(src))
     data = load_corpus(
-        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt",
+        infer_method=not infer_variable, infer_variable=infer_variable,
     )
     cfg = TrainConfig(
-        max_epoch=25, batch_size=4, encode_size=48, terminal_embed_size=24,
-        path_embed_size=24, max_path_length=64, lr=0.01,
-        print_sample_cycle=0,
+        max_epoch=epochs, batch_size=4, encode_size=48,
+        terminal_embed_size=24, path_embed_size=24, max_path_length=64,
+        lr=0.01, print_sample_cycle=0,
+        infer_method_name=not infer_variable,
+        infer_variable_name=infer_variable,
     )
     train(cfg, data, out_dir=str(out))
     return ds, out
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    return _train_on_util(tmp_path_factory, "predict")
 
 
 def test_meta_persisted(trained):
@@ -91,19 +99,49 @@ def test_oov_source_degrades_gracefully(trained):
     assert len(m.predictions) == 2  # still returns ranked predictions
 
 
-def test_variable_task_checkpoint_rejected(trained, tmp_path):
+def test_task_mismatches_rejected(trained):
     ds, out = trained
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    # this checkpoint is method-task: variable prediction must refuse
+    with pytest.raises(ValueError, match="not trained for the variable"):
+        p.predict_variables(JAVA)
+    # and a variable-only checkpoint must refuse method prediction
     meta_path = out / "model_meta.json"
     original = meta_path.read_text()
     meta = json.loads(original)
     meta["infer_method_name"] = False
     try:
         meta_path.write_text(json.dumps(meta))
+        p2 = Predictor(str(out), str(ds / "terminal_idxs.txt"),
+                       str(ds / "path_idxs.txt"))
         with pytest.raises(ValueError, match="variable-name task"):
-            Predictor(str(out), str(ds / "terminal_idxs.txt"),
-                      str(ds / "path_idxs.txt"))
+            p2.predict_source(JAVA)
     finally:
         meta_path.write_text(original)
+
+
+@pytest.fixture(scope="module")
+def trained_vars(tmp_path_factory):
+    """A variable-name-task model on the same extracted Java corpus."""
+    return _train_on_util(
+        tmp_path_factory, "predict_vars", infer_variable=True, epochs=30
+    )
+
+
+def test_predicts_memorized_variables(trained_vars):
+    ds, out = trained_vars
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt"))
+    results = p.predict_variables(JAVA, "*", top_k=3)
+    # every method declares at least the parameters; JAVA has vars
+    # total/product/even plus params a/b/n across 6 methods
+    assert len(results) >= 12
+    hits = 0
+    for m in results:
+        assert m.target_variable is not None
+        assert m.n_contexts > 0
+        names = [pr.name for pr in m.predictions]
+        hits += m.target_variable.lower() in names
+    assert hits >= len(results) // 2  # memorization ranks the true name
 
 
 def test_missing_meta_explains(trained, tmp_path):
