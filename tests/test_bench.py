@@ -1,0 +1,394 @@
+"""bench.py helper coverage: the benchmark feedback loop and the
+wedged-tunnel fallback decision.
+
+These guard the two historical bench failure modes the round-2 verdict
+called out: `vs_baseline` silently stuck at 1.0 because prior rounds were
+read through the wrong schema (Weak #1), and a wedged TPU tunnel producing
+rc=1 with zero perf data because init hangs rather than raises (Weak #2).
+The fallback tests monkeypatch the killable subprocess probe so no real
+backend is touched; the suite runs under the conftest CPU platform either
+way.
+"""
+
+import importlib.util
+import json
+import os
+import os as bench_os  # alias: the name monkeypatched for _kill_tree's killpg
+import sys
+
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("_bench_under_test", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestExtractMetric:
+    def test_bare_value_payload(self, bench):
+        assert bench._extract_metric({"value": 123.5}) == (123.5, None)
+
+    def test_driver_parsed_schema(self, bench):
+        payload = {"rc": 0, "parsed": {"metric": "ctx/s", "value": 6955073}}
+        assert bench._extract_metric(payload) == (6955073.0, None)
+
+    def test_tail_scan_takes_last_metric_line(self, bench):
+        tail = "\n".join(
+            [
+                "noise",
+                json.dumps({"detail": "not the metric"}),
+                json.dumps({"metric": "ctx/s", "value": 42.0, "backend": "tpu"}),
+            ]
+        )
+        assert bench._extract_metric({"rc": 0, "tail": tail}) == (42.0, "tpu")
+
+    def test_backend_from_detail_line(self, bench):
+        # BENCH_r02 shape: metric line without a backend field, detail line
+        # (with backend) printed after it
+        tail = "\n".join(
+            [
+                json.dumps({"metric": "ctx/s", "value": 6955072.6}),
+                json.dumps({"detail": {"backend": "tpu", "steps_per_sec": 33.96}}),
+            ]
+        )
+        assert bench._extract_metric({"rc": 0, "tail": tail}) == (6955072.6, "tpu")
+
+    def test_non_numeric_and_missing_value(self, bench):
+        assert bench._extract_metric({"parsed": {"value": None}}) is None
+        assert bench._extract_metric({"parsed": {"value": "n/a"}}) is None
+        assert bench._extract_metric({"rc": 0, "tail": "no json here"}) is None
+        assert bench._extract_metric({}) is None
+
+
+class TestPreviousBenchmark:
+    def _write(self, tmp_path, name, payload):
+        (tmp_path / name).write_text(json.dumps(payload))
+
+    def test_newest_successful_round_wins(self, bench, tmp_path, monkeypatch):
+        self._write(tmp_path, "BENCH_r01.json", {"rc": 1, "parsed": {"value": 1.0}})
+        self._write(tmp_path, "BENCH_r02.json", {"rc": 0, "parsed": {"value": 2.0}})
+        self._write(tmp_path, "BENCH_r03.json", {"rc": 0, "parsed": {"value": 3.0}})
+        monkeypatch.setattr(
+            bench.glob,
+            "glob",
+            lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
+        )
+        assert bench._previous_benchmark("tpu") == 3.0
+
+    def test_failed_and_valueless_rounds_skipped(self, bench, tmp_path, monkeypatch):
+        self._write(tmp_path, "BENCH_r01.json", {"rc": 0, "parsed": {"value": 5.0}})
+        self._write(tmp_path, "BENCH_r02.json", {"rc": 1, "parsed": {"value": 9.0}})
+        self._write(tmp_path, "BENCH_r03.json", {"rc": 0, "parsed": {"detail": "x"}})
+        (tmp_path / "BENCH_r04.json").write_text("{corrupt")
+        monkeypatch.setattr(
+            bench.glob,
+            "glob",
+            lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
+        )
+        assert bench._previous_benchmark("tpu") == 5.0
+
+    def test_cpu_fallback_round_cannot_poison_device_baseline(
+        self, bench, tmp_path, monkeypatch
+    ):
+        # a wedged-tunnel round lands a (labeled) CPU number; the next
+        # healthy device run must still compare against the last DEVICE
+        # round, or vs_baseline becomes a meaningless ~2000x
+        self._write(
+            tmp_path,
+            "BENCH_r02.json",
+            {"rc": 0, "parsed": {"value": 6955072.6, "backend": "tpu"}},
+        )
+        self._write(
+            tmp_path,
+            "BENCH_r03.json",
+            {"rc": 0, "parsed": {"value": 103955.6, "backend": "cpu"}},
+        )
+        monkeypatch.setattr(
+            bench.glob,
+            "glob",
+            lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
+        )
+        assert bench._previous_benchmark("tpu") == 6955072.6
+        # and a cpu run compares like-for-like against the cpu round
+        assert bench._previous_benchmark("cpu") == 103955.6
+
+    def test_unlabeled_round_counts_as_device(self, bench, tmp_path, monkeypatch):
+        self._write(tmp_path, "BENCH_r02.json", {"rc": 0, "parsed": {"value": 7.0}})
+        monkeypatch.setattr(
+            bench.glob,
+            "glob",
+            lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
+        )
+        assert bench._previous_benchmark("tpu") == 7.0
+        assert bench._previous_benchmark("cpu") is None
+
+    def test_no_prior_rounds(self, bench, monkeypatch):
+        monkeypatch.setattr(bench.glob, "glob", lambda pattern: [])
+        assert bench._previous_benchmark("tpu") is None
+
+
+class TestInitBackendFallback:
+    """The fallback *decision* logic, with the subprocess probe stubbed.
+
+    The real probe compiles + executes a tiny jit in a killable subprocess
+    because a wedged axon tunnel has been observed to hang on the first
+    dispatch while `jax.devices()` still answers — an in-process attempt
+    would stall the whole benchmark past the driver's window.
+    """
+
+    def test_wedged_probe_falls_back_to_cpu(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        probes = []
+        monkeypatch.setattr(
+            bench, "_probe_default_backend", lambda t: probes.append(t) or False
+        )
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        _jax, backend, fell_back = bench._init_backend()
+        assert fell_back is True
+        assert backend == "cpu"
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert len(probes) == 2  # one retry before giving up on the tunnel
+
+    def test_healthy_probe_keeps_default_backend(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setattr(bench, "_probe_default_backend", lambda t: True)
+        _jax, backend, fell_back = bench._init_backend()
+        assert fell_back is False
+        # under the test harness the default backend IS cpu; the point is
+        # that no fallback was recorded and the env was left alone
+        assert "JAX_PLATFORMS" not in os.environ or os.environ["JAX_PLATFORMS"] == ""
+
+    def test_cpu_platform_skips_probe(self, bench, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+        def _boom(t):  # pragma: no cover - failure is the assertion
+            raise AssertionError("probe must not run for an explicit cpu platform")
+
+        monkeypatch.setattr(bench, "_probe_default_backend", _boom)
+        _jax, backend, fell_back = bench._init_backend()
+        assert fell_back is False
+        assert backend == "cpu"
+
+    def test_ambient_device_platform_is_probed(self, bench, monkeypatch):
+        # the harness exports JAX_PLATFORMS=axon ambiently — that must NOT
+        # read as an operator pin, or the wedge guard never fires in the
+        # exact environment it was built for
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setattr(bench, "_probe_default_backend", lambda t: False)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        _jax, backend, fell_back = bench._init_backend()
+        assert fell_back is True
+        assert backend == "cpu"
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+    def test_fell_back_env_marks_emergency_recipe(self, bench, monkeypatch):
+        # the supervisor's CPU retry sets both vars; the child must report
+        # fell_back=True so the reduced emergency recipe kicks in
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("BENCH_FELL_BACK", "1")
+        _jax, backend, fell_back = bench._init_backend()
+        assert fell_back is True
+        assert backend == "cpu"
+
+    def test_probe_timeout_env_respected(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("BENCH_INIT_TIMEOUT", "7")
+        seen = []
+        monkeypatch.setattr(
+            bench, "_probe_default_backend", lambda t: seen.append(t) or True
+        )
+        bench._init_backend()
+        assert seen == [7.0]
+
+
+class _FakeProc:
+    def __init__(self, rc, hang=False):
+        self._rc = rc
+        self._hang = hang
+        self.killed = False
+        self.pid = -1  # never passed to a real killpg (stubbed in _patch_popen)
+
+    def wait(self, timeout=None):
+        import subprocess
+
+        if self._hang and not self.killed:
+            raise subprocess.TimeoutExpired(cmd="bench", timeout=timeout)
+        return self._rc
+
+    def kill(self):
+        self.killed = True
+
+
+class TestSupervisor:
+    """_supervise(): the killable-child harness that defends against the
+    post-init hang (probe passes, first compile wedges — observed live on
+    the axon tunnel, 2026-07-30)."""
+
+    def _patch_popen(self, monkeypatch, procs, envs):
+        import subprocess
+
+        it = iter(procs)
+
+        def fake_popen(cmd, env=None, **kwargs):
+            envs.append(env)
+            return next(it)
+
+        monkeypatch.setattr(subprocess, "Popen", fake_popen)
+
+        # route _kill_tree's killpg to the fallback .kill() path instead of
+        # letting a fake pid reach the real syscall
+        def fake_killpg(pgid, sig):
+            raise ProcessLookupError(pgid)
+
+        monkeypatch.setattr(bench_os, "killpg", fake_killpg)
+
+    def test_healthy_child_single_attempt(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(0)], envs)
+        assert bench._supervise() == 0
+        assert len(envs) == 1
+        assert envs[0]["BENCH_SUPERVISED"] == "1"
+        assert "BENCH_FELL_BACK" not in envs[0]
+
+    def test_stale_fell_back_export_stripped_from_device_attempt(
+        self, bench, monkeypatch
+    ):
+        # a leftover BENCH_FELL_BACK=1 export must not put a healthy device
+        # attempt on the reduced emergency recipe
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("BENCH_FELL_BACK", "1")
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(0)], envs)
+        assert bench._supervise() == 0
+        assert "BENCH_FELL_BACK" not in envs[0]
+
+    def test_hung_child_killed_then_cpu_retry(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        envs = []
+        hung = _FakeProc(0, hang=True)
+        self._patch_popen(monkeypatch, [hung, _FakeProc(0)], envs)
+        assert bench._supervise() == 0
+        assert hung.killed
+        assert envs[1]["JAX_PLATFORMS"] == "cpu"
+        assert envs[1]["BENCH_FELL_BACK"] == "1"
+
+    def test_failing_child_cpu_retry(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(3), _FakeProc(0)], envs)
+        assert bench._supervise() == 0
+        assert envs[1]["JAX_PLATFORMS"] == "cpu"
+
+    def test_both_attempts_fail_emits_contract_line(self, bench, monkeypatch, capsys):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(1), _FakeProc(1)], envs)
+        assert bench._supervise() == 1
+        last = capsys.readouterr().out.strip().splitlines()[-1]
+        obj = json.loads(last)
+        assert obj["metric"] == "path_contexts_per_sec_per_chip"
+        assert obj["value"] is None
+        assert "error" in obj
+
+    def test_cpu_platform_skips_cpu_retry(self, bench, monkeypatch, capsys):
+        # already on cpu: a cpu retry would repeat the same failure
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(1)], envs)
+        assert bench._supervise() == 1
+        assert len(envs) == 1
+        assert json.loads(capsys.readouterr().out.strip().splitlines()[-1])["value"] is None
+
+    def test_ambient_device_platform_still_gets_cpu_retry(self, bench, monkeypatch):
+        # JAX_PLATFORMS=axon is exported by the harness itself; a hung
+        # device attempt must still produce a labeled cpu number
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        envs = []
+        hung = _FakeProc(0, hang=True)
+        self._patch_popen(monkeypatch, [hung, _FakeProc(0)], envs)
+        assert bench._supervise() == 0
+        assert hung.killed
+        assert envs[1]["JAX_PLATFORMS"] == "cpu"
+        assert envs[1]["BENCH_FELL_BACK"] == "1"
+
+    def test_no_fallback_opt_out(self, bench, monkeypatch, capsys):
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("BENCH_NO_FALLBACK", "1")
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(1)], envs)
+        assert bench._supervise() == 1
+        assert len(envs) == 1
+        assert json.loads(capsys.readouterr().out.strip().splitlines()[-1])["value"] is None
+
+    def test_deadline_env_respected(self, bench, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("BENCH_DEADLINE", "17")
+        seen = []
+
+        class _Proc(_FakeProc):
+            def wait(self, timeout=None):
+                seen.append(timeout)
+                return 0
+
+        envs = []
+        self._patch_popen(monkeypatch, [_Proc(0)], envs)
+        assert bench._supervise() == 0
+        # single (final) attempt gets the whole remaining budget
+        assert len(seen) == 1 and 16.0 < seen[0] <= 17.0
+
+    def test_malformed_deadline_does_not_crash(self, bench, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("BENCH_DEADLINE", "20m")
+        envs = []
+        self._patch_popen(monkeypatch, [_FakeProc(0)], envs)
+        assert bench._supervise() == 0  # fell back to the 1200s default
+
+    def test_first_attempt_reserves_budget_for_cpu_retry(self, bench, monkeypatch):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("BENCH_DEADLINE", "1000")
+        seen = []
+
+        class _Proc(_FakeProc):
+            def wait(self, timeout=None):
+                if timeout is not None:  # ignore the post-kill reap
+                    seen.append(timeout)
+                return super().wait(timeout=timeout)
+
+        hung = _Proc(0, hang=True)
+        ok = _Proc(0)
+        envs = []
+        self._patch_popen(monkeypatch, [hung, ok], envs)
+        assert bench._supervise() == 0
+        # attempt 1 is held back from the full budget (1000 - min(420, 500));
+        # the final attempt gets everything left of the TOTAL budget (the
+        # fakes consume no wall-clock, so that is still ~1000 here)
+        assert len(seen) == 2
+        assert 570.0 < seen[0] <= 580.0
+        assert 990.0 < seen[1] <= 1000.0
+
+    def test_no_fallback_raise_path_raises_instead_of_cpu(self, bench, monkeypatch):
+        # with the opt-out set, init that RAISES must surface the failure
+        # (-> error JSON line), not silently measure CPU
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        monkeypatch.setenv("BENCH_NO_FALLBACK", "1")
+        monkeypatch.delenv("BENCH_FELL_BACK", raising=False)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+        import builtins
+
+        real_import = builtins.__import__
+
+        def failing_import(name, *args, **kwargs):
+            if name == "jax":
+                raise RuntimeError("no backend")
+            return real_import(name, *args, **kwargs)
+
+        monkeypatch.setattr(builtins, "__import__", failing_import)
+        with pytest.raises(RuntimeError, match="BENCH_NO_FALLBACK"):
+            bench._init_backend()

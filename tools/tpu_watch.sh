@@ -11,11 +11,16 @@ cd "$(dirname "$0")/.."
 echo "[tpu_watch] quiet period $(date)"
 sleep 900
 for i in $(seq 1 60); do
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # bench.py's probe: a real compile+dispatch in a killable subprocess
+  # (jax.devices() can answer on a tunnel whose first compile then hangs,
+  # observed 2026-07-30) with the shared persistent compile cache
+  if timeout 120 python -c "import bench; raise SystemExit(0 if bench._probe_default_backend(90) else 1)" >/dev/null 2>&1; then
     echo "[tpu_watch] tunnel up after probe $i: $(date)"
     timeout 2400 python tools/run_tpu_ablation.py > /tmp/ablation_results.txt 2>&1
     echo "[tpu_watch] ablation rc=$? $(date)"
-    timeout 600 python bench.py > /tmp/bench_tpu.txt 2>&1
+    # outer timeout must exceed the supervisor's own total budget, or
+    # timeout(1) kills the supervisor and orphans the measurement child
+    BENCH_DEADLINE=1200 timeout 1500 python bench.py > /tmp/bench_tpu.txt 2>&1
     echo "[tpu_watch] bench rc=$? $(date)"
     exit 0
   fi
