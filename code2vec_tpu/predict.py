@@ -441,8 +441,16 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--path_idx_path", required=True)
     parser.add_argument("--method_name", default="*", help="* = all methods")
     parser.add_argument("--no_cuda", action="store_true", default=False,
-                        help="run on CPU (pins the cpu JAX backend; a "
-                        "single-example forward doesn't need the TPU)")
+                        help="accepted for train-CLI symmetry; CPU is "
+                        "already the default here")
+    parser.add_argument(
+        "--accelerator", action="store_true", default=False,
+        help="run on the default device backend instead of CPU. Off by "
+        "default: a single-example forward gains nothing from the TPU, "
+        "and the first compile through a cold (or wedged) device tunnel "
+        "costs 20-40s (or hangs) — latency a one-off inference CLI "
+        "should not pay",
+    )
     parser.add_argument(
         "--task", default="auto", choices=("auto", "method", "variable"),
         help="what to predict; auto follows the checkpoint's training task "
@@ -466,7 +474,11 @@ def main(argv: list[str] | None = None) -> None:
 
     from code2vec_tpu.cli import pin_platform
 
-    pin_platform(args.no_cuda)
+    # CPU unless --accelerator: inference is one tiny forward, and the
+    # ambient JAX_PLATFORMS can point at a device tunnel that is cold or
+    # wedged. An explicit --no_cuda still wins over --accelerator — the
+    # flag's CPU guarantee must hold in every combination.
+    pin_platform(args.no_cuda or not args.accelerator)
 
     # resolve/validate the neighbors source BEFORE the expensive model
     # load: file present, dims matching the checkpoint, loaded once with

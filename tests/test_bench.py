@@ -379,6 +379,10 @@ class TestSupervisor:
         monkeypatch.setenv("BENCH_NO_FALLBACK", "1")
         monkeypatch.delenv("BENCH_FELL_BACK", raising=False)
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        # the real purge would evict jax from sys.modules and poison every
+        # later test in the suite (stale cross-module references); the
+        # decision under test is the no-fallback raise, not the purge
+        monkeypatch.setattr(bench, "_purge_jax_modules", lambda: None)
 
         import builtins
 

@@ -258,6 +258,33 @@ def test_cli(trained, tmp_path, capsys):
     assert "[" in printed  # an attention row
 
 
+def test_cli_pins_cpu_by_default(trained, tmp_path, monkeypatch):
+    """Inference must not touch the ambient device backend unless asked:
+    JAX_PLATFORMS can point at a cold/wedged tunnel, and a one-off forward
+    gains nothing from it (the examples/java demo hung exactly here)."""
+    import code2vec_tpu.cli as cli_mod
+
+    ds, out = trained
+    f = tmp_path / "Util.java"
+    f.write_text(JAVA)
+    pins = []
+    monkeypatch.setattr(cli_mod, "pin_platform", lambda no_cuda: pins.append(no_cuda))
+    base = [
+        str(f),
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--method_name", "add",
+        "--top_k", "1",
+    ]
+    predict_main(base)
+    assert pins == [True]  # default: pin cpu
+    predict_main(base + ["--accelerator"])
+    assert pins[-1] is False  # explicit opt-in reaches the device backend
+    predict_main(base + ["--accelerator", "--no_cuda"])
+    assert pins[-1] is True  # an explicit --no_cuda always wins
+
+
 def test_nearest_neighbors(trained, tmp_path, capsys):
     from code2vec_tpu.export import export_from_checkpoint
     from code2vec_tpu.predict import nearest_neighbors
