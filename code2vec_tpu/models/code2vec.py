@@ -81,7 +81,9 @@ class _EmbedTable(nn.Module):
 
 class Code2Vec(nn.Module):
     """Returns ``(logits, code_vector, attention)`` like the reference
-    forward (model/model.py:88); the margin head needs ``labels``."""
+    forward (model/model.py:88); the margin head uses ``labels`` to place
+    the training margin and serves plain scaled-cosine logits without
+    them (inference)."""
 
     config: Code2VecConfig
 
@@ -181,10 +183,14 @@ class Code2Vec(nn.Module):
     ) -> jnp.ndarray:
         """ArcFace-style head (model/model.py:71-80): cosine logits with an
         additive angular margin on the true class, falling back to the plain
-        cosine where cos <= 0, scaled by the inverse temperature."""
+        cosine where cos <= 0, scaled by the inverse temperature.
+
+        With ``labels=None`` (inference — the reference never runs this
+        head without labels) the margin is skipped and the scaled cosine
+        logits are returned directly: the margin exists to shape the
+        TRAINING decision boundary; at inference ArcFace-family models
+        rank classes by plain cosine similarity."""
         c = self.config
-        if labels is None:
-            raise ValueError("the angular-margin head requires labels")
         weight = self.param(
             "output_margin_weight",
             nn.initializers.xavier_uniform(),
@@ -198,6 +204,8 @@ class Code2Vec(nn.Module):
             jnp.linalg.norm(weight, axis=-1, keepdims=True) + 1e-12
         )
         cosine = (normalized_cv @ normalized_w.T)[:, : c.label_count]
+        if labels is None:
+            return cosine * c.inverse_temp
         sine = jnp.sqrt(jnp.clip(1.0 - cosine**2, 0.0, 1.0))
         cos_m = math.cos(c.angular_margin)
         sin_m = math.sin(c.angular_margin)

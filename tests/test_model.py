@@ -173,16 +173,26 @@ class TestAngularMarginHead:
         expected = (one_hot * phi + (1 - one_hot) * cosine) * 30.0
         np.testing.assert_allclose(np.asarray(logits), expected, rtol=1e-4, atol=1e-4)
 
-    def test_requires_labels(self):
-        c = small_config(angular_margin_loss=True)
+    def test_inference_without_labels_is_plain_cosine(self):
+        """labels=None (prediction): the margin is skipped — ArcFace-family
+        models rank classes by plain cosine at inference. This is what lets
+        `predict` and imported margin-head checkpoints serve label-free."""
+        c = small_config(angular_margin_loss=True, dropout_prob=0.0)
         rng = np.random.default_rng(7)
         starts, paths, ends, labels = make_batch(rng, config=c)
         model = Code2Vec(c)
         params = model.init(
             jax.random.PRNGKey(0), starts, paths, ends, labels=labels
         )
-        with pytest.raises(ValueError):
-            model.apply(params, starts, paths, ends)
+        logits, cv, _ = model.apply(params, starts, paths, ends)
+
+        w = np.asarray(params["params"]["output_margin_weight"])
+        cvn = np.asarray(cv)
+        cvn = cvn / np.linalg.norm(cvn, axis=-1, keepdims=True)
+        wn = w / np.linalg.norm(w, axis=-1, keepdims=True)
+        np.testing.assert_allclose(
+            np.asarray(logits), (cvn @ wn.T) * 30.0, rtol=1e-4, atol=1e-4
+        )
 
 
 class TestEmbedGradModes:

@@ -1,0 +1,365 @@
+"""Import a trained checkpoint from the reference implementation.
+
+The reference saves ``torch.save(model.state_dict(), <model_path>/
+code2vec.model)`` on every new best F1 (reference main.py:231). This tool
+converts that file into a checkpoint of THIS framework — so a user
+switching over keeps their trained models, not just their datasets:
+
+    python tools/import_reference_checkpoint.py \
+        --reference_model /path/to/output/code2vec.model \
+        --corpus_path corpus.txt \
+        --terminal_idx_path terminal_idxs.txt \
+        --path_idx_path path_idxs.txt \
+        --model_path out/
+
+``out/`` then works everywhere a trained model dir does: `predict`,
+`--export_only` vector export, eval, or resumed fine-tuning (optimizer
+moments start fresh — the reference checkpoint has none).
+
+The corpus/vocab files must be the ones the checkpoint was trained with:
+the label vocabulary is rebuilt from the corpus in the reference's
+insertion order (our reader reproduces it bit-for-bit — data/reader.py),
+and every tensor dimension is cross-checked against the state_dict before
+anything is written.
+
+Parameter mapping (reference model/model.py:21-42 → models/code2vec.py):
+
+    terminal_embedding.weight [T, dt]  → terminal_embedding.embedding
+    path_embedding.weight     [P, dp]  → path_embedding.embedding
+    input_linear.weight   [E, 2dt+dp]  → input_dense.kernel (TRANSPOSED —
+                                         torch Linear stores [out, in];
+                                         concat order start|path|end is
+                                         the same on both sides)
+    input_layer_norm.weight/bias  [E]  → input_layer_norm.scale/bias
+    attention_parameter           [E]  → attention
+    output_linear.weight/bias (plain)  → output_dense.kernel (T)/bias
+    output_linear (margin Parameter)   → output_margin_weight
+
+After conversion the tool runs BOTH forwards (torch in eval mode vs our
+model, deterministic) on a probe batch from the corpus and refuses to
+write unless the logits agree to --atol (default 2e-4 — f32 reduction
+order differs across frameworks; bit-equality is not expected).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logger = logging.getLogger("import_reference_checkpoint")
+
+PLAIN_KEYS = {
+    "terminal_embedding.weight",
+    "path_embedding.weight",
+    "input_linear.weight",
+    "input_layer_norm.weight",
+    "input_layer_norm.bias",
+    "attention_parameter",
+    "output_linear.weight",
+    "output_linear.bias",
+}
+MARGIN_KEYS = (PLAIN_KEYS - {"output_linear.weight", "output_linear.bias"}) | {
+    "output_linear"
+}
+
+
+def load_state_dict(path: str) -> dict[str, np.ndarray]:
+    """torch.load the reference state_dict (cpu, weights_only) → numpy."""
+    import torch
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "code2vec.model")
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    arrays = {k: np.asarray(v.detach().cpu().numpy(), np.float32) for k, v in sd.items()}
+    keys = set(arrays)
+    if keys not in (PLAIN_KEYS, MARGIN_KEYS):
+        raise SystemExit(
+            f"unrecognized state_dict layout: {sorted(keys)}\n"
+            "expected the reference Code2Vec model "
+            "(model/model.py:21-42, plain or angular-margin head)"
+        )
+    return arrays
+
+
+def infer_dims(sd: dict[str, np.ndarray]) -> dict:
+    t_count, t_dim = sd["terminal_embedding.weight"].shape
+    p_count, p_dim = sd["path_embedding.weight"].shape
+    encode = sd["input_layer_norm.weight"].shape[0]
+    margin = "output_linear.weight" not in sd
+    head = sd["output_linear"] if margin else sd["output_linear.weight"]
+    label_count = head.shape[0]
+    expect_in = 2 * t_dim + p_dim
+    got_out, got_in = sd["input_linear.weight"].shape
+    if (got_out, got_in) != (encode, expect_in):
+        raise SystemExit(
+            f"input_linear.weight is {got_out}x{got_in}, expected "
+            f"{encode}x{expect_in} (encode x 2*terminal_embed+path_embed)"
+        )
+    return {
+        "terminal_count": t_count,
+        "path_count": p_count,
+        "label_count": label_count,
+        "terminal_embed_size": t_dim,
+        "path_embed_size": p_dim,
+        "encode_size": encode,
+        "angular_margin_loss": margin,
+    }
+
+
+def to_param_tree(sd: dict[str, np.ndarray], dims: dict) -> dict:
+    """The flax param tree for Code2Vec(vocab_pad_multiple=1)."""
+    tree = {
+        "terminal_embedding": {"embedding": sd["terminal_embedding.weight"]},
+        "path_embedding": {"embedding": sd["path_embedding.weight"]},
+        "input_dense": {"kernel": sd["input_linear.weight"].T.copy()},
+        "input_layer_norm": {
+            "scale": sd["input_layer_norm.weight"],
+            "bias": sd["input_layer_norm.bias"],
+        },
+        "attention": sd["attention_parameter"],
+    }
+    if dims["angular_margin_loss"]:
+        tree["output_margin_weight"] = sd["output_linear"]
+    else:
+        tree["output_dense"] = {
+            "kernel": sd["output_linear.weight"].T.copy(),
+            "bias": sd["output_linear.bias"],
+        }
+    return tree
+
+
+def reference_forward(
+    sd: dict[str, np.ndarray],
+    dims: dict,
+    starts: np.ndarray,
+    paths: np.ndarray,
+    ends: np.ndarray,
+    labels: np.ndarray,
+    angular_margin: float,
+    inverse_temp: float,
+) -> np.ndarray:
+    """The reference forward (model/model.py:44-88) in torch, eval mode —
+    the oracle the imported params must reproduce."""
+    import math
+
+    import torch
+    import torch.nn.functional as F
+
+    t = {k: torch.from_numpy(v) for k, v in sd.items()}
+    starts_t = torch.from_numpy(starts).long()
+    paths_t = torch.from_numpy(paths).long()
+    ends_t = torch.from_numpy(ends).long()
+    ccv = torch.cat(
+        (
+            t["terminal_embedding.weight"][starts_t],
+            t["path_embedding.weight"][paths_t],
+            t["terminal_embedding.weight"][ends_t],
+        ),
+        dim=2,
+    )
+    ccv = ccv @ t["input_linear.weight"].T
+    ccv = F.layer_norm(
+        ccv, (dims["encode_size"],),
+        t["input_layer_norm.weight"], t["input_layer_norm.bias"],
+    )
+    ccv = torch.tanh(ccv)
+    mask = (starts_t > 0).float()
+    ninf = -3.4e38
+    attn = F.softmax(
+        (ccv * t["attention_parameter"]).sum(-1) * mask + (1 - mask) * ninf,
+        dim=1,
+    )
+    code_vector = (ccv * attn.unsqueeze(-1)).sum(1)
+    if dims["angular_margin_loss"]:
+        labels_t = torch.from_numpy(labels).long()
+        cosine = F.normalize(code_vector) @ F.normalize(t["output_linear"]).T
+        sine = torch.sqrt(torch.clamp(1.0 - cosine**2, min=0.0))
+        phi = cosine * math.cos(angular_margin) - sine * math.sin(angular_margin)
+        phi = torch.where(cosine > 0, phi, cosine)
+        one_hot = torch.zeros_like(cosine)
+        one_hot.scatter_(1, labels_t.view(-1, 1), 1)
+        out = ((one_hot * phi) + ((1.0 - one_hot) * cosine)) * inverse_temp
+    else:
+        out = code_vector @ t["output_linear.weight"].T + t["output_linear.bias"]
+    return out.numpy()
+
+
+def run_import(args) -> None:
+    sd = load_state_dict(args.reference_model)
+    dims = infer_dims(sd)
+    logger.info("state_dict dims: %s", dims)
+
+    from code2vec_tpu.data.reader import load_corpus
+
+    data = load_corpus(
+        args.corpus_path,
+        args.path_idx_path,
+        args.terminal_idx_path,
+        infer_method=args.infer_method_name,
+        infer_variable=args.infer_variable_name,
+        cache=not args.no_corpus_cache,
+    )
+    mismatches = [
+        (name, have, want)
+        for name, have, want in (
+            ("terminal vocab", len(data.terminal_vocab), dims["terminal_count"]),
+            ("path vocab", len(data.path_vocab), dims["path_count"]),
+            ("label vocab", len(data.label_vocab), dims["label_count"]),
+        )
+        if have != want
+    ]
+    if mismatches:
+        raise SystemExit(
+            "corpus/vocab files do not match the checkpoint: "
+            + "; ".join(f"{n}: files give {h}, checkpoint has {w}" for n, h, w in mismatches)
+            + "\n(pass the exact corpus + idx files the reference trained on,"
+            " and the same --infer_method_name/--infer_variable_name flags)"
+        )
+
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.checkpoint import TrainMeta, save_checkpoint
+    from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.predict import save_inference_meta
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    model_config = Code2VecConfig(
+        terminal_count=dims["terminal_count"],
+        path_count=dims["path_count"],
+        label_count=dims["label_count"],
+        terminal_embed_size=dims["terminal_embed_size"],
+        path_embed_size=dims["path_embed_size"],
+        encode_size=dims["encode_size"],
+        dropout_prob=args.dropout_prob,
+        angular_margin_loss=dims["angular_margin_loss"],
+        angular_margin=args.angular_margin,
+        inverse_temp=args.inverse_temp,
+        vocab_pad_multiple=1,
+    )
+    config = TrainConfig(
+        batch_size=min(8, data.n_items),
+        max_path_length=args.max_path_length,
+        terminal_embed_size=dims["terminal_embed_size"],
+        path_embed_size=dims["path_embed_size"],
+        encode_size=dims["encode_size"],
+        dropout_prob=args.dropout_prob,
+        angular_margin_loss=dims["angular_margin_loss"],
+        angular_margin=args.angular_margin,
+        inverse_temp=args.inverse_temp,
+        infer_method_name=args.infer_method_name,
+        infer_variable_name=args.infer_variable_name,
+    )
+
+    rng = np.random.default_rng(0)
+    probe_items = np.arange(min(8, data.n_items))
+    epoch = build_method_epoch(data, probe_items, args.max_path_length, rng)
+    batch = next(iter_batches(epoch, len(probe_items), rng=rng, pad_final=False))
+    # with --infer_method_name False the method labels are -1 (unused for
+    # training); the margin head's one-hot needs a valid class on BOTH
+    # sides of the probe, and which class it is does not affect parity —
+    # clamp to 0 for the probe only
+    batch = dict(batch, labels=np.maximum(np.asarray(batch["labels"]), 0))
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), batch
+    )
+
+    tree = jax.tree.map(jnp.asarray, to_param_tree(sd, dims))
+    init_shapes = jax.tree.map(jnp.shape, state.params)
+    got_shapes = jax.tree.map(jnp.shape, tree)
+    if init_shapes != got_shapes:
+        raise SystemExit(
+            f"converted tree does not match the model:\n  model: "
+            f"{init_shapes}\n  converted: {got_shapes}"
+        )
+    state = state.replace(params=tree)
+
+    # the probe: both forwards on a real batch, eval mode
+    ours, _cv, _attn = state.apply_fn(
+        {"params": state.params},
+        batch["starts"], batch["paths"], batch["ends"],
+        labels=batch["labels"], deterministic=True,
+    )
+    theirs = reference_forward(
+        sd, dims,
+        np.asarray(batch["starts"]), np.asarray(batch["paths"]),
+        np.asarray(batch["ends"]), np.asarray(batch["labels"]),
+        args.angular_margin, args.inverse_temp,
+    )
+    diff = float(np.max(np.abs(np.asarray(ours, np.float32) - theirs)))
+    logger.info("probe max |Δlogits| vs the reference forward: %.3g", diff)
+    if diff > args.atol:
+        raise SystemExit(
+            f"imported forward disagrees with the reference: max |Δ| = "
+            f"{diff:.3g} > atol {args.atol:.3g} — refusing to write"
+        )
+
+    os.makedirs(args.model_path, exist_ok=True)
+    meta = TrainMeta(
+        epoch=0,
+        best_f1=None,
+        rng_impl=config.rng_impl,
+        vocab_pad_multiple=1,
+    )
+    path = save_checkpoint(args.model_path, state, meta, slot="best")
+    save_inference_meta(args.model_path, config, model_config, data)
+    print(
+        json.dumps(
+            {
+                "imported": os.path.abspath(path),
+                "probe_max_abs_logit_diff": diff,
+                **dims,
+            }
+        )
+    )
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Convert a reference code2vec.model (torch state_dict) "
+        "into a checkpoint of this framework."
+    )
+    parser.add_argument(
+        "--reference_model", required=True,
+        help="path to code2vec.model (or the directory containing it)",
+    )
+    parser.add_argument("--corpus_path", required=True)
+    parser.add_argument("--terminal_idx_path", required=True)
+    parser.add_argument("--path_idx_path", required=True)
+    parser.add_argument("--model_path", required=True, help="output dir")
+    parser.add_argument("--max_path_length", type=int, default=200)
+    parser.add_argument("--dropout_prob", type=float, default=0.25)
+    # runtime constants of the margin head — not stored in the state_dict
+    # (reference main.py:74-75 defaults)
+    parser.add_argument("--angular_margin", type=float, default=0.5)
+    parser.add_argument("--inverse_temp", type=float, default=30.0)
+    from code2vec_tpu.cli import _strtobool
+
+    # same parser as the main CLI: "true"/"1"/"yes" all work, bad values
+    # error loudly instead of silently flipping the label vocab
+    parser.add_argument("--infer_method_name", type=_strtobool, default=True)
+    parser.add_argument("--infer_variable_name", type=_strtobool, default=False)
+    parser.add_argument("--no_corpus_cache", action="store_true")
+    parser.add_argument("--atol", type=float, default=2e-4)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    # inference-scale work: pin CPU like predict does (the ambient
+    # JAX_PLATFORMS may point at a cold/wedged device tunnel)
+    from code2vec_tpu.cli import pin_platform
+
+    pin_platform(True)
+    run_import(args)
+
+
+if __name__ == "__main__":
+    main()
