@@ -1,0 +1,225 @@
+"""Two-way checkpoint interop with the reference implementation.
+
+The reference trains a torch ``Code2Vec`` and persists
+``torch.save(model.state_dict(), <model_path>/code2vec.model)`` on every
+new best F1 (reference main.py:231). This module holds the lossless
+tensor mapping between that state_dict and our flax param tree, plus the
+torch-side oracle forward used to gate conversions — shared by
+``tools/import_reference_checkpoint.py`` (theirs → ours) and
+``tools/export_reference_checkpoint.py`` (ours → theirs).
+
+Mapping (reference model/model.py:21-42 → models/code2vec.py):
+
+    terminal_embedding.weight [T, dt]  ↔ terminal_embedding.embedding
+    path_embedding.weight     [P, dp]  ↔ path_embedding.embedding
+    input_linear.weight   [E, 2dt+dp]  ↔ input_dense.kernel (TRANSPOSED —
+                                         torch Linear stores [out, in];
+                                         concat order start|path|end is
+                                         the same on both sides)
+    input_layer_norm.weight/bias  [E]  ↔ input_layer_norm.scale/bias
+    attention_parameter           [E]  ↔ attention
+    output_linear.weight/bias (plain)  ↔ output_dense.kernel (T)/bias
+    output_linear (margin Parameter)   ↔ output_margin_weight
+
+Both directions assume ``vocab_pad_multiple == 1`` shapes (the reference
+has no padding); exporting a padded checkpoint slices the pad rows off,
+which is exact because pad rows never receive gradient (their indices
+never occur in data).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+PLAIN_KEYS = {
+    "terminal_embedding.weight",
+    "path_embedding.weight",
+    "input_linear.weight",
+    "input_layer_norm.weight",
+    "input_layer_norm.bias",
+    "attention_parameter",
+    "output_linear.weight",
+    "output_linear.bias",
+}
+MARGIN_KEYS = (PLAIN_KEYS - {"output_linear.weight", "output_linear.bias"}) | {
+    "output_linear"
+}
+
+
+def load_state_dict(path: str) -> dict[str, np.ndarray]:
+    """torch.load the reference state_dict (cpu, weights_only) → numpy."""
+    import torch
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "code2vec.model")
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    arrays = {
+        k: np.asarray(v.detach().cpu().numpy(), np.float32) for k, v in sd.items()
+    }
+    keys = set(arrays)
+    if keys not in (PLAIN_KEYS, MARGIN_KEYS):
+        raise SystemExit(
+            f"unrecognized state_dict layout: {sorted(keys)}\n"
+            "expected the reference Code2Vec model "
+            "(model/model.py:21-42, plain or angular-margin head)"
+        )
+    return arrays
+
+
+def save_state_dict(sd: dict[str, np.ndarray], path: str) -> str:
+    """numpy → torch.save, the file the reference's load expects."""
+    import torch
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "code2vec.model")
+    torch.save(
+        {k: torch.from_numpy(np.array(v, np.float32)) for k, v in sd.items()},
+        path,
+    )
+    return path
+
+
+def infer_dims(sd: dict[str, np.ndarray]) -> dict:
+    t_count, t_dim = sd["terminal_embedding.weight"].shape
+    p_count, p_dim = sd["path_embedding.weight"].shape
+    encode = sd["input_layer_norm.weight"].shape[0]
+    margin = "output_linear.weight" not in sd
+    head = sd["output_linear"] if margin else sd["output_linear.weight"]
+    label_count = head.shape[0]
+    expect_in = 2 * t_dim + p_dim
+    got_out, got_in = sd["input_linear.weight"].shape
+    if (got_out, got_in) != (encode, expect_in):
+        raise SystemExit(
+            f"input_linear.weight is {got_out}x{got_in}, expected "
+            f"{encode}x{expect_in} (encode x 2*terminal_embed+path_embed)"
+        )
+    return {
+        "terminal_count": t_count,
+        "path_count": p_count,
+        "label_count": label_count,
+        "terminal_embed_size": t_dim,
+        "path_embed_size": p_dim,
+        "encode_size": encode,
+        "angular_margin_loss": margin,
+    }
+
+
+def to_param_tree(sd: dict[str, np.ndarray], dims: dict) -> dict:
+    """state_dict → the flax param tree for Code2Vec(vocab_pad_multiple=1)."""
+    tree = {
+        "terminal_embedding": {"embedding": sd["terminal_embedding.weight"]},
+        "path_embedding": {"embedding": sd["path_embedding.weight"]},
+        "input_dense": {"kernel": sd["input_linear.weight"].T.copy()},
+        "input_layer_norm": {
+            "scale": sd["input_layer_norm.weight"],
+            "bias": sd["input_layer_norm.bias"],
+        },
+        "attention": sd["attention_parameter"],
+    }
+    if dims["angular_margin_loss"]:
+        tree["output_margin_weight"] = sd["output_linear"]
+    else:
+        tree["output_dense"] = {
+            "kernel": sd["output_linear.weight"].T.copy(),
+            "bias": sd["output_linear.bias"],
+        }
+    return tree
+
+
+def from_param_tree(params: dict, model_config) -> dict[str, np.ndarray]:
+    """Flax param tree → state_dict, slicing off vocab-pad rows/columns.
+
+    Inverse of :func:`to_param_tree` for unpadded models; for padded ones
+    (``vocab_pad_multiple > 1``) the extra rows/head columns are dropped —
+    exact, since pad ids never occur in data and their rows keep their
+    init values without ever affecting a real logit.
+    """
+    c = model_config
+    p = {k: np.asarray(v, np.float32) for k, v in _flatten(params).items()}
+    sd = {
+        "terminal_embedding.weight": p["terminal_embedding/embedding"][
+            : c.terminal_count
+        ],
+        "path_embedding.weight": p["path_embedding/embedding"][: c.path_count],
+        "input_linear.weight": p["input_dense/kernel"].T.copy(),
+        "input_layer_norm.weight": p["input_layer_norm/scale"],
+        "input_layer_norm.bias": p["input_layer_norm/bias"],
+        "attention_parameter": p["attention"],
+    }
+    if c.angular_margin_loss:
+        sd["output_linear"] = p["output_margin_weight"][: c.label_count]
+    else:
+        sd["output_linear.weight"] = p["output_dense/kernel"].T[: c.label_count].copy()
+        sd["output_linear.bias"] = p["output_dense/bias"][: c.label_count]
+    return sd
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+def reference_forward(
+    sd: dict[str, np.ndarray],
+    dims: dict,
+    starts: np.ndarray,
+    paths: np.ndarray,
+    ends: np.ndarray,
+    labels: np.ndarray,
+    angular_margin: float,
+    inverse_temp: float,
+) -> np.ndarray:
+    """The reference forward (model/model.py:44-88) in torch, eval mode —
+    the oracle a conversion must reproduce before it is written."""
+    import math
+
+    import torch
+    import torch.nn.functional as F
+
+    # np.array copies: orbax-restored arrays can be non-writable, which
+    # torch.from_numpy warns about (it never writes here, but keep it clean)
+    t = {k: torch.from_numpy(np.array(v)) for k, v in sd.items()}
+    starts_t = torch.from_numpy(starts).long()
+    paths_t = torch.from_numpy(paths).long()
+    ends_t = torch.from_numpy(ends).long()
+    ccv = torch.cat(
+        (
+            t["terminal_embedding.weight"][starts_t],
+            t["path_embedding.weight"][paths_t],
+            t["terminal_embedding.weight"][ends_t],
+        ),
+        dim=2,
+    )
+    ccv = ccv @ t["input_linear.weight"].T
+    ccv = F.layer_norm(
+        ccv, (dims["encode_size"],),
+        t["input_layer_norm.weight"], t["input_layer_norm.bias"],
+    )
+    ccv = torch.tanh(ccv)
+    mask = (starts_t > 0).float()
+    ninf = -3.4e38
+    attn = F.softmax(
+        (ccv * t["attention_parameter"]).sum(-1) * mask + (1 - mask) * ninf,
+        dim=1,
+    )
+    code_vector = (ccv * attn.unsqueeze(-1)).sum(1)
+    if dims["angular_margin_loss"]:
+        labels_t = torch.from_numpy(labels).long()
+        cosine = F.normalize(code_vector) @ F.normalize(t["output_linear"]).T
+        sine = torch.sqrt(torch.clamp(1.0 - cosine**2, min=0.0))
+        phi = cosine * math.cos(angular_margin) - sine * math.sin(angular_margin)
+        phi = torch.where(cosine > 0, phi, cosine)
+        one_hot = torch.zeros_like(cosine)
+        one_hot.scatter_(1, labels_t.view(-1, 1), 1)
+        out = ((one_hot * phi) + ((1.0 - one_hot) * cosine)) * inverse_temp
+    else:
+        out = code_vector @ t["output_linear.weight"].T + t["output_linear.bias"]
+    return out.numpy()

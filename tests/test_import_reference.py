@@ -18,14 +18,26 @@ import pytest
 _TOOL = os.path.join(
     os.path.dirname(__file__), "..", "tools", "import_reference_checkpoint.py"
 )
+_EXPORT_TOOL = os.path.join(
+    os.path.dirname(__file__), "..", "tools", "export_reference_checkpoint.py"
+)
+
+
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 @pytest.fixture(scope="module")
 def tool():
-    spec = importlib.util.spec_from_file_location("_import_tool", _TOOL)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return _load(_TOOL, "_import_tool")
+
+
+@pytest.fixture(scope="module")
+def export_tool():
+    return _load(_EXPORT_TOOL, "_export_tool")
 
 
 @pytest.fixture(scope="module")
@@ -175,6 +187,102 @@ def test_unknown_layout_refuses(tool, dataset, tmp_path):
     torch.save({"some.other.weight": torch.zeros(3)}, sd_path)
     with pytest.raises(SystemExit, match="unrecognized state_dict layout"):
         _run_tool(tool, tmp_path, paths, sd_path)
+
+
+@pytest.mark.parametrize("margin", [False, True], ids=["plain", "margin"])
+def test_export_round_trips_to_reference_format(
+    tool, export_tool, dataset, tmp_path, capsys, margin
+):
+    """ours → theirs (tools/export_reference_checkpoint): importing a
+    state_dict and exporting it back reproduces every tensor exactly —
+    the conversion is lossless in both directions."""
+    import torch
+
+    paths, data = dataset
+    sd = _make_state_dict(data, margin=margin)
+    sd_path = tmp_path / "code2vec.model"
+    torch.save(sd, sd_path)
+    out_dir = _run_tool(tool, tmp_path, paths, sd_path)
+    capsys.readouterr()
+
+    rt_path = tmp_path / "roundtrip.model"
+    export_tool.main(
+        ["--model_path", str(out_dir), "--output", str(rt_path)]
+    )
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["probe_max_abs_logit_diff"] < 2e-4
+    assert report["angular_margin_loss"] is margin
+
+    rt = torch.load(rt_path, map_location="cpu", weights_only=True)
+    assert set(rt) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(
+            rt[k].numpy(), sd[k].numpy(), err_msg=k
+        )
+
+
+def test_export_slices_vocab_padding(export_tool, dataset, tmp_path, capsys):
+    """A model trained with vocab_pad_multiple > 1 (sharded tables) exports
+    with the pad rows/head columns sliced off — the reference has no
+    padding, and pad ids never receive gradient, so the slice is exact."""
+    import jax
+    import torch
+
+    from code2vec_tpu.checkpoint import TrainMeta, save_checkpoint
+    from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.predict import save_inference_meta
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    _paths, data = dataset
+    pad = 8  # vocab sizes here are not multiples of 8 -> real pad rows
+    model_config = Code2VecConfig(
+        terminal_count=len(data.terminal_vocab),
+        path_count=len(data.path_vocab),
+        label_count=len(data.label_vocab),
+        terminal_embed_size=12, path_embed_size=14, encode_size=16,
+        vocab_pad_multiple=pad,
+    )
+    assert model_config.padded(model_config.terminal_count) > model_config.terminal_count
+    config = TrainConfig(
+        batch_size=4, max_path_length=20,
+        terminal_embed_size=12, path_embed_size=14, encode_size=16,
+        vocab_pad_multiple=pad, infer_method_name=True,
+    )
+    rng = np.random.default_rng(1)
+    epoch = build_method_epoch(data, np.arange(4), 20, rng)
+    batch = next(iter_batches(epoch, 4, rng=rng, pad_final=False))
+    state = create_train_state(config, model_config, jax.random.PRNGKey(2), batch)
+
+    out_dir = tmp_path / "padded_model"
+    os.makedirs(out_dir)
+    save_checkpoint(
+        str(out_dir), state,
+        TrainMeta(rng_impl=config.rng_impl, vocab_pad_multiple=pad),
+        slot="best",
+    )
+    save_inference_meta(str(out_dir), config, model_config, data)
+
+    rt_path = tmp_path / "padded.model"
+    export_tool.main(["--model_path", str(out_dir), "--output", str(rt_path)])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["probe_max_abs_logit_diff"] < 2e-4
+
+    rt = torch.load(rt_path, map_location="cpu", weights_only=True)
+    T, L = len(data.terminal_vocab), len(data.label_vocab)
+    assert rt["terminal_embedding.weight"].shape == (T, 12)
+    assert rt["path_embedding.weight"].shape == (len(data.path_vocab), 14)
+    assert rt["output_linear.weight"].shape == (L, 16)
+    # the kept rows/columns are exactly the unpadded slices of the params
+    np.testing.assert_array_equal(
+        rt["terminal_embedding.weight"].numpy(),
+        np.asarray(state.params["terminal_embedding"]["embedding"])[:T],
+    )
+    np.testing.assert_array_equal(
+        rt["output_linear.weight"].numpy(),
+        np.asarray(state.params["output_dense"]["kernel"]).T[:L],
+    )
 
 
 def test_exports_vectors_from_imported_checkpoint(tool, dataset, tmp_path, capsys):

@@ -1,0 +1,182 @@
+"""Export a checkpoint of THIS framework to the reference's format.
+
+The inverse of ``tools/import_reference_checkpoint.py``: converts a
+trained model dir (orbax checkpoint + ``model_meta.json``) into the
+``code2vec.model`` torch state_dict the reference's
+``torch.save(model.state_dict(), ...)`` produces (reference main.py:231)
+— so models trained here can be served or fine-tuned by existing torch
+infrastructure, completing the two-way migration story:
+
+    python tools/export_reference_checkpoint.py \
+        --model_path out/ \
+        --output /path/to/refout/code2vec.model
+
+Dims and head type come from ``model_meta.json`` (written at train time,
+or by the import tool); vocab-pad rows/head columns beyond the true
+vocab sizes are sliced off — exact, because pad ids never occur in data
+(see code2vec_tpu/interop.py). Before writing, the tool replays the
+reference forward (torch, eval mode) against ours on a random probe
+batch and refuses unless the logits agree to --atol.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logger = logging.getLogger("export_reference_checkpoint")
+
+from code2vec_tpu.interop import (  # noqa: E402 - after sys.path insert
+    from_param_tree,
+    infer_dims,
+    reference_forward,
+    save_state_dict,
+)
+
+
+def run_export(args) -> None:
+    meta_file = os.path.join(args.model_path, "model_meta.json")
+    if not os.path.exists(meta_file):
+        raise SystemExit(
+            f"{meta_file} not found — the model dir must come from a train "
+            "run (or tools/import_reference_checkpoint.py), which persists "
+            "the model dims there"
+        )
+    with open(meta_file) as f:
+        meta = json.load(f)
+
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.checkpoint import restore_checkpoint
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    model_config = Code2VecConfig(
+        terminal_count=meta["terminal_count"],
+        path_count=meta["path_count"],
+        label_count=meta["label_count"],
+        terminal_embed_size=meta["terminal_embed_size"],
+        path_embed_size=meta["path_embed_size"],
+        encode_size=meta["encode_size"],
+        angular_margin_loss=meta["angular_margin_loss"],
+        angular_margin=meta["angular_margin"],
+        inverse_temp=meta["inverse_temp"],
+        vocab_pad_multiple=meta.get("vocab_pad_multiple") or 1,
+    )
+    config = TrainConfig(
+        batch_size=4,
+        max_path_length=meta.get("max_path_length", 200),
+        rng_impl=meta.get("rng_impl", "threefry2x32"),
+    )
+
+    # a synthetic probe batch is enough: the probe compares the two
+    # forwards on the SAME inputs, it does not need real data
+    rng = np.random.default_rng(0)
+    bag = min(32, config.max_path_length)
+    batch = {
+        "starts": rng.integers(
+            1, meta["terminal_count"], (4, bag), dtype=np.int32
+        ),
+        "paths": rng.integers(1, meta["path_count"], (4, bag), dtype=np.int32),
+        "ends": rng.integers(
+            1, meta["terminal_count"], (4, bag), dtype=np.int32
+        ),
+        "labels": rng.integers(0, meta["label_count"], (4,), dtype=np.int32),
+        "example_mask": np.ones((4,), np.float32),
+    }
+    batch["starts"][:, bag // 2:] = 0  # exercise the padding mask too
+
+    template = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), batch
+    )
+    restored = restore_checkpoint(
+        args.model_path, template,
+        vocab_pad_multiple=model_config.vocab_pad_multiple,
+        prefer_best=True,
+    )
+    if restored is None:
+        raise SystemExit(f"no checkpoint found under {args.model_path}")
+    state, _train_meta = restored
+
+    sd = from_param_tree(jax.tree.map(np.asarray, state.params), model_config)
+    # re-derive dims from the converted tensors: catches a model_meta.json
+    # that disagrees with the checkpoint with a clear message instead of a
+    # confusing layer_norm shape error in the probe
+    dims = infer_dims(sd)
+    for key in ("encode_size", "angular_margin_loss", "label_count"):
+        if dims[key] != meta[key]:
+            raise SystemExit(
+                f"model_meta.json disagrees with the checkpoint: {key} is "
+                f"{meta[key]} in the meta but {dims[key]} in the tensors"
+            )
+
+    ours, _cv, _attn = state.apply_fn(
+        {"params": state.params},
+        jnp.asarray(batch["starts"]), jnp.asarray(batch["paths"]),
+        jnp.asarray(batch["ends"]),
+        labels=jnp.asarray(batch["labels"]), deterministic=True,
+    )
+    theirs = reference_forward(
+        sd, dims,
+        batch["starts"], batch["paths"], batch["ends"], batch["labels"],
+        meta["angular_margin"], meta["inverse_temp"],
+    )
+    diff = float(np.max(np.abs(np.asarray(ours, np.float32) - theirs)))
+    logger.info("probe max |Δlogits| vs the reference forward: %.3g", diff)
+    if diff > args.atol:
+        raise SystemExit(
+            f"exported forward disagrees with this checkpoint: max |Δ| = "
+            f"{diff:.3g} > atol {args.atol:.3g} — refusing to write"
+        )
+
+    out_dir = os.path.dirname(os.path.abspath(args.output))
+    os.makedirs(out_dir, exist_ok=True)
+    path = save_state_dict(sd, args.output)
+    print(
+        json.dumps(
+            {
+                "exported": os.path.abspath(path),
+                "probe_max_abs_logit_diff": diff,
+                "terminal_count": meta["terminal_count"],
+                "path_count": meta["path_count"],
+                "label_count": meta["label_count"],
+                "angular_margin_loss": meta["angular_margin_loss"],
+            }
+        )
+    )
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Convert a trained model dir of this framework into the "
+        "reference's code2vec.model torch state_dict."
+    )
+    parser.add_argument(
+        "--model_path", required=True,
+        help="trained model dir (checkpoint + model_meta.json)",
+    )
+    parser.add_argument(
+        "--output", required=True,
+        help="output file (conventionally <dir>/code2vec.model)",
+    )
+    parser.add_argument("--atol", type=float, default=2e-4)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    from code2vec_tpu.cli import pin_platform
+
+    pin_platform(True)
+    run_export(args)
+
+
+if __name__ == "__main__":
+    main()

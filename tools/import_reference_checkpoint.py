@@ -55,140 +55,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 logger = logging.getLogger("import_reference_checkpoint")
 
-PLAIN_KEYS = {
-    "terminal_embedding.weight",
-    "path_embedding.weight",
-    "input_linear.weight",
-    "input_layer_norm.weight",
-    "input_layer_norm.bias",
-    "attention_parameter",
-    "output_linear.weight",
-    "output_linear.bias",
-}
-MARGIN_KEYS = (PLAIN_KEYS - {"output_linear.weight", "output_linear.bias"}) | {
-    "output_linear"
-}
-
-
-def load_state_dict(path: str) -> dict[str, np.ndarray]:
-    """torch.load the reference state_dict (cpu, weights_only) → numpy."""
-    import torch
-
-    if os.path.isdir(path):
-        path = os.path.join(path, "code2vec.model")
-    sd = torch.load(path, map_location="cpu", weights_only=True)
-    arrays = {k: np.asarray(v.detach().cpu().numpy(), np.float32) for k, v in sd.items()}
-    keys = set(arrays)
-    if keys not in (PLAIN_KEYS, MARGIN_KEYS):
-        raise SystemExit(
-            f"unrecognized state_dict layout: {sorted(keys)}\n"
-            "expected the reference Code2Vec model "
-            "(model/model.py:21-42, plain or angular-margin head)"
-        )
-    return arrays
-
-
-def infer_dims(sd: dict[str, np.ndarray]) -> dict:
-    t_count, t_dim = sd["terminal_embedding.weight"].shape
-    p_count, p_dim = sd["path_embedding.weight"].shape
-    encode = sd["input_layer_norm.weight"].shape[0]
-    margin = "output_linear.weight" not in sd
-    head = sd["output_linear"] if margin else sd["output_linear.weight"]
-    label_count = head.shape[0]
-    expect_in = 2 * t_dim + p_dim
-    got_out, got_in = sd["input_linear.weight"].shape
-    if (got_out, got_in) != (encode, expect_in):
-        raise SystemExit(
-            f"input_linear.weight is {got_out}x{got_in}, expected "
-            f"{encode}x{expect_in} (encode x 2*terminal_embed+path_embed)"
-        )
-    return {
-        "terminal_count": t_count,
-        "path_count": p_count,
-        "label_count": label_count,
-        "terminal_embed_size": t_dim,
-        "path_embed_size": p_dim,
-        "encode_size": encode,
-        "angular_margin_loss": margin,
-    }
-
-
-def to_param_tree(sd: dict[str, np.ndarray], dims: dict) -> dict:
-    """The flax param tree for Code2Vec(vocab_pad_multiple=1)."""
-    tree = {
-        "terminal_embedding": {"embedding": sd["terminal_embedding.weight"]},
-        "path_embedding": {"embedding": sd["path_embedding.weight"]},
-        "input_dense": {"kernel": sd["input_linear.weight"].T.copy()},
-        "input_layer_norm": {
-            "scale": sd["input_layer_norm.weight"],
-            "bias": sd["input_layer_norm.bias"],
-        },
-        "attention": sd["attention_parameter"],
-    }
-    if dims["angular_margin_loss"]:
-        tree["output_margin_weight"] = sd["output_linear"]
-    else:
-        tree["output_dense"] = {
-            "kernel": sd["output_linear.weight"].T.copy(),
-            "bias": sd["output_linear.bias"],
-        }
-    return tree
-
-
-def reference_forward(
-    sd: dict[str, np.ndarray],
-    dims: dict,
-    starts: np.ndarray,
-    paths: np.ndarray,
-    ends: np.ndarray,
-    labels: np.ndarray,
-    angular_margin: float,
-    inverse_temp: float,
-) -> np.ndarray:
-    """The reference forward (model/model.py:44-88) in torch, eval mode —
-    the oracle the imported params must reproduce."""
-    import math
-
-    import torch
-    import torch.nn.functional as F
-
-    t = {k: torch.from_numpy(v) for k, v in sd.items()}
-    starts_t = torch.from_numpy(starts).long()
-    paths_t = torch.from_numpy(paths).long()
-    ends_t = torch.from_numpy(ends).long()
-    ccv = torch.cat(
-        (
-            t["terminal_embedding.weight"][starts_t],
-            t["path_embedding.weight"][paths_t],
-            t["terminal_embedding.weight"][ends_t],
-        ),
-        dim=2,
-    )
-    ccv = ccv @ t["input_linear.weight"].T
-    ccv = F.layer_norm(
-        ccv, (dims["encode_size"],),
-        t["input_layer_norm.weight"], t["input_layer_norm.bias"],
-    )
-    ccv = torch.tanh(ccv)
-    mask = (starts_t > 0).float()
-    ninf = -3.4e38
-    attn = F.softmax(
-        (ccv * t["attention_parameter"]).sum(-1) * mask + (1 - mask) * ninf,
-        dim=1,
-    )
-    code_vector = (ccv * attn.unsqueeze(-1)).sum(1)
-    if dims["angular_margin_loss"]:
-        labels_t = torch.from_numpy(labels).long()
-        cosine = F.normalize(code_vector) @ F.normalize(t["output_linear"]).T
-        sine = torch.sqrt(torch.clamp(1.0 - cosine**2, min=0.0))
-        phi = cosine * math.cos(angular_margin) - sine * math.sin(angular_margin)
-        phi = torch.where(cosine > 0, phi, cosine)
-        one_hot = torch.zeros_like(cosine)
-        one_hot.scatter_(1, labels_t.view(-1, 1), 1)
-        out = ((one_hot * phi) + ((1.0 - one_hot) * cosine)) * inverse_temp
-    else:
-        out = code_vector @ t["output_linear.weight"].T + t["output_linear.bias"]
-    return out.numpy()
+from code2vec_tpu.interop import (  # noqa: E402 - after sys.path insert
+    infer_dims,
+    load_state_dict,
+    reference_forward,
+    to_param_tree,
+)
 
 
 def run_import(args) -> None:
