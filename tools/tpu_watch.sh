@@ -7,10 +7,13 @@ cd "$(dirname "$0")/.."
 
 # a probe killed by timeout can itself leave the tunnel wedged
 # (.claude/skills/verify/SKILL.md gotchas), so: a long initial quiet
-# period, then infrequent probes
+# period, then infrequent probes. TPU_WATCH_QUIET/TPU_WATCH_PROBES bound
+# the lifetime — an unbounded watcher left running becomes a stray
+# concurrent tunnel client for whoever measures next (e.g. the driver's
+# end-of-round bench).
 echo "[tpu_watch] quiet period $(date)"
-sleep 900
-for i in $(seq 1 60); do
+sleep "${TPU_WATCH_QUIET:-900}"
+for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
   # bench.py's probe: a real compile+dispatch in a killable subprocess
   # (jax.devices() can answer on a tunnel whose first compile then hangs,
   # observed 2026-07-30) with the shared persistent compile cache
