@@ -1,0 +1,95 @@
+"""Property-based parity: our label normalization vs the REFERENCE's own code.
+
+The subtoken metrics (and hence every reported F1) sit on top of
+``normalize_method_name``/``subtokenize``; a silent divergence from the
+reference regexes would skew every quality number while all golden tests
+still pass. These tests import the reference's actual ``Vocab`` from
+/root/reference (skipped when the checkout is absent) and fuzz both
+implementations with hypothesis over adversarial identifier shapes —
+digit/underscore runs, caps runs (``HTMLParser``), unicode letters, and
+arbitrary text — asserting byte-identical outputs.
+"""
+
+import os
+import sys
+
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_REFERENCE = os.environ.get("CODE2VEC_REFERENCE", "/root/reference")
+if not os.path.isdir(os.path.join(_REFERENCE, "model")):
+    pytest.skip(
+        "reference checkout not available", allow_module_level=True
+    )
+sys.path.insert(0, _REFERENCE)
+try:
+    from model.dataset import Vocab as ReferenceVocab  # noqa: E402
+except ImportError as exc:  # e.g. the reference needs torch; env lacks it
+    pytest.skip(
+        f"reference Vocab not importable: {exc}", allow_module_level=True
+    )
+finally:
+    # don't leave the reference checkout on sys.path for the rest of the
+    # suite — its root main.py / model package could shadow repo modules
+    sys.path.remove(_REFERENCE)
+
+from code2vec_tpu.text import (  # noqa: E402
+    normalize_method_name,
+    subtokenize,
+)
+
+# identifier-ish strings: the shapes real corpora produce, plus hostile ones
+_ident_chars = st.sampled_from(
+    list("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_$")
+)
+identifiers = st.text(_ident_chars, min_size=0, max_size=40)
+# anything at all — the reference applies these regexes to raw label text,
+# so ours must match on arbitrary input too (unicode letters included)
+arbitrary = st.text(min_size=0, max_size=40)
+
+
+@settings(max_examples=2000, deadline=None)
+@given(identifiers | arbitrary)
+def test_normalize_matches_reference(name):
+    assert normalize_method_name(name) == ReferenceVocab.normalize_method_name(
+        name
+    )
+
+
+@settings(max_examples=2000, deadline=None)
+@given(identifiers | arbitrary)
+def test_subtokens_match_reference(name):
+    # the reference subtokenizes the NORMALIZED name (dataset_reader.py:97-100)
+    normalized = ReferenceVocab.normalize_method_name(name)
+    assert subtokenize(normalized) == ReferenceVocab.get_method_subtokens(
+        normalized
+    )
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "toString",
+        "HTMLParser",
+        "a",
+        "A",
+        "_",
+        "__init__",
+        "get2ndValue",
+        "parseHTTPResponse2JSON",
+        "ALLCAPS",
+        "snake_case_name",
+        "ñiño",  # unicode lowercase: [a-z] must NOT match it, in both
+        "ÉclairBuilder",
+    ],
+)
+def test_known_edges_match_reference(name):
+    assert normalize_method_name(name) == ReferenceVocab.normalize_method_name(
+        name
+    )
+    normalized = ReferenceVocab.normalize_method_name(name)
+    assert subtokenize(normalized) == ReferenceVocab.get_method_subtokens(
+        normalized
+    )
