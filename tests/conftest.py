@@ -30,3 +30,31 @@ assert jax.default_backend() == "cpu" and jax.device_count() >= 8, (
 
 def pytest_report_header(config):
     return f"jax devices: {jax.device_count()} ({jax.default_backend()})"
+
+
+def import_reference(module_name: str):
+    """Import a module from the reference checkout for oracle tests.
+
+    Skips the calling module when the checkout (CODE2VEC_REFERENCE, default
+    /root/reference) or its dependencies (torch) are absent, and keeps the
+    checkout off sys.path afterwards — its root main.py / model package
+    could shadow repo modules.
+    """
+    import importlib
+    import sys as _sys
+
+    import pytest as _pytest
+
+    reference = os.environ.get("CODE2VEC_REFERENCE", "/root/reference")
+    if not os.path.isdir(os.path.join(reference, "model")):
+        _pytest.skip("reference checkout not available", allow_module_level=True)
+    _sys.path.insert(0, reference)
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as exc:
+        _pytest.skip(
+            f"reference {module_name} not importable: {exc}",
+            allow_module_level=True,
+        )
+    finally:
+        _sys.path.remove(reference)

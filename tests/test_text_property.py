@@ -10,30 +10,14 @@ digit/underscore runs, caps runs (``HTMLParser``), unicode letters, and
 arbitrary text — asserting byte-identical outputs.
 """
 
-import os
-import sys
-
 import pytest
 
 hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st  # noqa: E402
 
-_REFERENCE = os.environ.get("CODE2VEC_REFERENCE", "/root/reference")
-if not os.path.isdir(os.path.join(_REFERENCE, "model")):
-    pytest.skip(
-        "reference checkout not available", allow_module_level=True
-    )
-sys.path.insert(0, _REFERENCE)
-try:
-    from model.dataset import Vocab as ReferenceVocab  # noqa: E402
-except ImportError as exc:  # e.g. the reference needs torch; env lacks it
-    pytest.skip(
-        f"reference Vocab not importable: {exc}", allow_module_level=True
-    )
-finally:
-    # don't leave the reference checkout on sys.path for the rest of the
-    # suite — its root main.py / model package could shadow repo modules
-    sys.path.remove(_REFERENCE)
+from conftest import import_reference  # noqa: E402
+
+ReferenceVocab = import_reference("model.dataset").Vocab
 
 from code2vec_tpu.text import (  # noqa: E402
     normalize_method_name,
