@@ -32,6 +32,75 @@ def pytest_report_header(config):
     return f"jax devices: {jax.device_count()} ({jax.default_backend()})"
 
 
+def make_reference_corpus(
+    tmp_path,
+    rng,
+    *,
+    n_methods=20,
+    n_terminals=28,
+    n_paths=32,
+    n_vars=4,
+    min_ctx=1,
+    max_ctx=12,
+    label_fn=None,
+    alias_fn=None,
+    include_method_token=False,
+):
+    """Write a random corpus + idx files for reference-oracle tests.
+
+    Shared by the reader/builder differential suites so the corpus format
+    lives in one place. ``label_fn(i, rng) -> str`` and
+    ``alias_fn(i, v, rng) -> str`` customize label/alias-original naming
+    (defaults: unique per method / per alias). Returns
+    (corpus, path_idx, terminal_idx) paths.
+    """
+    from code2vec_tpu.formats.corpus_io import CorpusRecord, write_corpus
+    from code2vec_tpu.formats.vocab_io import write_vocab_from_names
+
+    if label_fn is None:
+        label_fn = lambda i, _rng: f"method{i}Name"  # noqa: E731
+    if alias_fn is None:
+        alias_fn = lambda i, v, _rng: f"orig{i}Var{v}"  # noqa: E731
+    plain = n_terminals - n_vars - (1 if include_method_token else 0)
+    terminal_names = [f"term{i}" for i in range(plain)]
+    if include_method_token:
+        terminal_names.append("@method_0")
+    terminal_names += [f"@var_{i}" for i in range(n_vars)]
+    if not include_method_token:
+        rng.shuffle(terminal_names)
+    write_vocab_from_names(tmp_path / "terminal_idxs.txt", terminal_names)
+    write_vocab_from_names(
+        tmp_path / "path_idxs.txt", [f"path{i}" for i in range(n_paths)]
+    )
+    records = []
+    for i in range(n_methods):
+        n_ctx = int(rng.integers(min_ctx, max_ctx + 1))
+        contexts = [
+            (
+                int(rng.integers(0, n_terminals)),
+                int(rng.integers(1, n_paths + 1)),
+                int(rng.integers(0, n_terminals)),
+            )
+            for _ in range(n_ctx)
+        ]
+        aliases = [
+            (alias_fn(i, v, rng), f"@var_{v}")
+            for v in range(int(rng.integers(0, n_vars)))
+        ]
+        records.append(
+            CorpusRecord(
+                id=i * 7 + 1,
+                label=label_fn(i, rng),
+                source=f"com/example/C{i}.java",
+                path_contexts=contexts,
+                aliases=aliases,
+            )
+        )
+    corpus = tmp_path / "corpus.txt"
+    write_corpus(corpus, records)
+    return corpus, tmp_path / "path_idxs.txt", tmp_path / "terminal_idxs.txt"
+
+
 def import_reference(module_name: str):
     """Import a module from the reference checkout for oracle tests.
 

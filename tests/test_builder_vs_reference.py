@@ -1,0 +1,202 @@
+"""Differential test: our epoch builders vs the REFERENCE's DatasetBuilder.
+
+`data/pipeline.py` mirrors the reference's per-epoch tensor construction
+(model/dataset_builder.py:112-210): @question substitution, per-method
+context subsampling, and the variable-task expansion (one example per
+@var alias, target renamed to @question). Both sides shuffle with their
+own RNGs, so rows are compared as SORTED context triples (order within a
+bag is irrelevant to the permutation-invariant attention pooling), and
+the subsample case (n > L) is checked against its invariants
+(every row a without-replacement subset of the item's contexts).
+
+The reference's `build_data` is invoked directly on an
+``object.__new__``-constructed builder (its ``__init__`` only does the
+unseeded train/test split and logging, neither of which is under test).
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from conftest import import_reference, make_reference_corpus
+
+_builder_mod = import_reference("model.dataset_builder")
+ReferenceReader = import_reference("model.dataset_reader").DatasetReader
+
+from code2vec_tpu.data.pipeline import (  # noqa: E402
+    build_method_epoch,
+    build_variable_epoch,
+)
+from code2vec_tpu.data.reader import load_corpus  # noqa: E402
+
+L = 20  # max_path_length for all tests
+
+
+def _reference_build(reader, items, max_path_length):
+    b = object.__new__(_builder_mod.DatasetBuilder)
+    b.reader = reader
+    ids, starts, paths, ends, labels = b.build_data(reader, items, max_path_length)
+    return (
+        ids,
+        starts.numpy(),
+        paths.numpy(),
+        ends.numpy(),
+        labels.numpy(),
+    )
+
+
+def _row_triples(starts, paths, ends):
+    """Sorted (start, path, end) triples of one row, pads (path==0) dropped."""
+    keep = paths != 0
+    return sorted(zip(starts[keep].tolist(), paths[keep].tolist(), ends[keep].tolist()))
+
+
+def _make_corpus(tmp_path, rng, **kwargs):
+    """Unique label per method and per (method, alias) so rows can be keyed."""
+    kwargs.setdefault("n_methods", 18)
+    kwargs.setdefault("n_terminals", 26)
+    kwargs.setdefault("n_paths", 30)
+    kwargs.setdefault("n_vars", 4)
+    return make_reference_corpus(
+        tmp_path, rng, include_method_token=True, **kwargs
+    )
+
+
+def _load_both(corpus, path_idx, terminal_idx, infer_method, infer_variable):
+    theirs_reader = ReferenceReader(
+        str(corpus), str(path_idx), str(terminal_idx),
+        infer_method=infer_method, infer_variable=infer_variable,
+        shuffle_variable_indexes=False,
+    )
+    ours = load_corpus(
+        corpus, path_idx, terminal_idx,
+        infer_method=infer_method, infer_variable=infer_variable,
+        cache=False,
+    )
+    return theirs_reader, ours
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_method_epoch_matches_reference(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    corpus, path_idx, terminal_idx = _make_corpus(tmp_path, rng)
+    theirs_reader, ours = _load_both(corpus, path_idx, terminal_idx, True, False)
+
+    ids_t, starts_t, paths_t, ends_t, labels_t = _reference_build(
+        theirs_reader, theirs_reader.items, L
+    )
+    epoch = build_method_epoch(
+        ours, np.arange(ours.n_items), L, np.random.default_rng(seed + 100)
+    )
+
+    assert epoch.ids.tolist() == ids_t
+    assert epoch.labels.tolist() == labels_t.tolist()
+    for i in range(len(ids_t)):
+        assert _row_triples(
+            epoch.starts[i], epoch.paths[i], epoch.ends[i]
+        ) == _row_triples(starts_t[i], paths_t[i], ends_t[i]), f"row {i}"
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_variable_epoch_matches_reference(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    corpus, path_idx, terminal_idx = _make_corpus(tmp_path, rng)
+    theirs_reader, ours = _load_both(corpus, path_idx, terminal_idx, False, True)
+
+    ids_t, starts_t, paths_t, ends_t, labels_t = _reference_build(
+        theirs_reader, theirs_reader.items, L
+    )
+    epoch = build_variable_epoch(
+        ours, np.arange(ours.n_items), L, np.random.default_rng(seed + 100)
+    )
+
+    # expansion order: items in order, aliases in insertion order — both
+    # sides iterate the same way, so ids/labels match as SEQUENCES
+    assert epoch.ids.tolist() == ids_t
+    assert epoch.labels.tolist() == labels_t.tolist()
+    for i in range(len(ids_t)):
+        assert _row_triples(
+            epoch.starts[i], epoch.paths[i], epoch.ends[i]
+        ) == _row_triples(starts_t[i], paths_t[i], ends_t[i]), f"example {i}"
+
+
+def test_variable_truncation_invariants(tmp_path):
+    """Per-alias bags larger than L: both sides keep an L-subset of the
+    alias's renamed contexts (truncate-after-filter+rename, dataset_builder
+    .py:196-199). Dense corpora (~360 contexts/method over 26 terminals)
+    push many aliases past L so the truncation branch genuinely runs."""
+    rng = np.random.default_rng(5)
+    corpus, path_idx, terminal_idx = _make_corpus(
+        tmp_path, rng, n_methods=6, min_ctx=350, max_ctx=400
+    )
+    theirs_reader, ours = _load_both(corpus, path_idx, terminal_idx, False, True)
+
+    _ids_t, starts_t, paths_t, ends_t, _labels_t = _reference_build(
+        theirs_reader, theirs_reader.items, L
+    )
+    epoch = build_variable_epoch(
+        ours, np.arange(ours.n_items), L, np.random.default_rng(6)
+    )
+
+    q = theirs_reader.QUESTION_TOKEN_INDEX
+    stoi = theirs_reader.terminal_vocab.stoi
+    row = 0
+    truncated_rows = 0
+    for item in theirs_reader.items:
+        for alias_name in item.aliases:
+            if not alias_name.startswith("@var_"):
+                continue
+            v = stoi[alias_name]
+            full = Counter(
+                (q if s == v else s, p, q if e == v else e)
+                for s, p, e in item.path_contexts
+                if s == v or e == v
+            )
+            want = min(sum(full.values()), L)
+            if want == L and sum(full.values()) > L:
+                truncated_rows += 1
+            for side_name, (s_row, p_row, e_row) in {
+                "ours": (epoch.starts[row], epoch.paths[row], epoch.ends[row]),
+                "theirs": (starts_t[row], paths_t[row], ends_t[row]),
+            }.items():
+                picked = Counter(_row_triples(s_row, p_row, e_row))
+                assert sum(picked.values()) == want, (side_name, row)
+                assert all(picked[t] <= full[t] for t in picked), (side_name, row)
+            row += 1
+    assert row == len(epoch.ids) == len(starts_t)
+    assert truncated_rows > 0, "corpus never exercised the truncation branch"
+
+
+def test_method_subsample_invariants(tmp_path):
+    """n > L rows: both sides draw a without-replacement L-subset of the
+    item's substituted contexts (the draws differ; the invariant must not)."""
+    rng = np.random.default_rng(3)
+    corpus, path_idx, terminal_idx = _make_corpus(
+        tmp_path, rng, min_ctx=L + 5, max_ctx=L + 15
+    )
+    theirs_reader, ours = _load_both(corpus, path_idx, terminal_idx, True, False)
+
+    _ids_t, starts_t, paths_t, ends_t, _labels_t = _reference_build(
+        theirs_reader, theirs_reader.items, L
+    )
+    epoch = build_method_epoch(
+        ours, np.arange(ours.n_items), L, np.random.default_rng(4)
+    )
+
+    # full substituted context multiset per item, from the oracle reader
+    # (reader parity is pinned by test_reader_vs_reference)
+    q = theirs_reader.QUESTION_TOKEN_INDEX
+    m = theirs_reader.terminal_vocab.stoi["@method_0"]
+    for i, item in enumerate(theirs_reader.items):
+        full = Counter(
+            (q if s == m else s, p, q if e == m else e)
+            for s, p, e in item.path_contexts
+        )
+        for side_name, (s_row, p_row, e_row) in {
+            "ours": (epoch.starts[i], epoch.paths[i], epoch.ends[i]),
+            "theirs": (starts_t[i], paths_t[i], ends_t[i]),
+        }.items():
+            picked = Counter(_row_triples(s_row, p_row, e_row))
+            assert sum(picked.values()) == L, (side_name, i)
+            assert all(picked[t] <= full[t] for t in picked), (side_name, i)

@@ -20,9 +20,9 @@ from conftest import import_reference
 
 ReferenceReader = import_reference("model.dataset_reader").DatasetReader
 
+from conftest import make_reference_corpus  # noqa: E402
+
 from code2vec_tpu.data.reader import load_corpus  # noqa: E402
-from code2vec_tpu.formats.corpus_io import CorpusRecord, write_corpus  # noqa: E402
-from code2vec_tpu.formats.vocab_io import write_vocab_from_names  # noqa: E402
 
 # label pool deliberately includes repeats-by-normalization ("getValue2" and
 # "getValue" collide), caps runs, and names that normalize to ""
@@ -33,42 +33,13 @@ _LABELS = [
 _ORIGINALS = ["userName", "i", "HTTPClient", "temp_1", "x2", "_private"]
 
 
-def _random_corpus(tmp_path, rng, n_methods=25, n_terminals=30, n_paths=40,
-                   n_vars=5):
-    terminal_names = [f"term{i}" for i in range(n_terminals - n_vars)] + [
-        f"@var_{i}" for i in range(n_vars)
-    ]
-    rng.shuffle(terminal_names)
-    path_names = [f"path{i}" for i in range(n_paths)]
-    write_vocab_from_names(tmp_path / "terminal_idxs.txt", terminal_names)
-    write_vocab_from_names(tmp_path / "path_idxs.txt", path_names)
-
-    records = []
-    for i in range(n_methods):
-        n_ctx = int(rng.integers(1, 12))
-        contexts = [
-            (
-                int(rng.integers(0, n_terminals)),
-                int(rng.integers(1, n_paths + 1)),
-                int(rng.integers(0, n_terminals)),
-            )
-            for _ in range(n_ctx)
-        ]
-        aliases = []
-        for v in range(int(rng.integers(0, n_vars))):
-            aliases.append((str(rng.choice(_ORIGINALS)), f"@var_{v}"))
-        records.append(
-            CorpusRecord(
-                id=i * 7 + 1,
-                label=str(rng.choice(_LABELS)),
-                source=f"com/example/C{i}.java",
-                path_contexts=contexts,
-                aliases=aliases,
-            )
-        )
-    corpus = tmp_path / "corpus.txt"
-    write_corpus(corpus, records)
-    return corpus, tmp_path / "path_idxs.txt", tmp_path / "terminal_idxs.txt"
+def _random_corpus(tmp_path, rng):
+    return make_reference_corpus(
+        tmp_path, rng,
+        n_methods=25, n_terminals=30, n_paths=40, n_vars=5,
+        label_fn=lambda i, r: str(r.choice(_LABELS)),
+        alias_fn=lambda i, v, r: str(r.choice(_ORIGINALS)),
+    )
 
 
 def _compare(ours, theirs):
