@@ -1,0 +1,100 @@
+"""Differential test: our eval metrics vs the REFERENCE's own evaluators.
+
+`metrics.py` re-implements main.py:300-359 (exact / subtoken /
+ave_subtoken); a drift in any of them would shift every reported quality
+number. These tests import the reference's actual functions from its
+main.py (argv patched to defaults — the module parses flags at import)
+and compare all four returned numbers on randomized label vocabularies
+and prediction vectors.
+
+The reference's `subtoken_match` calls ``.item()`` on elements produced
+by ``.tolist()`` — an upstream crash on plain arrays (python ints have no
+``.item()``). The oracle is driven through a thin sequence wrapper whose
+``tolist()`` yields numpy scalars, which exercises the reference code
+unmodified.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import import_reference
+
+_argv = sys.argv
+sys.argv = ["main.py"]
+try:
+    _ref_main = import_reference("main")
+finally:
+    sys.argv = _argv
+
+from code2vec_tpu import metrics  # noqa: E402
+from code2vec_tpu.data.vocab import Vocab  # noqa: E402
+from code2vec_tpu.text import normalize_and_subtokenize  # noqa: E402
+
+_NAMES = [
+    "getValue", "toString", "HTMLParser", "parseHTTPResponse", "a",
+    "setUserName", "indexOf", "X", "snake_case_name", "computeMax2",
+]
+
+
+class _NumpyScalarList(list):
+    """tolist() -> numpy scalars, so the reference's ``x.item()`` works."""
+
+    def tolist(self):
+        return [np.int64(x) for x in self]
+
+
+def _vocabs():
+    ours = Vocab()
+    theirs = _ref_main.Vocab()
+    for name in _NAMES:
+        ours.add_label(name)
+        normalized, subtokens = normalize_and_subtokenize(name)
+        theirs.append(normalized, subtokens=list(subtokens))
+    assert ours.itos == theirs.itos
+    return ours, theirs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_subtoken_match_matches_reference(seed):
+    ours_vocab, theirs_vocab = _vocabs()
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 60))
+    expected = rng.integers(0, len(_NAMES), n)
+    actual = rng.integers(0, len(_NAMES), n)
+
+    ours = metrics.subtoken_match(expected, actual, ours_vocab)
+    theirs = _ref_main.subtoken_match(
+        _NumpyScalarList(expected), _NumpyScalarList(actual), theirs_vocab
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_averaged_subtoken_match_matches_reference(seed):
+    ours_vocab, theirs_vocab = _vocabs()
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 60))
+    expected = rng.integers(0, len(_NAMES), n)
+    actual = rng.integers(0, len(_NAMES), n)
+
+    ours = metrics.averaged_subtoken_match(expected, actual, ours_vocab)
+    theirs = _ref_main.averaged_subtoken_match(
+        _NumpyScalarList(expected), _NumpyScalarList(actual), theirs_vocab
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-12)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_exact_match_matches_reference(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 60))
+    expected = rng.integers(0, len(_NAMES), n)
+    actual = np.where(
+        rng.random(n) < 0.5, expected, rng.integers(0, len(_NAMES), n)
+    )
+
+    ours = metrics.exact_match(expected, actual)
+    theirs = _ref_main.exact_match(expected, actual)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-12)
