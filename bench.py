@@ -496,6 +496,15 @@ def main() -> None:
         # unsafe_rbg: ~2 ms/step cheaper dropout bits (ablation winner);
         # fine for a throughput benchmark, selectable for training runs
         rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
+        # f32 default = torch parity; bfloat16 is the measured-on-demand
+        # HBM lever (tools/run_tpu_ablation.py has the A/B row). Same
+        # alias handling as BENCH_DTYPE: "bf16"/"bfloat16" opt in.
+        adam_mu_dtype=(
+            "bfloat16"
+            if os.environ.get("BENCH_ADAM_MU_DTYPE", "float32").strip().lower()
+            in ("bfloat16", "bf16")
+            else "float32"
+        ),
     )
 
     rng = np.random.default_rng(0)

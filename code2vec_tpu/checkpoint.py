@@ -41,6 +41,25 @@ class TrainMeta:
     # depend on it) — validated on restore so resuming under a different
     # model_axis fails with guidance, not an orbax shape error
     vocab_pad_multiple: int | None = None
+    # Adam first-moment storage dtype (--adam_mu_dtype) — validated on
+    # restore so resuming a bf16-mu checkpoint without the flag fails with
+    # guidance, not an orbax dtype error
+    adam_mu_dtype: str | None = None
+
+
+def _adam_mu_dtype_name(state) -> str | None:
+    """Dtype of the Adam first-moment buffers, read off the live opt_state
+    (None when no ScaleByAdamState is present — e.g. a bare template)."""
+    import optax
+
+    for leaf in jax.tree_util.tree_leaves(
+        state.opt_state,
+        is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState),
+    ):
+        if isinstance(leaf, optax.ScaleByAdamState):
+            mu_leaves = jax.tree_util.tree_leaves(leaf.mu)
+            return str(mu_leaves[0].dtype) if mu_leaves else None
+    return None
 
 
 def _rng_impl_name(dropout_rng) -> str:
@@ -90,6 +109,7 @@ def save_checkpoint(out_dir: str, state, meta: TrainMeta, slot: str = "best") ->
     os.makedirs(base, exist_ok=True)
     previous = _latest_step_dir(base, prefix)
     meta.rng_impl = _rng_impl_name(state.dropout_rng)
+    meta.adam_mu_dtype = _adam_mu_dtype_name(state) or meta.adam_mu_dtype
     path = os.path.join(base, f"{prefix}_{int(state.step)}")
     if os.path.exists(path):
         shutil.rmtree(path)
@@ -180,6 +200,16 @@ def restore_checkpoint(
             f"checkpoint in {base} was saved with --rng_impl "
             f"{saved_impl} but this run uses {want_impl}; pass "
             f"--rng_impl {saved_impl} to resume it"
+        )
+    want_mu = _adam_mu_dtype_name(state)
+    # metas from before the field hold f32 moments (the only behavior then);
+    # a template without Adam state (want_mu None) skips the check
+    saved_mu = saved_meta.adam_mu_dtype or "float32"
+    if want_mu is not None and saved_mu != want_mu:
+        raise ValueError(
+            f"checkpoint in {base} stores Adam first moments as "
+            f"{saved_mu} but this run uses {want_mu}; pass "
+            f"--adam_mu_dtype {saved_mu} to resume it"
         )
     saved_pad = saved_meta.vocab_pad_multiple
     if (

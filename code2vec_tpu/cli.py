@@ -170,6 +170,11 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=("threefry2x32", "rbg", "unsafe_rbg"),
                         help="dropout-stream PRNG (rbg/unsafe_rbg are "
                              "faster on TPU)")
+    parser.add_argument("--adam_mu_dtype", type=str, default="float32",
+                        choices=("float32", "bfloat16"),
+                        help="Adam first-moment storage dtype (bfloat16 "
+                             "trims HBM traffic on the memory-bound step; "
+                             "float32 keeps torch parity)")
     parser.add_argument("--vocab_pad_multiple", type=int, default=0,
                         help="pad vocab/label table dims to this multiple "
                              "for even model-axis sharding (0 = follow "
@@ -220,6 +225,7 @@ def config_from_args(args: argparse.Namespace):
         pallas_block_b=args.pallas_block_b,
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
+        adam_mu_dtype=args.adam_mu_dtype,
         vocab_pad_multiple=args.vocab_pad_multiple,
         resume=args.resume,
         checkpoint_cycle=args.checkpoint_cycle,

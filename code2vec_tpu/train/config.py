@@ -62,6 +62,11 @@ class TrainConfig:
     # reproducible everywhere) | rbg | unsafe_rbg (faster on TPU; different
     # stream, still seeded-deterministic per backend)
     rng_impl: str = "threefry2x32"
+    # Adam first-moment storage dtype: float32 (torch parity, default) |
+    # bfloat16 (opt-in HBM-traffic lever — mu is read-modify-written every
+    # step, ~280 MB at top11 scale; nu always stays f32). Checkpoints
+    # store whatever dtype was used; resume with the same setting.
+    adam_mu_dtype: str = "float32"
     # pad table/head vocab dims to this multiple so they shard evenly over
     # the model axis; 0 = auto (use model_axis). Checkpoint param shapes
     # depend on it — pin it explicitly to resume a run under a different

@@ -76,6 +76,7 @@ def run_export(args) -> None:
         batch_size=4,
         max_path_length=meta.get("max_path_length", 200),
         rng_impl=meta.get("rng_impl", "threefry2x32"),
+        adam_mu_dtype=meta.get("adam_mu_dtype", "float32"),
     )
 
     # a synthetic probe batch is enough: the probe compares the two

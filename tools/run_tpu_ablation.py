@@ -31,6 +31,7 @@ def measure_step(
     bag: int = 200,
     chunk: int = 16,
     steps: int = 48,
+    adam_mu_dtype: str = "float32",
 ) -> float:
     """ms/step on the EpochRunner scanned-chunk path (what bench.py runs)."""
     import jax.numpy as jnp
@@ -64,7 +65,10 @@ def measure_step(
         use_pallas=use_pallas,
         pallas_block_b=pallas_block_b,
     )
-    config = TrainConfig(batch_size=batch, max_path_length=bag, rng_impl=rng_impl)
+    config = TrainConfig(
+        batch_size=batch, max_path_length=bag, rng_impl=rng_impl,
+        adam_mu_dtype=adam_mu_dtype,
+    )
     rng = np.random.default_rng(0)
     example = {
         "starts": np.zeros((batch, bag), np.int32),
@@ -142,6 +146,13 @@ def main() -> None:
             f"{best['embed_grad']}/{best['rng_impl']}/f32",
             embed_grad=best["embed_grad"], rng_impl=best["rng_impl"],
             dtype_name="f32",
+        )
+        # bf16 first-moment storage: does trimming the mu read-modify-write
+        # (~280 MB/step at top11 scale) show up end-to-end?
+        record(
+            f"{best['embed_grad']}/{best['rng_impl']}/f32/mu-bf16",
+            embed_grad=best["embed_grad"], rng_impl=best["rng_impl"],
+            dtype_name="f32", adam_mu_dtype="bfloat16",
         )
 
     # --- pallas vs XLA attention at two bag sizes + block_b tuning -------
