@@ -350,6 +350,23 @@ def _supervise() -> int:
             flush=True,
         )
         return 1
+    except KeyboardInterrupt:
+        # Ctrl-C: still honor the output contract (the killed child may
+        # have left a partial line — hence the leading newline)
+        sys.stdout.write("\n")
+        print(
+            json.dumps(
+                {
+                    "metric": "path_contexts_per_sec_per_chip",
+                    "value": None,
+                    "unit": "contexts/sec",
+                    "vs_baseline": None,
+                    "error": "supervisor interrupted (SIGINT)",
+                }
+            ),
+            flush=True,
+        )
+        return 130
     finally:
         # Ctrl-C (KeyboardInterrupt) and any other exit path: the child is
         # in its own session, so the terminal's SIGINT never reaches it —
@@ -447,6 +464,10 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP_CHUNKS", 5))
     data_axis = int(os.environ.get("BENCH_DATA_AXIS", 1))
     model_axis = int(os.environ.get("BENCH_MODEL_AXIS", 1))
+    # ctx axis: shards the bag dim L (long-bag regime, SURVEY §5.7); the
+    # batch sharding constraint routes pooling through the streaming-softmax
+    # collectives (parallel/context.py semantics, GSPMD-inserted)
+    ctx_axis = int(os.environ.get("BENCH_CTX_AXIS", 1))
     # dims: default is the reference top11 recipe; BENCH_EMBED/BENCH_ENCODE
     # override for e.g. the wide-model config (BASELINE config 4: 512/512)
     embed_size = int(os.environ.get("BENCH_EMBED", 100))
@@ -515,9 +536,9 @@ def main() -> None:
 
     # the measured path is the flagship one: corpus staged to device memory
     # once, per-epoch context sampling on device, scanned chunks of batches
-    # per dispatch (train/device_epoch.py). BENCH_DATA_AXIS/BENCH_MODEL_AXIS
-    # > 1 runs the same path SPMD over a mesh (corpus replicated, batches
-    # sharded) — the multi-chip scale-out configuration.
+    # per dispatch (train/device_epoch.py). BENCH_DATA_AXIS/BENCH_MODEL_AXIS/
+    # BENCH_CTX_AXIS > 1 runs the same path SPMD over a mesh (corpus
+    # replicated, batches sharded) — the multi-chip scale-out configuration.
     chunk = int(os.environ.get("BENCH_CHUNK", 16))
     if fell_back:
         if "BENCH_CHUNK" not in os.environ:
@@ -526,13 +547,13 @@ def main() -> None:
             warmup = 1
     mesh = None
     corpus_placement = None
-    if data_axis * model_axis > 1:
+    if data_axis * model_axis * ctx_axis > 1:
         from jax.sharding import NamedSharding, PartitionSpec
 
         from code2vec_tpu.parallel.mesh import make_mesh
         from code2vec_tpu.parallel.shardings import shard_state
 
-        mesh = make_mesh(data=data_axis, model=model_axis)
+        mesh = make_mesh(data=data_axis, model=model_axis, ctx=ctx_axis)
         state = shard_state(mesh, state)
         corpus_placement = NamedSharding(mesh, PartitionSpec())
 

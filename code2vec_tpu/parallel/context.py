@@ -39,7 +39,12 @@ def _local_pool(contexts, mask, attn_param, axis_name):
     mask = mask.astype(jnp.float32)
     masked = scores * mask + (1.0 - mask) * NINF
     local_max = jnp.max(masked, axis=-1)
-    global_max = jax.lax.pmax(local_max, axis_name)
+    # stop_gradient INSIDE the pmax: pmax has no AD rule, and none is
+    # needed — the softmax max-shift is gradient-free (the -dm terms cancel
+    # exactly in the normalization). Stopping the operand zeroes its tangent
+    # symbolically, so AD never differentiates the collective, keeping
+    # backward through the pool exact AND trainable.
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
     e = jnp.exp(masked - global_max[:, None])
     local_sum = jnp.sum(e, axis=-1)
     global_sum = jax.lax.psum(local_sum, axis_name)

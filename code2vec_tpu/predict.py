@@ -190,7 +190,10 @@ class Predictor:
         state = create_train_state(
             config, model_config, jax.random.PRNGKey(0), example
         )
-        restored = restore_checkpoint(model_path, state, prefer_best=True)
+        restored = restore_checkpoint(
+            model_path, state, prefer_best=True,
+            vocab_pad_multiple=model_config.vocab_pad_multiple,
+        )
         if restored is None:
             raise FileNotFoundError(f"no checkpoint found under {model_path}")
         self.state = restored[0]

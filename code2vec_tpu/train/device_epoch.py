@@ -804,7 +804,7 @@ class ShardedEpochRunner:
                 _, (losses, preds, max_logits) = jax.lax.scan(
                     body, key, jnp.arange(n_batches)
                 )
-                return jnp.sum(losses), preds, max_logits  # [nb, B] each
+                return losses, preds, max_logits  # [nb], [nb, B], [nb, B]
 
             self._eval_chunks[n_batches] = run
         return self._eval_chunks[n_batches]
@@ -816,8 +816,18 @@ class ShardedEpochRunner:
         key: jax.Array,
     ) -> tuple[float, np.ndarray, np.ndarray]:
         """One eval pass, each shard in its natural row order. Returns
-        (summed per-batch mean loss, preds, max_logits) where preds align
-        with ``corpus.flat_labels()`` (shard-concatenation order)."""
+        (loss, preds, max_logits) where preds align with
+        ``corpus.flat_labels()`` (shard-concatenation order).
+
+        Loss scale: the sharded pass runs ``ceil(max_shard/per_shard)``
+        batches — more than the replicated runner's ``ceil(N/B)`` when
+        shards are imbalanced, with tail batches mixing masked rows — so a
+        raw sum of per-batch means would not be comparable across paths.
+        Instead the per-batch means are recombined weighted by their
+        valid-row counts (exactly the global per-example mean under uniform
+        class weights) and reported as ``mean × ceil(N/B)``: the same
+        summed-per-batch-mean scale the replicated runner and the host
+        pipeline report."""
         D, per_shard = self.n_shards, self.per_shard
         counts = corpus.shard_counts
         nb_total = max(-(-int(counts.max()) // per_shard), 1)
@@ -827,7 +837,8 @@ class ShardedEpochRunner:
         remap_ids = corpus.remap_ids if use_remap else None
         remap_flags = corpus.remap_flags if use_remap else None
 
-        total_loss = 0.0
+        weighted_loss = 0.0
+        weight_total = 0.0
         shard_preds: list[list[np.ndarray]] = [[] for _ in range(D)]
         shard_logits: list[list[np.ndarray]] = [[] for _ in range(D)]
         lo = 0
@@ -842,11 +853,14 @@ class ShardedEpochRunner:
                 rows[s, : len(take)] = take
                 valid[s, : len(take)] = 1.0
             key, chunk_key = jax.random.split(key)
-            loss, p, ml = self._eval_chunk(nb)(
+            losses, p, ml = self._eval_chunk(nb)(
                 state, corpus.contexts, corpus.row_splits, corpus.labels,
                 rows, valid, chunk_key, remap_ids, remap_flags,
             )
-            total_loss += float(loss)
+            # valid rows in global batch i of this chunk, across shards
+            batch_valid = valid.reshape(D, nb, per_shard).sum(axis=(0, 2))
+            weighted_loss += float(np.asarray(losses) @ batch_valid)
+            weight_total += float(batch_valid.sum())
             p = np.asarray(p).reshape(nb, D, per_shard)
             ml = np.asarray(ml).reshape(nb, D, per_shard)
             for s in range(D):
@@ -863,6 +877,11 @@ class ShardedEpochRunner:
         max_logits = np.concatenate(
             [np.concatenate(x) if x else np.zeros(0, np.float32) for x in shard_logits]
         )
+        # replicated-equivalent scale: per-example mean × ceil(N/B)
+        n_total = int(counts.sum())
+        batch_size = per_shard * D
+        mean_loss = weighted_loss / max(weight_total, 1.0)
+        total_loss = mean_loss * max(-(-n_total // batch_size), 1)
         return total_loss, preds, max_logits
 
     def run_train_epoch(

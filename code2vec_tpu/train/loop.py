@@ -328,8 +328,10 @@ def train(
         )
     device_runner = None
     sharded_train_runner = None  # (ShardedEpochRunner, ShardedStagedCorpus)
+    use_device_epoch = False  # gates the epoch-loop branch for both runners
     if config.device_epoch:
         if jax.process_count() == 1:
+            use_device_epoch = True
             from code2vec_tpu.train.device_epoch import (
                 EpochRunner,
                 ShardedEpochRunner,
@@ -340,15 +342,18 @@ def train(
                 stage_variable_corpus,
             )
 
-            device_runner = EpochRunner(
-                model_config,
-                class_weights,
-                config.batch_size,
-                config.max_path_length,
-                config.device_chunk_batches,
-                mesh=mesh,
-                shuffle_variable_ids=config.shuffle_variable_indexes,
-            )
+            if not config.shard_staged_corpus:
+                # the replicated runner is unused in sharded-staging mode;
+                # don't build it (and its step closures) there
+                device_runner = EpochRunner(
+                    model_config,
+                    class_weights,
+                    config.batch_size,
+                    config.max_path_length,
+                    config.device_chunk_batches,
+                    mesh=mesh,
+                    shuffle_variable_ids=config.shuffle_variable_indexes,
+                )
             corpus_placement = None
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
@@ -451,7 +456,7 @@ def train(
 
             train_epoch = None  # host epoch arrays, built lazily in device mode
             test_epoch = None
-            if device_runner is not None:
+            if use_device_epoch:
                 jax_rng, train_key, eval_key = jax.random.split(jax_rng, 3)
                 if sharded_train_runner is not None:
                     runner, staged = sharded_train_runner

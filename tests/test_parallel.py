@@ -202,6 +202,36 @@ class TestContextParallelAttention:
             np.asarray(attn_cp), np.asarray(attn_ref), rtol=1e-5, atol=1e-6
         )
 
+    def test_gradient_matches_reference_pool(self):
+        # the streaming decomposition's max-shift is gradient-free (the -dm
+        # terms cancel in the softmax normalization), so stop_gradient on
+        # the pmax keeps backward EXACT — grads must match the XLA pool's
+        mesh = make_mesh(data=1, model=1, ctx=8)
+        rng = np.random.default_rng(1)
+        B, L, E = 4, 32, 16
+        ctx = rng.normal(size=(B, L, E)).astype(np.float32)
+        mask = (rng.random((B, L)) > 0.3).astype(np.float32)
+        mask[:, 0] = 1.0
+        a = rng.normal(size=E).astype(np.float32)
+        cotangent = rng.normal(size=(B, E)).astype(np.float32)
+
+        def ref_loss(ctx, a):
+            cv, _ = attention_pool(ctx, jnp.asarray(mask), a)
+            return jnp.sum(cv * jnp.asarray(cotangent))
+
+        def stream_loss(ctx, a):
+            cv, _ = context_parallel_attention_pool(
+                mesh, ctx, jnp.asarray(mask), a
+            )
+            return jnp.sum(cv * jnp.asarray(cotangent))
+
+        g_ref = jax.grad(ref_loss, argnums=(0, 1))(jnp.asarray(ctx), jnp.asarray(a))
+        g_cp = jax.grad(stream_loss, argnums=(0, 1))(jnp.asarray(ctx), jnp.asarray(a))
+        for r, c in zip(g_ref, g_cp):
+            np.testing.assert_allclose(
+                np.asarray(c), np.asarray(r), rtol=1e-5, atol=1e-6
+            )
+
 
 class TestShardBatchAndState:
     def test_batch_placement(self):

@@ -1,0 +1,171 @@
+"""Long-bag / ctx-axis benchmark (SURVEY §5.7; the reference caps bags at
+200 — main.py:48's max_path_length — so everything past bag 200 is regime
+this framework adds).
+
+Two measurement families, single chip:
+
+1. ``pool``: the attention pooling op in isolation — forward + backward of
+   the masked softmax + weighted sum — comparing the plain XLA chain
+   (ops/attention.py) against the explicit streaming-softmax shard_map
+   variant (parallel/context.py) on a 1-device ctx mesh, where its pmax /
+   psum collectives are no-ops. Parity of the two timings shows the
+   ctx-parallel building block adds no single-chip overhead; the multi-chip
+   ctx split itself stays staged until hardware with >1 chip is available
+   (the dryrun validates it compiles + executes on the virtual mesh).
+
+2. ``step``: the full flagship train step (EpochRunner scanned chunks, the
+   same path bench.py measures) at lifted-cap bag sizes, batch scaled to
+   hold B x L context slots roughly constant, on a synthetic corpus whose
+   per-method context counts actually fill the long bags (mean 0.8 x bag)
+   — top11 vocabs, so the embedding tables stay at production scale.
+
+Prints one JSON line per row plus a markdown table for docs/ARCHITECTURE.md.
+Usage: python tools/bench_ctx.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: the package
+sys.path.insert(0, _HERE)  # tools/: run_tpu_ablation's measure_step
+
+
+def _pin_platform() -> None:
+    """The experimental axon plugin pre-empts the JAX_PLATFORMS env var
+    (verify SKILL gotchas) — an operator's JAX_PLATFORMS=cpu would silently
+    hit the tunnel. Re-assert the env choice via the reliable config API."""
+    plat = os.environ.get("JAX_PLATFORMS", "").strip()
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def _time_it(fn, *args, warmup: int = 2, iters: int = 20) -> float:
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def measure_pool(batch: int, bag: int, encode: int = 100) -> dict:
+    """ms for forward+backward of the pooling op: XLA vs streaming."""
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.ops.attention import attention_pool
+    from code2vec_tpu.parallel.context import context_parallel_attention_pool
+    from code2vec_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    contexts = jnp.asarray(rng.standard_normal((batch, bag, encode)), jnp.float32)
+    mask = jnp.asarray(rng.random((batch, bag)) < 0.9, jnp.float32)
+    attn = jnp.asarray(rng.standard_normal(encode), jnp.float32)
+
+    def xla_loss(contexts, attn):
+        cv, _ = attention_pool(contexts, mask, attn)
+        return jnp.sum(cv * cv)
+
+    mesh = make_mesh(data=1, model=1, ctx=1, devices=jax.devices()[:1])
+
+    def stream_loss(contexts, attn):
+        cv, _ = context_parallel_attention_pool(mesh, contexts, mask, attn)
+        return jnp.sum(cv * cv)
+
+    xla_fb = jax.jit(jax.value_and_grad(xla_loss, argnums=(0, 1)))
+    stream_fb = jax.jit(jax.value_and_grad(stream_loss, argnums=(0, 1)))
+    return {
+        "xla_ms": round(_time_it(xla_fb, contexts, attn), 3),
+        "streaming_ms": round(_time_it(stream_fb, contexts, attn), 3),
+    }
+
+
+def measure_long_bag_step(batch: int, bag: int, steps: int = 32) -> float:
+    """ms/step of the flagship scanned-chunk path at a lifted-cap bag size,
+    on a corpus whose methods actually have ~0.8 x bag contexts each.
+    Delegates to run_tpu_ablation.measure_step (the one timing harness) with
+    the round-3 winner recipe and a long-bag synth spec."""
+    import jax
+
+    from run_tpu_ablation import measure_step
+
+    return measure_step(
+        jax,
+        embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
+        batch=batch, bag=bag, chunk=8, steps=steps,
+        n_methods=max(batch * 4, 1024),
+        mean_contexts=0.8 * bag, max_contexts=2 * bag,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    _pin_platform()
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend()}), flush=True)
+
+    rows = []
+    # pool microbench: B x L held at ~256k slots
+    pool_shapes = [(1024, 200), (256, 1024)] if args.quick else [
+        (1024, 200), (256, 1024), (64, 4096),
+    ]
+    for batch, bag in pool_shapes:
+        try:
+            r = measure_pool(batch, bag)
+        except Exception as e:  # noqa: BLE001 - stream what we have
+            print(json.dumps({"pool": f"b{batch}/bag{bag}", "error": str(e)[:300]}), flush=True)
+            continue
+        row = {"kind": "pool", "batch": batch, "bag": bag, **r}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # full step at lifted caps
+    step_shapes = [(256, 1024)] if args.quick else [
+        (1024, 200), (256, 1024), (64, 4096),
+    ]
+    for batch, bag in step_shapes:
+        try:
+            ms = measure_long_bag_step(batch, bag)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"step": f"b{batch}/bag{bag}", "error": str(e)[:300]}), flush=True)
+            continue
+        row = {
+            "kind": "step", "batch": batch, "bag": bag,
+            "ms_per_step": round(ms, 3),
+            "contexts_per_sec": round(batch * bag / ms * 1e3, 0),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print("\n| kind | batch | bag | ms (xla / streaming or step) | ctx/s |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        if r["kind"] == "pool":
+            ms = f"{r['xla_ms']} / {r['streaming_ms']}"
+            cs = ""
+        else:
+            ms = f"{r['ms_per_step']}"
+            cs = f"{int(r['contexts_per_sec']):,}"
+        print(f"| {r['kind']} | {r['batch']} | {r['bag']} | {ms} | {cs} |")
+
+
+if __name__ == "__main__":
+    main()

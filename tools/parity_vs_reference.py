@@ -7,9 +7,15 @@ on an identical recipe.
 Usage: python tools/parity_vs_reference.py [--reference /root/reference]
 Prints one JSON line: both F1 trajectories and bests.
 
-Notes: --eval_method exact (the reference's subtoken evaluator crashes on
-current numpy — `int.item()` in main.py:subtoken_match — an upstream bug,
-not a format issue). The reference's train/test split is unseeded
+Default --eval_method subtoken: the BASELINE headline metric. The
+reference's own subtoken evaluator crashes on current numpy (`int.item()`
+in main.py:339-359 — `tolist()` yields python ints on modern numpy, which
+have no `.item()`; an upstream bug, not a format issue), so the reference
+subprocess runs through a driver that monkeypatches `subtoken_match` /
+`averaged_subtoken_match` to re-wrap their inputs in a list whose
+`tolist()` yields numpy scalars — the same shim
+tests/test_metrics_vs_reference.py uses; the reference's metric code
+itself runs unmodified. The reference's train/test split is unseeded
 (SURVEY §2.6), so trajectories are comparable, not identical.
 """
 
@@ -25,20 +31,65 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Driver for the reference subprocess. Imports the reference's main.py with
+# patched argv (it parses flags at import), installs the tolist shim around
+# the subtoken evaluators, then calls its main(). Formatted with
+# (ref_dir, json-encoded argv list).
+_REF_DRIVER = """\
+import sys
 
-def run_reference(ref_dir: str, paths: dict, out_dir: str, epochs: int) -> list[float]:
+sys.path.insert(0, {ref_dir!r})
+sys.argv = {argv}
+
+import numpy as np
+
+import main as ref_main
+
+
+class _NumpyScalarList(list):
+    \"\"\"tolist() -> numpy scalars, so the reference's ``x.item()`` works
+    on numpy versions where plain-array tolist() yields python ints.\"\"\"
+
+    def tolist(self):
+        return [np.int64(x) for x in self]
+
+
+def _shimmed(fn):
+    def wrapper(expected_labels, actual_labels, label_vocab):
+        return fn(
+            _NumpyScalarList(int(x) for x in np.asarray(expected_labels).ravel()),
+            _NumpyScalarList(int(x) for x in np.asarray(actual_labels).ravel()),
+            label_vocab,
+        )
+
+    return wrapper
+
+
+ref_main.subtoken_match = _shimmed(ref_main.subtoken_match)
+ref_main.averaged_subtoken_match = _shimmed(ref_main.averaged_subtoken_match)
+ref_main.main()
+"""
+
+
+def run_reference(
+    ref_dir: str, paths: dict, out_dir: str, epochs: int, eval_method: str
+) -> list[float]:
+    argv = [
+        "main.py",
+        "--corpus_path", str(paths["corpus"]),
+        "--path_idx_path", str(paths["path_idx"]),
+        "--terminal_idx_path", str(paths["terminal_idx"]),
+        "--batch_size", "64", "--encode_size", "100",
+        "--max_epoch", str(epochs), "--no_cuda",
+        "--eval_method", eval_method,
+        "--model_path", out_dir,
+        "--vectors_path", os.path.join(out_dir, "code.vec"),
+    ]
+    driver = os.path.join(out_dir, "_ref_driver.py")
+    with open(driver, "w") as f:
+        f.write(_REF_DRIVER.format(ref_dir=ref_dir, argv=json.dumps(argv)))
     result = subprocess.run(
-        [
-            sys.executable, "main.py",
-            "--corpus_path", str(paths["corpus"]),
-            "--path_idx_path", str(paths["path_idx"]),
-            "--terminal_idx_path", str(paths["terminal_idx"]),
-            "--batch_size", "64", "--encode_size", "100",
-            "--max_epoch", str(epochs), "--no_cuda",
-            "--eval_method", "exact",
-            "--model_path", out_dir,
-            "--vectors_path", os.path.join(out_dir, "code.vec"),
-        ],
+        [sys.executable, driver],
         cwd=ref_dir,
         capture_output=True,
         text=True,
@@ -63,7 +114,7 @@ def run_reference(ref_dir: str, paths: dict, out_dir: str, epochs: int) -> list[
     return f1s
 
 
-def run_ours(paths: dict, epochs: int) -> list[float]:
+def run_ours(paths: dict, epochs: int, eval_method: str) -> list[float]:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -79,7 +130,7 @@ def run_ours(paths: dict, epochs: int) -> list[float]:
         batch_size=64,
         encode_size=100,
         max_epoch=epochs,
-        eval_method="exact",
+        eval_method=eval_method,
         print_sample_cycle=0,
     )
     result = train(config, data)
@@ -90,6 +141,12 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--reference", default="/root/reference")
     ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument(
+        "--eval_method",
+        default="subtoken",
+        choices=["exact", "subtoken", "ave_subtoken"],
+        help="subtoken (default) is the BASELINE headline metric",
+    )
     args = ap.parse_args()
 
     from code2vec_tpu.data.synth import SPECS, generate_corpus_files
@@ -98,14 +155,16 @@ def main() -> None:
         paths = generate_corpus_files(tmp, SPECS["small"])
         ref_out = os.path.join(tmp, "ref_out")
         os.makedirs(ref_out)
-        ref_f1 = run_reference(args.reference, paths, ref_out, args.epochs)
-        ours_f1 = run_ours(paths, args.epochs)
+        ref_f1 = run_reference(
+            args.reference, paths, ref_out, args.epochs, args.eval_method
+        )
+        ours_f1 = run_ours(paths, args.epochs, args.eval_method)
 
     print(
         json.dumps(
             {
                 "corpus": "synth small (2000 methods), identical artifact files",
-                "eval_method": "exact",
+                "eval_method": args.eval_method,
                 "reference_f1": [round(v, 4) for v in ref_f1],
                 "ours_f1": [round(v, 4) for v in ours_f1],
                 "reference_best": round(max(ref_f1), 4),

@@ -1,43 +1,78 @@
-"""Microbenchmark: split the train-step time into sampling / fwd / fwd+bwd /
-full step to find the bottleneck. Not part of the package; dev tool."""
+"""Component attribution for the flagship train step (VERDICT r3 #2: where
+do 25.3 ms go, against a ~4-6 ms HBM roofline?).
 
+Times each piece of the step in isolation on the current backend:
+
+- ``sample``        on-device CSR batch assembly (gathers + randint subsample)
+- ``forward``       embedding gathers + encoder + attention pool + head
+- ``grad``          full fwd+bwd including the embedding-table scatter-adds
+- ``grad_frozen``   fwd+bwd with stop_gradient on the embedding lookups —
+                    the same compute minus table grads; ``grad - grad_frozen``
+                    isolates the scatter-add + table-grad materialization
+- ``adam``          optimizer update alone on precomputed grads (the
+                    full-table moment read-modify-write: ~2.2 GB/step at
+                    top11 scale with f32 moments)
+- ``step``          one fused train step (scan of 1)
+- ``chunk/N``       the production scanned chunk, per-step — vs ``step``
+                    shows dispatch amortization
+
+Recipe knobs via env (defaults = the measured round-3 winner):
+PROF_DTYPE=float32|bfloat16  PROF_EMBED_GRAD=dense|segment|segment_sorted
+PROF_RNG_IMPL=unsafe_rbg|threefry2x32  PROF_ADAM_MU_DTYPE=float32|bfloat16
+PROF_BATCH, PROF_BAG, PROF_CHUNK, PROF_TRACE_DIR (jax.profiler trace of the
+chunk when set).
+
+Prints one JSON line per row, then a markdown table for ARCHITECTURE.md.
+"""
+
+import json
+import os
 import time
 from functools import partial
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip():
+    # the axon plugin pre-empts the env var; re-assert via the config API
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"].strip())
+
 import jax.numpy as jnp
 import numpy as np
 
-from code2vec_tpu.data.synth import SynthSpec, generate_corpus_data
-from code2vec_tpu.data.vocab import Vocab
-from code2vec_tpu.data.reader import CorpusData
+from code2vec_tpu.data.synth import SynthSpec, corpus_data_from_raw, generate_corpus_data
 from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
 from code2vec_tpu.train.config import TrainConfig
 from code2vec_tpu.train.device_epoch import EpochRunner, stage_method_corpus, _sample_batch
-from code2vec_tpu.train.step import create_train_state, build_train_step_fn
+from code2vec_tpu.train.step import build_train_step_fn, create_train_state, weighted_nll
 
-B, L = 1024, 200
-spec = SynthSpec(n_methods=8192, n_terminals=360_631, n_paths=342_845,
-                 n_labels=8_000, mean_contexts=120.0, max_contexts=400, seed=0)
-raw = generate_corpus_data(spec)
-label_vocab = Vocab()
-for name in raw.label_names:
-    label_vocab.add_label(name)
-data = CorpusData(
-    starts=raw.starts + 1, paths=raw.paths, ends=raw.ends + 1,
-    row_splits=raw.row_splits, ids=np.arange(spec.n_methods, dtype=np.int64),
-    labels=raw.label_ids.astype(np.int32), normalized_labels=[],
-    sources=[None] * spec.n_methods, aliases=[{} for _ in range(spec.n_methods)],
-    terminal_vocab=Vocab(), path_vocab=Vocab(), label_vocab=label_vocab)
-data.terminal_vocab.add("<PAD/>", 0)
-data.terminal_vocab.add("@question", 1)
-data.terminal_vocab.add("@method_0", 2)
+B = int(os.environ.get("PROF_BATCH", 1024))
+L = int(os.environ.get("PROF_BAG", 200))
+CHUNK = int(os.environ.get("PROF_CHUNK", 16))
+DTYPE = (
+    jnp.bfloat16
+    if os.environ.get("PROF_DTYPE", "float32").strip().lower() in ("bfloat16", "bf16")
+    else jnp.float32
+)
+EMBED_GRAD = os.environ.get("PROF_EMBED_GRAD", "dense")
+RNG_IMPL = os.environ.get("PROF_RNG_IMPL", "unsafe_rbg")
+ADAM_MU_DTYPE = os.environ.get("PROF_ADAM_MU_DTYPE", "float32")
+
+print(json.dumps({"backend": jax.default_backend(), "batch": B, "bag": L,
+                  "dtype": DTYPE.__name__, "embed_grad": EMBED_GRAD,
+                  "rng_impl": RNG_IMPL}), flush=True)
+
+spec = SynthSpec(n_methods=max(B * 8, 8192), n_terminals=360_631,
+                 n_paths=342_845, n_labels=8_000, mean_contexts=120.0,
+                 max_contexts=400, seed=0)
+data = corpus_data_from_raw(generate_corpus_data(spec))
 
 mc = Code2VecConfig(
     terminal_count=spec.n_terminals + 2, path_count=spec.n_paths + 1,
-    label_count=len(label_vocab), terminal_embed_size=100, path_embed_size=100,
-    encode_size=100, dropout_prob=0.25, dtype=jnp.bfloat16)
-tc = TrainConfig(batch_size=B, max_path_length=L)
+    label_count=len(data.label_vocab), terminal_embed_size=100,
+    path_embed_size=100, encode_size=100, dropout_prob=0.25, dtype=DTYPE,
+    embed_grad=EMBED_GRAD)
+tc = TrainConfig(batch_size=B, max_path_length=L, rng_impl=RNG_IMPL,
+                 adam_mu_dtype=ADAM_MU_DTYPE)
 
 rng = np.random.default_rng(0)
 staged = stage_method_corpus(data, np.arange(data.n_items), rng)
@@ -46,28 +81,17 @@ valid = jnp.ones(B, jnp.float32)
 key = jax.random.PRNGKey(0)
 
 sample = jax.jit(partial(_sample_batch, bag=L))
-batch = sample(staged.contexts, staged.row_splits, staged.labels, rows, valid, key=key)
-batch = jax.device_put(batch)
+batch = jax.device_put(sample(staged.contexts, staged.row_splits,
+                              staged.labels, rows, valid, key=key))
 
-state = create_train_state(tc, mc, jax.random.PRNGKey(0), jax.tree.map(np.asarray, batch))
+state = create_train_state(tc, mc, jax.random.PRNGKey(0),
+                           jax.tree.map(np.asarray, batch))
 cw = jnp.ones(mc.label_count, jnp.float32)
 raw_train = build_train_step_fn(mc, cw)
-train = jax.jit(raw_train, donate_argnums=0)
-
 model = Code2Vec(mc)
 
-@jax.jit
-def fwd(params, batch):
-    logits, _, _ = model.apply({"params": params}, batch["starts"], batch["paths"],
-                               batch["ends"], deterministic=True)
-    return logits.sum()
+results = {}
 
-def loss_fn(params, batch, key):
-    logits, _, _ = model.apply({"params": params}, batch["starts"], batch["paths"],
-                               batch["ends"], deterministic=False, rngs={"dropout": key})
-    return logits.astype(jnp.float32).sum()
-
-grad = jax.jit(jax.grad(loss_fn))
 
 def bench(name, fn, *args, n=30, **kw):
     out = fn(*args, **kw)
@@ -77,27 +101,131 @@ def bench(name, fn, *args, n=30, **kw):
         out = fn(*args, **kw)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / n * 1e3
-    print(f"{name:28s} {dt:8.3f} ms")
+    results[name] = dt
+    print(json.dumps({"component": name, "ms": round(dt, 3)}), flush=True)
     return dt
 
-bench("sample_batch", sample, staged.contexts, staged.row_splits, staged.labels, rows, valid, key=key)
-bench("forward", fwd, state.params, batch)
-bench("grad (fwd+bwd)", grad, state.params, batch, key)
 
-# full step without donation pitfalls: rebuild state each call is costly; instead
-# time N chained steps
+# --- sampling ------------------------------------------------------------
+bench("sample", sample, staged.contexts, staged.row_splits, staged.labels,
+      rows, valid, key=key)
+
+
+# --- forward -------------------------------------------------------------
 @jax.jit
-def steps10(state, batch):
-    def body(s, _):
-        s, loss = raw_train(s, batch)
-        return s, loss
-    state, losses = jax.lax.scan(body, state, None, length=10)
-    return state, losses.sum()
+def fwd(params, batch):
+    logits, _, _ = model.apply({"params": params}, batch["starts"],
+                               batch["paths"], batch["ends"], deterministic=True)
+    return logits.astype(jnp.float32).sum()
 
-st = state
-out = steps10(st, batch); jax.block_until_ready(out[1])
+
+bench("forward", fwd, state.params, batch)
+
+
+# --- fwd+bwd, with and without table grads -------------------------------
+def loss_fn(params, batch, key):
+    logits, _, _ = model.apply(
+        {"params": params}, batch["starts"], batch["paths"], batch["ends"],
+        deterministic=False, rngs={"dropout": key})
+    return weighted_nll(logits.astype(jnp.float32), batch["labels"], cw,
+                        batch["example_mask"])
+
+
+bench("grad", jax.jit(jax.grad(loss_fn)), state.params, batch, key)
+
+# same compute minus the embedding-table backward: zero out the table grads
+# by treating the tables as constants (closure capture, not params)
+frozen_tables = {
+    k: v for k, v in state.params.items()
+    if "embedding" in k
+}
+train_params = {k: v for k, v in state.params.items() if "embedding" not in k}
+
+
+def loss_frozen(params, batch, key):
+    full = dict(params, **frozen_tables)
+    logits, _, _ = model.apply(
+        {"params": full}, batch["starts"], batch["paths"], batch["ends"],
+        deterministic=False, rngs={"dropout": key})
+    return weighted_nll(logits.astype(jnp.float32), batch["labels"], cw,
+                        batch["example_mask"])
+
+
+bench("grad_frozen_tables", jax.jit(jax.grad(loss_frozen)), train_params,
+      batch, key)
+
+
+# --- optimizer update alone ----------------------------------------------
+grads = jax.jit(jax.grad(loss_fn))(state.params, batch, key)
+jax.block_until_ready(grads)
+
+
+@jax.jit
+def adam_only(state, grads):
+    return state.apply_gradients(grads=grads)
+
+
+bench("adam", adam_only, state, grads)
+
+
+# --- full step + production chunk ----------------------------------------
+@jax.jit
+def one_step(state, batch):
+    return raw_train(state, batch)
+
+
+bench("step", lambda s, b: one_step(s, b)[1], state, batch)
+
+runner = EpochRunner(mc, cw, B, L, CHUNK)
+run_chunk = runner._train_chunk(CHUNK)
+n_valid = CHUNK * B
+crows = rng.integers(0, data.n_items, n_valid).astype(np.int32)
+
+trace_dir = os.environ.get("PROF_TRACE_DIR", "").strip()
+state2 = create_train_state(tc, mc, jax.random.PRNGKey(0),
+                            jax.tree.map(np.asarray, batch))
+
+
+def chunk_step(state, key):
+    key, sub = jax.random.split(key)
+    state, loss = run_chunk(state, staged.contexts, staged.row_splits,
+                            staged.labels, crows, n_valid, sub)
+    return state, loss, key
+
+
+k = jax.random.PRNGKey(1)
+state2, loss, k = chunk_step(state2, k)  # compile
+jax.block_until_ready(loss)
+if trace_dir:
+    jax.profiler.start_trace(trace_dir)
 t0 = time.perf_counter()
-for _ in range(10):
-    st, l = steps10(st, batch)
-jax.block_until_ready(l)
-print(f"{'full step (scan/10)':28s} {(time.perf_counter()-t0)/100*1e3:8.3f} ms")
+NCH = 6
+for _ in range(NCH):
+    state2, loss, k = chunk_step(state2, k)
+jax.block_until_ready(loss)
+dt = (time.perf_counter() - t0) / (NCH * CHUNK) * 1e3
+if trace_dir:
+    jax.profiler.stop_trace()
+    print(json.dumps({"trace_dir": trace_dir}), flush=True)
+results[f"chunk/{CHUNK}"] = dt
+print(json.dumps({"component": f"chunk/{CHUNK}", "ms": round(dt, 3)}), flush=True)
+
+# --- attribution summary -------------------------------------------------
+table_bwd = results["grad"] - results["grad_frozen_tables"]
+print(json.dumps({
+    "attribution": {
+        "sample": round(results["sample"], 3),
+        "fwd": round(results["forward"], 3),
+        "bwd_encoder": round(results["grad_frozen_tables"] - results["forward"], 3),
+        "bwd_tables(scatter)": round(table_bwd, 3),
+        "adam": round(results["adam"], 3),
+        "sum_components": round(results["sample"] + results["grad"] + results["adam"], 3),
+        "fused_step": round(results["step"], 3),
+        "chunk_per_step": round(results[f"chunk/{CHUNK}"], 3),
+    }
+}), flush=True)
+
+print("\n| component | ms |")
+print("|---|---|")
+for name, ms in results.items():
+    print(f"| {name} | {ms:.3f} |")

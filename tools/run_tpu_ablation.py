@@ -32,6 +32,11 @@ def measure_step(
     chunk: int = 16,
     steps: int = 48,
     adam_mu_dtype: str = "float32",
+    embed: int = 100,
+    encode: int = 100,
+    n_methods: int | None = None,
+    mean_contexts: float = 120.0,
+    max_contexts: int = 400,
 ) -> float:
     """ms/step on the EpochRunner scanned-chunk path (what bench.py runs)."""
     import jax.numpy as jnp
@@ -43,12 +48,12 @@ def measure_step(
     from code2vec_tpu.train.step import create_train_state
 
     spec = SynthSpec(
-        n_methods=max(batch * 8, 8192),
+        n_methods=n_methods if n_methods is not None else max(batch * 8, 8192),
         n_terminals=360_631,
         n_paths=342_845,
         n_labels=8_000,
-        mean_contexts=120.0,
-        max_contexts=400,
+        mean_contexts=mean_contexts,
+        max_contexts=max_contexts,
         seed=0,
     )
     data = corpus_data_from_raw(generate_corpus_data(spec))
@@ -56,9 +61,9 @@ def measure_step(
         terminal_count=spec.n_terminals + 2,
         path_count=spec.n_paths + 1,
         label_count=len(data.label_vocab),
-        terminal_embed_size=100,
-        path_embed_size=100,
-        encode_size=100,
+        terminal_embed_size=embed,
+        path_embed_size=embed,
+        encode_size=encode,
         dropout_prob=0.25,
         dtype=jnp.bfloat16 if dtype_name == "bf16" else jnp.float32,
         embed_grad=embed_grad,
@@ -110,9 +115,22 @@ def measure_step(
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="fewer configs")
+    ap.add_argument(
+        "--r4",
+        action="store_true",
+        help="round-4 focused matrix: winner recipe x2 repeats, mu-bf16 A/B "
+        "x2, wide-model (512/512) f32 vs bf16 x2 — bounds the ~3%% "
+        "run-to-run noise band on the round-3 single-measurement claims",
+    )
     args = ap.parse_args()
 
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip():
+        # the axon plugin pre-empts the env var; re-assert via config API
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"].strip())
 
     backend = jax.default_backend()
     print(json.dumps({"backend": backend}), flush=True)
@@ -130,6 +148,35 @@ def main() -> None:
                "contexts_per_sec": round(ctx_s, 0)}
         results.append(row)
         print(json.dumps(row), flush=True)
+
+    def print_table():
+        print("\n| config | ms/step | contexts/sec |")
+        print("|---|---|---|")
+        for r in sorted(results, key=lambda r: r["ms_per_step"]):
+            print(f"| {r['config']} | {r['ms_per_step']} | {int(r['contexts_per_sec']):,} |")
+
+    if args.r4:
+        # winner recipe (round-3 ablation): dense/unsafe_rbg/f32 — two
+        # repeats re-confirm the 25.3 ms claim and bound the noise
+        for rep in (1, 2):
+            record(f"dense/unsafe_rbg/f32 #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32")
+        # the staged HBM lever: bf16 Adam first moment (~280 MB/step less RMW)
+        for rep in (1, 2):
+            record(f"dense/unsafe_rbg/f32/mu-bf16 #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
+                   adam_mu_dtype="bfloat16")
+        # wide model (BASELINE config 4: 512/512): the dtype-regime-flip
+        # claim (bf16 wins wide) gets its second measurement, both arms
+        for rep in (1, 2):
+            record(f"wide512/f32 #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
+                   embed=512, encode=512)
+            record(f"wide512/bf16 #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="bf16",
+                   embed=512, encode=512)
+        print_table()
+        return
 
     # --- embed_grad x rng_impl (bf16, the production recipe) -------------
     grads = ["dense", "segment", "segment_sorted"]
@@ -179,10 +226,7 @@ def main() -> None:
                 dtype_name="bf16", chunk=chunk,
             )
 
-    print("\n| config | ms/step | contexts/sec |")
-    print("|---|---|---|")
-    for r in sorted(results, key=lambda r: r["ms_per_step"]):
-        print(f"| {r['config']} | {r['ms_per_step']} | {int(r['contexts_per_sec']):,} |")
+    print_table()
 
 
 if __name__ == "__main__":
