@@ -326,7 +326,25 @@ def main(argv: list[str] | None = None) -> None:
                 "JAX_AUTO_DISTRIBUTED=1 on a TPU pod) to form the process "
                 "group; loading the full corpus"
             )
-        shard = (jax.process_index(), jax.process_count())
+        # shard by FEED GROUP (processes covering the same data-axis
+        # coords), not by process index: with a model/ctx axis spanning
+        # processes — or a permuted device mesh — the two differ, and
+        # train() validates the shard against feed_groups(mesh)
+        if (
+            jax.process_count() > 1
+            and args.data_axis * args.model_axis * args.context_axis <= 1
+        ):
+            raise SystemExit(
+                "--host_shard_corpus requires mesh axes (--data_axis / "
+                "--model_axis / --context_axis)"
+            )
+        from code2vec_tpu.parallel.distributed import feed_groups
+        from code2vec_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(
+            data=args.data_axis, model=args.model_axis, ctx=args.context_axis
+        )
+        shard = feed_groups(mesh)
         logger.info("loading corpus shard %d/%d", shard[0], shard[1])
     data = load_corpus(
         args.corpus_path,

@@ -6,10 +6,14 @@ process group, XLA compiles the collectives, ICI carries intra-slice traffic
 and DCN carries inter-slice.
 
 Host-local batches become global arrays via
-``jax.make_array_from_process_local_data`` — each host loads only its
-round-robin share of the corpus (``load_corpus(shard=(index, count))``;
-record i is local iff ``i % count == index``, see
-``data.reader.CorpusData.local_rows_of_global``).
+``jax.make_array_from_process_local_data`` — each FEED GROUP (the
+processes whose devices cover the same data-axis coords — see
+``feed_groups``) loads only its round-robin share of the corpus
+(``load_corpus(shard=feed_groups(mesh))``; record i is local iff
+``i % n_groups == group``, see
+``data.reader.CorpusData.local_rows_of_global``). For pure-DP meshes a
+group is just one process; a model/ctx axis spanning processes makes the
+group's members replicas that load identical shards.
 """
 
 from __future__ import annotations
@@ -76,10 +80,12 @@ def local_to_global_batch(
 ) -> dict[str, jax.Array]:
     """Assemble a global device batch from HOST-LOCAL sub-batches (the
     host-sharded corpus path, SURVEY §7.4): each process supplies its
-    ``batch/n_hosts`` rows and ``make_array_from_process_local_data``
-    stitches them along the data-sharded dimension. Rows land in process
-    order (a host's devices are contiguous in jax device order), so process
-    p owns global rows [p*feed, (p+1)*feed).
+    ``batch/n_groups`` rows and ``make_array_from_process_local_data``
+    stitches them along the data-sharded dimension. Rows land by data-axis
+    coord, and ``feed_groups`` orders groups by their coords, so group g
+    owns global rows [g*feed, (g+1)*feed); the processes replicating a
+    group (model/ctx axes spanning processes) supply identical sub-batches
+    for the same rows.
     """
     shardings = batch_shardings(mesh)
     if jax.process_count() == 1:
@@ -88,6 +94,44 @@ def local_to_global_batch(
         k: jax.make_array_from_process_local_data(shardings[k], v)
         for k, v in local_batch.items()
     }
+
+
+def feed_groups(mesh: Mesh) -> tuple[int, int]:
+    """Host-sharded feeding groups for this mesh: (my_group, n_groups).
+
+    A feed group is the set of processes whose devices cover the SAME
+    data-axis coordinates — with a model/ctx axis spanning processes, those
+    processes are replicas of the same batch rows and must load the SAME
+    corpus shard and supply identical sub-batches (a per-process round-robin
+    shard would hand replicas different rows, which cannot assemble into
+    one global array). Pure-DP meshes degenerate to group == process.
+
+    Shard a corpus for this layout with ``load_corpus(shard=feed_groups(mesh))``.
+    """
+    coords: dict[int, set[int]] = {}
+    for pos, dev in np.ndenumerate(mesh.devices):
+        coords.setdefault(dev.process_index, set()).add(int(pos[0]))
+    canon = {p: tuple(sorted(c)) for p, c in coords.items()}
+    groups = sorted(set(canon.values()))
+    covered = [c for g in groups for c in g]
+    if sorted(covered) != list(range(mesh.devices.shape[0])):
+        raise ValueError(
+            "processes' data-axis coverage overlaps partially "
+            f"({canon}); host-sharded feeding needs processes to partition "
+            "the data axis into clean groups"
+        )
+    for g in groups:
+        if list(g) != list(range(g[0], g[-1] + 1)):
+            raise ValueError(
+                f"feed group {g} covers non-contiguous data coords; the "
+                "host-sharded feed lays group rows out contiguously"
+            )
+    if len({len(g) for g in groups}) != 1:
+        raise ValueError(
+            f"feed groups cover unequal data-axis shares ({groups}); "
+            "equal per-group sub-batches need a uniform partition"
+        )
+    return groups.index(canon[jax.process_index()]), len(groups)
 
 
 def allgather_to_host(x: jax.Array) -> np.ndarray:

@@ -262,24 +262,37 @@ def train(
     # - replicated corpus (data.shard is None): every process builds the
     #   same full batch (epochs are seeded identically) and serves the
     #   slices its devices own;
-    # - host-sharded corpus: each process builds only its local sub-batch
-    #   of batch_size/n_hosts rows from its own shard, assembled into the
-    #   global array (stratified-by-host sampling, standard DDP semantics)
+    # - host-sharded corpus: each FEED GROUP builds only its local
+    #   sub-batch of batch_size/n_groups rows from its own shard, assembled
+    #   into the global array (stratified-by-group sampling, standard DDP
+    #   semantics). A feed group is the processes covering the same
+    #   data-axis coords (parallel.distributed.feed_groups) — with model/
+    #   ctx axes inside one process that is just "one group per process",
+    #   but a model axis SPANNING processes makes those processes replicas
+    #   of the same rows: they must load the same shard and feed
+    #   identically.
     n_hosts = jax.process_count()
     sharded_feed = data.shard is not None and n_hosts > 1
     feed_batch = config.batch_size
+    feed_group = 0
+    n_feed_groups = 1
     if sharded_feed:
         if mesh is None:
             raise ValueError("a host-sharded corpus requires mesh axes")
-        if data.shard[1] != n_hosts:
+        from code2vec_tpu.parallel.distributed import feed_groups
+
+        feed_group, n_feed_groups = feed_groups(mesh)
+        if data.shard != (feed_group, n_feed_groups):
             raise ValueError(
-                f"corpus was sharded over {data.shard[1]} hosts but "
-                f"{n_hosts} processes are running"
+                f"corpus shard {data.shard} does not match this process's "
+                f"feed group ({feed_group}, {n_feed_groups}); shard the "
+                "corpus with load_corpus(shard=feed_groups(mesh)) — NOT by "
+                "process index when the model/ctx axes span processes"
             )
-        if config.batch_size % n_hosts:
+        if config.batch_size % n_feed_groups:
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by "
-                f"{n_hosts} processes"
+                f"{n_feed_groups} feed groups"
             )
         if data.infer_variable:
             # the variable task expands each method into a data-dependent
@@ -289,7 +302,7 @@ def train(
                 "host-sharded feeding supports the method task only; load "
                 "the corpus unsharded for infer_variable runs"
             )
-        feed_batch = config.batch_size // n_hosts
+        feed_batch = config.batch_size // n_feed_groups
         from code2vec_tpu.parallel.distributed import local_to_global_batch
 
         def to_device(batch):
@@ -304,12 +317,12 @@ def train(
             return batch  # jit in_shardings place host arrays directly
 
     # every host must run the same number of (collective) steps; the split
-    # is a random permutation, so per-host membership is hypergeometric —
+    # is a random permutation, so per-group membership is hypergeometric —
     # compute the true max share from the global split (identical on every
-    # host), and short hosts pad with fully-masked batches up to it
+    # host), and short groups pad with fully-masked batches up to it
     def synced_steps(global_idx: np.ndarray) -> int:
         shares = np.bincount(
-            np.asarray(global_idx) % n_hosts, minlength=n_hosts
+            np.asarray(global_idx) % n_feed_groups, minlength=n_feed_groups
         )
         return max(-(-int(shares.max()) // feed_batch), 1)
 
@@ -515,6 +528,7 @@ def train(
                 test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
                     config, data, state, eval_step, test_batches, to_device,
                     gather_processes=sharded_feed,
+                    feed_group=(feed_group, n_feed_groups),
                 )
             else:
                 train_epoch = build_epoch(
@@ -557,6 +571,7 @@ def train(
                 test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
                     config, data, state, eval_step, test_batches, to_device,
                     gather_processes=sharded_feed,
+                    feed_group=(feed_group, n_feed_groups),
                 )
 
             metrics = {
@@ -611,9 +626,9 @@ def train(
                 if sharded_feed and vectors_path is not None:
                     logger.warning(
                         "vector export is not supported with host-sharded "
-                        "feeding (each host holds 1/%d of the corpus); run "
-                        "a single-host export pass on the saved checkpoint",
-                        n_hosts,
+                        "feeding (each feed group holds 1/%d of the corpus); "
+                        "run a single-host export pass on the saved checkpoint",
+                        n_feed_groups,
                     )
                 elif report_fn is None and vectors_path is not None:
                     if train_epoch is None:
@@ -723,16 +738,20 @@ def _evaluate_batches(
     batches,
     to_device=lambda batch: batch,
     gather_processes: bool = False,
+    feed_group: tuple[int, int] = (0, 1),
 ) -> tuple[float, float, float, float, float]:
     """Test pass: accumulate per-batch mean losses (reference semantics,
     main.py:283-284) and pooled predictions, then dispatch the matcher.
 
-    ``gather_processes``: host-sharded feeding — each process saw only its
-    own sub-batch rows, so expected/actual are all-gathered across
-    processes before computing the (global) metrics. The host's rows sit at
-    ``[process_index * feed, (process_index + 1) * feed)`` of the global
-    prediction vector (jax device order groups a host's devices
-    contiguously, which is how local_to_global_batch laid the rows out).
+    ``gather_processes``: host-sharded feeding — each feed group saw only
+    its own sub-batch rows, so expected/actual are all-gathered across
+    processes before computing the (global) metrics. The group's rows sit
+    at ``[group * feed, (group + 1) * feed)`` of the global prediction
+    vector (feed groups are ordered by their data-axis coords, which is how
+    local_to_global_batch laid the rows out). Processes replicating a group
+    (a model/ctx axis spanning processes) contribute duplicate rows to the
+    gather — uniform duplication, under which every pooled metric is
+    unchanged.
     """
     import jax as _jax
 
@@ -747,7 +766,7 @@ def _evaluate_batches(
         preds = allgather_to_host(out["preds"])
         if gather_processes and len(preds) != len(valid):
             feed = len(valid)
-            lo = _jax.process_index() * feed
+            lo = feed_group[0] * feed
             preds = preds[lo : lo + feed]
         expected.append(batch["labels"][valid])
         actual.append(preds[valid])

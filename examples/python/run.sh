@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# End-to-end walkthrough of the Python language leg (BASELINE config 5):
+# Python sources -> path contexts (ast walker) -> trained model -> exported
+# code vectors -> predictions. Run from this directory. CPU-friendly (~2 min).
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO_ROOT="$(cd ../.. && pwd)"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+
+# 1. Extract path contexts. dataset/methods.txt lists "<py-file>\t<name>"
+#    rows ("*" = every function); .py rows route through the pure-Python
+#    ast extractor (code2vec_tpu/pyextract.py), which applies the same
+#    anonymization/path conventions as the native Java extractor and can
+#    merge both languages into one vocab space (mixed methods.txt).
+python -m code2vec_tpu.extractor dataset/ .
+
+# 2. Train method-name prediction on the extracted corpus. Each function
+#    name is implemented twice (string_ops/number_ops mirror
+#    text_utils/math_utils), so the held-out split shares labels with
+#    training and the final test F1 is meaningfully nonzero.
+python "$REPO_ROOT/main.py" \
+  --corpus_path dataset/corpus.txt \
+  --path_idx_path dataset/path_idxs.txt \
+  --terminal_idx_path dataset/terminal_idxs.txt \
+  --batch_size 4 --encode_size 64 --max_epoch 8 --lr 0.01 \
+  --model_path output --vectors_path output/code.vec --no_cuda
+
+# 3. Inspect the exported vectors (one "label\tfloats" row per method).
+head -3 output/code.vec
+echo "---"
+
+# 4. Predict method names for a Python source file from the trained
+#    checkpoint: top-k labels with probabilities and the
+#    highest-attention path-contexts.
+python -m code2vec_tpu.predict src/util/math_utils.py \
+  --model_path output \
+  --terminal_idx_path dataset/terminal_idxs.txt \
+  --path_idx_path dataset/path_idxs.txt \
+  --top_k 3 --show_attention 1
+echo "---"
+echo "artifacts: dataset/{corpus,terminal_idxs,path_idxs,params}.txt, output/code.vec"
+echo "visualize: python $REPO_ROOT/visualize_code_vec.py --code_vec_path output/code.vec"

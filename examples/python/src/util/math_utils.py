@@ -1,0 +1,35 @@
+"""Numeric helpers (mirrored by number_ops.py — see text_utils.py)."""
+
+
+def find_max(values):
+    best = values[0]
+    for v in values[1:]:
+        if v > best:
+            best = v
+    return best
+
+
+def sum_of_squares(values):
+    total = 0
+    for v in values:
+        total += v * v
+    return total
+
+
+def is_prime(number):
+    if number < 2:
+        return False
+    factor = 2
+    while factor * factor <= number:
+        if number % factor == 0:
+            return False
+        factor += 1
+    return True
+
+
+def clamp_value(value, low, high):
+    if value < low:
+        return low
+    if value > high:
+        return high
+    return value

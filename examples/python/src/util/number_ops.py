@@ -1,0 +1,26 @@
+"""Second implementations of the math_utils.py method names."""
+
+
+def find_max(items):
+    result = None
+    for item in items:
+        if result is None or item > result:
+            result = item
+    return result
+
+
+def sum_of_squares(items):
+    return sum(item ** 2 for item in items)
+
+
+def is_prime(candidate):
+    if candidate < 2:
+        return False
+    for divisor in range(2, int(candidate ** 0.5) + 1):
+        if candidate % divisor == 0:
+            return False
+    return True
+
+
+def clamp_value(amount, minimum, maximum):
+    return max(minimum, min(amount, maximum))

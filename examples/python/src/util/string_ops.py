@@ -1,0 +1,29 @@
+"""Second implementations of the text_utils.py method names — different
+bodies, same labels, so test-split methods have in-vocabulary names."""
+
+
+def count_words(sentence):
+    pieces = [p for p in sentence.split() if len(p) > 0]
+    return len(pieces)
+
+
+def reverse_text(value):
+    chars = list(value)
+    lo, hi = 0, len(chars) - 1
+    while lo < hi:
+        chars[lo], chars[hi] = chars[hi], chars[lo]
+        lo += 1
+        hi -= 1
+    return "".join(chars)
+
+
+def is_palindrome(value):
+    kept = [c.lower() for c in value if c.isalnum()]
+    return kept == kept[::-1]
+
+
+def capitalize_words(sentence):
+    out = []
+    for token in sentence.split(" "):
+        out.append(token.capitalize() if token else token)
+    return " ".join(out)

@@ -1,0 +1,41 @@
+"""Text helpers (mirrored by string_ops.py so each method name appears
+twice in the corpus — the held-out split then shares labels with training)."""
+
+
+def count_words(text):
+    total = 0
+    for chunk in text.split():
+        if chunk:
+            total += 1
+    return total
+
+
+def reverse_text(text):
+    result = ""
+    for ch in text:
+        result = ch + result
+    return result
+
+
+def is_palindrome(text):
+    cleaned = ""
+    for ch in text:
+        if ch.isalnum():
+            cleaned += ch.lower()
+    left, right = 0, len(cleaned) - 1
+    while left < right:
+        if cleaned[left] != cleaned[right]:
+            return False
+        left += 1
+        right -= 1
+    return True
+
+
+def capitalize_words(text):
+    parts = []
+    for word in text.split(" "):
+        if word:
+            parts.append(word[0].upper() + word[1:])
+        else:
+            parts.append(word)
+    return " ".join(parts)

@@ -17,7 +17,13 @@ import json
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+# MP_LOCAL_DEVICES=1 lets a 4-process group put the model axis ACROSS
+# process boundaries (mesh rows pair devices from different processes),
+# exercising cross-process tensor-parallel collectives under Gloo
+_LOCAL_DEVICES = int(os.environ.get("MP_LOCAL_DEVICES", "2"))
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}"
+)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import faulthandler
@@ -34,9 +40,10 @@ from code2vec_tpu.parallel.distributed import initialize_from_env
 
 def main() -> None:
     dataset_dir, out_dir = sys.argv[1], sys.argv[2]
+    n_procs = int(os.environ["NUM_PROCESSES"])
     assert initialize_from_env(), "worker needs the distributed env vars"
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 4, jax.devices()
+    assert jax.process_count() == n_procs, jax.process_count()
+    assert len(jax.devices()) == n_procs * _LOCAL_DEVICES, jax.devices()
 
     from code2vec_tpu.data.reader import load_corpus
     from code2vec_tpu.data.synth import SynthSpec, generate_corpus_files
@@ -52,11 +59,6 @@ def main() -> None:
         mean_contexts=10.0, max_contexts=16, seed=11,
     )
     paths = generate_corpus_files(dataset_dir, spec)
-    shard = (jax.process_index(), jax.process_count())
-    data = load_corpus(
-        paths["corpus"], paths["path_idx"], paths["terminal_idx"], shard=shard
-    )
-    assert data.shard == shard
 
     cfg = TrainConfig(
         max_epoch=3,
@@ -65,9 +67,27 @@ def main() -> None:
         terminal_embed_size=16,
         path_embed_size=16,
         max_path_length=16,
-        data_axis=4,  # spans both processes' devices
+        # default: the data axis spans every device of every process;
+        # MP_MODEL_AXIS=2 (with MP_LOCAL_DEVICES=1) makes each model pair
+        # straddle two processes — cross-process TP collectives
+        data_axis=int(
+            os.environ.get("MP_DATA_AXIS", str(n_procs * _LOCAL_DEVICES))
+        ),
+        model_axis=int(os.environ.get("MP_MODEL_AXIS", "1")),
         print_sample_cycle=0,
     )
+    # shard the corpus by FEED GROUP (the processes sharing this one's
+    # data-axis coords), not by process index — with a model axis spanning
+    # processes the group has 2 members that must load identical shards
+    from code2vec_tpu.parallel.distributed import feed_groups
+    from code2vec_tpu.train.loop import build_mesh
+
+    shard = feed_groups(build_mesh(cfg))
+    data = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"], shard=shard
+    )
+    assert data.shard == shard
+
     result = train(cfg, data, out_dir=out_dir)
     # full-precision floats: the parent asserts bit-for-bit agreement
     print(json.dumps({

@@ -1,0 +1,419 @@
+"""java-large (BASELINE config 3) end-to-end rehearsal, synthetic.
+
+VERDICT r3 missing-#2: the machinery for 16M methods / 1.3M-path vocab
+exists (streaming epochs, host shards, sharded staging, int32 guard) but
+had never been exercised at that scale. This tool does, on one host:
+
+  phase gen     — chunked corpus synthesis at --n_methods x ~120 ctx/method
+                  into memmap-able .npy files (the fully-vectorized
+                  generate_corpus_data would peak ~100 GB in int64
+                  temporaries at 1.9G contexts; chunking caps it)
+  phase guard   — the staging int32 row_splits guard
+                  (train/device_epoch.py) against the REAL total, plus a
+                  forced-overflow probe asserting it fires past 2^31
+  phase stream  — the bounded-RSS host pipeline: --stream_chunk_items
+                  semantics (iter_streaming_batches) driving real train
+                  steps on the 1.3M-vocab model, corpus memmap'd from disk
+  phase shard   — the device-epoch sharded-staging path
+                  (stage_method_corpus_sharded + ShardedEpochRunner) on a
+                  --data_axis-device virtual CPU mesh, real train steps,
+                  per-device staged bytes reported against the /D budget
+                  prediction
+
+Each phase runs in its own subprocess (clean VmHWM attribution; the parent
+never imports jax). Results stream as JSON lines; the parent writes a
+summary table comparing measured numbers to docs/ARCHITECTURE.md's
+memory-budget formulas.
+
+Scale notes vs the real config 3: path/terminal vocabs at 1.3M rows are the
+sharded-embedding dimension of the config; labels default to 50k (a
+plausible method-name vocab; the head is [100, labels]). The corpus text
+layer (29 GB of corpus.txt + a JVM-scale parse) is NOT rehearsed — phases
+drive the array-level production paths below it; --host_shard_corpus's
+round-robin share is emulated at array level with the same semantics.
+
+Usage:
+  python tools/rehearse_java_large.py                  # full 16M rehearsal
+  python tools/rehearse_java_large.py --n_methods 2000000 --steps 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+DEFAULT_DIR = "/tmp/java_large_rehearsal"
+
+N_TERMINALS = 1_300_000
+N_PATHS = 1_300_000
+N_LABELS = 50_000
+MEAN_CONTEXTS = 120.0
+MAX_CONTEXTS = 1000
+
+
+def _rss() -> dict:
+    """Current and peak RSS in MB from /proc/self/status."""
+    out = {}
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith(("VmRSS", "VmHWM")):
+                k, v = line.split(":")
+                out[k] = round(int(v.split()[0]) / 1024.0)
+    return out
+
+
+def _emit(**kw) -> None:
+    print(json.dumps(kw), flush=True)
+
+
+# --------------------------------------------------------------------------
+# phase: gen
+# --------------------------------------------------------------------------
+
+def phase_gen(work_dir: str, n_methods: int) -> None:
+    import numpy as np
+
+    os.makedirs(work_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    counts = np.clip(
+        rng.lognormal(np.log(MEAN_CONTEXTS), 0.6, n_methods).astype(np.int64),
+        3, MAX_CONTEXTS,
+    )
+    row_splits = np.zeros(n_methods + 1, np.int64)
+    np.cumsum(counts, out=row_splits[1:])
+    total = int(row_splits[-1])
+    _emit(phase="gen", n_methods=n_methods, total_contexts=total,
+          int32_margin=2**31 - total)
+
+    labels = rng.integers(0, N_LABELS, n_methods).astype(np.int32)
+    np.save(os.path.join(work_dir, "row_splits.npy"), row_splits)
+    np.save(os.path.join(work_dir, "labels.npy"), labels)
+
+    # chunked context synthesis straight into on-disk memmaps: peak host
+    # memory stays at the chunk temporaries (~1.5 GB), not ~100 GB
+    chunk = 64_000_000
+    mms = {
+        name: np.lib.format.open_memmap(
+            os.path.join(work_dir, f"{name}.npy"), mode="w+",
+            dtype=np.int32, shape=(total,),
+        )
+        for name in ("starts", "paths", "ends")
+    }
+    lo = 0
+    while lo < total:
+        hi = min(lo + chunk, total)
+        n = hi - lo
+        mms["starts"][lo:hi] = rng.integers(1, N_TERMINALS + 1, n, dtype=np.int32)
+        mms["paths"][lo:hi] = rng.integers(1, N_PATHS + 1, n, dtype=np.int32)
+        mms["ends"][lo:hi] = rng.integers(1, N_TERMINALS + 1, n, dtype=np.int32)
+        lo = hi
+    for m in mms.values():
+        m.flush()
+    bytes_csr = total * 3 * 4
+    _emit(phase="gen", done=True, seconds=round(time.time() - t0, 1),
+          csr_gb=round(bytes_csr / 2**30, 2), **_rss())
+
+
+# --------------------------------------------------------------------------
+# corpus loading shared by the step phases
+# --------------------------------------------------------------------------
+
+def _load_corpus_data(work_dir: str):
+    """CorpusData over memmap'd context arrays (RSS stays page-cache-only
+    until a path materializes rows). Minimal aux fields: the rehearsal
+    drives training steps, not subtoken eval/export."""
+    import numpy as np
+
+    from code2vec_tpu.data.reader import CorpusData
+    from code2vec_tpu.data.vocab import Vocab
+
+    starts = np.load(os.path.join(work_dir, "starts.npy"), mmap_mode="r")
+    paths = np.load(os.path.join(work_dir, "paths.npy"), mmap_mode="r")
+    ends = np.load(os.path.join(work_dir, "ends.npy"), mmap_mode="r")
+    row_splits = np.load(os.path.join(work_dir, "row_splits.npy"))
+    labels = np.load(os.path.join(work_dir, "labels.npy"))
+    n = len(row_splits) - 1
+
+    label_vocab = Vocab()
+    for i in range(N_LABELS):
+        label_vocab.add_label(f"label{i}")
+    terminal_vocab = Vocab()
+    terminal_vocab.add("<PAD/>", 0)
+    terminal_vocab.add("@question", 1)
+    path_vocab = Vocab()
+    path_vocab.add("<PAD/>", 0)
+    empty: dict = {}
+    return CorpusData(
+        starts=starts, paths=paths, ends=ends, row_splits=row_splits,
+        ids=np.arange(n, dtype=np.int64), labels=labels,
+        normalized_labels=[], sources=[None] * n, aliases=[empty] * n,
+        terminal_vocab=terminal_vocab, path_vocab=path_vocab,
+        label_vocab=label_vocab,
+    )
+
+
+def _model_bits(batch: int, bag: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    mc = Code2VecConfig(
+        terminal_count=N_TERMINALS + 2, path_count=N_PATHS + 1,
+        label_count=N_LABELS, terminal_embed_size=100, path_embed_size=100,
+        encode_size=100, dropout_prob=0.25, dtype=jnp.float32,
+        embed_grad="dense",
+    )
+    tc = TrainConfig(batch_size=batch, max_path_length=bag,
+                     rng_impl="unsafe_rbg")
+    example = {
+        "starts": np.zeros((batch, bag), np.int32),
+        "paths": np.zeros((batch, bag), np.int32),
+        "ends": np.zeros((batch, bag), np.int32),
+        "labels": np.zeros(batch, np.int32),
+        "example_mask": np.ones(batch, np.float32),
+    }
+    state = create_train_state(tc, mc, jax.random.PRNGKey(0), example)
+    cw = jnp.ones(mc.label_count, jnp.float32)
+    return mc, tc, state, cw
+
+
+# --------------------------------------------------------------------------
+# phase: guard
+# --------------------------------------------------------------------------
+
+def phase_guard(work_dir: str) -> None:
+    import numpy as np
+
+    from code2vec_tpu.train.device_epoch import stage_method_corpus
+
+    row_splits = np.load(os.path.join(work_dir, "row_splits.npy"))
+    total = int(row_splits[-1])
+    _emit(phase="guard", total_contexts=total, fits_int32=total < 2**31,
+          margin=2**31 - total)
+
+    # forced overflow: a stub corpus whose selected rows exceed 2^31
+    # contexts must trip the guard BEFORE any giant allocation happens
+    class _Stub:
+        pass
+
+    stub = _Stub()
+    stub.row_splits = np.array([0, 2**31 + 10], np.int64)
+    try:
+        stage_method_corpus(stub, np.array([0]), np.random.default_rng(0))
+    except ValueError as e:
+        _emit(phase="guard", overflow_guard="fired", message=str(e)[:120])
+    else:
+        _emit(phase="guard", overflow_guard="DID NOT FIRE (BUG)")
+        sys.exit(1)
+
+
+# --------------------------------------------------------------------------
+# phase: stream
+# --------------------------------------------------------------------------
+
+def phase_stream(work_dir: str, batch: int, bag: int, steps: int,
+                 chunk_items: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from code2vec_tpu.data.pipeline import iter_streaming_batches, build_epoch
+    from code2vec_tpu.train.step import make_train_step
+
+    data = _load_corpus_data(work_dir)
+    _emit(phase="stream", loaded=True, **_rss())
+    mc, tc, state, cw = _model_bits(batch, bag)
+    train_step = make_train_step(mc, cw)
+    rng = np.random.default_rng(0)
+
+    def chunk_builder(idx):
+        return build_epoch(data, idx, bag, rng, False)
+
+    idx = np.arange(data.n_items)
+    it = iter_streaming_batches(chunk_builder, idx, batch, rng,
+                                chunk_items=chunk_items)
+    t_start = time.time()
+    first_batch_s = None
+    times = []
+    done = 0
+    for b in it:
+        if first_batch_s is None:
+            first_batch_s = time.time() - t_start  # first chunk build
+        t0 = time.time()
+        state, loss = train_step(state, b)
+        loss.block_until_ready()
+        times.append(time.time() - t0)
+        done += 1
+        if done >= steps:
+            break
+    _emit(phase="stream", steps=done,
+          first_step_s=round(times[0], 1) if times else None,
+          later_step_s=round(float(np.mean(times[1:])), 2) if len(times) > 1 else None,
+          chunk_items=chunk_items,
+          time_to_first_batch_s=round(first_batch_s, 1) if first_batch_s else None,
+          final_loss=float(loss), **_rss())
+
+
+# --------------------------------------------------------------------------
+# phase: shard
+# --------------------------------------------------------------------------
+
+def phase_shard(work_dir: str, batch: int, bag: int, steps: int,
+                data_axis: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={data_axis} "
+        + os.environ.get("XLA_FLAGS", "")
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from code2vec_tpu.parallel.mesh import make_mesh
+    from code2vec_tpu.parallel.shardings import shard_state
+    from code2vec_tpu.train.device_epoch import (
+        ShardedEpochRunner,
+        stage_method_corpus_sharded,
+    )
+
+    data = _load_corpus_data(work_dir)
+    _emit(phase="shard", loaded=True, **_rss())
+    mc, tc, state, cw = _model_bits(batch, bag)
+    mesh = make_mesh(data=data_axis, model=1, ctx=1)
+    state = shard_state(mesh, state)
+    rng = np.random.default_rng(0)
+
+    t0 = time.time()
+    staged = stage_method_corpus_sharded(
+        data, np.arange(data.n_items), rng, mesh
+    )
+    per_device_bytes = int(staged.contexts.shape[1]) * 3 * 4 + (
+        int(staged.row_splits.shape[1]) * 4
+    )
+    _emit(phase="shard", staged=True, seconds=round(time.time() - t0, 1),
+          data_axis=data_axis,
+          per_device_staged_mb=round(per_device_bytes / 2**20),
+          total_staged_mb=round(per_device_bytes * data_axis / 2**20),
+          **_rss())
+
+    runner = ShardedEpochRunner(mc, cw, batch, bag, chunk_batches=1,
+                                mesh=mesh)
+    run_chunk = runner._train_chunk(1)
+    span = runner.per_shard
+    valid = np.ones((runner.n_shards, span), np.float32)
+    key = jax.random.PRNGKey(1)
+    times = []
+    for _ in range(steps):
+        rows = rng.integers(
+            0, np.maximum(staged.shard_counts[:, None], 1),
+            (runner.n_shards, span),
+        ).astype(np.int32)
+        key, sub = jax.random.split(key)
+        t0 = time.time()
+        state, loss = run_chunk(
+            state, staged.contexts, staged.row_splits, staged.labels,
+            rows, valid, sub,
+        )
+        jax.block_until_ready(loss)
+        times.append(time.time() - t0)
+    _emit(phase="shard", steps=steps, first_step_s=round(times[0], 1),
+          later_step_s=round(float(np.mean(times[1:])), 2) if len(times) > 1 else None,
+          final_loss=float(np.asarray(loss).sum()), **_rss())
+
+
+# --------------------------------------------------------------------------
+# phase: hostshard (array-level emulation of --host_shard_corpus's share)
+# --------------------------------------------------------------------------
+
+def phase_hostshard(work_dir: str, n_hosts: int) -> None:
+    import numpy as np
+
+    row_splits = np.load(os.path.join(work_dir, "row_splits.npy"))
+    n = len(row_splits) - 1
+    counts = np.diff(row_splits)
+    # the reader keeps rows where id % n_hosts == host (data/reader.py
+    # round-robin); per-host CSR bytes is the dominant budget term
+    shares = []
+    for host in range(n_hosts):
+        share = int(counts[host::n_hosts].sum()) * 3 * 4
+        shares.append(share)
+    _emit(phase="hostshard", n_hosts=n_hosts,
+          per_host_csr_gb=[round(s / 2**30, 2) for s in shares],
+          max_over_min=round(max(shares) / min(shares), 4))
+
+
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", choices=["gen", "guard", "stream", "shard",
+                                        "hostshard"])
+    ap.add_argument("--work_dir", default=DEFAULT_DIR)
+    ap.add_argument("--n_methods", type=int, default=16_000_000)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--bag", type=int, default=200)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--chunk_items", type=int, default=65_536)
+    ap.add_argument("--data_axis", type=int, default=4)
+    ap.add_argument("--n_hosts", type=int, default=8)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the generated corpus files")
+    args = ap.parse_args()
+
+    if args.phase == "gen":
+        return phase_gen(args.work_dir, args.n_methods)
+    if args.phase == "guard":
+        return phase_guard(args.work_dir)
+    if args.phase == "stream":
+        return phase_stream(args.work_dir, args.batch, args.bag, args.steps,
+                            args.chunk_items)
+    if args.phase == "shard":
+        return phase_shard(args.work_dir, args.batch, args.bag, args.steps,
+                           args.data_axis)
+    if args.phase == "hostshard":
+        return phase_hostshard(args.work_dir, args.n_hosts)
+
+    # parent: run every phase in its own subprocess, streaming output
+    t0 = time.time()
+    phases = [
+        ["--phase", "gen", "--n_methods", str(args.n_methods)],
+        ["--phase", "guard"],
+        ["--phase", "hostshard", "--n_hosts", str(args.n_hosts)],
+        ["--phase", "stream", "--steps", str(args.steps),
+         "--chunk_items", str(args.chunk_items)],
+        ["--phase", "shard", "--steps", str(args.steps),
+         "--data_axis", str(args.data_axis)],
+    ]
+    for extra in phases:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--work_dir", args.work_dir] + extra
+        _emit(running=extra[1])
+        rc = subprocess.call(cmd)
+        if rc != 0:
+            _emit(phase=extra[1], rc=rc, error="phase failed")
+            sys.exit(rc)
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(args.work_dir, ignore_errors=True)
+    _emit(done=True, total_minutes=round((time.time() - t0) / 60.0, 1))
+
+
+if __name__ == "__main__":
+    main()
