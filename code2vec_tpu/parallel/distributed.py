@@ -112,6 +112,13 @@ def feed_groups(mesh: Mesh) -> tuple[int, int]:
     for pos, dev in np.ndenumerate(mesh.devices):
         coords.setdefault(dev.process_index, set()).add(int(pos[0]))
     canon = {p: tuple(sorted(c)) for p, c in coords.items()}
+    if jax.process_index() not in canon:
+        raise ValueError(
+            f"process {jax.process_index()} has no devices in the mesh "
+            f"(mesh covers processes {sorted(canon)}); the mesh axes must "
+            "span every participating host's devices for host-sharded "
+            "feeding"
+        )
     groups = sorted(set(canon.values()))
     covered = [c for g in groups for c in g]
     if sorted(covered) != list(range(mesh.devices.shape[0])):

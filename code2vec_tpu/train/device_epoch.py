@@ -73,18 +73,35 @@ class StagedCorpus:
         return int(self.contexts.shape[0])
 
 
+def _check_device_total(total: int) -> None:
+    """Device row_splits are int32; enforced at every whole-corpus device
+    boundary (direct staging and place_staged). shard_staged's limit is
+    per-SHARD instead — java-large's ~2.3G contexts exceed this whole-
+    corpus limit and stage fine sharded."""
+    if total >= 2**31:
+        raise ValueError(
+            f"staged corpus has {total} contexts; device row_splits are "
+            "int32 — use --shard_staged_corpus (per-shard limit) or stage "
+            "a subset / shard over hosts"
+        )
+
+
 def _per_row_shuffle(
     total: int, row_splits: np.ndarray, rng: np.random.Generator
 ) -> np.ndarray:
     """A permutation of [0, total) that shuffles within each CSR row only.
 
     Vectorized: sort (row_id, uniform) pairs — stable layout per row, random
-    order within. O(total log total) once at staging.
+    order within. O(total log total) once at staging. Keys are kept narrow
+    (int32 row ids, f32 uniforms) — at java-large scale (2.3G contexts)
+    every byte per element is gigabytes of staging transients; an f32
+    collision within a row falls back to stable order, a negligible bias
+    at realistic bag sizes.
     """
     row_ids = np.repeat(
-        np.arange(len(row_splits) - 1, dtype=np.int64), np.diff(row_splits)
+        np.arange(len(row_splits) - 1, dtype=np.int32), np.diff(row_splits)
     )
-    return np.lexsort((rng.random(total), row_ids))
+    return np.lexsort((rng.random(total, dtype=np.float32), row_ids))
 
 
 def stage_method_corpus(
@@ -104,19 +121,25 @@ def stage_method_corpus(
     new_splits = np.zeros(len(item_idx) + 1, np.int64)
     np.cumsum(counts, out=new_splits[1:])
     total = int(new_splits[-1])
-    if total >= 2**31:
-        raise ValueError(
-            f"staged corpus has {total} contexts; device row_splits are "
-            "int32 — stage a subset (or shard the corpus over hosts)"
-        )
+    if device != "host":
+        # a host-staged intermediate keeps int64 splits; place_staged /
+        # shard_staged enforce the device-side limits downstream
+        _check_device_total(total)
 
-    # flat indices of every context of every selected item, in item order
+    # flat indices of every context of every selected item, in item order;
+    # the per-row shuffle is applied to the INDICES before the gather (one
+    # [total, 3] pass instead of gather-then-permute — at java-large scale
+    # that second copy is ~27 GB of transient)
     flat, _, _ = flat_context_indices(data.row_splits, item_idx)
+    perm = _per_row_shuffle(total, new_splits, rng)
+    flat = flat[perm]
+    del perm
 
     contexts = np.empty((total, 3), np.int32)
     contexts[:, 0] = data.starts[flat]
     contexts[:, 1] = data.paths[flat]
     contexts[:, 2] = data.ends[flat]
+    del flat
 
     method_idx = data.method_token_index
     if method_idx is not None:
@@ -124,12 +147,11 @@ def stage_method_corpus(
         np.putmask(terms, terms == method_idx, QUESTION_TOKEN_INDEX)
         contexts[:, (0, 2)] = terms
 
-    contexts = contexts[_per_row_shuffle(total, new_splits, rng)]
-
     put = _putter(device)
+    splits_dtype = np.int64 if device == "host" else np.int32
     return StagedCorpus(
         contexts=put(contexts),
-        row_splits=put(new_splits.astype(np.int32)),
+        row_splits=put(new_splits.astype(splits_dtype)),
         labels=put(data.labels[item_idx].astype(np.int32)),
         n_items=len(item_idx),
     )
@@ -185,13 +207,16 @@ def stage_variable_corpus(
     )
     row_splits = np.zeros(len(counts) + 1, np.int64)
     np.cumsum(counts, out=row_splits[1:])
-    if int(row_splits[-1]) >= 2**31:
+    if device != "host" and int(row_splits[-1]) >= 2**31:
+        # host-staged intermediates keep int64 splits (see
+        # stage_method_corpus); the device cast enforces the int32 limit
         raise ValueError("staged variable corpus exceeds int32 row_splits")
 
     put = _putter(device)
+    splits_dtype = np.int64 if device == "host" else np.int32
     return StagedCorpus(
         contexts=put(contexts),
-        row_splits=put(row_splits.astype(np.int32)),
+        row_splits=put(row_splits.astype(splits_dtype)),
         labels=put(np.asarray(labels, np.int32)),
         n_items=len(labels),
         remap_ids=put(data.variable_indexes.astype(np.int32)),
@@ -205,16 +230,12 @@ def concat_staged(a: StagedCorpus, b: StagedCorpus) -> StagedCorpus:
     before device_put-ing (stage with device="host", then place_staged)."""
     a_ctx, b_ctx = np.asarray(a.contexts), np.asarray(b.contexts)
     a_rs, b_rs = np.asarray(a.row_splits), np.asarray(b.row_splits)
-    # int64 math + re-check: both parts can pass their own 2**31 guard
-    # while the combined total overflows int32 row_splits
+    # int64 math: the host intermediate carries int64 splits (the combined
+    # total may exceed int32 yet still shard fine); place_staged /
+    # shard_staged enforce the device-side limits
     row_splits = np.concatenate(
         [a_rs.astype(np.int64), b_rs[1:].astype(np.int64) + int(a_rs[-1])]
     )
-    if int(row_splits[-1]) >= 2**31:
-        raise ValueError(
-            f"combined staged corpus has {int(row_splits[-1])} contexts; "
-            "device row_splits are int32 — stage a subset"
-        )
     flags_a = (
         np.asarray(a.remap_flags)
         if a.remap_flags is not None
@@ -228,7 +249,7 @@ def concat_staged(a: StagedCorpus, b: StagedCorpus) -> StagedCorpus:
     remap_ids = a.remap_ids if a.remap_ids is not None else b.remap_ids
     return StagedCorpus(
         contexts=np.concatenate([a_ctx, b_ctx]),
-        row_splits=row_splits.astype(np.int32),
+        row_splits=row_splits,
         labels=np.concatenate([np.asarray(a.labels), np.asarray(b.labels)]),
         n_items=a.n_items + b.n_items,
         remap_ids=remap_ids,
@@ -237,10 +258,15 @@ def concat_staged(a: StagedCorpus, b: StagedCorpus) -> StagedCorpus:
 
 
 def place_staged(staged: StagedCorpus, device: Any | None = None) -> StagedCorpus:
+    """Move a host staging onto a device (or mesh placement). The device
+    sampler indexes with int32 ``row_splits``; a host staging past the
+    int32 total must go through ``shard_staged`` instead (per-SHARD limit)."""
+    rs = np.asarray(staged.row_splits)
+    _check_device_total(int(rs[-1]) if len(rs) else 0)
     put = partial(jax.device_put, device=device)
     return StagedCorpus(
         contexts=put(staged.contexts),
-        row_splits=put(staged.row_splits),
+        row_splits=put(rs.astype(np.int32)),
         labels=put(staged.labels),
         n_items=staged.n_items,
         remap_ids=None if staged.remap_ids is None else put(staged.remap_ids),
@@ -327,6 +353,14 @@ def shard_staged(staged: StagedCorpus, mesh) -> ShardedStagedCorpus:
     items_cap = max((len(g) for g in groups), default=1)
     ctx_cap = max((int(counts[g].sum()) for g in groups), default=1)
     items_cap, ctx_cap = max(items_cap, 1), max(ctx_cap, 1)
+    if ctx_cap >= 2**31:
+        # per-SHARD row_splits are int32 — the total may exceed 2^31 (the
+        # point of sharding: java-large's ~2.3G contexts at data_axis >= 2
+        # stays well under per shard), but one shard may not
+        raise ValueError(
+            f"largest shard holds {ctx_cap} contexts (int32 row_splits); "
+            f"increase data_axis beyond {n_shards}"
+        )
 
     contexts = np.zeros((n_shards, ctx_cap, 3), np.int32)
     row_splits = np.zeros((n_shards, items_cap + 1), np.int32)

@@ -127,18 +127,22 @@ def phase_gen(work_dir: str, n_methods: int) -> None:
 # corpus loading shared by the step phases
 # --------------------------------------------------------------------------
 
-def _load_corpus_data(work_dir: str):
-    """CorpusData over memmap'd context arrays (RSS stays page-cache-only
-    until a path materializes rows). Minimal aux fields: the rehearsal
-    drives training steps, not subtoken eval/export."""
+def _load_corpus_data(work_dir: str, ram: bool = False):
+    """CorpusData over the generated context arrays. Default: memmap'd (RSS
+    stays page-cache-only until a path materializes rows — the streaming
+    phase's bounded-RSS story). ``ram=True`` loads them fully (the staging
+    phase gathers billions of random elements; memmap would thrash disk).
+    Minimal aux fields: the rehearsal drives training steps, not subtoken
+    eval/export."""
     import numpy as np
+
+    mm = None if ram else "r"
+    starts = np.load(os.path.join(work_dir, "starts.npy"), mmap_mode=mm)
+    paths = np.load(os.path.join(work_dir, "paths.npy"), mmap_mode=mm)
+    ends = np.load(os.path.join(work_dir, "ends.npy"), mmap_mode=mm)
 
     from code2vec_tpu.data.reader import CorpusData
     from code2vec_tpu.data.vocab import Vocab
-
-    starts = np.load(os.path.join(work_dir, "starts.npy"), mmap_mode="r")
-    paths = np.load(os.path.join(work_dir, "paths.npy"), mmap_mode="r")
-    ends = np.load(os.path.join(work_dir, "ends.npy"), mmap_mode="r")
     row_splits = np.load(os.path.join(work_dir, "row_splits.npy"))
     labels = np.load(os.path.join(work_dir, "labels.npy"))
     n = len(row_splits) - 1
@@ -290,7 +294,7 @@ def phase_shard(work_dir: str, batch: int, bag: int, steps: int,
         stage_method_corpus_sharded,
     )
 
-    data = _load_corpus_data(work_dir)
+    data = _load_corpus_data(work_dir, ram=True)
     _emit(phase="shard", loaded=True, **_rss())
     mc, tc, state, cw = _model_bits(batch, bag)
     mesh = make_mesh(data=data_axis, model=1, ctx=1)
