@@ -19,22 +19,20 @@ for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
   # observed 2026-07-30) with the shared persistent compile cache
   if timeout 120 python -c "import bench; raise SystemExit(0 if bench._probe_default_backend(90) else 1)" >/dev/null 2>&1; then
     echo "[tpu_watch] tunnel up after probe $i: $(date)"
-    # round-4 measurement sequence, cheapest/most-important first so a
-    # short tunnel window still lands the headline stamp (VERDICT r3 #1):
-    # 1. headline bench at HEAD (gate-zero re-stamp)
-    # outer timeout must exceed the supervisor's own total budget, or
-    # timeout(1) kills the supervisor and orphans the measurement child
+    # Remaining round-4 queue (2026-07-31: bench re-stamp + --r4 ablation
+    # + pool rows already captured in the morning window before the
+    # tunnel re-wedged mid-bench_ctx; what's left):
+    # 1. headline bench at the NEW default (mu-bf16 flip landed after the
+    #    morning stamp, which ran at f32 moments)
     BENCH_DEADLINE=1200 timeout 1500 python bench.py > /tmp/bench_tpu.txt 2>&1
     echo "[tpu_watch] bench rc=$? $(date)"
-    # 2. focused ablation: winner x2, mu-bf16 A/B x2, wide512 f32/bf16 x2
-    timeout 2400 python tools/run_tpu_ablation.py --r4 > /tmp/ablation_r4.txt 2>&1
-    echo "[tpu_watch] ablation --r4 rc=$? $(date)"
-    # 3. long-bag / ctx-axis table (VERDICT r3 #4)
-    timeout 1800 python tools/bench_ctx.py > /tmp/bench_ctx.txt 2>&1
-    echo "[tpu_watch] bench_ctx rc=$? $(date)"
-    # 4. component attribution of the 25.3ms step (VERDICT r3 #2)
+    # 2. component attribution of the 25.3ms step (VERDICT r3 #2)
     timeout 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
     echo "[tpu_watch] profile_step rc=$? $(date)"
+    # 3. long-bag full-step rows (the wedge point last time; pools are
+    #    cheap and re-run alongside)
+    timeout 1800 python tools/bench_ctx.py > /tmp/bench_ctx.txt 2>&1
+    echo "[tpu_watch] bench_ctx rc=$? $(date)"
     exit 0
   fi
   echo "[tpu_watch] probe $i: tunnel still down $(date)"
