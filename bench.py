@@ -126,6 +126,22 @@ def _previous_benchmark(current_backend: str) -> float | None:
     return best
 
 
+def _mu_dtype_from_env() -> str:
+    """BENCH_ADAM_MU_DTYPE → TrainConfig.adam_mu_dtype, strictly: the two
+    arms have distinct measurement meaning (bf16 = measured bench winner,
+    f32 = torch parity), so an unrecognized alias raises instead of
+    silently picking one."""
+    raw = os.environ.get("BENCH_ADAM_MU_DTYPE", "bfloat16").strip().lower()
+    if raw in ("float32", "f32", "fp32"):
+        return "float32"
+    if raw in ("bfloat16", "bf16"):
+        return "bfloat16"
+    raise ValueError(
+        f"BENCH_ADAM_MU_DTYPE={raw!r}: expected float32/f32/fp32 or "
+        "bfloat16/bf16"
+    )
+
+
 def _env_float(name: str, default: float) -> float:
     """A malformed knob must degrade to its default, not crash the run —
     a crash here yields rc=1 with zero perf data (or silently converts a
@@ -504,6 +520,11 @@ def main() -> None:
         in ("bfloat16", "bf16")
         else jnp.float32,
         embed_grad=os.environ.get("BENCH_EMBED_GRAD", "dense"),
+        # "xla" | "streaming": attention-pool lowering (same math; the
+        # streaming exp/sum chain measured faster in isolation on v5e —
+        # ablation has the end-to-end A/B row)
+        # unknown values raise at model trace time (fail-loud dispatch)
+        attn_impl=os.environ.get("BENCH_ATTN_IMPL", "xla").strip().lower() or "xla",
         use_pallas=os.environ.get("BENCH_USE_PALLAS", "0").strip().lower()
         in ("1", "true", "yes", "on"),
         pallas_block_b=int(os.environ.get("BENCH_PALLAS_BLOCK_B", 8)),
@@ -521,14 +542,10 @@ def main() -> None:
         # ms, x2 repeats — tools/run_tpu_ablation.py --r4): trims ~280 MB
         # of the per-step moment RMW at top11 scale. Training keeps f32 as
         # ITS default (torch-parity configuration pinned by the train-step
-        # differential test); the bench takes the measured winner. Same
-        # alias handling as BENCH_DTYPE: "float32"/"f32" opts back out.
-        adam_mu_dtype=(
-            "float32"
-            if os.environ.get("BENCH_ADAM_MU_DTYPE", "bfloat16").strip().lower()
-            in ("float32", "f32")
-            else "bfloat16"
-        ),
+        # differential test); the bench takes the measured winner.
+        # Unrecognized values raise rather than silently landing on either
+        # arm — a typo'd opt-out must not get recorded as an f32 stamp.
+        adam_mu_dtype=_mu_dtype_from_env(),
     )
 
     rng = np.random.default_rng(0)
@@ -653,6 +670,12 @@ def main() -> None:
                     "shard_staged": shard_staged,
                     "final_chunk_loss_sum": float(loss),  # sum over BENCH_CHUNK batch losses
                     "compute_dtype": str(model_config.dtype.__name__ if hasattr(model_config.dtype, "__name__") else model_config.dtype),
+                    # run-variable knobs: stamps must be self-describing
+                    # across default flips (mu-bf16 landed round 4);
+                    # use_pallas=true overrides attn_impl in the dispatch
+                    "adam_mu_dtype": config.adam_mu_dtype,
+                    "attn_impl": model_config.attn_impl,
+                    "use_pallas": model_config.use_pallas,
                 }
             }
         ),

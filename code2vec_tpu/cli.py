@@ -124,6 +124,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pallas_block_b", type=int, default=8,
                         help="batch-tile size of the fused kernel (tune via "
                              "tools/run_tpu_ablation.py)")
+    parser.add_argument("--attn_impl", type=str, default="xla",
+                        choices=("xla", "streaming"),
+                        help="attention-pool lowering: jax.nn.softmax chain "
+                             "or the explicit streaming exp/sum decomposition "
+                             "(same math; --use_pallas overrides)")
     from code2vec_tpu.ops.embed import GRAD_MODES
 
     parser.add_argument("--embed_grad", type=str, default="dense",
@@ -223,6 +228,7 @@ def config_from_args(args: argparse.Namespace):
         context_axis=args.context_axis,
         use_pallas=args.use_pallas,
         pallas_block_b=args.pallas_block_b,
+        attn_impl=args.attn_impl,
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
         adam_mu_dtype=args.adam_mu_dtype,

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.nn.initializers import normal, zeros
 
-from code2vec_tpu.ops.attention import attention_pool
+from code2vec_tpu.ops.attention import attention_pool, streaming_attention_pool
 from code2vec_tpu.ops.embed import embedding_lookup
 
 
@@ -48,6 +48,10 @@ class Code2VecConfig:
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
     use_pallas: bool = False  # fused attention-pooling kernel (ops.pallas_attention)
     pallas_block_b: int = 8  # batch-tile size of the fused kernel
+    # "xla" = jax.nn.softmax chain; "streaming" = the explicit exp/sum
+    # decomposition (ops.attention.streaming_attention_pool) — same math,
+    # different lowering; use_pallas overrides both
+    attn_impl: str = "xla"
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
     # round table/head vocab dims up to this multiple so they shard evenly
     # over the model mesh axis (parallel.shardings.pad_to_multiple); padded
@@ -157,9 +161,18 @@ class Code2Vec(nn.Module):
                 contexts, mask, attention_param.astype(c.dtype),
                 block_b=c.pallas_block_b,
             )
-        else:
+        elif c.attn_impl == "streaming":
+            code_vector, attention = streaming_attention_pool(
+                contexts, mask, attention_param.astype(c.dtype)
+            )
+        elif c.attn_impl == "xla":
             code_vector, attention = attention_pool(
                 contexts, mask, attention_param.astype(c.dtype)
+            )
+        else:  # fail loudly: a typo'd lowering name must not run (and get
+            # measured as) the default one
+            raise ValueError(
+                f"unknown attn_impl {c.attn_impl!r}: expected 'xla' or 'streaming'"
             )
         code_vector_f32 = code_vector.astype(jnp.float32)
 

@@ -1,3 +1,7 @@
 """Compute ops: XLA reference implementations + Pallas TPU kernels."""
 
-from code2vec_tpu.ops.attention import attention_pool, masked_attention_weights
+from code2vec_tpu.ops.attention import (
+    attention_pool,
+    masked_attention_weights,
+    streaming_attention_pool,
+)

@@ -43,3 +43,55 @@ def attention_pool(
     attention = masked_attention_weights(scores, mask)
     code_vector = jnp.einsum("bl,ble->be", attention.astype(contexts.dtype), contexts)
     return code_vector, attention
+
+
+def streaming_attention_pool(
+    contexts: jnp.ndarray,  # [B, l, E] (l = local shard of L when sharded)
+    mask: jnp.ndarray,  # [B, l]
+    attn_param: jnp.ndarray,  # [E]
+    axis_name: str | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The streaming-softmax decomposition of :func:`attention_pool`:
+
+        m   = [pmax](max(local_scores))          one scalar per row
+        e   = exp(local_scores - m)
+        s   = [psum](sum(e))
+        out = [psum](e @ local_contexts) / s
+
+    With ``axis_name=None`` the collectives drop out and this is an exact
+    single-device reformulation of the masked-softmax pool — same math as
+    ``attention_pool`` (the ``1e-38`` clamp is inert: ``e`` always carries
+    a 1.0 at the max position, so the sum is ≥ 1). It exists as a separate
+    lowering because the explicit exp/sum chain can fuse differently from
+    ``jax.nn.softmax`` (measured faster in isolation on TPU v5e —
+    tools/bench_ctx.py pool rows; selectable end-to-end via
+    ``Code2VecConfig.attn_impl="streaming"``).
+
+    With ``axis_name`` set (under ``shard_map``, bag axis sharded) the
+    pmax/psum collectives make it the ctx-parallel pool: ring attention's
+    exact rank-1 degenerate case — one pmax + two psums over ICI touch
+    each context shard exactly once (parallel/context.py).
+    """
+    scores = jnp.einsum("ble,e->bl", contexts, attn_param).astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    masked = scores * mask + (1.0 - mask) * NINF
+    local_max = jnp.max(masked, axis=-1)
+    # stop_gradient INSIDE the collective: pmax has no AD rule, and none is
+    # needed — the softmax max-shift is gradient-free (the -dm terms cancel
+    # exactly in the normalization). Stopping the operand zeroes its tangent
+    # symbolically, so AD never differentiates the collective, keeping
+    # backward through the pool exact AND trainable.
+    global_max = jax.lax.stop_gradient(local_max)
+    if axis_name is not None:
+        global_max = jax.lax.pmax(global_max, axis_name)
+    e = jnp.exp(masked - global_max[:, None])
+    local_sum = jnp.sum(e, axis=-1)
+    global_sum = (
+        jax.lax.psum(local_sum, axis_name) if axis_name is not None else local_sum
+    )
+    weights = e / jnp.maximum(global_sum[:, None], 1e-38)
+    local_cv = jnp.einsum("bl,ble->be", weights.astype(contexts.dtype), contexts)
+    code_vector = (
+        jax.lax.psum(local_cv, axis_name) if axis_name is not None else local_cv
+    )
+    return code_vector, weights

@@ -30,28 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from code2vec_tpu.ops.attention import NINF
+from code2vec_tpu.ops.attention import streaming_attention_pool
 from code2vec_tpu.parallel.mesh import AXIS_CTX
-
-
-def _local_pool(contexts, mask, attn_param, axis_name):
-    scores = jnp.einsum("ble,e->bl", contexts, attn_param).astype(jnp.float32)
-    mask = mask.astype(jnp.float32)
-    masked = scores * mask + (1.0 - mask) * NINF
-    local_max = jnp.max(masked, axis=-1)
-    # stop_gradient INSIDE the pmax: pmax has no AD rule, and none is
-    # needed — the softmax max-shift is gradient-free (the -dm terms cancel
-    # exactly in the normalization). Stopping the operand zeroes its tangent
-    # symbolically, so AD never differentiates the collective, keeping
-    # backward through the pool exact AND trainable.
-    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
-    e = jnp.exp(masked - global_max[:, None])
-    local_sum = jnp.sum(e, axis=-1)
-    global_sum = jax.lax.psum(local_sum, axis_name)
-    weights = e / jnp.maximum(global_sum[:, None], 1e-38)
-    local_cv = jnp.einsum("bl,ble->be", weights.astype(contexts.dtype), contexts)
-    code_vector = jax.lax.psum(local_cv, axis_name)
-    return code_vector, weights
 
 
 def context_parallel_attention_pool(
@@ -61,9 +41,11 @@ def context_parallel_attention_pool(
     attn_param: jnp.ndarray,  # [E] replicated
 ):
     """shard_map-wrapped pooling; returns (code_vector [B, E] replicated
-    over ctx, attention [B, L] sharded like the input)."""
+    over ctx, attention [B, L] sharded like the input). The per-shard math
+    (and the single-device ``attn_impl="streaming"`` model variant) lives
+    in ops.attention.streaming_attention_pool."""
     return jax.shard_map(
-        partial(_local_pool, axis_name=AXIS_CTX),
+        partial(streaming_attention_pool, axis_name=AXIS_CTX),
         mesh=mesh,
         in_specs=(P(None, AXIS_CTX, None), P(None, AXIS_CTX), P()),
         out_specs=(P(), P(None, AXIS_CTX)),

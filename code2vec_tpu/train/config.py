@@ -57,6 +57,7 @@ class TrainConfig:
     context_axis: int = 1
     use_pallas: bool = False  # fused attention-pooling kernel on TPU
     pallas_block_b: int = 8  # the kernel's batch-tile size
+    attn_impl: str = "xla"  # attention-pool lowering: "xla" | "streaming"
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
     # PRNG impl for the dropout stream: threefry2x32 (jax default,
     # reproducible everywhere) | rbg | unsafe_rbg (faster on TPU; different

@@ -6,7 +6,11 @@ import numpy as np
 import pytest
 
 from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
-from code2vec_tpu.ops.attention import attention_pool, masked_attention_weights
+from code2vec_tpu.ops.attention import (
+    attention_pool,
+    masked_attention_weights,
+    streaming_attention_pool,
+)
 
 
 def small_config(**kw):
@@ -69,6 +73,65 @@ class TestAttentionPool:
             jnp.zeros((1, 4)), jnp.zeros((1, 4))
         )
         assert not np.isnan(np.asarray(attn)).any()
+
+
+class TestStreamingAttentionPool:
+    """The explicit exp/sum lowering (attn_impl='streaming') is the same
+    math as attention_pool — outputs AND gradients must match."""
+
+    def _inputs(self, seed=3, B=4, L=9, E=5):
+        rng = np.random.default_rng(seed)
+        ctx = jnp.asarray(rng.normal(size=(B, L, E)), jnp.float32)
+        mask = jnp.asarray((rng.random((B, L)) > 0.3), jnp.float32)
+        mask = mask.at[:, 0].set(1.0)
+        a = jnp.asarray(rng.normal(size=E), jnp.float32)
+        return ctx, mask, a
+
+    def test_outputs_match_xla_pool(self):
+        ctx, mask, a = self._inputs()
+        cv_x, attn_x = attention_pool(ctx, mask, a)
+        cv_s, attn_s = streaming_attention_pool(ctx, mask, a)
+        np.testing.assert_allclose(np.asarray(cv_s), np.asarray(cv_x), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(attn_s), np.asarray(attn_x), rtol=1e-6)
+
+    def test_gradients_match_xla_pool(self):
+        ctx, mask, a = self._inputs(seed=4)
+
+        def loss(pool, ctx, a):
+            cv, _ = pool(ctx, mask, a)
+            return jnp.sum(cv * jnp.cos(cv))
+
+        gx = jax.grad(lambda c, p: loss(attention_pool, c, p), argnums=(0, 1))(ctx, a)
+        gs = jax.grad(
+            lambda c, p: loss(streaming_attention_pool, c, p), argnums=(0, 1)
+        )(ctx, a)
+        for a_, b_ in zip(gx, gs):
+            np.testing.assert_allclose(np.asarray(b_), np.asarray(a_), rtol=1e-5,
+                                       atol=1e-7)
+
+    def test_all_masked_row_not_nan_and_grad_finite(self):
+        ctx = jnp.ones((1, 4, 3), jnp.float32)
+        mask = jnp.zeros((1, 4), jnp.float32)
+        a = jnp.ones(3, jnp.float32)
+        cv, attn = streaming_attention_pool(ctx, mask, a)
+        assert not np.isnan(np.asarray(attn)).any()
+        g = jax.grad(lambda c: jnp.sum(streaming_attention_pool(c, mask, a)[0]))(ctx)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_model_logits_match_across_attn_impl(self):
+        c = small_config(dropout_prob=0.0)
+        rng = np.random.default_rng(5)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        params = Code2Vec(c).init(jax.random.PRNGKey(0), starts, paths, ends)
+        logits_x, cv_x, _ = Code2Vec(c).apply(params, starts, paths, ends)
+        cs = c.with_updates(attn_impl="streaming")
+        logits_s, cv_s, _ = Code2Vec(cs).apply(params, starts, paths, ends)
+        np.testing.assert_allclose(
+            np.asarray(logits_s), np.asarray(logits_x), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(cv_s), np.asarray(cv_x), rtol=1e-5, atol=1e-6
+        )
 
 
 class TestCode2VecForward:

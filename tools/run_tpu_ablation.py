@@ -27,6 +27,7 @@ def measure_step(
     dtype_name: str,
     use_pallas: bool = False,
     pallas_block_b: int = 8,
+    attn_impl: str = "xla",
     batch: int = 1024,
     bag: int = 200,
     chunk: int = 16,
@@ -69,6 +70,7 @@ def measure_step(
         embed_grad=embed_grad,
         use_pallas=use_pallas,
         pallas_block_b=pallas_block_b,
+        attn_impl=attn_impl,
     )
     config = TrainConfig(
         batch_size=batch, max_path_length=bag, rng_impl=rng_impl,
@@ -122,6 +124,13 @@ def main() -> None:
         "x2, wide-model (512/512) f32 vs bf16 x2 — bounds the ~3%% "
         "run-to-run noise band on the round-3 single-measurement claims",
     )
+    ap.add_argument(
+        "--attn-ab",
+        action="store_true",
+        help="just the streaming-vs-xla attention lowering A/B on the "
+        "current winner recipe (x2 each arm) — the focused follow-up for "
+        "a short tunnel window after the full --r4 matrix was captured",
+    )
     args = ap.parse_args()
 
     import os
@@ -155,6 +164,17 @@ def main() -> None:
         for r in sorted(results, key=lambda r: r["ms_per_step"]):
             print(f"| {r['config']} | {r['ms_per_step']} | {int(r['contexts_per_sec']):,} |")
 
+    if args.attn_ab:
+        for rep in (1, 2):
+            record(f"dense/unsafe_rbg/f32/mu-bf16/attn-xla #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
+                   adam_mu_dtype="bfloat16", attn_impl="xla")
+            record(f"dense/unsafe_rbg/f32/mu-bf16/attn-streaming #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
+                   adam_mu_dtype="bfloat16", attn_impl="streaming")
+        print_table()
+        return
+
     if args.r4:
         # winner recipe (round-3 ablation): dense/unsafe_rbg/f32 — two
         # repeats re-confirm the 25.3 ms claim and bound the noise
@@ -175,6 +195,14 @@ def main() -> None:
             record(f"wide512/bf16 #{rep}",
                    embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="bf16",
                    embed=512, encode=512)
+        # streaming-softmax pool lowering A/B on the winner recipe: the
+        # isolated pool fwd+bwd measured faster than jax.nn.softmax's chain
+        # (bench_ctx pool rows, 2.7 vs 3.8 ms at B1024/bag200) — does it
+        # survive fusion into the full step?
+        for rep in (1, 2):
+            record(f"dense/unsafe_rbg/f32/mu-bf16/attn-streaming #{rep}",
+                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
+                   adam_mu_dtype="bfloat16", attn_impl="streaming")
         print_table()
         return
 
