@@ -525,6 +525,8 @@ def main() -> None:
         # ablation has the end-to-end A/B row)
         # unknown values raise at model trace time (fail-loud dispatch)
         attn_impl=os.environ.get("BENCH_ATTN_IMPL", "xla").strip().lower() or "xla",
+        encoder_impl=os.environ.get("BENCH_ENCODER_IMPL", "concat").strip().lower()
+        or "concat",
         use_pallas=os.environ.get("BENCH_USE_PALLAS", "0").strip().lower()
         in ("1", "true", "yes", "on"),
         pallas_block_b=int(os.environ.get("BENCH_PALLAS_BLOCK_B", 8)),
@@ -675,6 +677,7 @@ def main() -> None:
                     # use_pallas=true overrides attn_impl in the dispatch
                     "adam_mu_dtype": config.adam_mu_dtype,
                     "attn_impl": model_config.attn_impl,
+                    "encoder_impl": model_config.encoder_impl,
                     "use_pallas": model_config.use_pallas,
                 }
             }

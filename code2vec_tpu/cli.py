@@ -129,6 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="attention-pool lowering: jax.nn.softmax chain "
                              "or the explicit streaming exp/sum decomposition "
                              "(same math; --use_pallas overrides)")
+    parser.add_argument("--encoder_impl", type=str, default="concat",
+                        choices=("concat", "split"),
+                        help="context-encoder lowering: one [3E,H] matmul on "
+                             "the concat, or the same kernel as three sliced "
+                             "matmuls summed (same math and params)")
     from code2vec_tpu.ops.embed import GRAD_MODES
 
     parser.add_argument("--embed_grad", type=str, default="dense",
@@ -229,6 +234,7 @@ def config_from_args(args: argparse.Namespace):
         use_pallas=args.use_pallas,
         pallas_block_b=args.pallas_block_b,
         attn_impl=args.attn_impl,
+        encoder_impl=args.encoder_impl,
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
         adam_mu_dtype=args.adam_mu_dtype,

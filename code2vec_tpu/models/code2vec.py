@@ -52,6 +52,13 @@ class Code2VecConfig:
     # decomposition (ops.attention.streaming_attention_pool) — same math,
     # different lowering; use_pallas overrides both
     attn_impl: str = "xla"
+    # "concat" = [start;path;end] concat then one [3E,H] matmul (the
+    # reference formulation, model/model.py:24,56-61); "split" = the same
+    # kernel applied as three sliced matmuls summed — algebraically
+    # identical, but skips materializing the [B, L, 3E] concat (and its
+    # gradient) if XLA wasn't already fusing it. Param tree is identical
+    # either way (input_dense/kernel [3E, H]), so checkpoints interchange.
+    encoder_impl: str = "concat"
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
     # round table/head vocab dims up to this multiple so they shard evenly
     # over the model mesh axis (parallel.shardings.pad_to_multiple); padded
@@ -80,6 +87,32 @@ class _EmbedTable(nn.Module):
     def __call__(self) -> jnp.ndarray:
         return self.param(
             "embedding", normal(stddev=1.0), (self.vocab, self.dim), jnp.float32
+        )
+
+
+class _SplitEncoder(nn.Module):
+    """``concat([a,b,c]) @ W`` computed as ``a@W1 + b@W2 + c@W3`` on slices
+    of the SAME ``kernel`` param ``nn.Dense(name="input_dense")`` would
+    create (same path, shape, dtype, and default init → identical values
+    from the same RNG), so the two encoder lowerings share checkpoints."""
+
+    features: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, e_start, e_path, e_end):
+        ds, dp = e_start.shape[-1], e_path.shape[-1]
+        de = e_end.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.linear.default_kernel_init,  # nn.Dense's init (lecun_normal)
+            (ds + dp + de, self.features),
+            jnp.float32,
+        ).astype(self.dtype)
+        return (
+            e_start @ kernel[:ds]
+            + e_path @ kernel[ds : ds + dp]
+            + e_end @ kernel[ds + dp :]
         )
 
 
@@ -126,15 +159,26 @@ class Code2Vec(nn.Module):
         embed_paths = embedding_lookup(
             path_table, paths, compute_dtype=c.dtype, grad_mode=c.embed_grad
         )
-        contexts = jnp.concatenate([embed_starts, embed_paths, embed_ends], axis=-1)
-
-        contexts = nn.Dense(
-            c.encode_size,
-            use_bias=False,
-            dtype=c.dtype,
-            param_dtype=jnp.float32,
-            name="input_dense",
-        )(contexts)
+        if c.encoder_impl == "split":
+            contexts = _SplitEncoder(
+                c.encode_size, dtype=c.dtype, name="input_dense"
+            )(embed_starts, embed_paths, embed_ends)
+        elif c.encoder_impl == "concat":
+            contexts = jnp.concatenate(
+                [embed_starts, embed_paths, embed_ends], axis=-1
+            )
+            contexts = nn.Dense(
+                c.encode_size,
+                use_bias=False,
+                dtype=c.dtype,
+                param_dtype=jnp.float32,
+                name="input_dense",
+            )(contexts)
+        else:  # fail loudly, same contract as attn_impl
+            raise ValueError(
+                f"unknown encoder_impl {c.encoder_impl!r}: expected "
+                "'concat' or 'split'"
+            )
         contexts = nn.LayerNorm(
             dtype=jnp.float32, param_dtype=jnp.float32, name="input_layer_norm"
         )(contexts.astype(jnp.float32)).astype(c.dtype)

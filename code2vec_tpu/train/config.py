@@ -58,6 +58,7 @@ class TrainConfig:
     use_pallas: bool = False  # fused attention-pooling kernel on TPU
     pallas_block_b: int = 8  # the kernel's batch-tile size
     attn_impl: str = "xla"  # attention-pool lowering: "xla" | "streaming"
+    encoder_impl: str = "concat"  # context-encoder lowering: "concat" | "split"
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
     # PRNG impl for the dropout stream: threefry2x32 (jax default,
     # reproducible everywhere) | rbg | unsafe_rbg (faster on TPU; different

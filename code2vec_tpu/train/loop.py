@@ -82,6 +82,7 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
         use_pallas=config.use_pallas,
         pallas_block_b=config.pallas_block_b,
         attn_impl=config.attn_impl,
+        encoder_impl=config.encoder_impl,
         embed_grad=config.embed_grad,
         # pad table/head vocab dims so they shard evenly over the model axis
         # (a few dummy rows on a 360k-row table cost nothing; indivisible

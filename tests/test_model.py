@@ -118,6 +118,13 @@ class TestStreamingAttentionPool:
         g = jax.grad(lambda c: jnp.sum(streaming_attention_pool(c, mask, a)[0]))(ctx)
         assert np.isfinite(np.asarray(g)).all()
 
+    def test_unknown_attn_impl_raises(self):
+        c = small_config(attn_impl="streamin")
+        rng = np.random.default_rng(6)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        with pytest.raises(ValueError, match="unknown attn_impl"):
+            Code2Vec(c).init(jax.random.PRNGKey(0), starts, paths, ends)
+
     def test_model_logits_match_across_attn_impl(self):
         c = small_config(dropout_prob=0.0)
         rng = np.random.default_rng(5)
@@ -132,6 +139,60 @@ class TestStreamingAttentionPool:
         np.testing.assert_allclose(
             np.asarray(cv_s), np.asarray(cv_x), rtol=1e-5, atol=1e-6
         )
+
+
+class TestSplitEncoder:
+    """encoder_impl='split' computes the concat matmul as three sliced
+    matmuls on the SAME input_dense/kernel param — identical param tree,
+    identical init values, identical outputs and gradients."""
+
+    def _configs(self):
+        c = small_config(dropout_prob=0.0)
+        return c, c.with_updates(encoder_impl="split")
+
+    def test_param_trees_and_init_values_identical(self):
+        c, cs = self._configs()
+        rng = np.random.default_rng(7)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        p1 = Code2Vec(c).init(jax.random.PRNGKey(0), starts, paths, ends)
+        p2 = Code2Vec(cs).init(jax.random.PRNGKey(0), starts, paths, ends)
+        f1 = jax.tree_util.tree_leaves_with_path(p1)
+        f2 = jax.tree_util.tree_leaves_with_path(p2)
+        assert [k for k, _ in f1] == [k for k, _ in f2]
+        for (k, a), (_, b) in zip(f1, f2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_outputs_and_grads_match_concat(self):
+        c, cs = self._configs()
+        rng = np.random.default_rng(8)
+        starts, paths, ends, labels = make_batch(rng, config=c)
+        params = Code2Vec(c).init(jax.random.PRNGKey(0), starts, paths, ends)
+        l1, cv1, _ = Code2Vec(c).apply(params, starts, paths, ends)
+        l2, cv2, _ = Code2Vec(cs).apply(params, starts, paths, ends)
+        np.testing.assert_allclose(np.asarray(l2), np.asarray(l1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cv2), np.asarray(cv1),
+                                   rtol=1e-5, atol=1e-6)
+
+        def loss(model, p):
+            logits, _, _ = model.apply(p, starts, paths, ends)
+            return jnp.sum(jax.nn.log_softmax(logits)[:, 0])
+
+        g1 = jax.grad(lambda p: loss(Code2Vec(c), p))(params)
+        g2 = jax.grad(lambda p: loss(Code2Vec(cs), p))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-5, atol=1e-6
+            ),
+            g1, g2,
+        )
+
+    def test_unknown_encoder_impl_raises(self):
+        c = small_config(encoder_impl="cat")
+        rng = np.random.default_rng(9)
+        starts, paths, ends, _ = make_batch(rng, config=c)
+        with pytest.raises(ValueError, match="unknown encoder_impl"):
+            Code2Vec(c).init(jax.random.PRNGKey(0), starts, paths, ends)
 
 
 class TestCode2VecForward:

@@ -28,6 +28,7 @@ def measure_step(
     use_pallas: bool = False,
     pallas_block_b: int = 8,
     attn_impl: str = "xla",
+    encoder_impl: str = "concat",
     batch: int = 1024,
     bag: int = 200,
     chunk: int = 16,
@@ -71,6 +72,7 @@ def measure_step(
         use_pallas=use_pallas,
         pallas_block_b=pallas_block_b,
         attn_impl=attn_impl,
+        encoder_impl=encoder_impl,
     )
     config = TrainConfig(
         batch_size=batch, max_path_length=bag, rng_impl=rng_impl,
@@ -127,9 +129,10 @@ def main() -> None:
     ap.add_argument(
         "--attn-ab",
         action="store_true",
-        help="just the streaming-vs-xla attention lowering A/B on the "
-        "current winner recipe (x2 each arm) — the focused follow-up for "
-        "a short tunnel window after the full --r4 matrix was captured",
+        help="the lowering matrix on the current winner recipe: attention "
+        "{xla, streaming} x encoder {concat, split} once each, then the "
+        "two fastest combos re-measured — the focused follow-up for a "
+        "short tunnel window after the full --r4 matrix was captured",
     )
     args = ap.parse_args()
 
@@ -165,13 +168,20 @@ def main() -> None:
             print(f"| {r['config']} | {r['ms_per_step']} | {int(r['contexts_per_sec']):,} |")
 
     if args.attn_ab:
-        for rep in (1, 2):
-            record(f"dense/unsafe_rbg/f32/mu-bf16/attn-xla #{rep}",
-                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
-                   adam_mu_dtype="bfloat16", attn_impl="xla")
-            record(f"dense/unsafe_rbg/f32/mu-bf16/attn-streaming #{rep}",
-                   embed_grad="dense", rng_impl="unsafe_rbg", dtype_name="f32",
-                   adam_mu_dtype="bfloat16", attn_impl="streaming")
+        base = dict(embed_grad="dense", rng_impl="unsafe_rbg",
+                    dtype_name="f32", adam_mu_dtype="bfloat16")
+        combos = [
+            (a, e) for a in ("xla", "streaming") for e in ("concat", "split")
+        ]
+        for a, e in combos:
+            record(f"mu-bf16/attn-{a}/enc-{e} #1",
+                   attn_impl=a, encoder_impl=e, **base)
+        # second measurement for the two fastest combos: bounds the noise
+        # on exactly the rows a default flip would rest on
+        for row in sorted(results, key=lambda r: r["ms_per_step"])[:2]:
+            record(row["config"].replace("#1", "#2"),
+                   attn_impl=row["attn_impl"],
+                   encoder_impl=row["encoder_impl"], **base)
         print_table()
         return
 

@@ -29,9 +29,10 @@ for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
     # 2. component attribution of the 25.3ms step (VERDICT r3 #2)
     timeout 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
     echo "[tpu_watch] profile_step rc=$? $(date)"
-    # 2b. streaming-vs-xla attention lowering A/B (added after the morning
-    #     --r4 capture, which predates the attn_impl knob)
-    timeout 1200 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
+    # 2b. lowering matrix A/B: attention {xla,streaming} x encoder
+    #     {concat,split} (added after the morning --r4 capture, which
+    #     predates both knobs) — 4 combos + 2 winner repeats
+    timeout 1800 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
     echo "[tpu_watch] attn-ab rc=$? $(date)"
     # 3. long-bag full-step rows (the wedge point last time; pools are
     #    cheap and re-run alongside)
