@@ -27,8 +27,13 @@ Prints one JSON line per row, then a markdown table for ARCHITECTURE.md.
 
 import json
 import os
+import sys
 import time
 from functools import partial
+
+# runnable as `python tools/profile_step.py` from the repo root (sys.path[0]
+# is tools/, not the cwd)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
@@ -56,10 +61,14 @@ DTYPE = (
 EMBED_GRAD = os.environ.get("PROF_EMBED_GRAD", "dense")
 RNG_IMPL = os.environ.get("PROF_RNG_IMPL", "unsafe_rbg")
 ADAM_MU_DTYPE = os.environ.get("PROF_ADAM_MU_DTYPE", "float32")
+ATTN_IMPL = os.environ.get("PROF_ATTN_IMPL", "xla")
+ENCODER_IMPL = os.environ.get("PROF_ENCODER_IMPL", "concat")
 
 print(json.dumps({"backend": jax.default_backend(), "batch": B, "bag": L,
                   "dtype": DTYPE.__name__, "embed_grad": EMBED_GRAD,
-                  "rng_impl": RNG_IMPL}), flush=True)
+                  "rng_impl": RNG_IMPL, "adam_mu_dtype": ADAM_MU_DTYPE,
+                  "attn_impl": ATTN_IMPL, "encoder_impl": ENCODER_IMPL}),
+      flush=True)
 
 spec = SynthSpec(n_methods=max(B * 8, 8192), n_terminals=360_631,
                  n_paths=342_845, n_labels=8_000, mean_contexts=120.0,
@@ -70,7 +79,7 @@ mc = Code2VecConfig(
     terminal_count=spec.n_terminals + 2, path_count=spec.n_paths + 1,
     label_count=len(data.label_vocab), terminal_embed_size=100,
     path_embed_size=100, encode_size=100, dropout_prob=0.25, dtype=DTYPE,
-    embed_grad=EMBED_GRAD)
+    embed_grad=EMBED_GRAD, attn_impl=ATTN_IMPL, encoder_impl=ENCODER_IMPL)
 tc = TrainConfig(batch_size=B, max_path_length=L, rng_impl=RNG_IMPL,
                  adam_mu_dtype=ADAM_MU_DTYPE)
 
