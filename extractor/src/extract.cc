@@ -347,22 +347,28 @@ struct Extractor {
 };
 
 // ---- terminal discovery (cell8 `findTerminal`) -------------------------
+// Vocab-free: records the lowercased terminal name (what terminal_index
+// would intern) instead of interning, so discovery can run off-thread.
 struct TerminalEntry {
   const ENode* node;
   std::vector<std::pair<const ENode*, int>> path_from_root;
-  int terminal_index;
+  int name_index;  // into MethodFeaturesStr::terminal_names
 };
 
 void find_terminals(const ENode& ast,
                     std::vector<std::pair<const ENode*, int>>& path,
-                    Vocabs& vocabs, std::vector<TerminalEntry>& out) {
+                    std::vector<std::string>& terminal_names,
+                    std::vector<TerminalEntry>& out) {
   if (ast.terminal.has_value()) {
-    out.push_back({&ast, path, vocabs.terminal_index(*ast.terminal)});
+    int idx = static_cast<int>(terminal_names.size());
+    terminal_names.push_back(lower(*ast.terminal));  // vocab-size reduction
+                                                     // (cell7), worker-side
+    out.push_back({&ast, path, idx});
     return;
   }
   for (size_t i = 0; i < ast.children.size(); ++i) {
     path.emplace_back(ast.children[i].get(), static_cast<int>(i));
-    find_terminals(*ast.children[i], path, vocabs, out);
+    find_terminals(*ast.children[i], path, terminal_names, out);
     path.pop_back();
   }
 }
@@ -418,7 +424,11 @@ Variable Env::fresh(const std::string& original) {
 }
 
 int Vocabs::terminal_index(const std::string& terminal) {
-  std::string name = lower(terminal);  // vocab-size reduction (cell7)
+  return terminal_index_lowered(lower(terminal));  // vocab-size reduction
+                                                   // (cell7)
+}
+
+int Vocabs::terminal_index_lowered(const std::string& name) {
   auto it = terminal_map_.find(name);
   if (it != terminal_map_.end()) return it->second;
   int index = static_cast<int>(terminal_list_.size()) + 1;
@@ -465,29 +475,28 @@ ENodePtr extract_ast(const JNode& method, VarEnv& env,
   return extractor.extract(method, nullptr).first;
 }
 
-std::vector<MethodFeatures> extract_features(const JNode& cu,
-                                             const std::string& method_name,
-                                             Vocabs& vocabs,
-                                             const ExtractConfig& config) {
+std::vector<MethodFeaturesStr> extract_features_str(
+    const JNode& cu, const std::string& method_name,
+    const ExtractConfig& config) {
   std::string target = lower(method_name);
   std::vector<const JNode*> methods;
   collect_methods(cu, methods);
 
-  std::vector<MethodFeatures> out;
+  std::vector<MethodFeaturesStr> out;
   for (const JNode* m : methods) {
     const JNode* name_node = find_child(*m, "SimpleName");
     std::string name = name_node ? name_node->text : "";
     if (!(method_name == "*" || lower(name) == target)) continue;
     if (is_ignorable_method(*m)) continue;
 
-    MethodFeatures mf;
+    MethodFeaturesStr mf;
     mf.method_name = name;
     mf.method_source = m->text;
     ENodePtr ast = extract_ast(*m, mf.env, config);
 
     std::vector<TerminalEntry> terminals;
     std::vector<std::pair<const ENode*, int>> path{{ast.get(), 0}};
-    find_terminals(*ast, path, vocabs, terminals);
+    find_terminals(*ast, path, mf.terminal_names, terminals);
 
     for (size_t i = 0; i < terminals.size(); ++i) {
       for (size_t j = i + 1; j < terminals.size(); ++j) {
@@ -495,14 +504,42 @@ std::vector<MethodFeatures> extract_features(const JNode& cu,
             get_path(terminals[i].path_from_root, terminals[j].path_from_root,
                      config.max_length, config.max_width);
         if (!p.empty()) {
-          mf.features.push_back({terminals[i].terminal_index,
-                                 vocabs.path_index(p),
-                                 terminals[j].terminal_index});
+          mf.features.push_back({terminals[i].name_index,
+                                 terminals[j].name_index, std::move(p)});
         }
       }
     }
     out.push_back(std::move(mf));
   }
+  return out;
+}
+
+MethodFeatures intern_features(MethodFeaturesStr mf, Vocabs& vocabs) {
+  // Replays the sequential interning order exactly: every discovered
+  // terminal in encounter order (even ones no surviving path touches —
+  // find_terminals interned eagerly), then paths in (i, j) pair order.
+  std::vector<int> ids;
+  ids.reserve(mf.terminal_names.size());
+  for (const auto& name : mf.terminal_names)
+    ids.push_back(vocabs.terminal_index_lowered(name));
+  MethodFeatures out;
+  out.env = std::move(mf.env);
+  out.method_name = std::move(mf.method_name);
+  out.method_source = std::move(mf.method_source);
+  out.features.reserve(mf.features.size());
+  for (auto& f : mf.features)
+    out.features.push_back(
+        {ids[f.start_terminal], vocabs.path_index(f.path), ids[f.end_terminal]});
+  return out;
+}
+
+std::vector<MethodFeatures> extract_features(const JNode& cu,
+                                             const std::string& method_name,
+                                             Vocabs& vocabs,
+                                             const ExtractConfig& config) {
+  std::vector<MethodFeatures> out;
+  for (auto& mf : extract_features_str(cu, method_name, config))
+    out.push_back(intern_features(std::move(mf), vocabs));
   return out;
 }
 

@@ -60,6 +60,8 @@ struct VarEnv {
 class Vocabs {
  public:
   int terminal_index(const std::string& terminal);
+  // deferred-interning path: the caller already lowercased (worker side)
+  int terminal_index_lowered(const std::string& terminal);
   int path_index(const std::string& path);
   const std::vector<std::pair<std::string, int>>& terminals() const {
     return terminal_list_;
@@ -87,6 +89,23 @@ struct MethodFeatures {
   std::string method_source;   // raw decl text (method_declarations.txt)
 };
 
+// ---- vocab-free variant (parallel extraction) -------------------------
+// Workers extract to strings; a sequential committer interns in the same
+// order the single-threaded path would (all terminals in encounter order,
+// then paths in pair order), so vocab files stay byte-identical.
+struct FeatureStr {
+  int start_terminal, end_terminal;  // indexes into terminal_names
+  std::string path;
+};
+
+struct MethodFeaturesStr {
+  std::vector<std::string> terminal_names;  // lowercased, encounter order
+  std::vector<FeatureStr> features;
+  VarEnv env;
+  std::string method_name;
+  std::string method_source;
+};
+
 // Trivial-method filter (ipynb cell4 `isIgnorableMethod`).
 bool is_ignorable_method(const JNode& method);
 
@@ -100,5 +119,14 @@ std::vector<MethodFeatures> extract_features(const JNode& cu,
                                              const std::string& method_name,
                                              Vocabs& vocabs,
                                              const ExtractConfig& config);
+
+// Vocab-free extraction (thread-safe: touches no shared state) plus the
+// sequential interning step. extract_features == intern_features applied
+// to extract_features_str, in order.
+std::vector<MethodFeaturesStr> extract_features_str(
+    const JNode& cu, const std::string& method_name,
+    const ExtractConfig& config);
+
+MethodFeatures intern_features(MethodFeaturesStr mf, Vocabs& vocabs);
 
 }  // namespace c2v

@@ -7,20 +7,32 @@
 // corpus.txt, terminal_idxs.txt, path_idxs.txt, params.txt,
 // actual_methods.txt, and optionally method_declarations.txt.
 //
+// Parallel pipeline: consecutive same-file rows form a group (the unit the
+// sequential CU cache covered); N workers parse+extract groups into
+// vocab-free string features (extract_features_str), and the main thread
+// commits results IN ROW ORDER, interning into the vocabs exactly as the
+// sequential loop would — artifacts are byte-identical for any --jobs.
+//
 // Usage:
 //   c2v-extract <dataset_dir> <source_dir> [options]
 // Options:
 //   --max-length N               path length cap (default 8)
 //   --max-width N                sibling-width cap (default 3)
+//   --jobs N                     worker threads (default: hardware cores)
 //   --method-declarations FILE   also dump raw method sources
 //   --no-normalize-string / --no-normalize-char
 //   --normalize-int / --normalize-double
 
+#include <atomic>
+#include <condition_variable>
 #include <fstream>
 #include <iostream>
 #include <map>
+#include <memory>
+#include <mutex>
 #include <sstream>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "extract.h"
@@ -36,6 +48,111 @@ std::string read_file(const std::string& path) {
   return ss.str();
 }
 
+struct Row {
+  std::string line;         // original methods.txt row (error messages)
+  std::string method_name;  // after the TAB
+};
+
+struct RowOut {
+  // Mirrors the sequential loop's three outcomes per row:
+  //   0 = extracted; 1 = ParseError/LexError ("ERROR: parse error.");
+  //   2 = other std::exception ("WARNING: <what>")
+  int status = 0;
+  std::string error_msg;
+  std::vector<c2v::MethodFeaturesStr> features;
+};
+
+struct Group {
+  std::string file;
+  std::vector<Row> rows;
+  std::vector<RowOut> outs;
+  bool done = false;  // guarded by the pipeline mutex
+};
+
+// Streams methods.txt into maximal consecutive same-file groups, one at a
+// time — memory stays bounded by the in-flight window, not the corpus
+// (java-large's methods.txt alone is ~16M rows).
+class GroupReader {
+ public:
+  explicit GroupReader(std::istream& in) : in_(in) {}
+
+  bool next(Group& g) {
+    if (!has_pending_ && !read_row()) return false;
+    g.file = pending_file_;
+    g.rows.push_back(std::move(pending_row_));
+    has_pending_ = false;
+    while (read_row()) {
+      if (pending_file_ != g.file) return true;  // stays pending
+      g.rows.push_back(std::move(pending_row_));
+      has_pending_ = false;
+    }
+    return true;
+  }
+
+ private:
+  bool read_row() {
+    if (has_pending_) return true;
+    std::string line;
+    while (std::getline(in_, line)) {
+      while (!line.empty() && (line.back() == '\r' || line.back() == '\n'))
+        line.pop_back();
+      if (line.empty()) continue;
+      size_t tab = line.find('\t');
+      if (tab == std::string::npos) continue;
+      pending_file_ = line.substr(0, tab);
+      pending_row_ = {line, line.substr(tab + 1)};
+      has_pending_ = true;
+      return true;
+    }
+    return false;
+  }
+
+  std::istream& in_;
+  std::string pending_file_;
+  Row pending_row_;
+  bool has_pending_ = false;
+};
+
+// The sequential loop re-parses on every row after an error (it clears its
+// CU cache), and parsing is deterministic — so one failed parse stands for
+// the whole group, replicated per row.
+void process_group(Group& g, const std::string& source_dir,
+                   const c2v::ExtractConfig& config) {
+  g.outs.resize(g.rows.size());
+  c2v::JNodePtr cu;
+  int parse_status = 0;
+  std::string parse_msg;
+  try {
+    cu = c2v::parse_compilation_unit(read_file(source_dir + "/" + g.file));
+  } catch (const c2v::ParseError& e) {
+    parse_status = 1;
+    parse_msg = e.what();
+  } catch (const c2v::LexError& e) {
+    // same actionable ERROR-with-row form as ParseError (which file to
+    // exclude), e.g. the Java 15 text-block rejection
+    parse_status = 1;
+    parse_msg = e.what();
+  } catch (const std::exception& e) {
+    parse_status = 2;
+    parse_msg = e.what();
+  }
+  for (size_t i = 0; i < g.rows.size(); ++i) {
+    RowOut& out = g.outs[i];
+    if (parse_status != 0) {
+      out.status = parse_status;
+      out.error_msg = parse_msg;
+      continue;
+    }
+    try {
+      out.features =
+          c2v::extract_features_str(*cu, g.rows[i].method_name, config);
+    } catch (const std::exception& e) {
+      out.status = 2;
+      out.error_msg = e.what();
+    }
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -47,10 +164,12 @@ int main(int argc, char** argv) {
   std::string source_dir = argv[2];
   c2v::ExtractConfig config;
   std::string method_declarations_name;
+  int jobs = 0;  // 0 = hardware concurrency
   for (int i = 3; i < argc; ++i) {
     std::string arg = argv[i];
     if (arg == "--max-length" && i + 1 < argc) config.max_length = std::stoi(argv[++i]);
     else if (arg == "--max-width" && i + 1 < argc) config.max_width = std::stoi(argv[++i]);
+    else if (arg == "--jobs" && i + 1 < argc) jobs = std::stoi(argv[++i]);
     else if (arg == "--method-declarations" && i + 1 < argc) method_declarations_name = argv[++i];
     else if (arg == "--no-normalize-string") config.normalize_string_literal = false;
     else if (arg == "--no-normalize-char") config.normalize_char_literal = false;
@@ -61,6 +180,10 @@ int main(int argc, char** argv) {
       std::cerr << "unknown option: " << arg << "\n";
       return 2;
     }
+  }
+  if (jobs <= 0) {
+    jobs = static_cast<int>(std::thread::hardware_concurrency());
+    if (jobs <= 0) jobs = 1;
   }
 
   std::ifstream method_list(dataset_dir + "/methods.txt");
@@ -79,67 +202,116 @@ int main(int argc, char** argv) {
   std::map<std::string, int> method_names;  // method_name_vocab_count
   int id_counter = 0;
 
-  std::string last_file;
-  c2v::JNodePtr last_cu;
-  std::string line;
-  while (std::getline(method_list, line)) {
-    while (!line.empty() && (line.back() == '\r' || line.back() == '\n'))
-      line.pop_back();
-    if (line.empty()) continue;
-    size_t tab = line.find('\t');
-    if (tab == std::string::npos) continue;
-    std::string java_file = line.substr(0, tab);
-    std::string method_name = line.substr(tab + 1);
+  // ---- lazy producer + workers + in-order committer -------------------
+  // A ring of `window` in-flight groups bounds memory to the window, not
+  // the corpus: the main thread produces group idx only once the commit
+  // frontier has passed idx - window, workers claim produced groups by
+  // global index, and the main thread commits them back in order.
+  const size_t window = static_cast<size_t>(jobs) * 4 + 16;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<std::unique_ptr<Group>> ring(window);
+  size_t produced = 0;  // guarded by mu
+  bool eof = false;     // guarded by mu
+  std::atomic<size_t> next_claim{0};
 
-    try {
-      if (java_file != last_file) {
-        last_cu = c2v::parse_compilation_unit(
-            read_file(source_dir + "/" + java_file));
-        last_file = java_file;
+  auto worker = [&]() {
+    for (;;) {
+      size_t idx = next_claim.fetch_add(1);
+      Group* g = nullptr;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv.wait(lock, [&] { return idx < produced || eof; });
+        if (idx >= produced) return;  // eof: no group idx will ever exist
+        // the slot cannot be recycled while idx is uncommitted (the
+        // producer stays within committed + window)
+        g = ring[idx % window].get();
       }
-      auto features =
-          c2v::extract_features(*last_cu, method_name, vocabs, config);
-      for (auto& mf : features) {
-        int corpus_id = id_counter++;
-        corpus << "#" << corpus_id << "\n";
-        corpus << "label:" << mf.method_name << "\n";
-        corpus << "class:" << java_file << "\n";
-        corpus << "paths:\n";
-        for (const auto& f : mf.features)
-          corpus << f.start << "\t" << f.path << "\t" << f.end << "\n";
-        corpus << "vars:\n";
-        // reverse encounter order (the reference's prepend-built lists)
-        for (auto it = mf.env.vars.variables.rbegin();
-             it != mf.env.vars.variables.rend(); ++it)
-          corpus << it->name << "\t" << it->id << "\n";
-        for (auto it = mf.env.labels.variables.rbegin();
-             it != mf.env.labels.variables.rend(); ++it)
-          corpus << it->name << "\t" << it->id << "\n";
-        corpus << "\n";
-
-        actual_methods << java_file << "\t" << mf.method_name << "\t"
-                       << corpus_id << "\t" << mf.features.size() << "\n";
-        if (method_declarations.is_open())
-          method_declarations << "#" << corpus_id << "\t" << java_file << "#"
-                              << mf.method_name << "\n"
-                              << mf.method_source << "\n\n";
-        ++method_names[mf.method_name];
+      process_group(*g, source_dir, config);
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        g->done = true;
       }
-      if (features.empty() && method_name != "*")
-        std::cerr << "WARNING: method not found. " << line << "\n";
-    } catch (const c2v::ParseError& e) {
-      std::cerr << "ERROR: parse error. " << line << " (" << e.what() << ")\n";
-      last_file.clear();  // do not reuse a broken unit
-    } catch (const c2v::LexError& e) {
-      // same actionable ERROR-with-row form as ParseError (which file to
-      // exclude), e.g. the Java 15 text-block rejection
-      std::cerr << "ERROR: parse error. " << line << " (" << e.what() << ")\n";
-      last_file.clear();
-    } catch (const std::exception& e) {
-      std::cerr << "WARNING: " << e.what() << "\n";
-      last_file.clear();
+      cv.notify_all();
     }
+  };
+
+  std::vector<std::thread> pool;
+  for (int t = 0; t < jobs; ++t) pool.emplace_back(worker);
+
+  auto commit_row = [&](Group& g, size_t i) {
+    const Row& row = g.rows[i];
+    RowOut& out = g.outs[i];
+    if (out.status == 1) {
+      std::cerr << "ERROR: parse error. " << row.line << " (" << out.error_msg
+                << ")\n";
+      return;
+    }
+    if (out.status == 2) {
+      std::cerr << "WARNING: " << out.error_msg << "\n";
+      return;
+    }
+    bool had_features = !out.features.empty();
+    for (auto& mfs : out.features) {
+      c2v::MethodFeatures mf = c2v::intern_features(std::move(mfs), vocabs);
+      int corpus_id = id_counter++;
+      corpus << "#" << corpus_id << "\n";
+      corpus << "label:" << mf.method_name << "\n";
+      corpus << "class:" << g.file << "\n";
+      corpus << "paths:\n";
+      for (const auto& f : mf.features)
+        corpus << f.start << "\t" << f.path << "\t" << f.end << "\n";
+      corpus << "vars:\n";
+      // reverse encounter order (the reference's prepend-built lists)
+      for (auto it = mf.env.vars.variables.rbegin();
+           it != mf.env.vars.variables.rend(); ++it)
+        corpus << it->name << "\t" << it->id << "\n";
+      for (auto it = mf.env.labels.variables.rbegin();
+           it != mf.env.labels.variables.rend(); ++it)
+        corpus << it->name << "\t" << it->id << "\n";
+      corpus << "\n";
+
+      actual_methods << g.file << "\t" << mf.method_name << "\t" << corpus_id
+                     << "\t" << mf.features.size() << "\n";
+      if (method_declarations.is_open())
+        method_declarations << "#" << corpus_id << "\t" << g.file << "#"
+                            << mf.method_name << "\n"
+                            << mf.method_source << "\n\n";
+      ++method_names[mf.method_name];
+    }
+    if (!had_features && row.method_name != "*")
+      std::cerr << "WARNING: method not found. " << row.line << "\n";
+  };
+
+  GroupReader reader(method_list);
+  for (size_t commit_idx = 0;; ++commit_idx) {
+    Group* g = nullptr;
+    {
+      std::unique_lock<std::mutex> lock(mu);
+      // top up the window before sleeping, so every group a worker may
+      // claim (all < committed + window) exists
+      while (!eof && produced < commit_idx + window) {
+        auto fresh = std::make_unique<Group>();
+        if (reader.next(*fresh)) {
+          ring[produced % window] = std::move(fresh);
+          ++produced;
+        } else {
+          eof = true;
+        }
+        cv.notify_all();
+      }
+      if (commit_idx >= produced) break;  // eof and fully drained
+      cv.wait(lock, [&] { return ring[commit_idx % window]->done; });
+      g = ring[commit_idx % window].get();
+    }
+    for (size_t i = 0; i < g->rows.size(); ++i) commit_row(*g, i);
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      ring[commit_idx % window].reset();  // frees rows + features
+    }
+    cv.notify_all();
   }
+  for (auto& t : pool) t.join();
 
   {
     std::ofstream terminal_idx(dataset_dir + "/terminal_idxs.txt");

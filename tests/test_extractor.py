@@ -519,3 +519,63 @@ class TestModernJava:
             "final class B extends A { }", "f")
         assert [m.label for m in r.methods] == ["f"]
         assert "sealed" not in set(r.terminal_vocab.values())
+
+
+class TestParallelExtraction:
+    """--jobs N must produce byte-identical artifacts and identical
+    per-row stderr diagnostics to the sequential pipeline: workers extract
+    to strings, the committer interns in row order (main.cc)."""
+
+    ARTIFACTS = ("corpus.txt", "terminal_idxs.txt", "path_idxs.txt",
+                 "params.txt", "actual_methods.txt", "decls.txt")
+
+    def _make_dataset(self, root):
+        src = root / "src"
+        src.mkdir()
+        # enough distinct files that groups actually interleave across
+        # workers, plus every error shape the sequential loop reports
+        for i in range(12):
+            (src / f"F{i}.java").write_text(
+                f"class F{i} {{\n"
+                f"  int alpha{i}(int a, int b) {{ return a * b + {i}; }}\n"
+                f"  void beta{i}(String s) {{ System.out.println(s + alpha{i}(1, 2)); }}\n"
+                f"}}\n"
+            )
+        (src / "Broken.java").write_text("class Broken { int f( { }")
+        rows = []
+        for i in range(12):
+            rows.append(f"F{i}.java\talpha{i}")
+            rows.append(f"F{i}.java\t*")  # consecutive same-file rows
+        rows.insert(5, "Broken.java\t*")        # parse error mid-stream
+        rows.insert(9, "Missing.java\tf")       # unreadable file
+        rows.insert(13, "F0.java\tnoSuchMethod")  # method-not-found warning
+        dataset = root / "ds"
+        dataset.mkdir()
+        (dataset / "methods.txt").write_text("\n".join(rows) + "\n")
+        return dataset, src
+
+    def _run(self, tmp_path, name, jobs):
+        root = tmp_path / name
+        root.mkdir()
+        dataset, src = self._make_dataset(root)
+        result = extract_dataset(
+            str(dataset), str(src), method_declarations="decls.txt",
+            extra_args=["--jobs", str(jobs)],
+        )
+        blobs = {
+            a: (dataset / a).read_bytes() for a in self.ARTIFACTS
+        }
+        # the "cannot open <abs path>" diagnostic embeds the per-run tmp dir
+        return blobs, result.stderr.replace(str(src), "<src>")
+
+    def test_jobs_byte_identical(self, tmp_path):
+        seq_blobs, seq_err = self._run(tmp_path, "seq", jobs=1)
+        par_blobs, par_err = self._run(tmp_path, "par", jobs=4)
+        for name in self.ARTIFACTS:
+            assert par_blobs[name] == seq_blobs[name], name
+        assert par_err == seq_err
+        # the dataset exercised every diagnostic shape
+        assert "ERROR: parse error. Broken.java" in seq_err
+        assert "WARNING: cannot open" in seq_err
+        assert "WARNING: method not found. F0.java\tnoSuchMethod" in seq_err
+        assert seq_blobs["corpus.txt"].count(b"label:") > 12
