@@ -584,7 +584,17 @@ def main() -> None:
     shard_staged = mesh is not None and os.environ.get(
         "BENCH_SHARD_STAGED", "0"
     ).strip().lower() in ("1", "true", "yes", "on")
+    sample_prefetch = os.environ.get(
+        "BENCH_SAMPLE_PREFETCH", "0"
+    ).strip().lower() in ("1", "true", "yes", "on")
     if shard_staged:
+        if sample_prefetch:
+            # fail loudly rather than stamping an unprefetched run as a
+            # prefetch measurement (train/loop.py applies the same rule)
+            raise ValueError(
+                "BENCH_SAMPLE_PREFETCH is not implemented for "
+                "BENCH_SHARD_STAGED=1"
+            )
         from code2vec_tpu.train.device_epoch import (
             ShardedEpochRunner,
             stage_method_corpus_sharded,
@@ -615,7 +625,10 @@ def main() -> None:
             return state, loss, key
     else:
         runner = EpochRunner(
-            model_config, class_weights, batch_size, bag, chunk, mesh=mesh
+            model_config, class_weights, batch_size, bag, chunk, mesh=mesh,
+            # double-buffered on-device sampling (same batches, same
+            # order; see train/device_epoch.py) — measured via the ablation
+            sample_prefetch=sample_prefetch,
         )
         staged = stage_method_corpus(
             data, np.arange(data.n_items), rng, device=corpus_placement
@@ -679,6 +692,7 @@ def main() -> None:
                     "attn_impl": model_config.attn_impl,
                     "encoder_impl": model_config.encoder_impl,
                     "use_pallas": model_config.use_pallas,
+                    "sample_prefetch": sample_prefetch,
                 }
             }
         ),

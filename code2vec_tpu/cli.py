@@ -134,6 +134,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="context-encoder lowering: one [3E,H] matmul on "
                              "the concat, or the same kernel as three sliced "
                              "matmuls summed (same math and params)")
+    parser.add_argument("--sample_prefetch", type=_strtobool, default=False,
+                        help="device-epoch chunks sample batch i+1 while "
+                             "stepping on batch i (double-buffering; same "
+                             "batches, losses equal up to float reassociation)")
     from code2vec_tpu.ops.embed import GRAD_MODES
 
     parser.add_argument("--embed_grad", type=str, default="dense",
@@ -235,6 +239,7 @@ def config_from_args(args: argparse.Namespace):
         pallas_block_b=args.pallas_block_b,
         attn_impl=args.attn_impl,
         encoder_impl=args.encoder_impl,
+        sample_prefetch=args.sample_prefetch,
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
         adam_mu_dtype=args.adam_mu_dtype,

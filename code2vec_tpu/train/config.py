@@ -59,6 +59,10 @@ class TrainConfig:
     pallas_block_b: int = 8  # the kernel's batch-tile size
     attn_impl: str = "xla"  # attention-pool lowering: "xla" | "streaming"
     encoder_impl: str = "concat"  # context-encoder lowering: "concat" | "split"
+    # device-epoch train chunks sample batch i+1 while stepping on batch i
+    # (double-buffering; same batches in the same order — losses match up
+    # to float reassociation across the two compiled programs)
+    sample_prefetch: bool = False
     embed_grad: str = "dense"  # embedding backward formulation (ops.embed)
     # PRNG impl for the dropout stream: threefry2x32 (jax default,
     # reproducible everywhere) | rbg | unsafe_rbg (faster on TPU; different

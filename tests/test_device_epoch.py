@@ -265,6 +265,158 @@ class TestRunnerEquivalence:
         assert np.array_equal(host_preds, dev_preds)
 
 
+class TestSamplePrefetch:
+    """sample_prefetch=True double-buffers sampling inside the scanned
+    chunk. The sample-key split sequence is unchanged, so the runner
+    consumes the SAME batches in the same order; losses/params match up
+    to float reassociation (the two settings compile different XLA
+    programs, which may reorder f32 reductions — observed ~1e-7)."""
+
+    def test_bit_identical_losses_and_params(self, tiny):
+        _, data = tiny
+        bag = 8
+        config = TrainConfig(
+            batch_size=16, max_path_length=bag, encode_size=32,
+            terminal_embed_size=16, path_embed_size=16,
+        )
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16, path_embed_size=16, encode_size=32,
+            dropout_prob=0.25,  # dropout ON: the state rng stream must
+                                # align too, not just the sample keys
+        )
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        idx = np.arange(data.n_items)
+        staged = stage_method_corpus(data, idx, np.random.default_rng(0))
+        chunk = 4
+        n_valid = chunk * 16
+        rows = np.random.default_rng(1).integers(
+            0, data.n_items, n_valid
+        ).astype(np.int32)
+
+        finals = []
+        for prefetch in (False, True):
+            state = create_train_state(
+                config, model_config, jax.random.PRNGKey(0), example
+            )
+            runner = EpochRunner(model_config, cw, 16, bag, chunk,
+                                 sample_prefetch=prefetch)
+            run = runner._train_chunk(chunk)
+            state, loss = run(state, staged.contexts, staged.row_splits,
+                              staged.labels, rows, n_valid,
+                              jax.random.PRNGKey(7))
+            finals.append((state, float(loss)))
+
+        (state_a, loss_a), (state_b, loss_b) = finals
+        np.testing.assert_allclose(loss_b, loss_a, rtol=1e-6)
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-7
+            ),
+            state_a.params, state_b.params,
+        )
+
+    def test_prefetch_consumes_identical_batches_in_order(self, tiny):
+        """The stronger claim, pinned against the REAL chunk programs: stub
+        the train step with an exact integer checksum of the batch, weighted
+        by the step counter (order-sensitive), and require the two variants'
+        chunk outputs to be equal — integer sums are associative, so this
+        is cross-program exact, unlike the float loss."""
+        _, data = tiny
+        bag = 8
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16, path_embed_size=16, encode_size=32,
+        )
+        config = TrainConfig(batch_size=16, max_path_length=bag,
+                             encode_size=32, terminal_embed_size=16,
+                             path_embed_size=16)
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        idx = np.arange(data.n_items)
+        staged = stage_method_corpus(data, idx, np.random.default_rng(0))
+        chunk = 4
+        n_valid = chunk * 16
+        rows = np.random.default_rng(1).integers(
+            0, data.n_items, n_valid
+        ).astype(np.int32)
+
+        def checksum_step(state, batch):
+            # int32 wraparound arithmetic: exact and order-independent
+            # within a batch; the step-counter weight pins batch ORDER
+            chk = (
+                jnp.sum(batch["starts"].astype(jnp.int32)) * 7
+                + jnp.sum(batch["paths"].astype(jnp.int32)) * 11
+                + jnp.sum(batch["ends"].astype(jnp.int32)) * 13
+                + jnp.sum(batch["labels"].astype(jnp.int32)) * 17
+            )
+            state = state.replace(step=state.step + 1)
+            # stays int32 through scan/sum: exact mod 2^32 (a float32 cast
+            # would lose exactness above 2^24)
+            return state, chk * state.step.astype(jnp.int32)
+
+        sums = []
+        for prefetch in (False, True):
+            state = create_train_state(
+                config, model_config, jax.random.PRNGKey(0), example
+            )
+            runner = EpochRunner(model_config, cw, 16, bag, chunk,
+                                 sample_prefetch=prefetch)
+            runner._raw_train = checksum_step  # before _train_chunk caches
+            run = runner._train_chunk(chunk)
+            _, total = run(state, staged.contexts, staged.row_splits,
+                           staged.labels, rows, n_valid,
+                           jax.random.PRNGKey(7))
+            sums.append(float(total))
+        assert sums[0] == sums[1]  # exact: same batches, same order
+
+    def test_prefetch_composes_with_mesh(self, tiny):
+        """The carried batch lives in the scan carry with its sharding
+        constraints — must compile and train on a data×ctx mesh via the
+        full loop."""
+        _, data = tiny
+        config = TrainConfig(
+            max_epoch=2, batch_size=16, encode_size=32,
+            terminal_embed_size=16, path_embed_size=16, max_path_length=32,
+            print_sample_cycle=0, device_epoch=True,
+            device_chunk_batches=4, sample_prefetch=True,
+            data_axis=2, context_axis=2,
+        )
+        result = train(config, data)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
+
+    def test_prefetch_rejected_off_device_epoch_and_sharded(self, tiny):
+        _, data = tiny
+        base = dict(
+            max_epoch=1, batch_size=16, encode_size=32,
+            terminal_embed_size=16, path_embed_size=16, max_path_length=32,
+            print_sample_cycle=0, sample_prefetch=True,
+        )
+        with pytest.raises(ValueError, match="requires --device_epoch"):
+            train(TrainConfig(**base), data)
+        with pytest.raises(ValueError, match="not implemented"):
+            train(TrainConfig(**base, device_epoch=True, data_axis=2,
+                              shard_staged_corpus=True), data)
+
+
 class TestVariableTask:
     """Device epochs for the variable task: corpus-static expansion staged
     as rows, per-epoch @var remap on device."""

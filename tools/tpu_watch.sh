@@ -31,8 +31,9 @@ for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
     echo "[tpu_watch] profile_step rc=$? $(date)"
     # 2b. lowering matrix A/B: attention {xla,streaming} x encoder
     #     {concat,split} (added after the morning --r4 capture, which
-    #     predates both knobs) — 4 combos + 2 winner repeats
-    timeout 1800 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
+    #     predates both knobs) — 4 combos + 2 winner repeats + winner with
+    #     double-buffered sampling x2
+    timeout 2400 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
     echo "[tpu_watch] attn-ab rc=$? $(date)"
     # 3. long-bag full-step rows (the wedge point last time; pools are
     #    cheap and re-run alongside)
