@@ -588,20 +588,14 @@ def main() -> None:
         "BENCH_SAMPLE_PREFETCH", "0"
     ).strip().lower() in ("1", "true", "yes", "on")
     if shard_staged:
-        if sample_prefetch:
-            # fail loudly rather than stamping an unprefetched run as a
-            # prefetch measurement (train/loop.py applies the same rule)
-            raise ValueError(
-                "BENCH_SAMPLE_PREFETCH is not implemented for "
-                "BENCH_SHARD_STAGED=1"
-            )
         from code2vec_tpu.train.device_epoch import (
             ShardedEpochRunner,
             stage_method_corpus_sharded,
         )
 
         runner = ShardedEpochRunner(
-            model_config, class_weights, batch_size, bag, chunk, mesh=mesh
+            model_config, class_weights, batch_size, bag, chunk, mesh=mesh,
+            sample_prefetch=sample_prefetch,
         )
         staged = stage_method_corpus_sharded(
             data, np.arange(data.n_items), rng, mesh

@@ -466,6 +466,48 @@ def _sample_batch(
     }
 
 
+def _scan_train_chunk(sample_i, raw_train, state, key, n_batches,
+                      prefetch: bool):
+    """The chunk's scan-over-batches, shared by the replicated and sharded
+    runners (their ``sample_i`` closures differ, the control flow must not).
+
+    ``prefetch=False``: sample then step, one batch per iteration.
+
+    ``prefetch=True`` double-buffers: iteration i trains on the batch
+    sampled during iteration i-1 while sampling batch i+1 — the two are
+    data-independent, so the TPU scheduler can overlap the sampling
+    gathers with the step's compute. The key split SEQUENCE is unchanged
+    (batch 0 consumes split 1, the i=0 body's prefetch split 2, ...), so
+    every sampled batch is bit-identical to the unprefetched path
+    (tested); losses match up to float reassociation between the two
+    compiled programs. The one dummy tail sample (clamped to the last
+    block) is discarded.
+    """
+    if not prefetch:
+        def body(carry, i):
+            state, key = carry
+            key, batch = sample_i(key, i)
+            state, loss = raw_train(state, batch)
+            return (state, key), loss
+
+        (state, _), losses = jax.lax.scan(
+            body, (state, key), jnp.arange(n_batches)
+        )
+        return state, jnp.sum(losses)
+
+    def body(carry, i):
+        state, key, batch = carry
+        key, next_batch = sample_i(key, jnp.minimum(i + 1, n_batches - 1))
+        state, loss = raw_train(state, batch)
+        return (state, key, next_batch), loss
+
+    key, batch0 = sample_i(key, jnp.int32(0))
+    (state, _, _), losses = jax.lax.scan(
+        body, (state, key, batch0), jnp.arange(n_batches)
+    )
+    return state, jnp.sum(losses)
+
+
 class EpochRunner:
     """Scanned on-device train/eval epochs over a :class:`StagedCorpus`.
 
@@ -558,41 +600,10 @@ class EpochRunner:
                     ))
                     return key, batch
 
-                if not self.sample_prefetch:
-                    def body(carry, i):
-                        state, key = carry
-                        key, batch = sample_i(key, i)
-                        state, loss = self._raw_train(state, batch)
-                        return (state, key), loss
-
-                    (state, _), losses = jax.lax.scan(
-                        body, (state, key), jnp.arange(n_batches)
-                    )
-                    return state, jnp.sum(losses)
-
-                # Double-buffered: iteration i trains on the batch sampled
-                # during iteration i-1 while sampling batch i+1 — the two
-                # are data-independent, so the TPU scheduler can overlap
-                # the sampling gathers with the step's compute. The key
-                # split SEQUENCE is unchanged (batch 0 consumes split 1,
-                # the i=0 body's prefetch split 2, ...), so every sampled
-                # batch is bit-identical to the unprefetched path (tested);
-                # losses match up to float reassociation between the two
-                # compiled programs. The one dummy tail sample (clamped to
-                # the last block) is discarded.
-                def body(carry, i):
-                    state, key, batch = carry
-                    key, next_batch = sample_i(
-                        key, jnp.minimum(i + 1, n_batches - 1)
-                    )
-                    state, loss = self._raw_train(state, batch)
-                    return (state, key, next_batch), loss
-
-                key, batch0 = sample_i(key, jnp.int32(0))
-                (state, _, _), losses = jax.lax.scan(
-                    body, (state, key, batch0), jnp.arange(n_batches)
+                return _scan_train_chunk(
+                    sample_i, self._raw_train, state, key, n_batches,
+                    self.sample_prefetch,
                 )
-                return state, jnp.sum(losses)
 
             self._train_chunks[n_batches] = run
         return self._train_chunks[n_batches]
@@ -737,10 +748,12 @@ class ShardedEpochRunner:
         chunk_batches: int = 16,
         mesh=None,
         shuffle_variable_ids: bool = False,
+        sample_prefetch: bool = False,
     ):
         if mesh is None:
             raise ValueError("ShardedEpochRunner needs a mesh")
         self.shuffle_variable_ids = shuffle_variable_ids
+        self.sample_prefetch = sample_prefetch
         if mesh.shape.get("ctx", 1) > 1:
             raise ValueError(
                 "sharded corpus staging composes with data/model axes; a "
@@ -815,8 +828,7 @@ class ShardedEpochRunner:
                         jnp.int32,
                     )
 
-                def body(carry, i):
-                    state, key = carry
+                def sample_i(key, i):
                     key, sample_key = jax.random.split(key)
                     sl = lambda a: jax.lax.dynamic_slice_in_dim(
                         a, i * per_shard, per_shard, 1
@@ -826,13 +838,12 @@ class ShardedEpochRunner:
                         sl(perm_rows), sl(perm_valid), sample_key,
                         remap_ids, remap_flags,
                     )
-                    state, loss = self._raw_train(state, batch)
-                    return (state, key), loss
+                    return key, batch
 
-                (state, _), losses = jax.lax.scan(
-                    body, (state, key), jnp.arange(n_batches)
+                return _scan_train_chunk(
+                    sample_i, self._raw_train, state, key, n_batches,
+                    self.sample_prefetch,
                 )
-                return state, jnp.sum(losses)
 
             self._train_chunks[n_batches] = run
         return self._train_chunks[n_batches]

@@ -341,15 +341,6 @@ def train(
             "--shard_staged_corpus shards the device-staged corpus; it "
             "requires --device_epoch"
         )
-    if config.sample_prefetch and config.shard_staged_corpus:
-        # fail loudly rather than silently measuring the unprefetched
-        # sampler: the sharded runner's shard_map chunk has no
-        # double-buffered variant (yet)
-        raise ValueError(
-            "--sample_prefetch is not implemented for "
-            "--shard_staged_corpus (the replicated device-epoch runner "
-            "supports it)"
-        )
     if config.sample_prefetch and not config.device_epoch:
         raise ValueError(
             "--sample_prefetch double-buffers the device-epoch sampler; "
@@ -427,6 +418,7 @@ def train(
                         config.device_chunk_batches,
                         mesh=mesh,
                         shuffle_variable_ids=config.shuffle_variable_indexes,
+                        sample_prefetch=config.sample_prefetch,
                     ),
                     shard_staged(stage_host(train_idx), mesh),
                 )

@@ -403,7 +403,7 @@ class TestSamplePrefetch:
         assert result.epochs_run == 2
         assert np.isfinite(result.history[-1]["train_loss"])
 
-    def test_prefetch_rejected_off_device_epoch_and_sharded(self, tiny):
+    def test_prefetch_rejected_without_device_epoch(self, tiny):
         _, data = tiny
         base = dict(
             max_epoch=1, batch_size=16, encode_size=32,
@@ -412,9 +412,86 @@ class TestSamplePrefetch:
         )
         with pytest.raises(ValueError, match="requires --device_epoch"):
             train(TrainConfig(**base), data)
-        with pytest.raises(ValueError, match="not implemented"):
-            train(TrainConfig(**base, device_epoch=True, data_axis=2,
-                              shard_staged_corpus=True), data)
+
+    def test_sharded_prefetch_consumes_identical_batches_in_order(self, tiny):
+        """Same exact-checksum pin as the replicated runner, against the
+        sharded runner's shard_map sampler on a data=2 mesh."""
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.train.device_epoch import (
+            ShardedEpochRunner,
+            stage_method_corpus_sharded,
+        )
+
+        _, data = tiny
+        bag = 8
+        mesh = make_mesh(data=2)
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16, path_embed_size=16, encode_size=32,
+        )
+        config = TrainConfig(batch_size=16, max_path_length=bag,
+                             encode_size=32, terminal_embed_size=16,
+                             path_embed_size=16)
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        staged = stage_method_corpus_sharded(
+            data, np.arange(data.n_items), np.random.default_rng(0), mesh
+        )
+        chunk = 4
+
+        def checksum_step(state, batch):
+            chk = (
+                jnp.sum(batch["starts"].astype(jnp.int32)) * 7
+                + jnp.sum(batch["paths"].astype(jnp.int32)) * 11
+                + jnp.sum(batch["ends"].astype(jnp.int32)) * 13
+                + jnp.sum(batch["labels"].astype(jnp.int32)) * 17
+            )
+            state = state.replace(step=state.step + 1)
+            return state, chk * state.step.astype(jnp.int32)
+
+        sums = []
+        for prefetch in (False, True):
+            state = create_train_state(
+                config, model_config, jax.random.PRNGKey(0), example
+            )
+            runner = ShardedEpochRunner(model_config, cw, 16, bag, chunk,
+                                        mesh=mesh, sample_prefetch=prefetch)
+            runner._raw_train = checksum_step
+            run = runner._train_chunk(chunk)
+            span = chunk * runner.per_shard
+            rows = np.random.default_rng(1).integers(
+                0, np.maximum(staged.shard_counts[:, None], 1),
+                (runner.n_shards, span),
+            ).astype(np.int32)
+            valid = np.ones((runner.n_shards, span), np.float32)
+            _, total = run(state, staged.contexts, staged.row_splits,
+                           staged.labels, rows, valid,
+                           jax.random.PRNGKey(7))
+            sums.append(int(total))
+        assert sums[0] == sums[1]
+
+    def test_prefetch_composes_with_sharded_staging(self, tiny):
+        """The sharded runner's shard_map sampler double-buffers the same
+        way; end-to-end via the full loop on a data=2 mesh."""
+        _, data = tiny
+        config = TrainConfig(
+            max_epoch=2, batch_size=16, encode_size=32,
+            terminal_embed_size=16, path_embed_size=16, max_path_length=32,
+            print_sample_cycle=0, device_epoch=True,
+            device_chunk_batches=4, sample_prefetch=True,
+            data_axis=2, shard_staged_corpus=True,
+        )
+        result = train(config, data)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
 
 
 class TestVariableTask:

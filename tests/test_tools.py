@@ -1,0 +1,38 @@
+"""The tools/ scripts are the TPU-window measurement queue — a bug that
+only fires at import or arg-parse time (e.g. the profile_step sys.path
+regression, fixed 2026-07-31) silently burns a scarce tunnel window via
+the watcher. Pin the cheap layers: byte-compilation and argparse."""
+
+import os
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+SCRIPTS = sorted(
+    f for f in os.listdir(TOOLS) if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_tool_compiles(script):
+    py_compile.compile(os.path.join(TOOLS, script), doraise=True)
+
+
+@pytest.mark.parametrize(
+    "script", ["run_tpu_ablation.py", "bench_ctx.py", "rehearse_java_large.py",
+               "parity_vs_reference.py"]
+)
+def test_tool_argparse_help(script):
+    """--help exercises import + argparse without touching a backend.
+    (profile_step and the profile_ablate pair run at import; their compile
+    check above plus the watcher's CPU smoke cover them.)"""
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, script), "--help"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.join(TOOLS, ".."),
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
