@@ -508,6 +508,28 @@ def _scan_train_chunk(sample_i, raw_train, state, key, n_batches,
     return state, jnp.sum(losses)
 
 
+def _scan_eval_chunk(sample_i, eval_body, key, n_batches, prefetch: bool):
+    """Eval counterpart of :func:`_scan_train_chunk`: same key-walk
+    identity, same double-buffering; ``eval_body(batch)`` returns the
+    per-batch output tuple the scan stacks."""
+    if not prefetch:
+        def body(key, i):
+            key, batch = sample_i(key, i)
+            return key, eval_body(batch)
+
+        _, outs = jax.lax.scan(body, key, jnp.arange(n_batches))
+        return outs
+
+    def body(carry, i):
+        key, batch = carry
+        key, next_batch = sample_i(key, jnp.minimum(i + 1, n_batches - 1))
+        return (key, next_batch), eval_body(batch)
+
+    key, batch0 = sample_i(key, jnp.int32(0))
+    _, outs = jax.lax.scan(body, (key, batch0), jnp.arange(n_batches))
+    return outs
+
+
 class EpochRunner:
     """Scanned on-device train/eval epochs over a :class:`StagedCorpus`.
 
@@ -619,7 +641,7 @@ class EpochRunner:
                     jnp.arange(n_batches * batch_size) < n_valid
                 ).astype(jnp.float32)
 
-                def body(key, i):
+                def sample_i(key, i):
                     key, sample_key = jax.random.split(key)
                     sl = lambda a: jax.lax.dynamic_slice_in_dim(
                         a, i * batch_size, batch_size, 0
@@ -629,11 +651,14 @@ class EpochRunner:
                         sl(perm_rows), sl(perm_valid), bag, sample_key,
                         remap_ids, remap_flags,
                     ))
-                    out = self._raw_eval(state, batch)
-                    return key, (out["loss"], out["preds"], out["max_logit"])
+                    return key, batch
 
-                _, (losses, preds, max_logits) = jax.lax.scan(
-                    body, key, jnp.arange(n_batches)
+                def eval_body(batch):
+                    out = self._raw_eval(state, batch)
+                    return out["loss"], out["preds"], out["max_logit"]
+
+                losses, preds, max_logits = _scan_eval_chunk(
+                    sample_i, eval_body, key, n_batches, self.sample_prefetch
                 )
                 return jnp.sum(losses), preds.reshape(-1), max_logits.reshape(-1)
 
@@ -864,7 +889,7 @@ class ShardedEpochRunner:
                         jnp.int32,
                     )
 
-                def body(key, i):
+                def sample_i(key, i):
                     key, sample_key = jax.random.split(key)
                     sl = lambda a: jax.lax.dynamic_slice_in_dim(
                         a, i * per_shard, per_shard, 1
@@ -874,11 +899,14 @@ class ShardedEpochRunner:
                         sl(perm_rows), sl(perm_valid), sample_key,
                         remap_ids, remap_flags,
                     )
-                    out = self._raw_eval(state, batch)
-                    return key, (out["loss"], out["preds"], out["max_logit"])
+                    return key, batch
 
-                _, (losses, preds, max_logits) = jax.lax.scan(
-                    body, key, jnp.arange(n_batches)
+                def eval_body(batch):
+                    out = self._raw_eval(state, batch)
+                    return out["loss"], out["preds"], out["max_logit"]
+
+                losses, preds, max_logits = _scan_eval_chunk(
+                    sample_i, eval_body, key, n_batches, self.sample_prefetch
                 )
                 return losses, preds, max_logits  # [nb], [nb, B], [nb, B]
 

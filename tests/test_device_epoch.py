@@ -413,6 +413,48 @@ class TestSamplePrefetch:
         with pytest.raises(ValueError, match="requires --device_epoch"):
             train(TrainConfig(**base), data)
 
+    def test_eval_epoch_matches_unprefetched(self, tiny):
+        """Eval chunks double-buffer too: same key walk → same sampled
+        batches → identical predictions (integer argmax; float loss up to
+        reassociation)."""
+        _, data = tiny
+        bag = 8
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16, path_embed_size=16, encode_size=32,
+        )
+        config = TrainConfig(batch_size=16, max_path_length=bag,
+                             encode_size=32, terminal_embed_size=16,
+                             path_embed_size=16)
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        state = create_train_state(
+            config, model_config, jax.random.PRNGKey(0), example
+        )
+        staged = stage_method_corpus(
+            data, np.arange(data.n_items), np.random.default_rng(0)
+        )
+        outs = []
+        for prefetch in (False, True):
+            runner = EpochRunner(model_config, cw, 16, bag, 4,
+                                 sample_prefetch=prefetch)
+            outs.append(runner.run_eval_epoch(
+                state, staged, jax.random.PRNGKey(5)
+            ))
+        (loss_a, preds_a, ml_a), (loss_b, preds_b, ml_b) = outs
+        np.testing.assert_array_equal(np.asarray(preds_b), np.asarray(preds_a))
+        np.testing.assert_allclose(np.asarray(ml_b), np.asarray(ml_a),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(loss_b, loss_a, rtol=1e-6)
+
     def test_sharded_prefetch_consumes_identical_batches_in_order(self, tiny):
         """Same exact-checksum pin as the replicated runner, against the
         sharded runner's shard_map sampler on a data=2 mesh."""
@@ -477,6 +519,54 @@ class TestSamplePrefetch:
                            jax.random.PRNGKey(7))
             sums.append(int(total))
         assert sums[0] == sums[1]
+
+    def test_sharded_eval_epoch_matches_unprefetched(self, tiny):
+        """The sharded eval chunk's prefetch carry (a shard_map-assembled
+        batch dict with data-axis shardings) must compile and produce
+        identical predictions."""
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_state
+        from code2vec_tpu.train.device_epoch import (
+            ShardedEpochRunner,
+            stage_method_corpus_sharded,
+        )
+
+        _, data = tiny
+        bag = 8
+        mesh = make_mesh(data=2)
+        model_config = Code2VecConfig(
+            terminal_count=len(data.terminal_vocab),
+            path_count=len(data.path_vocab),
+            label_count=len(data.label_vocab),
+            terminal_embed_size=16, path_embed_size=16, encode_size=32,
+        )
+        config = TrainConfig(batch_size=16, max_path_length=bag,
+                             encode_size=32, terminal_embed_size=16,
+                             path_embed_size=16)
+        cw = jnp.ones(model_config.label_count, jnp.float32)
+        example = {
+            "starts": np.zeros((16, bag), np.int32),
+            "paths": np.zeros((16, bag), np.int32),
+            "ends": np.zeros((16, bag), np.int32),
+            "labels": np.zeros(16, np.int32),
+            "example_mask": np.ones(16, np.float32),
+        }
+        state = shard_state(mesh, create_train_state(
+            config, model_config, jax.random.PRNGKey(0), example
+        ))
+        staged = stage_method_corpus_sharded(
+            data, np.arange(data.n_items), np.random.default_rng(0), mesh
+        )
+        outs = []
+        for prefetch in (False, True):
+            runner = ShardedEpochRunner(model_config, cw, 16, bag, 4,
+                                        mesh=mesh, sample_prefetch=prefetch)
+            outs.append(runner.run_eval_epoch(
+                state, staged, jax.random.PRNGKey(5)
+            ))
+        (loss_a, preds_a, _), (loss_b, preds_b, _) = outs
+        np.testing.assert_array_equal(np.asarray(preds_b), np.asarray(preds_a))
+        np.testing.assert_allclose(loss_b, loss_a, rtol=1e-6)
 
     def test_prefetch_composes_with_sharded_staging(self, tiny):
         """The sharded runner's shard_map sampler double-buffers the same
