@@ -112,10 +112,87 @@ def measure_long_bag_step(batch: int, bag: int, steps: int = 32) -> float:
     )
 
 
+def _run_row_subprocess(mode: str, batch: int, bag: int,
+                        timeout_s: float) -> dict:
+    """One measurement row in a killable child. The child gets its own
+    process group and on timeout the WHOLE group is SIGKILLed — a wedged
+    tunnel compile can hang forever, and plugin helper processes holding
+    the captured pipes would otherwise keep a plain subprocess.run blocked
+    in communicate() past its timeout (bench.py's _kill_tree lesson).
+    Output goes to a temp file, not a pipe, for the same reason."""
+    import signal
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out_f, \
+            tempfile.TemporaryFile("w+") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             f"--{mode}-row", str(batch), str(bag)],
+            stdout=out_f, stderr=err_f, start_new_session=True,
+        )
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            return {"error": f"timeout {timeout_s}s (tunnel wedge?)"}
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout, stderr = out_f.read(), err_f.read()
+    try:
+        line = next(
+            l for l in reversed(stdout.splitlines())
+            if l.startswith("{") and '"kind"' in l
+        )
+        return json.loads(line)
+    except Exception:  # noqa: BLE001 - child died before a row line
+        return {"error": f"rc={proc.returncode} {stderr[-250:]}"}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--step-row", nargs=2, type=int, metavar=("BATCH", "BAG"),
+        default=None,
+        help="internal: measure ONE long-bag step row and print its JSON "
+        "line (the parent runs each row in a killable subprocess so a "
+        "tunnel wedge costs one row's timeout, not the rest of the run "
+        "— the 2026-07-31 window died mid-run)",
+    )
+    ap.add_argument(
+        "--pool-row", nargs=2, type=int, metavar=("BATCH", "BAG"),
+        default=None, help="internal: measure ONE pool row (see --step-row)",
+    )
+    ap.add_argument(
+        "--row-timeout", type=float, default=600.0,
+        help="per-row subprocess budget, seconds",
+    )
     args = ap.parse_args()
+
+    if args.step_row is not None:
+        _pin_platform()
+        batch, bag = args.step_row
+        ms = measure_long_bag_step(batch, bag)
+        print(json.dumps({
+            "kind": "step", "batch": batch, "bag": bag,
+            "ms_per_step": round(ms, 3),
+            "contexts_per_sec": round(batch * bag / ms * 1e3, 0),
+        }), flush=True)
+        return
+
+    if args.pool_row is not None:
+        _pin_platform()
+        batch, bag = args.pool_row
+        print(json.dumps({
+            "kind": "pool", "batch": batch, "bag": bag,
+            **measure_pool(batch, bag),
+        }), flush=True)
+        return
 
     _pin_platform()
     import jax
@@ -128,12 +205,10 @@ def main() -> None:
         (1024, 200), (256, 1024), (64, 4096),
     ]
     for batch, bag in pool_shapes:
-        try:
-            r = measure_pool(batch, bag)
-        except Exception as e:  # noqa: BLE001 - stream what we have
-            print(json.dumps({"pool": f"b{batch}/bag{bag}", "error": str(e)[:300]}), flush=True)
+        row = _run_row_subprocess("pool", batch, bag, args.row_timeout)
+        if "error" in row:
+            print(json.dumps({"pool": f"b{batch}/bag{bag}", **row}), flush=True)
             continue
-        row = {"kind": "pool", "batch": batch, "bag": bag, **r}
         rows.append(row)
         print(json.dumps(row), flush=True)
 
@@ -142,16 +217,10 @@ def main() -> None:
         (1024, 200), (256, 1024), (64, 4096),
     ]
     for batch, bag in step_shapes:
-        try:
-            ms = measure_long_bag_step(batch, bag)
-        except Exception as e:  # noqa: BLE001
-            print(json.dumps({"step": f"b{batch}/bag{bag}", "error": str(e)[:300]}), flush=True)
+        row = _run_row_subprocess("step", batch, bag, args.row_timeout)
+        if "error" in row:
+            print(json.dumps({"step": f"b{batch}/bag{bag}", **row}), flush=True)
             continue
-        row = {
-            "kind": "step", "batch": batch, "bag": bag,
-            "ms_per_step": round(ms, 3),
-            "contexts_per_sec": round(batch * bag / ms * 1e3, 0),
-        }
         rows.append(row)
         print(json.dumps(row), flush=True)
 
