@@ -27,6 +27,7 @@ Prints one JSON line per row, then a markdown table for ARCHITECTURE.md.
 
 import json
 import os
+import signal
 import sys
 import time
 from functools import partial
@@ -70,6 +71,27 @@ print(json.dumps({"backend": jax.default_backend(), "batch": B, "bag": L,
                   "attn_impl": ATTN_IMPL, "encoder_impl": ENCODER_IMPL}),
       flush=True)
 
+results = {}
+
+
+def _partial_summary(signum, frame):  # noqa: ARG001 - signal signature
+    """The watcher runs this under ``timeout -k`` (TERM, then KILL after a
+    grace) — and a wedged tunnel can hang any single bench forever. On a
+    TERM that actually gets delivered (i.e. the main thread is in Python,
+    not blocked in a native XLA call — CPython defers handlers inside C
+    calls, which is why the watcher's ``-k`` KILL backstop is REQUIRED),
+    dump whatever components already measured so the window isn't a total
+    loss, then exit nonzero. Re-arms SIG_DFL first so a second TERM kills
+    immediately even if this handler's own I/O wedges."""
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    print(json.dumps({"partial": True, "components": {
+        k: round(v, 3) for k, v in results.items()
+    }}), flush=True)
+    raise SystemExit(124)
+
+
+signal.signal(signal.SIGTERM, _partial_summary)
+
 spec = SynthSpec(n_methods=max(B * 8, 8192), n_terminals=360_631,
                  n_paths=342_845, n_labels=8_000, mean_contexts=120.0,
                  max_contexts=400, seed=0)
@@ -98,9 +120,6 @@ state = create_train_state(tc, mc, jax.random.PRNGKey(0),
 cw = jnp.ones(mc.label_count, jnp.float32)
 raw_train = build_train_step_fn(mc, cw)
 model = Code2Vec(mc)
-
-results = {}
-
 
 def bench(name, fn, *args, n=30, **kw):
     out = fn(*args, **kw)

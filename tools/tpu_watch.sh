@@ -22,22 +22,26 @@ for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
     # Remaining round-4 queue (2026-07-31: bench re-stamp + --r4 ablation
     # + pool rows already captured in the morning window before the
     # tunnel re-wedged mid-bench_ctx; what's left):
+    # -k 60: a wedged tunnel blocks the main thread in a native XLA call,
+    # where CPython DEFERS the TERM handler — without the KILL backstop a
+    # hung measurement would survive its timeout and hold the device
     # 1. headline bench at the NEW default (mu-bf16 flip landed after the
     #    morning stamp, which ran at f32 moments)
-    BENCH_DEADLINE=1200 timeout 1500 python bench.py > /tmp/bench_tpu.txt 2>&1
+    BENCH_DEADLINE=1200 timeout -k 60 1500 python bench.py > /tmp/bench_tpu.txt 2>&1
     echo "[tpu_watch] bench rc=$? $(date)"
-    # 2. component attribution of the 25.3ms step (VERDICT r3 #2)
-    timeout 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
+    # 2. component attribution of the 25.3ms step (VERDICT r3 #2);
+    #    profile_step prints a partial summary on a delivered TERM
+    timeout -k 60 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
     echo "[tpu_watch] profile_step rc=$? $(date)"
     # 2b. lowering matrix A/B: attention {xla,streaming} x encoder
     #     {concat,split} (added after the morning --r4 capture, which
     #     predates both knobs) — 4 combos + 2 winner repeats + winner with
     #     double-buffered sampling x2
-    timeout 2400 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
+    timeout -k 60 2400 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
     echo "[tpu_watch] attn-ab rc=$? $(date)"
-    # 3. long-bag full-step rows (the wedge point last time; pools are
-    #    cheap and re-run alongside)
-    timeout 1800 python tools/bench_ctx.py > /tmp/bench_ctx.txt 2>&1
+    # 3. long-bag full-step rows (the wedge point last time; every row now
+    #    runs in its own killable process group inside bench_ctx)
+    timeout -k 60 1800 python tools/bench_ctx.py > /tmp/bench_ctx.txt 2>&1
     echo "[tpu_watch] bench_ctx rc=$? $(date)"
     exit 0
   fi
