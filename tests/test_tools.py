@@ -3,6 +3,8 @@ only fires at import or arg-parse time (e.g. the profile_step sys.path
 regression, fixed 2026-07-31) silently burns a scarce tunnel window via
 the watcher. Pin the cheap layers: byte-compilation and argparse."""
 
+import json
+import math
 import os
 import py_compile
 import subprocess
@@ -19,6 +21,32 @@ SCRIPTS = sorted(
 @pytest.mark.parametrize("script", SCRIPTS)
 def test_tool_compiles(script):
     py_compile.compile(os.path.join(TOOLS, script), doraise=True)
+
+
+def test_rehearse_java_large_tiny_end_to_end(tmp_path):
+    """The java-large rehearsal (round-4 evidence for BASELINE config 3)
+    must keep running end-to-end: all phases (gen, int32 guard, host
+    shards, streaming steps, sharded staging + steps) at a ~3k-method
+    scale on the virtual CPU mesh. ~2.5 min."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "rehearse_java_large.py"),
+         "--n_methods", "3000", "--batch", "64", "--bag", "16",
+         "--steps", "1", "--chunk_items", "1024", "--data_axis", "2",
+         "--n_hosts", "2", "--work_dir", str(tmp_path / "rjl")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()},
+        cwd=os.path.join(TOOLS, ".."),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines()
+             if l.startswith("{")]
+    assert any(r.get("done") for r in lines)
+    phases = {r.get("phase") for r in lines}
+    assert {"gen", "guard", "hostshard", "stream", "shard"} <= phases
+    finals = [r["final_loss"] for r in lines if "final_loss" in r]
+    assert finals and all(math.isfinite(v) for v in finals)
 
 
 @pytest.mark.parametrize(

@@ -395,14 +395,18 @@ def main() -> None:
 
     # parent: run every phase in its own subprocess, streaming output
     t0 = time.time()
+    # forward the recipe shape too — the train phases read batch/bag, and
+    # silently running the defaults would make a small-scale invocation
+    # lie about what it exercised
+    shape = ["--batch", str(args.batch), "--bag", str(args.bag)]
     phases = [
         ["--phase", "gen", "--n_methods", str(args.n_methods)],
         ["--phase", "guard"],
         ["--phase", "hostshard", "--n_hosts", str(args.n_hosts)],
         ["--phase", "stream", "--steps", str(args.steps),
-         "--chunk_items", str(args.chunk_items)],
+         "--chunk_items", str(args.chunk_items)] + shape,
         ["--phase", "shard", "--steps", str(args.steps),
-         "--data_axis", str(args.data_axis)],
+         "--data_axis", str(args.data_axis)] + shape,
     ]
     for extra in phases:
         cmd = [sys.executable, os.path.abspath(__file__),
