@@ -387,6 +387,27 @@ class TestSamplePrefetch:
             sums.append(float(total))
         assert sums[0] == sums[1]  # exact: same batches, same order
 
+    def test_prefetch_composes_with_variable_task(self, tmp_path_factory):
+        """The remap-enabled sampler (variable task, shuffled @var ids)
+        rides in the prefetch carry too."""
+        out = tmp_path_factory.mktemp("prefetch_vars")
+        paths = generate_corpus_files(out, SPECS["tiny"])
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            infer_method=False, infer_variable=True, cache=False,
+        )
+        config = TrainConfig(
+            max_epoch=2, batch_size=16, encode_size=32,
+            terminal_embed_size=16, path_embed_size=16, max_path_length=32,
+            print_sample_cycle=0, device_epoch=True,
+            device_chunk_batches=4, sample_prefetch=True,
+            infer_method_name=False, infer_variable_name=True,
+            shuffle_variable_indexes=True,
+        )
+        result = train(config, data)
+        assert result.epochs_run == 2
+        assert np.isfinite(result.history[-1]["train_loss"])
+
     def test_prefetch_composes_with_mesh(self, tiny):
         """The carried batch lives in the scan carry with its sharding
         constraints — must compile and train on a data×ctx mesh via the
