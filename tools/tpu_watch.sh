@@ -1,6 +1,9 @@
 #!/usr/bin/env bash
-# Poll the TPU tunnel; when it answers, immediately run the ablation matrix
-# and the headline bench, streaming results to log files. Detach with:
+# Poll the TPU tunnel; when it answers, run whatever measurement tasks have
+# not yet produced a complete result, and keep polling until every task is
+# done or the probe budget runs out — an intermittent tunnel that wedges
+# mid-queue gets another shot at the REMAINING tasks on its next window.
+# Detach with:
 #   setsid nohup bash tools/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
@@ -13,39 +16,75 @@ cd "$(dirname "$0")/.."
 # end-of-round bench).
 echo "[tpu_watch] quiet period $(date)"
 sleep "${TPU_WATCH_QUIET:-900}"
+
+# Completion predicates: a task is done when its output file carries the
+# marker its successful run always prints. Re-running a finished task
+# wastes a scarce window; re-running a half-finished one is the point.
+# Content (not just existence) gates staleness: the bench stamp must be at
+# the CURRENT default (mu-bf16 — the detail record is self-describing for
+# exactly this reason), so an old f32-default stamp can't satisfy it; the
+# attn-ab matrix emits 8 ms_per_step rows (4 combos + 2 winner repeats +
+# 2 winner/prefetch), so a wedge after row 6 still re-runs.
+# (grep -c prints "0" AND exits 1 on zero matches, so `|| echo 0` would
+# double-print; capture and default instead)
+count_in() { local n; n=$(grep -c "$1" "$2" 2>/dev/null); echo "${n:-0}"; }
+bench_done()    { grep -q '"backend": "tpu"' /tmp/bench_tpu.txt 2>/dev/null && \
+                  grep -q '"adam_mu_dtype": "bfloat16"' /tmp/bench_tpu.txt 2>/dev/null; }
+profile_done()  { grep -q '"attribution"' /tmp/profile_step.txt 2>/dev/null; }
+attn_ab_done()  { [ "$(count_in '"ms_per_step"' /tmp/attn_ab.txt)" -ge 8 ]; }
+ctx_done()      { [ "$(count_in '"kind": "step"' /tmp/bench_ctx.txt)" -ge 3 ]; }
+
+all_done() { bench_done && profile_done && attn_ab_done && ctx_done; }
+
+# -k 60: a wedged tunnel blocks the main thread in a native XLA call,
+# where CPython DEFERS the TERM handler — without the KILL backstop a
+# hung measurement would survive its timeout and hold the device
+run_queue() {
+  if ! bench_done; then
+    # headline bench at the NEW default (mu-bf16 flip landed after the
+    # morning stamp, which ran at f32 moments)
+    BENCH_DEADLINE=1200 timeout -k 60 1500 python bench.py > /tmp/bench_tpu.txt 2>&1
+    echo "[tpu_watch] bench rc=$? $(date)"
+  fi
+  if ! profile_done; then
+    # component attribution of the 25.3ms step (VERDICT r3 #2);
+    # profile_step prints a partial summary on a delivered TERM
+    timeout -k 60 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
+    echo "[tpu_watch] profile_step rc=$? $(date)"
+  fi
+  if ! attn_ab_done; then
+    # lowering matrix A/B: attention {xla,streaming} x encoder
+    # {concat,split} — 4 combos + 2 winner repeats + winner/prefetch x2
+    timeout -k 60 2400 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
+    echo "[tpu_watch] attn-ab rc=$? $(date)"
+  fi
+  if ! ctx_done; then
+    # long-bag full-step rows (every row runs in its own killable
+    # process group inside bench_ctx)
+    timeout -k 60 1800 python tools/bench_ctx.py > /tmp/bench_ctx.txt 2>&1
+    echo "[tpu_watch] bench_ctx rc=$? $(date)"
+  fi
+}
+
 for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
+  if all_done; then
+    echo "[tpu_watch] all tasks complete $(date)"
+    exit 0
+  fi
   # bench.py's probe: a real compile+dispatch in a killable subprocess
   # (jax.devices() can answer on a tunnel whose first compile then hangs,
   # observed 2026-07-30) with the shared persistent compile cache
   if timeout 120 python -c "import bench; raise SystemExit(0 if bench._probe_default_backend(90) else 1)" >/dev/null 2>&1; then
     echo "[tpu_watch] tunnel up after probe $i: $(date)"
-    # Remaining round-4 queue (2026-07-31: bench re-stamp + --r4 ablation
-    # + pool rows already captured in the morning window before the
-    # tunnel re-wedged mid-bench_ctx; what's left):
-    # -k 60: a wedged tunnel blocks the main thread in a native XLA call,
-    # where CPython DEFERS the TERM handler — without the KILL backstop a
-    # hung measurement would survive its timeout and hold the device
-    # 1. headline bench at the NEW default (mu-bf16 flip landed after the
-    #    morning stamp, which ran at f32 moments)
-    BENCH_DEADLINE=1200 timeout -k 60 1500 python bench.py > /tmp/bench_tpu.txt 2>&1
-    echo "[tpu_watch] bench rc=$? $(date)"
-    # 2. component attribution of the 25.3ms step (VERDICT r3 #2);
-    #    profile_step prints a partial summary on a delivered TERM
-    timeout -k 60 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
-    echo "[tpu_watch] profile_step rc=$? $(date)"
-    # 2b. lowering matrix A/B: attention {xla,streaming} x encoder
-    #     {concat,split} (added after the morning --r4 capture, which
-    #     predates both knobs) — 4 combos + 2 winner repeats + winner with
-    #     double-buffered sampling x2
-    timeout -k 60 2400 python tools/run_tpu_ablation.py --attn-ab > /tmp/attn_ab.txt 2>&1
-    echo "[tpu_watch] attn-ab rc=$? $(date)"
-    # 3. long-bag full-step rows (the wedge point last time; every row now
-    #    runs in its own killable process group inside bench_ctx)
-    timeout -k 60 1800 python tools/bench_ctx.py > /tmp/bench_ctx.txt 2>&1
-    echo "[tpu_watch] bench_ctx rc=$? $(date)"
-    exit 0
+    run_queue
+    if all_done; then
+      echo "[tpu_watch] all tasks complete $(date)"
+      exit 0
+    fi
+    echo "[tpu_watch] queue incomplete (wedge mid-run?) — resuming polls $(date)"
+  else
+    echo "[tpu_watch] probe $i: tunnel still down $(date)"
   fi
-  echo "[tpu_watch] probe $i: tunnel still down $(date)"
   sleep 600
 done
 echo "[tpu_watch] gave up"
