@@ -17,6 +17,13 @@ cd "$(dirname "$0")/.."
 echo "[tpu_watch] quiet period $(date)"
 sleep "${TPU_WATCH_QUIET:-900}"
 
+# TPU_WATCH_DEADLINE (epoch seconds): past it, start no new tasks and stop
+# probing — a late-recovering tunnel must be left clean for the driver's
+# own end-of-round bench, not contended by a watcher mid-queue.
+past_deadline() {
+  [ -n "${TPU_WATCH_DEADLINE:-}" ] && [ "$(date +%s)" -ge "$TPU_WATCH_DEADLINE" ]
+}
+
 # Completion predicates: a task is done when its output file carries the
 # marker its successful run always prints. Re-running a finished task
 # wastes a scarce window; re-running a half-finished one is the point.
@@ -40,6 +47,10 @@ all_done() { bench_done && profile_done && attn_ab_done && ctx_done; }
 # where CPython DEFERS the TERM handler — without the KILL backstop a
 # hung measurement would survive its timeout and hold the device
 run_queue() {
+  if past_deadline; then
+    echo "[tpu_watch] deadline passed — not starting tasks $(date)"
+    return
+  fi
   if ! bench_done; then
     # headline bench at the NEW default (mu-bf16 flip landed after the
     # morning stamp, which ran at f32 moments)
@@ -69,6 +80,10 @@ run_queue() {
 for i in $(seq 1 "${TPU_WATCH_PROBES:-60}"); do
   if all_done; then
     echo "[tpu_watch] all tasks complete $(date)"
+    exit 0
+  fi
+  if past_deadline; then
+    echo "[tpu_watch] deadline passed — exiting to leave the tunnel clean $(date)"
     exit 0
   fi
   # bench.py's probe: a real compile+dispatch in a killable subprocess
