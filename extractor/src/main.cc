@@ -69,11 +69,18 @@ struct Group {
   bool done = false;  // guarded by the pipeline mutex
 };
 
-// Streams methods.txt into maximal consecutive same-file groups, one at a
-// time — memory stays bounded by the in-flight window, not the corpus
-// (java-large's methods.txt alone is ~16M rows).
+// Streams methods.txt into consecutive same-file groups, one at a time —
+// memory stays bounded by the in-flight window, not the corpus
+// (java-large's methods.txt alone is ~16M rows). Groups are additionally
+// capped at kMaxRowsPerGroup rows so one pathological same-file run (a
+// generated file queried per-method) can't make a single group's
+// rows+outs unbounded; splitting a run is safe because parsing is
+// deterministic — each sub-group re-parses to the identical CU, and the
+// committer preserves row order across sub-groups.
 class GroupReader {
  public:
+  static constexpr size_t kMaxRowsPerGroup = 4096;
+
   explicit GroupReader(std::istream& in) : in_(in) {}
 
   bool next(Group& g) {
@@ -81,7 +88,7 @@ class GroupReader {
     g.file = pending_file_;
     g.rows.push_back(std::move(pending_row_));
     has_pending_ = false;
-    while (read_row()) {
+    while (g.rows.size() < kMaxRowsPerGroup && read_row()) {
       if (pending_file_ != g.file) return true;  // stays pending
       g.rows.push_back(std::move(pending_row_));
       has_pending_ = false;

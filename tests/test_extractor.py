@@ -568,6 +568,50 @@ class TestParallelExtraction:
         # the "cannot open <abs path>" diagnostic embeds the per-run tmp dir
         return blobs, result.stderr.replace(str(src), "<src>")
 
+    def test_group_row_cap_splits_long_same_file_runs(self, tmp_path):
+        """A same-file run longer than GroupReader::kMaxRowsPerGroup (4096)
+        is split into sub-groups — memory stays bounded — and the split is
+        invisible in the artifacts: sub-groups re-parse the same CU and the
+        committer preserves row order (main.cc)."""
+
+        def run(name, jobs):
+            root = tmp_path / name
+            root.mkdir()
+            src = root / "src"
+            src.mkdir()
+            (src / "Gen.java").write_text(
+                "class Gen {\n"
+                "  int pick(int a) { return a + 1; }\n"
+                "  void emit(String s) { System.out.println(s); }\n"
+                "}\n"
+            )
+            # one run of 4100 consecutive same-file rows (> the 4096 cap),
+            # alternating named-method and method-not-found rows so commit
+            # order is observable in both corpus.txt and stderr
+            rows = []
+            for i in range(4100):
+                rows.append("Gen.java\tpick" if i % 2 == 0
+                            else f"Gen.java\tmissing{i}")
+            dataset = root / "ds"
+            dataset.mkdir()
+            (dataset / "methods.txt").write_text("\n".join(rows) + "\n")
+            result = extract_dataset(
+                str(dataset), str(src), extra_args=["--jobs", str(jobs)],
+            )
+            blobs = {a: (dataset / a).read_bytes()
+                     for a in self.ARTIFACTS if a != "decls.txt"}
+            return blobs, result.stderr
+
+        seq_blobs, seq_err = run("seq", jobs=1)
+        par_blobs, par_err = run("par", jobs=4)
+        assert par_blobs == seq_blobs
+        assert par_err == seq_err
+        # every named row extracted, every missingN row warned, in order
+        assert seq_blobs["corpus.txt"].count(b"label:pick") == 2050
+        assert seq_err.count("WARNING: method not found.") == 2050
+        first, last = seq_err.index("missing1\n"), seq_err.index("missing4099")
+        assert first < last
+
     def test_jobs_byte_identical(self, tmp_path):
         seq_blobs, seq_err = self._run(tmp_path, "seq", jobs=1)
         par_blobs, par_err = self._run(tmp_path, "par", jobs=4)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
@@ -112,6 +113,39 @@ def measure_long_bag_step(batch: int, bag: int, steps: int = 32) -> float:
     )
 
 
+# The in-flight row child, for the parent's own signal handler: the rows
+# run in their own sessions (so a wedge is killable without killing the
+# parent), which also detaches them from the watcher's `timeout -k` — a
+# TERM/KILL aimed at this parent would otherwise orphan a wedged child on
+# the tunnel indefinitely.
+_CURRENT_CHILD = None
+
+
+def _kill_current_child() -> None:
+    proc = _CURRENT_CHILD
+    if proc is not None and proc.poll() is None:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+
+
+def _on_term(signum, frame):  # noqa: ARG001 - signal handler signature
+    # No proc.wait() here: the signal usually interrupts the main thread
+    # inside proc.wait(timeout=...), which holds Popen's non-reentrant
+    # _waitpid_lock — waiting again on the same thread would deadlock
+    # (bench.py's _kill_tree lesson). Raw killpg, then a hard exit; the
+    # child is SIGKILLed so there is nothing to reap that init won't take.
+    proc = _CURRENT_CHILD
+    if proc is not None:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    os._exit(128 + signum)
+
+
 def _run_row_subprocess(mode: str, batch: int, bag: int,
                         timeout_s: float) -> dict:
     """One measurement row in a killable child. The child gets its own
@@ -120,26 +154,33 @@ def _run_row_subprocess(mode: str, batch: int, bag: int,
     the captured pipes would otherwise keep a plain subprocess.run blocked
     in communicate() past its timeout (bench.py's _kill_tree lesson).
     Output goes to a temp file, not a pipe, for the same reason."""
-    import signal
+    global _CURRENT_CHILD
     import subprocess
     import tempfile
 
     with tempfile.TemporaryFile("w+") as out_f, \
             tempfile.TemporaryFile("w+") as err_f:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__),
-             f"--{mode}-row", str(batch), str(bag)],
-            stdout=out_f, stderr=err_f, start_new_session=True,
-        )
+        # block TERM/INT across spawn+assignment: a signal landing between
+        # Popen returning and _CURRENT_CHILD being set would let _on_term
+        # exit without killing the just-spawned session-detached child
+        masked = {signal.SIGTERM, signal.SIGINT}
+        signal.pthread_sigmask(signal.SIG_BLOCK, masked)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 f"--{mode}-row", str(batch), str(bag)],
+                stdout=out_f, stderr=err_f, start_new_session=True,
+            )
+            _CURRENT_CHILD = proc
+        finally:
+            signal.pthread_sigmask(signal.SIG_UNBLOCK, masked)
         try:
             proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            proc.wait()
+            _kill_current_child()
             return {"error": f"timeout {timeout_s}s (tunnel wedge?)"}
+        finally:
+            _CURRENT_CHILD = None
         out_f.seek(0)
         err_f.seek(0)
         stdout, stderr = out_f.read(), err_f.read()
@@ -150,6 +191,13 @@ def _run_row_subprocess(mode: str, batch: int, bag: int,
         )
         return json.loads(line)
     except Exception:  # noqa: BLE001 - child died before a row line
+        # surface the child's own structured error row when it printed one
+        for l in reversed(stdout.splitlines()):
+            if l.startswith("{") and '"error"' in l:
+                try:
+                    return json.loads(l)
+                except Exception:  # noqa: BLE001 - not JSON after all
+                    break
         return {"error": f"rc={proc.returncode} {stderr[-250:]}"}
 
 
@@ -170,14 +218,29 @@ def main() -> None:
     )
     ap.add_argument(
         "--row-timeout", type=float, default=600.0,
-        help="per-row subprocess budget, seconds",
+        help="per-row subprocess budget, seconds (additionally capped by "
+        "the remaining --total-budget, so a slow early row shrinks later "
+        "rows instead of blowing the whole run's deadline)",
+    )
+    ap.add_argument(
+        "--total-budget", type=float,
+        default=float(os.environ.get("BENCH_CTX_BUDGET", 1680.0)),
+        help="whole-run budget, seconds (default 1680 = the watcher's "
+        "outer `timeout -k 60 1800` minus startup slack); rows that no "
+        "longer fit are skipped with an error row and the summary table "
+        "still prints, so a finished-but-slow sweep isn't discarded",
     )
     args = ap.parse_args()
 
     if args.step_row is not None:
         _pin_platform()
         batch, bag = args.step_row
-        ms = measure_long_bag_step(batch, bag)
+        try:
+            ms = measure_long_bag_step(batch, bag)
+        except Exception as e:  # noqa: BLE001 - structured row for the parent
+            print(json.dumps({"batch": batch, "bag": bag,
+                              "error": str(e)[:300]}), flush=True)
+            raise SystemExit(1)
         print(json.dumps({
             "kind": "step", "batch": batch, "bag": bag,
             "ms_per_step": round(ms, 3),
@@ -188,38 +251,60 @@ def main() -> None:
     if args.pool_row is not None:
         _pin_platform()
         batch, bag = args.pool_row
+        try:
+            row = measure_pool(batch, bag)
+        except Exception as e:  # noqa: BLE001 - structured row for the parent
+            print(json.dumps({"batch": batch, "bag": bag,
+                              "error": str(e)[:300]}), flush=True)
+            raise SystemExit(1)
         print(json.dumps({
-            "kind": "pool", "batch": batch, "bag": bag,
-            **measure_pool(batch, bag),
+            "kind": "pool", "batch": batch, "bag": bag, **row,
         }), flush=True)
         return
 
+    # parent mode: a TERM from the watcher's outer timeout must take the
+    # in-flight row child down with us (it lives in its own session, so
+    # nothing else will)
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
     _pin_platform()
+    t0 = time.monotonic()
     import jax
 
     print(json.dumps({"backend": jax.default_backend()}), flush=True)
 
     rows = []
+    # full step at lifted caps FIRST: the pool rows are cheap and were
+    # already captured in the 2026-07-31 window — the step family is the
+    # data a tight window must not miss
+    step_shapes = [(256, 1024)] if args.quick else [
+        (1024, 200), (256, 1024), (64, 4096),
+    ]
     # pool microbench: B x L held at ~256k slots
     pool_shapes = [(1024, 200), (256, 1024)] if args.quick else [
         (1024, 200), (256, 1024), (64, 4096),
     ]
-    for batch, bag in pool_shapes:
-        row = _run_row_subprocess("pool", batch, bag, args.row_timeout)
-        if "error" in row:
-            print(json.dumps({"pool": f"b{batch}/bag{bag}", **row}), flush=True)
+    for mode, batch, bag in (
+        [("step", b, g) for b, g in step_shapes]
+        + [("pool", b, g) for b, g in pool_shapes]
+    ):
+        # a row needs a realistic floor (tunnel compile alone is 20-40s,
+        # and SIGKILLing a mid-compile child is itself a wedge risk —
+        # tools/tpu_watch.sh's header) — skip rather than launch doomed
+        remaining = args.total_budget - (time.monotonic() - t0)
+        if remaining - 30 < 150:
+            print(json.dumps({mode: f"b{batch}/bag{bag}",
+                              "error": "skipped: total budget exhausted"}),
+                  flush=True)
             continue
-        rows.append(row)
-        print(json.dumps(row), flush=True)
-
-    # full step at lifted caps
-    step_shapes = [(256, 1024)] if args.quick else [
-        (1024, 200), (256, 1024), (64, 4096),
-    ]
-    for batch, bag in step_shapes:
-        row = _run_row_subprocess("step", batch, bag, args.row_timeout)
+        row_timeout = min(args.row_timeout, remaining - 30)
+        row = _run_row_subprocess(mode, batch, bag, row_timeout)
         if "error" in row:
-            print(json.dumps({"step": f"b{batch}/bag{bag}", **row}), flush=True)
+            if (row_timeout < args.row_timeout
+                    and row["error"].startswith("timeout")):
+                row["error"] += " [budget-capped, not the row's full timeout]"
+            print(json.dumps({mode: f"b{batch}/bag{bag}", **row}), flush=True)
             continue
         rows.append(row)
         print(json.dumps(row), flush=True)

@@ -30,16 +30,25 @@ past_deadline() {
 # Content (not just existence) gates staleness: the bench stamp must be at
 # the CURRENT default (mu-bf16 — the detail record is self-describing for
 # exactly this reason), so an old f32-default stamp can't satisfy it; the
-# attn-ab matrix emits 8 ms_per_step rows (4 combos + 2 winner repeats +
-# 2 winner/prefetch), so a wedge after row 6 still re-runs.
+# sweeps print their markdown table only after the full run, so a wedge
+# mid-matrix still re-runs — but a run that FINISHED with some error rows
+# registers as done (counting data rows alone could never converge when one
+# combo persistently fails, burning every window on re-runs). The table
+# marker alone is not enough either: print_table() emits the header even
+# when EVERY row errored, and an all-error sweep (half-wedged tunnel) must
+# retry on a later healthy window — so done = marker AND >=1 data row.
 # (grep -c prints "0" AND exits 1 on zero matches, so `|| echo 0` would
 # double-print; capture and default instead)
 count_in() { local n; n=$(grep -c "$1" "$2" 2>/dev/null); echo "${n:-0}"; }
 bench_done()    { grep -q '"backend": "tpu"' /tmp/bench_tpu.txt 2>/dev/null && \
                   grep -q '"adam_mu_dtype": "bfloat16"' /tmp/bench_tpu.txt 2>/dev/null; }
 profile_done()  { grep -q '"attribution"' /tmp/profile_step.txt 2>/dev/null; }
-attn_ab_done()  { [ "$(count_in '"ms_per_step"' /tmp/attn_ab.txt)" -ge 8 ]; }
-ctx_done()      { [ "$(count_in '"kind": "step"' /tmp/bench_ctx.txt)" -ge 3 ]; }
+attn_ab_done()  { grep -q '| config | ms/step |' /tmp/attn_ab.txt 2>/dev/null && \
+                  [ "$(count_in '"ms_per_step"' /tmp/attn_ab.txt)" -ge 1 ]; }
+# the step family is bench_ctx's reason to exist (pool rows were captured
+# in round 4), so done requires at least one STEP data row, not just any
+ctx_done()      { grep -q '| kind | batch | bag |' /tmp/bench_ctx.txt 2>/dev/null && \
+                  [ "$(count_in '"kind": "step"' /tmp/bench_ctx.txt)" -ge 1 ]; }
 
 all_done() { bench_done && profile_done && attn_ab_done && ctx_done; }
 
