@@ -544,7 +544,10 @@ def main() -> None:
         # ms, x2 repeats — tools/run_tpu_ablation.py --r4): trims ~280 MB
         # of the per-step moment RMW at top11 scale. Training keeps f32 as
         # ITS default (torch-parity configuration pinned by the train-step
-        # differential test); the bench takes the measured winner.
+        # differential test); the bench takes the measured winner. On the
+        # CPU fallback the flip is a wash (f32 95.3k/107.1k vs bf16
+        # 99.7k/104.7k ctx/s, x2 each — docs/ROUND5.md), so the recipe is
+        # NOT backend-split; r04's 13% CPU dip was run-to-run noise.
         # Unrecognized values raise rather than silently landing on either
         # arm — a typo'd opt-out must not get recorded as an f32 stamp.
         adam_mu_dtype=_mu_dtype_from_env(),
