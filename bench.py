@@ -551,6 +551,12 @@ def main() -> None:
         # Unrecognized values raise rather than silently landing on either
         # arm — a typo'd opt-out must not get recorded as an f32 stamp.
         adam_mu_dtype=_mu_dtype_from_env(),
+        # "dense" | "lazy": embedding-table optimizer (train/table_opt.py).
+        # Lazy updates only the touched rows (SparseAdam semantics) —
+        # staged for TPU measurement via run_tpu_ablation --r5; unknown
+        # values raise in create_train_state (fail-loud dispatch)
+        table_update=os.environ.get("BENCH_TABLE_UPDATE", "dense")
+        .strip().lower() or "dense",
     )
 
     rng = np.random.default_rng(0)
@@ -599,6 +605,7 @@ def main() -> None:
         runner = ShardedEpochRunner(
             model_config, class_weights, batch_size, bag, chunk, mesh=mesh,
             sample_prefetch=sample_prefetch,
+            table_update=config.table_update,
         )
         staged = stage_method_corpus_sharded(
             data, np.arange(data.n_items), rng, mesh
@@ -626,6 +633,7 @@ def main() -> None:
             # double-buffered on-device sampling (same batches, same
             # order; see train/device_epoch.py) — measured via the ablation
             sample_prefetch=sample_prefetch,
+            table_update=config.table_update,
         )
         staged = stage_method_corpus(
             data, np.arange(data.n_items), rng, device=corpus_placement
@@ -686,6 +694,7 @@ def main() -> None:
                     # across default flips (mu-bf16 landed round 4);
                     # use_pallas=true overrides attn_impl in the dispatch
                     "adam_mu_dtype": config.adam_mu_dtype,
+                    "table_update": config.table_update,
                     "attn_impl": model_config.attn_impl,
                     "encoder_impl": model_config.encoder_impl,
                     "use_pallas": model_config.use_pallas,

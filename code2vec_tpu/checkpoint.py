@@ -45,11 +45,17 @@ class TrainMeta:
     # restore so resuming a bf16-mu checkpoint without the flag fails with
     # guidance, not an orbax dtype error
     adam_mu_dtype: str | None = None
+    # embedding-table optimizer (--table_update): "lazy" stores a
+    # structurally different opt_state (train/table_opt.py), so a mismatch
+    # is caught here with guidance, not an orbax structure error
+    table_update: str | None = None
 
 
 def _adam_mu_dtype_name(state) -> str | None:
     """Dtype of the Adam first-moment buffers, read off the live opt_state
-    (None when no ScaleByAdamState is present — e.g. a bare template)."""
+    (None when no ScaleByAdamState is present — e.g. a bare template).
+    The lazy table optimizer nests a plain chain state for the non-table
+    params inside MixedTableOptState, which this NamedTuple walk reaches."""
     import optax
 
     for leaf in jax.tree_util.tree_leaves(
@@ -60,6 +66,18 @@ def _adam_mu_dtype_name(state) -> str | None:
             mu_leaves = jax.tree_util.tree_leaves(leaf.mu)
             return str(mu_leaves[0].dtype) if mu_leaves else None
     return None
+
+
+def _table_update_name(state) -> str:
+    """"lazy" when the opt_state carries the touched-rows table optimizer
+    (train/table_opt.py), else "dense"."""
+    from code2vec_tpu.train.table_opt import MixedTableOptState
+
+    return (
+        "lazy"
+        if isinstance(state.opt_state, MixedTableOptState)
+        else "dense"
+    )
 
 
 def _rng_impl_name(dropout_rng) -> str:
@@ -110,6 +128,7 @@ def save_checkpoint(out_dir: str, state, meta: TrainMeta, slot: str = "best") ->
     previous = _latest_step_dir(base, prefix)
     meta.rng_impl = _rng_impl_name(state.dropout_rng)
     meta.adam_mu_dtype = _adam_mu_dtype_name(state) or meta.adam_mu_dtype
+    meta.table_update = _table_update_name(state)
     path = os.path.join(base, f"{prefix}_{int(state.step)}")
     if os.path.exists(path):
         shutil.rmtree(path)
@@ -200,6 +219,16 @@ def restore_checkpoint(
             f"checkpoint in {base} was saved with --rng_impl "
             f"{saved_impl} but this run uses {want_impl}; pass "
             f"--rng_impl {saved_impl} to resume it"
+        )
+    want_update = _table_update_name(state)
+    # metas from before the field are dense (the only behavior then)
+    saved_update = saved_meta.table_update or "dense"
+    if saved_update != want_update:
+        raise ValueError(
+            f"checkpoint in {base} was saved with --table_update "
+            f"{saved_update} but this run uses {want_update}; pass "
+            f"--table_update {saved_update} to resume it (the optimizer "
+            "state structures differ)"
         )
     want_mu = _adam_mu_dtype_name(state)
     # metas from before the field hold f32 moments (the only behavior then);

@@ -189,6 +189,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Adam first-moment storage dtype (bfloat16 "
                              "trims HBM traffic on the memory-bound step; "
                              "float32 keeps torch parity)")
+    parser.add_argument("--table_update", type=str, default="dense",
+                        choices=("dense", "lazy"),
+                        help="embedding-table optimizer: dense = "
+                             "torch.optim.Adam parity; lazy = touched-rows "
+                             "updates (torch.optim.SparseAdam semantics) — "
+                             "skips the full-table gradient + Adam RMW, "
+                             "the win growing with vocab size")
     parser.add_argument("--vocab_pad_multiple", type=int, default=0,
                         help="pad vocab/label table dims to this multiple "
                              "for even model-axis sharding (0 = follow "
@@ -243,6 +250,7 @@ def config_from_args(args: argparse.Namespace):
         embed_grad=args.embed_grad,
         rng_impl=args.rng_impl,
         adam_mu_dtype=args.adam_mu_dtype,
+        table_update=args.table_update,
         vocab_pad_multiple=args.vocab_pad_multiple,
         resume=args.resume,
         checkpoint_cycle=args.checkpoint_cycle,

@@ -132,7 +132,14 @@ class Code2Vec(nn.Module):
         ends: jnp.ndarray,  # int32 [B, L]
         labels: jnp.ndarray | None = None,  # int32 [B], margin head only
         deterministic: bool = True,
+        embed_offsets: tuple[jnp.ndarray, jnp.ndarray] | None = None,
     ):
+        """``embed_offsets``: optional ``(off_se [B, 2L, E_t], off_p
+        [B, L, E_p])`` zero tensors added to the gathered embeddings — the
+        touched-rows optimizer differentiates w.r.t. these instead of the
+        tables, so the dense ``[vocab, dim]`` table gradient is never
+        materialized (train/table_opt.py). Zeros leave the forward math
+        bit-identical."""
         c = self.config
 
         # the param tree matches nn.Embed's ({name: {"embedding": table}}),
@@ -155,10 +162,14 @@ class Code2Vec(nn.Module):
             compute_dtype=c.dtype,
             grad_mode=c.embed_grad,
         )
+        if embed_offsets is not None:
+            embed_se = embed_se + embed_offsets[0]
         embed_starts, embed_ends = jnp.split(embed_se, 2, axis=1)
         embed_paths = embedding_lookup(
             path_table, paths, compute_dtype=c.dtype, grad_mode=c.embed_grad
         )
+        if embed_offsets is not None:
+            embed_paths = embed_paths + embed_offsets[1]
         if c.encoder_impl == "split":
             contexts = _SplitEncoder(
                 c.encode_size, dtype=c.dtype, name="input_dense"

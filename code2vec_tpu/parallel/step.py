@@ -25,13 +25,14 @@ from code2vec_tpu.train.step import (
 
 
 def make_parallel_train_step(
-    model_config: Code2VecConfig, class_weights, mesh: Mesh, state: TrainState
+    model_config: Code2VecConfig, class_weights, mesh: Mesh, state: TrainState,
+    table_update: str = "dense",
 ):
     """jit the train step with explicit mesh shardings; ``state`` supplies
     the pytree structure for the annotations."""
     state_sh = state_shardings(mesh, state)
     return jax.jit(
-        build_train_step_fn(model_config, class_weights),
+        build_train_step_fn(model_config, class_weights, table_update),
         in_shardings=(state_sh, batch_shardings(mesh)),
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=(0,),

@@ -41,6 +41,7 @@ def save_inference_meta(out_dir: str, config, model_config, data) -> None:
     meta = {
         "rng_impl": config.rng_impl,
         "adam_mu_dtype": config.adam_mu_dtype,
+        "table_update": config.table_update,
         "terminal_count": model_config.terminal_count,
         "path_count": model_config.path_count,
         "label_count": model_config.label_count,
@@ -175,10 +176,12 @@ class Predictor:
             batch_size=1, max_path_length=self.bag,
             infer_method_name=True, infer_variable_name=False,
             # the checkpoint's dropout key carries its PRNG impl and its
-            # opt_state carries the mu dtype; restore validates both, so
-            # reconstruct with what the model was trained with
+            # opt_state carries the mu dtype and table-update mode; restore
+            # validates all three, so reconstruct with what the model was
+            # trained with
             rng_impl=meta.get("rng_impl", "threefry2x32"),
             adam_mu_dtype=meta.get("adam_mu_dtype", "float32"),
+            table_update=meta.get("table_update", "dense"),
         )
         example = {
             "starts": np.zeros((1, self.bag), np.int32),

@@ -73,6 +73,12 @@ class TrainConfig:
     # step, ~280 MB at top11 scale; nu always stays f32). Checkpoints
     # store whatever dtype was used; resume with the same setting.
     adam_mu_dtype: str = "float32"
+    # embedding-table optimizer: "dense" (torch.optim.Adam parity — every
+    # row's moments decay every step) | "lazy" (touched-rows updates with
+    # torch.optim.SparseAdam semantics, train/table_opt.py — skips the
+    # full-table gradient materialization and Adam RMW; the opt-state
+    # structure differs, so resume with the same setting)
+    table_update: str = "dense"
     # pad table/head vocab dims to this multiple so they shard evenly over
     # the model axis; 0 = auto (use model_axis). Checkpoint param shapes
     # depend on it — pin it explicitly to resume a run under a different

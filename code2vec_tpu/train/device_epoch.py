@@ -558,6 +558,7 @@ class EpochRunner:
         mesh=None,
         shuffle_variable_ids: bool = False,
         sample_prefetch: bool = False,
+        table_update: str = "dense",
     ):
         self.batch_size = batch_size
         self.bag = bag
@@ -569,7 +570,9 @@ class EpochRunner:
             from code2vec_tpu.parallel.shardings import batch_shardings
 
             self._batch_shardings = batch_shardings(mesh)
-        self._raw_train = build_train_step_fn(model_config, class_weights)
+        self._raw_train = build_train_step_fn(
+            model_config, class_weights, table_update
+        )
         self._raw_eval = build_eval_step_fn(model_config, class_weights)
         self._train_chunks: dict[int, Callable] = {}
         self._eval_chunks: dict[int, Callable] = {}
@@ -774,6 +777,7 @@ class ShardedEpochRunner:
         mesh=None,
         shuffle_variable_ids: bool = False,
         sample_prefetch: bool = False,
+        table_update: str = "dense",
     ):
         if mesh is None:
             raise ValueError("ShardedEpochRunner needs a mesh")
@@ -795,7 +799,9 @@ class ShardedEpochRunner:
         self.bag = bag
         self.chunk_batches = chunk_batches
         self.mesh = mesh
-        self._raw_train = build_train_step_fn(model_config, class_weights)
+        self._raw_train = build_train_step_fn(
+            model_config, class_weights, table_update
+        )
         self._raw_eval = build_eval_step_fn(model_config, class_weights)
         self._train_chunks: dict[int, Callable] = {}
         self._eval_chunks: dict[int, Callable] = {}

@@ -247,7 +247,8 @@ def train(
         state = shard_state(mesh, state)
         if train_step is None:
             train_step = make_parallel_train_step(
-                model_config, class_weights, mesh, state
+                model_config, class_weights, mesh, state,
+                table_update=config.table_update,
             )
         if eval_step is None:
             # host numpy batches are auto-placed by the in_shardings
@@ -256,7 +257,9 @@ def train(
             )
 
     if train_step is None:
-        train_step = make_train_step(model_config, class_weights)
+        train_step = make_train_step(
+            model_config, class_weights, table_update=config.table_update
+        )
     if eval_step is None:
         eval_step = make_eval_step(model_config, class_weights)
 
@@ -374,6 +377,7 @@ def train(
                     mesh=mesh,
                     shuffle_variable_ids=config.shuffle_variable_indexes,
                     sample_prefetch=config.sample_prefetch,
+                    table_update=config.table_update,
                 )
             corpus_placement = None
             if mesh is not None:
@@ -419,6 +423,7 @@ def train(
                         mesh=mesh,
                         shuffle_variable_ids=config.shuffle_variable_indexes,
                         sample_prefetch=config.sample_prefetch,
+                        table_update=config.table_update,
                     ),
                     shard_staged(stage_host(train_idx), mesh),
                 )

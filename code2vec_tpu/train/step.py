@@ -33,6 +33,28 @@ class TrainState(train_state.TrainState):
 
     dropout_rng: jax.Array
 
+    def apply_gradients(self, *, grads, **kwargs):
+        """Sparse-aware: the touched-rows table optimizer hands table
+        grads as SparseTableGrad leaves and returns SparseRowUpdate
+        leaves, which ``optax.apply_updates`` cannot apply; dense grads
+        take flax's path unchanged (train/table_opt.py)."""
+        from code2vec_tpu.train.table_opt import (
+            apply_updates_sparse,
+            has_sparse_grads,
+        )
+
+        if not has_sparse_grads(grads):
+            return super().apply_gradients(grads=grads, **kwargs)
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        return self.replace(
+            step=self.step + 1,
+            params=apply_updates_sparse(self.params, updates),
+            opt_state=new_opt_state,
+            **kwargs,
+        )
+
 
 def torch_style_adam(
     lr: float,
@@ -88,7 +110,18 @@ def create_train_state(
         labels=example_batch["labels"],
         deterministic=True,
     )["params"]
-    tx = torch_style_adam(
+    table_update = getattr(config, "table_update", "dense")
+    if table_update == "lazy":
+        from code2vec_tpu.train.table_opt import mixed_table_adam
+
+        make_tx = mixed_table_adam
+    elif table_update == "dense":
+        make_tx = torch_style_adam
+    else:  # fail loudly before the (possibly GB-scale) state is built
+        raise ValueError(
+            f"table_update must be 'dense' or 'lazy', got {table_update!r}"
+        )
+    tx = make_tx(
         config.lr,
         config.beta_min,
         config.beta_max,
@@ -115,9 +148,17 @@ def weighted_nll(
 def build_train_step_fn(
     model_config: Code2VecConfig,
     class_weights: jnp.ndarray,
+    table_update: str = "dense",
 ) -> Callable[[TrainState, dict[str, jnp.ndarray]], tuple[TrainState, jnp.ndarray]]:
     """The raw (unjitted) SGD step; the single-chip and mesh-sharded
-    variants jit this same function with different sharding annotations."""
+    variants jit this same function with different sharding annotations.
+
+    ``table_update="lazy"`` pairs with a state built by
+    ``create_train_state`` under ``TrainConfig.table_update="lazy"``: the
+    step differentiates w.r.t. zero offsets on the gathered embeddings
+    (never forming the dense table gradient) and hands the optimizer
+    per-slot grads + ids as SparseTableGrad leaves (train/table_opt.py).
+    """
 
     needs_labels = model_config.angular_margin_loss
 
@@ -143,7 +184,74 @@ def build_train_step_fn(
         state = state.apply_gradients(grads=grads, dropout_rng=next_rng)
         return state, loss
 
-    return train_step
+    if table_update == "dense":
+        return train_step
+    if table_update != "lazy":
+        raise ValueError(
+            f"table_update must be 'dense' or 'lazy', got {table_update!r}"
+        )
+
+    from code2vec_tpu.train.table_opt import TABLE_KEYS, SparseTableGrad
+
+    def lazy_loss_fn(diff, tables, apply_fn, batch, dropout_rng):
+        nontable, offsets = diff
+        logits, _, _ = apply_fn(
+            {"params": {**nontable, **tables}},
+            batch["starts"],
+            batch["paths"],
+            batch["ends"],
+            labels=batch["labels"] if needs_labels else None,
+            deterministic=False,
+            rngs={"dropout": dropout_rng},
+            embed_offsets=offsets,
+        )
+        return weighted_nll(
+            logits, batch["labels"], class_weights, batch["example_mask"]
+        )
+
+    def lazy_train_step(state: TrainState, batch):
+        dropout_rng, next_rng = jax.random.split(state.dropout_rng)
+        tables = {k: state.params[k] for k in TABLE_KEYS}
+        nontable = {
+            k: v for k, v in state.params.items() if k not in TABLE_KEYS
+        }
+        b, l = batch["starts"].shape
+        off_se = jnp.zeros(
+            (b, 2 * l, model_config.terminal_embed_size), model_config.dtype
+        )
+        off_p = jnp.zeros(
+            (b, l, model_config.path_embed_size), model_config.dtype
+        )
+        # diff args only — the tables enter as constants, so autodiff
+        # never builds the [vocab, dim] scatter-add backward for them
+        loss, (g_nontable, (g_se, g_p)) = jax.value_and_grad(lazy_loss_fn)(
+            (nontable, (off_se, off_p)), tables, state.apply_fn, batch,
+            dropout_rng,
+        )
+        term_ids = jnp.concatenate(
+            [batch["starts"], batch["ends"]], axis=1
+        ).reshape(-1)
+        grads = {
+            **g_nontable,
+            "terminal_embedding": {
+                "embedding": SparseTableGrad(
+                    ids=term_ids.astype(jnp.int32),
+                    slots=g_se.reshape(-1, g_se.shape[-1]).astype(
+                        jnp.float32
+                    ),
+                )
+            },
+            "path_embedding": {
+                "embedding": SparseTableGrad(
+                    ids=batch["paths"].reshape(-1).astype(jnp.int32),
+                    slots=g_p.reshape(-1, g_p.shape[-1]).astype(jnp.float32),
+                )
+            },
+        }
+        state = state.apply_gradients(grads=grads, dropout_rng=next_rng)
+        return state, loss
+
+    return lazy_train_step
 
 
 def build_eval_step_fn(
@@ -181,10 +289,15 @@ def build_eval_step_fn(
     return eval_step
 
 
-def make_train_step(model_config: Code2VecConfig, class_weights: jnp.ndarray):
+def make_train_step(
+    model_config: Code2VecConfig,
+    class_weights: jnp.ndarray,
+    table_update: str = "dense",
+):
     """Single-device jitted train step."""
     return jax.jit(
-        build_train_step_fn(model_config, class_weights), donate_argnums=(0,)
+        build_train_step_fn(model_config, class_weights, table_update),
+        donate_argnums=(0,),
     )
 
 

@@ -1,0 +1,224 @@
+"""Touched-rows ("lazy") Adam for the embedding tables.
+
+The dense step pays two full-table costs every step regardless of batch
+content: materializing a ``[vocab, dim]`` gradient for each table (the
+autodiff scatter-add over the reference's ``nn.Embedding`` twins,
+model/model.py:21-22), and Adam's read-modify-write over every row of
+param/mu/nu (the reference's torch.optim.Adam over the same tables,
+main.py:138). At top11 scale that is ~2-3 GB/step of HBM traffic on a
+bandwidth-bound step (docs/ARCHITECTURE.md roofline); at java-large scale
+(multi-million-row vocabs) it is the difference between feasible and not —
+a batch touches at most ``B x L`` slots no matter how big the vocab grows.
+
+This module updates only the TOUCHED rows, with the exact semantics of
+``torch.optim.SparseAdam`` (the torch-side answer to the same problem):
+
+- duplicate ids in the batch are coalesced (summed) first, like torch's
+  ``grad.coalesce()``;
+- touched rows get the full Adam treatment (moment decay + bias-corrected
+  update with the GLOBAL step count, ``step_size = lr * sqrt(1-b2^t) /
+  (1-b1^t)``, ``denom = sqrt(nu) + eps`` — torch's eps placement);
+- untouched rows are left entirely alone (params AND moments) — that is
+  the one deliberate semantic difference from dense Adam, which keeps
+  decaying/applying stale moments to rows with zero gradient.
+
+TPU-first formulation, all static shapes under ``jit``:
+
+  sort the ``[N]`` ids -> run-boundary segment ids -> ``segment_sum`` the
+  per-slot grads into an ``[N, dim]`` unique-capacity buffer (sorted
+  indices, so XLA lowers a collision-free accumulation instead of a
+  duplicate-index scatter) -> gather param/mu/nu rows at the unique ids ->
+  Adam on rows -> scatter rows back (distinct indices by construction;
+  capacity padding carries an out-of-range sentinel id and is dropped by
+  ``mode="drop"``).
+
+The per-slot gradients come from the zero-offset hook in the model
+(``Code2Vec.__call__(embed_offsets=...)``): the step differentiates w.r.t.
+zero tensors added to the gathered embeddings instead of w.r.t. the tables
+themselves, so the dense ``[vocab, dim]`` gradient is never formed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+# top-level param-tree keys of the two big tables (models/code2vec.py)
+TABLE_KEYS = ("terminal_embedding", "path_embedding")
+
+
+@struct.dataclass
+class SparseTableGrad:
+    """Per-slot gradient of one embedding table: ``ids[i]`` is the row the
+    ``i``-th gathered slot read, ``slots[i]`` is d(loss)/d(that gather).
+    Stands in for the dense ``[vocab, dim]`` gradient leaf in the grads
+    pytree handed to ``TrainState.apply_gradients``."""
+
+    ids: jax.Array  # int32 [N]
+    slots: jax.Array  # f32 [N, dim]
+
+
+@struct.dataclass
+class SparseRowUpdate:
+    """Row-sparse param update: add ``rows[i]`` to ``param[uids[i]]``.
+    ``uids`` holds DISTINCT real row ids at the front and an out-of-range
+    sentinel (``vocab``) in the capacity padding, so a ``mode="drop"``
+    scatter applies exactly the touched rows."""
+
+    uids: jax.Array  # int32 [N]
+    rows: jax.Array  # f32 [N, dim]
+
+
+class LazyAdamState(NamedTuple):
+    count: jax.Array  # int32 scalar, shared by all tables (global step t)
+    mu: Any  # pytree mirroring the table subtree, [vocab, dim] in mu_dtype
+    nu: Any  # pytree mirroring the table subtree, [vocab, dim] f32
+
+
+class MixedTableOptState(NamedTuple):
+    dense: Any  # torch_style_adam chain state over the non-table params
+    lazy: LazyAdamState
+
+
+def _is_sparse_grad(x) -> bool:
+    return isinstance(x, SparseTableGrad)
+
+
+def has_sparse_grads(grads) -> bool:
+    return any(
+        _is_sparse_grad(leaf)
+        for leaf in jax.tree_util.tree_leaves(grads, is_leaf=_is_sparse_grad)
+    )
+
+
+def _dedupe_sorted(ids: jax.Array, slots: jax.Array, vocab: int):
+    """Coalesce duplicate ids: returns (uids, gsum) of capacity N where the
+    first K rows are the distinct touched ids with their summed grads and
+    the rest carry the ``vocab`` sentinel / zero rows."""
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    sid = ids[order]
+    sg = slots[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sid[1:] != sid[:-1]]
+    )
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1  # [N], sorted
+    gsum = jax.ops.segment_sum(
+        sg, seg, num_segments=n, indices_are_sorted=True
+    )
+    # place each segment's id at its segment index (duplicate writes within
+    # a segment store the same value); capacity padding keeps the sentinel
+    uids = jnp.full((n,), vocab, ids.dtype).at[seg].set(sid)
+    return uids, gsum
+
+
+def _lazy_rows(
+    g: SparseTableGrad,
+    mu: jax.Array,
+    nu: jax.Array,
+    count: jax.Array,
+    *,
+    lr: float,
+    b1: float,
+    b2: float,
+    eps: float,
+):
+    vocab = mu.shape[0]
+    uids, gsum = _dedupe_sorted(g.ids, g.slots.astype(jnp.float32), vocab)
+    safe = jnp.minimum(uids, vocab - 1)
+    mu_new = b1 * mu[safe].astype(jnp.float32) + (1.0 - b1) * gsum
+    nu_new = b2 * nu[safe] + (1.0 - b2) * (gsum * gsum)
+    t = count.astype(jnp.float32)
+    step_size = lr * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+    rows = -step_size * mu_new / (jnp.sqrt(nu_new) + eps)
+    new_mu = mu.at[uids].set(mu_new.astype(mu.dtype), mode="drop")
+    new_nu = nu.at[uids].set(nu_new, mode="drop")
+    return SparseRowUpdate(uids=uids, rows=rows), new_mu, new_nu
+
+
+def _split(tree):
+    tables = {k: tree[k] for k in TABLE_KEYS if k in tree}
+    rest = {k: v for k, v in tree.items() if k not in TABLE_KEYS}
+    return rest, tables
+
+
+def mixed_table_adam(
+    lr: float,
+    b1: float,
+    b2: float,
+    weight_decay: float,
+    mu_dtype: str | None = None,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """torch-style Adam on the non-table params + touched-rows SparseAdam
+    on the two embedding tables. Weight decay (coupled L2, reference
+    main.py:60 default 0.0) applies to the non-table params only —
+    torch.optim.SparseAdam has no decay either; a nonzero setting is
+    honored dense-side and skipped table-side."""
+    from code2vec_tpu.train.step import torch_style_adam
+
+    dense_tx = torch_style_adam(lr, b1, b2, weight_decay, mu_dtype=mu_dtype)
+    store_dtype = (
+        jnp.float32 if mu_dtype in (None, "float32") else jnp.dtype(mu_dtype)
+    )
+
+    def init(params):
+        rest, tables = _split(params)
+        return MixedTableOptState(
+            dense=dense_tx.init(rest),
+            lazy=LazyAdamState(
+                count=jnp.zeros((), jnp.int32),
+                mu=jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, store_dtype), tables
+                ),
+                nu=jax.tree.map(lambda p: jnp.zeros_like(p), tables),
+            ),
+        )
+
+    def update(grads, state, params=None):
+        g_rest, g_tables = _split(grads)
+        p_rest, _ = _split(params) if params is not None else (None, None)
+        u_rest, dense_state = dense_tx.update(g_rest, state.dense, p_rest)
+        count = state.lazy.count + 1
+        updates_t, mu_t, nu_t = {}, {}, {}
+        # each table subtree is {"embedding": leaf} (models/code2vec.py's
+        # _EmbedTable layout) — walk it directly
+        for key, g_sub in g_tables.items():
+            u_sub, mu_sub, nu_sub = {}, {}, {}
+            for name, g in g_sub.items():
+                u_sub[name], mu_sub[name], nu_sub[name] = _lazy_rows(
+                    g,
+                    state.lazy.mu[key][name],
+                    state.lazy.nu[key][name],
+                    count,
+                    lr=lr, b1=b1, b2=b2, eps=eps,
+                )
+            updates_t[key], mu_t[key], nu_t[key] = u_sub, mu_sub, nu_sub
+        new_state = MixedTableOptState(
+            dense=dense_state,
+            lazy=LazyAdamState(count=count, mu=mu_t, nu=nu_t),
+        )
+        return {**u_rest, **updates_t}, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def apply_updates_sparse(params, updates):
+    """``optax.apply_updates`` extended with :class:`SparseRowUpdate`
+    leaves: distinct-row scatter-add with the sentinel capacity rows
+    dropped. Dense leaves follow optax semantics (cast to the param
+    dtype)."""
+
+    def leaf(u, p):
+        if isinstance(u, SparseRowUpdate):
+            return p.at[u.uids].add(u.rows.astype(p.dtype), mode="drop")
+        return optax.apply_updates(p, u)
+
+    return jax.tree.map(
+        leaf, updates, params,
+        is_leaf=lambda x: isinstance(x, SparseRowUpdate),
+    )

@@ -1,0 +1,393 @@
+"""Touched-rows (lazy) table optimizer: torch.optim.SparseAdam parity,
+per-slot-grad (zero-offset) equivalence with the dense table gradient, and
+the lazy step through the scanned-chunk and mesh paths.
+
+The dense twin's oracle is the reference's torch.optim.Adam over the
+nn.Embedding tables (reference main.py:138, model/model.py:21-22); the
+lazy mode's oracle is torch.optim.SparseAdam — torch's own answer to the
+same full-table-RMW problem — which coalesces duplicate ids and updates
+only the touched rows (train/table_opt.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.step import (
+    build_train_step_fn,
+    create_train_state,
+    make_train_step,
+    weighted_nll,
+)
+from code2vec_tpu.train.table_opt import (
+    SparseTableGrad,
+    _dedupe_sorted,
+    mixed_table_adam,
+)
+
+
+def _toy_batch(rng, B=8, L=12, V_t=50, V_p=40, C=7):
+    return {
+        "starts": jnp.asarray(rng.integers(1, V_t, (B, L)), jnp.int32),
+        "paths": jnp.asarray(rng.integers(1, V_p, (B, L)), jnp.int32),
+        "ends": jnp.asarray(rng.integers(1, V_t, (B, L)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, C, (B,)), jnp.int32),
+        "example_mask": jnp.ones((B,), jnp.float32),
+    }
+
+
+def _toy_config(V_t=50, V_p=40, C=7, **kw):
+    return Code2VecConfig(
+        terminal_count=V_t, path_count=V_p, label_count=C,
+        terminal_embed_size=6, path_embed_size=5, encode_size=10, **kw
+    )
+
+
+class TestDedupe:
+    def test_coalesces_duplicates_and_pads_with_sentinel(self):
+        ids = jnp.asarray([3, 1, 3, 7, 1, 3], jnp.int32)
+        slots = jnp.asarray(
+            [[1.0], [10.0], [2.0], [100.0], [20.0], [4.0]], jnp.float32
+        )
+        uids, gsum = _dedupe_sorted(ids, slots, vocab=9)
+        uids, gsum = np.asarray(uids), np.asarray(gsum)
+        assert sorted(uids[:3].tolist()) == [1, 3, 7]
+        assert (uids[3:] == 9).all()  # capacity padding -> sentinel
+        by_id = {int(u): float(g) for u, g in zip(uids[:3], gsum[:3, 0])}
+        assert by_id == {1: 30.0, 3: 7.0, 7: 100.0}
+        assert (gsum[3:] == 0.0).all()
+
+    def test_all_distinct_and_all_same(self):
+        ids = jnp.asarray([4, 2, 8], jnp.int32)
+        slots = jnp.ones((3, 2), jnp.float32)
+        uids, gsum = _dedupe_sorted(ids, slots, vocab=10)
+        assert sorted(np.asarray(uids).tolist()) == [2, 4, 8]
+        assert np.asarray(gsum).sum() == 6.0
+        ids = jnp.asarray([5, 5, 5], jnp.int32)
+        uids, gsum = _dedupe_sorted(ids, slots, vocab=10)
+        assert np.asarray(uids)[0] == 5 and (np.asarray(uids)[1:] == 10).all()
+        assert (np.asarray(gsum)[0] == 3.0).all()
+
+
+class TestSparseAdamParity:
+    """The lazy table update IS torch.optim.SparseAdam: same coalescing,
+    same global-step bias correction, same eps placement."""
+
+    @pytest.mark.parametrize("mu_dtype", ["float32", "bfloat16"])
+    def test_matches_torch_sparse_adam(self, mu_dtype):
+        torch = pytest.importorskip("torch")
+
+        rng = np.random.default_rng(7)
+        vocab, dim, n_slots, steps = 23, 4, 40, 5
+        init = rng.standard_normal((vocab, dim)).astype(np.float32)
+        lr, b1, b2 = 0.01, 0.9, 0.999
+
+        # --- ours: the table subtree of the mixed transform
+        params = {"terminal_embedding": {"embedding": jnp.asarray(init)},
+                  "path_embedding": {"embedding": jnp.zeros((5, dim))},
+                  "other": jnp.zeros((3,))}
+        tx = mixed_table_adam(lr, b1, b2, 0.0, mu_dtype=mu_dtype)
+        opt_state = tx.init(params)
+
+        # --- torch: SparseAdam over the same tensor
+        t_param = torch.tensor(init, requires_grad=True)
+        t_opt = torch.optim.SparseAdam([t_param], lr=lr, betas=(b1, b2))
+
+        from code2vec_tpu.train.table_opt import apply_updates_sparse
+
+        for step in range(steps):
+            ids = rng.integers(0, vocab, n_slots).astype(np.int32)
+            slots = rng.standard_normal((n_slots, dim)).astype(np.float32)
+
+            grads = {
+                "terminal_embedding": {"embedding": SparseTableGrad(
+                    ids=jnp.asarray(ids), slots=jnp.asarray(slots))},
+                "path_embedding": {"embedding": SparseTableGrad(
+                    ids=jnp.zeros(4, jnp.int32),
+                    slots=jnp.zeros((4, dim), jnp.float32))},
+                "other": jnp.zeros((3,)),
+            }
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = apply_updates_sparse(params, updates)
+
+            t_grad = torch.sparse_coo_tensor(
+                torch.tensor(ids[None, :].astype(np.int64)),
+                torch.tensor(slots), (vocab, dim)
+            )
+            t_opt.zero_grad()
+            t_param.grad = t_grad
+            t_opt.step()
+
+            ours = np.asarray(params["terminal_embedding"]["embedding"])
+            theirs = t_param.detach().numpy()
+            tol = 2e-3 if mu_dtype == "bfloat16" else 1e-6
+            np.testing.assert_allclose(ours, theirs, atol=tol, rtol=tol,
+                                       err_msg=f"step {step}")
+
+    def test_untouched_rows_frozen(self):
+        rng = np.random.default_rng(3)
+        vocab, dim = 11, 3
+        init = rng.standard_normal((vocab, dim)).astype(np.float32)
+        params = {"terminal_embedding": {"embedding": jnp.asarray(init)},
+                  "path_embedding": {"embedding": jnp.asarray(init[:5])}}
+        tx = mixed_table_adam(0.01, 0.9, 0.999, 0.0)
+        opt_state = tx.init(params)
+        touched = np.array([2, 5, 2], np.int32)
+        grads = {
+            "terminal_embedding": {"embedding": SparseTableGrad(
+                ids=jnp.asarray(touched),
+                slots=jnp.ones((3, dim), jnp.float32))},
+            "path_embedding": {"embedding": SparseTableGrad(
+                ids=jnp.asarray([0], jnp.int32),
+                slots=jnp.zeros((1, dim), jnp.float32))},
+        }
+        from code2vec_tpu.train.table_opt import apply_updates_sparse
+
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = apply_updates_sparse(params, updates)
+        new = np.asarray(params["terminal_embedding"]["embedding"])
+        untouched = [i for i in range(vocab) if i not in (2, 5)]
+        np.testing.assert_array_equal(new[untouched], init[untouched])
+        assert not np.allclose(new[[2, 5]], init[[2, 5]])
+        # mu/nu of untouched rows also frozen (SparseAdam semantics)
+        mu = np.asarray(opt_state.lazy.mu["terminal_embedding"]["embedding"])
+        assert (mu[untouched] == 0.0).all()
+        assert not np.allclose(mu[[2, 5]], 0.0)
+
+
+class TestOffsetGradEquivalence:
+    """The zero-offset per-slot grads, scatter-added, equal the dense
+    table gradients — the lazy step sees the same gradient signal, just
+    never materialized as [vocab, dim]."""
+
+    @pytest.mark.parametrize("encoder_impl", ["concat", "split"])
+    def test_slot_grads_match_dense_table_grads(self, encoder_impl):
+        rng = np.random.default_rng(11)
+        mc = _toy_config(encoder_impl=encoder_impl)
+        batch = _toy_batch(rng)
+        model = Code2Vec(mc)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            batch["starts"], batch["paths"], batch["ends"],
+            labels=batch["labels"], deterministic=True,
+        )["params"]
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+
+        def dense_loss(params):
+            logits, _, _ = model.apply(
+                {"params": params}, batch["starts"], batch["paths"],
+                batch["ends"], deterministic=True,
+            )
+            return weighted_nll(logits, batch["labels"], cw,
+                                batch["example_mask"])
+
+        dense_grads = jax.grad(dense_loss)(params)
+
+        def offset_loss(offsets):
+            logits, _, _ = model.apply(
+                {"params": params}, batch["starts"], batch["paths"],
+                batch["ends"], deterministic=True, embed_offsets=offsets,
+            )
+            return weighted_nll(logits, batch["labels"], cw,
+                                batch["example_mask"])
+
+        B, L = batch["starts"].shape
+        off = (jnp.zeros((B, 2 * L, mc.terminal_embed_size)),
+               jnp.zeros((B, L, mc.path_embed_size)))
+        g_se, g_p = jax.grad(offset_loss)(off)
+
+        term_ids = np.concatenate(
+            [np.asarray(batch["starts"]), np.asarray(batch["ends"])], axis=1
+        ).reshape(-1)
+        scat_t = np.zeros((mc.terminal_count, mc.terminal_embed_size),
+                          np.float32)
+        np.add.at(scat_t, term_ids,
+                  np.asarray(g_se).reshape(-1, mc.terminal_embed_size))
+        np.testing.assert_allclose(
+            scat_t,
+            np.asarray(dense_grads["terminal_embedding"]["embedding"]),
+            atol=1e-5, rtol=1e-5,
+        )
+        scat_p = np.zeros((mc.path_count, mc.path_embed_size), np.float32)
+        np.add.at(scat_p, np.asarray(batch["paths"]).reshape(-1),
+                  np.asarray(g_p).reshape(-1, mc.path_embed_size))
+        np.testing.assert_allclose(
+            scat_p, np.asarray(dense_grads["path_embedding"]["embedding"]),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_offsets_leave_forward_bit_identical(self):
+        rng = np.random.default_rng(5)
+        mc = _toy_config()
+        batch = _toy_batch(rng)
+        model = Code2Vec(mc)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            batch["starts"], batch["paths"], batch["ends"],
+            deterministic=True,
+        )["params"]
+        B, L = batch["starts"].shape
+        out_plain = model.apply(
+            {"params": params}, batch["starts"], batch["paths"],
+            batch["ends"], deterministic=True,
+        )
+        out_off = model.apply(
+            {"params": params}, batch["starts"], batch["paths"],
+            batch["ends"], deterministic=True,
+            embed_offsets=(jnp.zeros((B, 2 * L, mc.terminal_embed_size)),
+                           jnp.zeros((B, L, mc.path_embed_size))),
+        )
+        for a, b in zip(out_plain, out_off):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestLazyTrainStep:
+    def test_tracks_dense_closely_and_nontable_params_match(self):
+        """Same init, same batches: the non-table params see identical
+        grads (so only eps-placement dust separates them), and the loss
+        trajectories stay within lazy-vs-dense semantic drift."""
+        rng = np.random.default_rng(0)
+        mc = _toy_config()
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+        batch = _toy_batch(rng)
+
+        states, losses = {}, {}
+        for mode in ("dense", "lazy"):
+            tc = TrainConfig(batch_size=8, table_update=mode)
+            state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+            step = make_train_step(mc, cw, table_update=mode)
+            ls = []
+            for _ in range(4):
+                state, loss = step(state, batch)
+                ls.append(float(loss))
+            states[mode], losses[mode] = state, ls
+        assert losses["dense"][0] == pytest.approx(losses["lazy"][0], abs=1e-6)
+        np.testing.assert_allclose(losses["dense"], losses["lazy"], atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(states["dense"].params["input_dense"]["kernel"]),
+            np.asarray(states["lazy"].params["input_dense"]["kernel"]),
+            atol=1e-4,
+        )
+
+    def test_weight_decay_applies_dense_side_only(self):
+        rng = np.random.default_rng(2)
+        mc = _toy_config()
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+        batch = _toy_batch(rng)
+        tc = TrainConfig(batch_size=8, table_update="lazy", weight_decay=0.1)
+        state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+        step = make_train_step(mc, cw, table_update="lazy")
+        state, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+
+    def test_unknown_mode_raises(self):
+        mc = _toy_config()
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+        with pytest.raises(ValueError, match="table_update"):
+            build_train_step_fn(mc, cw, table_update="sparse")
+
+
+class TestLazyChunkAndMesh:
+    def test_epoch_runner_scanned_chunk(self):
+        """The lazy step composes with the scanned-chunk device-epoch path
+        (the flagship path bench.py measures)."""
+        from code2vec_tpu.data.synth import (
+            SynthSpec, corpus_data_from_raw, generate_corpus_data,
+        )
+        from code2vec_tpu.train.device_epoch import (
+            EpochRunner, stage_method_corpus,
+        )
+
+        spec = SynthSpec(n_methods=64, n_terminals=60, n_paths=50,
+                         n_labels=9, mean_contexts=6.0, max_contexts=16,
+                         seed=0)
+        data = corpus_data_from_raw(generate_corpus_data(spec))
+        B, L, chunk = 16, 8, 2
+        mc = Code2VecConfig(
+            terminal_count=spec.n_terminals + 2,
+            path_count=spec.n_paths + 1,
+            label_count=len(data.label_vocab),
+            terminal_embed_size=6, path_embed_size=5, encode_size=10,
+        )
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+        rng = np.random.default_rng(0)
+        from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+
+        epoch = build_method_epoch(data, np.arange(B), L, rng)
+        example = next(iter_batches(epoch, B, rng=rng, pad_final=False))
+        tc = TrainConfig(batch_size=B, max_path_length=L,
+                         table_update="lazy")
+        state = create_train_state(tc, mc, jax.random.PRNGKey(0), example)
+        runner = EpochRunner(mc, cw, B, L, chunk, table_update="lazy")
+        staged = stage_method_corpus(data, np.arange(data.n_items), rng)
+        run = runner._train_chunk(chunk)
+        rows = rng.integers(0, data.n_items, chunk * B).astype(np.int32)
+        state, loss = run(state, staged.contexts, staged.row_splits,
+                          staged.labels, rows, chunk * B,
+                          jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+        assert int(state.step) == chunk
+
+    def test_mesh_compiles_and_runs(self):
+        """Lazy step over a data x model mesh: GSPMD partitions the sort/
+        segment/gather/scatter chain (collectives unoptimized for sharded
+        tables, but correct — the single-chip path is the perf target)."""
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_state
+        from code2vec_tpu.parallel.step import make_parallel_train_step
+
+        rng = np.random.default_rng(1)
+        mc = _toy_config(V_t=48, V_p=40, vocab_pad_multiple=2)
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+        batch = _toy_batch(rng, V_t=48, V_p=40)
+        batch["ids"] = jnp.arange(8, dtype=jnp.int64)  # batch_shardings key
+        tc = TrainConfig(batch_size=8, table_update="lazy")
+        state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+        mesh = make_mesh(data=2, model=2, ctx=1)
+        state = shard_state(mesh, state)
+        step = make_parallel_train_step(mc, cw, mesh, state,
+                                        table_update="lazy")
+        state, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+
+
+class TestLazyCheckpoint:
+    def test_roundtrip_and_mode_mismatch_guidance(self, tmp_path):
+        from code2vec_tpu.checkpoint import (
+            TrainMeta, restore_checkpoint, save_checkpoint,
+        )
+
+        rng = np.random.default_rng(4)
+        mc = _toy_config()
+        cw = jnp.ones((mc.label_count,), jnp.float32)
+        batch = _toy_batch(rng)
+        tc = TrainConfig(batch_size=8, table_update="lazy")
+        state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+        step = make_train_step(mc, cw, table_update="lazy")
+        state, _ = step(state, batch)
+        out = str(tmp_path / "ckpt")
+        save_checkpoint(out, state, TrainMeta())
+
+        template = create_train_state(tc, mc, jax.random.PRNGKey(9), batch)
+        restored, meta = restore_checkpoint(out, template)
+        assert meta.table_update == "lazy"
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["terminal_embedding"]["embedding"]),
+            np.asarray(state.params["terminal_embedding"]["embedding"]),
+        )
+        mu = restored.opt_state.lazy.mu["terminal_embedding"]["embedding"]
+        np.testing.assert_array_equal(
+            np.asarray(mu),
+            np.asarray(state.opt_state.lazy.mu["terminal_embedding"]["embedding"]),
+        )
+
+        dense_template = create_train_state(
+            TrainConfig(batch_size=8), mc, jax.random.PRNGKey(9), batch
+        )
+        with pytest.raises(ValueError, match="--table_update lazy"):
+            restore_checkpoint(out, dense_template)

@@ -204,6 +204,23 @@ def one_step(state, batch):
 
 bench("step", lambda s, b: one_step(s, b)[1], state, batch)
 
+# the touched-rows table optimizer (train/table_opt.py): same step, but the
+# table grads never materialize and Adam touches only gathered rows — the
+# delta vs "step" is the structural lever's whole-step value
+lazy_state = create_train_state(
+    tc.with_updates(table_update="lazy"), mc, jax.random.PRNGKey(0),
+    jax.tree.map(np.asarray, batch),
+)
+lazy_raw = build_train_step_fn(mc, cw, table_update="lazy")
+
+
+@jax.jit
+def one_lazy_step(state, batch):
+    return lazy_raw(state, batch)
+
+
+bench("lazy_step", lambda s, b: one_lazy_step(s, b)[1], lazy_state, batch)
+
 runner = EpochRunner(mc, cw, B, L, CHUNK)
 run_chunk = runner._train_chunk(CHUNK)
 n_valid = CHUNK * B
@@ -249,6 +266,7 @@ print(json.dumps({
         "adam": round(results["adam"], 3),
         "sum_components": round(results["sample"] + results["grad"] + results["adam"], 3),
         "fused_step": round(results["step"], 3),
+        "lazy_step": round(results["lazy_step"], 3),
         "chunk_per_step": round(results[f"chunk/{CHUNK}"], 3),
     }
 }), flush=True)

@@ -165,7 +165,7 @@ def _load_corpus_data(work_dir: str, ram: bool = False):
     )
 
 
-def _model_bits(batch: int, bag: int):
+def _model_bits(batch: int, bag: int, table_update: str = "dense"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -181,7 +181,7 @@ def _model_bits(batch: int, bag: int):
         embed_grad="dense",
     )
     tc = TrainConfig(batch_size=batch, max_path_length=bag,
-                     rng_impl="unsafe_rbg")
+                     rng_impl="unsafe_rbg", table_update=table_update)
     example = {
         "starts": np.zeros((batch, bag), np.int32),
         "paths": np.zeros((batch, bag), np.int32),
@@ -229,7 +229,7 @@ def phase_guard(work_dir: str) -> None:
 # --------------------------------------------------------------------------
 
 def phase_stream(work_dir: str, batch: int, bag: int, steps: int,
-                 chunk_items: int) -> None:
+                 chunk_items: int, table_update: str = "dense") -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -240,8 +240,8 @@ def phase_stream(work_dir: str, batch: int, bag: int, steps: int,
 
     data = _load_corpus_data(work_dir)
     _emit(phase="stream", loaded=True, **_rss())
-    mc, tc, state, cw = _model_bits(batch, bag)
-    train_step = make_train_step(mc, cw)
+    mc, tc, state, cw = _model_bits(batch, bag, table_update)
+    train_step = make_train_step(mc, cw, table_update=table_update)
     rng = np.random.default_rng(0)
 
     def chunk_builder(idx):
@@ -277,7 +277,7 @@ def phase_stream(work_dir: str, batch: int, bag: int, steps: int,
 # --------------------------------------------------------------------------
 
 def phase_shard(work_dir: str, batch: int, bag: int, steps: int,
-                data_axis: int) -> None:
+                data_axis: int, table_update: str = "dense") -> None:
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={data_axis} "
         + os.environ.get("XLA_FLAGS", "")
@@ -296,7 +296,7 @@ def phase_shard(work_dir: str, batch: int, bag: int, steps: int,
 
     data = _load_corpus_data(work_dir, ram=True)
     _emit(phase="shard", loaded=True, **_rss())
-    mc, tc, state, cw = _model_bits(batch, bag)
+    mc, tc, state, cw = _model_bits(batch, bag, table_update)
     mesh = make_mesh(data=data_axis, model=1, ctx=1)
     state = shard_state(mesh, state)
     rng = np.random.default_rng(0)
@@ -315,7 +315,7 @@ def phase_shard(work_dir: str, batch: int, bag: int, steps: int,
           **_rss())
 
     runner = ShardedEpochRunner(mc, cw, batch, bag, chunk_batches=1,
-                                mesh=mesh)
+                                mesh=mesh, table_update=table_update)
     run_chunk = runner._train_chunk(1)
     span = runner.per_shard
     valid = np.ones((runner.n_shards, span), np.float32)
@@ -376,6 +376,12 @@ def main() -> None:
     ap.add_argument("--chunk_items", type=int, default=65_536)
     ap.add_argument("--data_axis", type=int, default=4)
     ap.add_argument("--n_hosts", type=int, default=8)
+    ap.add_argument("--table_update", choices=("dense", "lazy"),
+                    default="dense",
+                    help="embedding-table optimizer for the train phases — "
+                    "'lazy' (touched-rows, train/table_opt.py) is the mode "
+                    "built for exactly this vocab scale, where the dense "
+                    "full-table Adam RMW grows with the 16M-row vocab")
     ap.add_argument("--keep", action="store_true",
                     help="keep the generated corpus files")
     args = ap.parse_args()
@@ -386,10 +392,10 @@ def main() -> None:
         return phase_guard(args.work_dir)
     if args.phase == "stream":
         return phase_stream(args.work_dir, args.batch, args.bag, args.steps,
-                            args.chunk_items)
+                            args.chunk_items, args.table_update)
     if args.phase == "shard":
         return phase_shard(args.work_dir, args.batch, args.bag, args.steps,
-                           args.data_axis)
+                           args.data_axis, args.table_update)
     if args.phase == "hostshard":
         return phase_hostshard(args.work_dir, args.n_hosts)
 
@@ -398,7 +404,8 @@ def main() -> None:
     # forward the recipe shape too — the train phases read batch/bag, and
     # silently running the defaults would make a small-scale invocation
     # lie about what it exercised
-    shape = ["--batch", str(args.batch), "--bag", str(args.bag)]
+    shape = ["--batch", str(args.batch), "--bag", str(args.bag),
+             "--table_update", args.table_update]
     phases = [
         ["--phase", "gen", "--n_methods", str(args.n_methods)],
         ["--phase", "guard"],

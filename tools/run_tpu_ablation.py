@@ -35,6 +35,7 @@ def measure_step(
     chunk: int = 16,
     steps: int = 48,
     adam_mu_dtype: str = "float32",
+    table_update: str = "dense",
     embed: int = 100,
     encode: int = 100,
     n_methods: int | None = None,
@@ -77,7 +78,7 @@ def measure_step(
     )
     config = TrainConfig(
         batch_size=batch, max_path_length=bag, rng_impl=rng_impl,
-        adam_mu_dtype=adam_mu_dtype,
+        adam_mu_dtype=adam_mu_dtype, table_update=table_update,
     )
     rng = np.random.default_rng(0)
     example = {
@@ -90,7 +91,8 @@ def measure_step(
     state = create_train_state(config, model_config, jax.random.PRNGKey(0), example)
     cw = jnp.ones(model_config.label_count, jnp.float32)
     runner = EpochRunner(model_config, cw, batch, bag, chunk,
-                         sample_prefetch=sample_prefetch)
+                         sample_prefetch=sample_prefetch,
+                         table_update=table_update)
     staged = stage_method_corpus(data, np.arange(data.n_items), rng)
     run_chunk = runner._train_chunk(chunk)
     n_valid = chunk * batch
@@ -135,6 +137,15 @@ def main() -> None:
         "{xla, streaming} x encoder {concat, split} once each, then the "
         "two fastest combos re-measured — the focused follow-up for a "
         "short tunnel window after the full --r4 matrix was captured",
+    )
+    ap.add_argument(
+        "--r5",
+        action="store_true",
+        help="the table-optimizer A/B on the winner recipe: dense vs lazy "
+        "(touched-rows SparseAdam, train/table_opt.py) x2 repeats each — "
+        "the structural lever for the full-table grad + Adam RMW traffic "
+        "(VERDICT r4 next-#2); plus lazy at a long-bag shape where the "
+        "touched-rows/vocab ratio is smaller",
     )
     args = ap.parse_args()
 
@@ -192,6 +203,23 @@ def main() -> None:
                    attn_impl=best["attn_impl"],
                    encoder_impl=best["encoder_impl"],
                    sample_prefetch=True, **base)
+        print_table()
+        return
+
+    if args.r5:
+        base = dict(embed_grad="dense", rng_impl="unsafe_rbg",
+                    dtype_name="f32", adam_mu_dtype="bfloat16")
+        for rep in (1, 2):
+            record(f"mu-bf16/table-dense #{rep}", table_update="dense", **base)
+        for rep in (1, 2):
+            record(f"mu-bf16/table-lazy #{rep}", table_update="lazy", **base)
+        # long-bag point: batch 256 x bag 1024 touches <=0.72M slots
+        # against the same 703k-row vocabs — the regime where touched-rows
+        # wins grow (and the java-large-vocab proxy)
+        for mode in ("dense", "lazy"):
+            record(f"b256/bag1024/table-{mode}", table_update=mode,
+                   batch=256, bag=1024, chunk=8,
+                   mean_contexts=819.2, max_contexts=2048, **base)
         print_table()
         return
 

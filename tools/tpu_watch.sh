@@ -43,6 +43,8 @@ count_in() { local n; n=$(grep -c "$1" "$2" 2>/dev/null); echo "${n:-0}"; }
 bench_done()    { grep -q '"backend": "tpu"' /tmp/bench_tpu.txt 2>/dev/null && \
                   grep -q '"adam_mu_dtype": "bfloat16"' /tmp/bench_tpu.txt 2>/dev/null; }
 profile_done()  { grep -q '"attribution"' /tmp/profile_step.txt 2>/dev/null; }
+r5_done()       { grep -q '| config | ms/step |' /tmp/r5_ab.txt 2>/dev/null && \
+                  [ "$(count_in '"ms_per_step"' /tmp/r5_ab.txt)" -ge 1 ]; }
 attn_ab_done()  { grep -q '| config | ms/step |' /tmp/attn_ab.txt 2>/dev/null && \
                   [ "$(count_in '"ms_per_step"' /tmp/attn_ab.txt)" -ge 1 ]; }
 # the step family is bench_ctx's reason to exist (pool rows were captured
@@ -50,7 +52,7 @@ attn_ab_done()  { grep -q '| config | ms/step |' /tmp/attn_ab.txt 2>/dev/null &&
 ctx_done()      { grep -q '| kind | batch | bag |' /tmp/bench_ctx.txt 2>/dev/null && \
                   [ "$(count_in '"kind": "step"' /tmp/bench_ctx.txt)" -ge 1 ]; }
 
-all_done() { bench_done && profile_done && attn_ab_done && ctx_done; }
+all_done() { bench_done && profile_done && r5_done && attn_ab_done && ctx_done; }
 
 # -k 60: a wedged tunnel blocks the main thread in a native XLA call,
 # where CPython DEFERS the TERM handler — without the KILL backstop a
@@ -71,6 +73,13 @@ run_queue() {
     # profile_step prints a partial summary on a delivered TERM
     timeout -k 60 1200 python tools/profile_step.py > /tmp/profile_step.txt 2>&1
     echo "[tpu_watch] profile_step rc=$? $(date)"
+  fi
+  if ! r5_done; then
+    # table-optimizer A/B: dense vs lazy (touched-rows SparseAdam) x2 on
+    # the winner recipe + one long-bag point — the round-5 structural
+    # lever for the full-table grad + Adam RMW traffic (VERDICT r4 #2)
+    timeout -k 60 2400 python tools/run_tpu_ablation.py --r5 > /tmp/r5_ab.txt 2>&1
+    echo "[tpu_watch] r5 rc=$? $(date)"
   fi
   if ! attn_ab_done; then
     # lowering matrix A/B: attention {xla,streaming} x encoder
