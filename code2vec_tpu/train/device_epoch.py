@@ -308,7 +308,9 @@ class ShardedStagedCorpus:
     def flat_labels(self) -> np.ndarray:
         """Valid labels in shard-concatenation order — the ``expected``
         array matching ``ShardedEpochRunner.run_eval_epoch``'s preds."""
-        lab = np.asarray(self.labels)
+        from code2vec_tpu.parallel.distributed import allgather_to_host
+
+        lab = allgather_to_host(self.labels)
         return np.concatenate(
             [lab[s, : int(c)] for s, c in enumerate(self.shard_counts)]
         )
@@ -332,6 +334,49 @@ def partition_items_balanced(
     return [np.sort(order[shard == s]).astype(np.int64) for s in range(n_shards)]
 
 
+def _check_shard_ctx_cap(ctx_cap: int, n_shards: int) -> None:
+    """Per-SHARD row_splits are int32 — the total may exceed 2^31 (the
+    point of sharding: java-large's ~2.3G contexts at data_axis >= 2
+    stays well under per shard), but one shard may not."""
+    if ctx_cap >= 2**31:
+        raise ValueError(
+            f"largest shard holds {ctx_cap} contexts (int32 row_splits); "
+            f"increase data_axis beyond {n_shards}"
+        )
+
+
+def _csr_blocks(
+    groups: list[np.ndarray],
+    counts: np.ndarray,
+    rs_all: np.ndarray,
+    ctx_all: np.ndarray,
+    labels_all: np.ndarray,
+    flags_all: np.ndarray | None,
+    items_cap: int,
+    ctx_cap: int,
+):
+    """Fill the uniform per-shard CSR blocks for one set of item groups
+    (shared by the single-host and multi-process sharded stagings, so the
+    padding rules can't diverge)."""
+    n = len(groups)
+    contexts = np.zeros((n, ctx_cap, 3), np.int32)
+    row_splits = np.zeros((n, items_cap + 1), np.int32)
+    labels = np.zeros((n, items_cap), np.int32)
+    flags = np.zeros((n, items_cap), np.int32)
+    for s, g in enumerate(groups):
+        flat, _, _ = flat_context_indices(rs_all, g)
+        block = ctx_all[flat]
+        contexts[s, : block.shape[0]] = block
+        splits = np.zeros(len(g) + 1, np.int64)
+        np.cumsum(counts[g], out=splits[1:])
+        row_splits[s, : len(splits)] = splits
+        row_splits[s, len(splits):] = splits[-1]  # pad rows are empty
+        labels[s, : len(g)] = labels_all[g]
+        if flags_all is not None:
+            flags[s, : len(g)] = flags_all[g]
+    return contexts, row_splits, labels, flags
+
+
 def shard_staged(staged: StagedCorpus, mesh) -> ShardedStagedCorpus:
     """Partition a HOST-staged corpus (method, variable, or concat — any
     :class:`StagedCorpus` still holding numpy arrays, i.e. staged with
@@ -353,30 +398,12 @@ def shard_staged(staged: StagedCorpus, mesh) -> ShardedStagedCorpus:
     items_cap = max((len(g) for g in groups), default=1)
     ctx_cap = max((int(counts[g].sum()) for g in groups), default=1)
     items_cap, ctx_cap = max(items_cap, 1), max(ctx_cap, 1)
-    if ctx_cap >= 2**31:
-        # per-SHARD row_splits are int32 — the total may exceed 2^31 (the
-        # point of sharding: java-large's ~2.3G contexts at data_axis >= 2
-        # stays well under per shard), but one shard may not
-        raise ValueError(
-            f"largest shard holds {ctx_cap} contexts (int32 row_splits); "
-            f"increase data_axis beyond {n_shards}"
-        )
+    _check_shard_ctx_cap(ctx_cap, n_shards)
 
-    contexts = np.zeros((n_shards, ctx_cap, 3), np.int32)
-    row_splits = np.zeros((n_shards, items_cap + 1), np.int32)
-    labels = np.zeros((n_shards, items_cap), np.int32)
-    flags = np.zeros((n_shards, items_cap), np.int32)
-    for s, g in enumerate(groups):
-        flat, _, _ = flat_context_indices(rs_all, g)
-        block = ctx_all[flat]
-        contexts[s, : block.shape[0]] = block
-        splits = np.zeros(len(g) + 1, np.int64)
-        np.cumsum(counts[g], out=splits[1:])
-        row_splits[s, : len(splits)] = splits
-        row_splits[s, len(splits):] = splits[-1]  # pad rows are empty
-        labels[s, : len(g)] = labels_all[g]
-        if flags_all is not None:
-            flags[s, : len(g)] = flags_all[g]
+    contexts, row_splits, labels, flags = _csr_blocks(
+        groups, counts, rs_all, ctx_all, labels_all, flags_all,
+        items_cap, ctx_cap,
+    )
 
     def put(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))
@@ -409,6 +436,119 @@ def stage_method_corpus_sharded(
     """Method-task convenience wrapper: host staging + :func:`shard_staged`."""
     return shard_staged(
         stage_method_corpus(data, item_idx, rng, device="host"), mesh
+    )
+
+
+def shard_staged_multiprocess(
+    staged_local: StagedCorpus, mesh
+) -> ShardedStagedCorpus:
+    """Pod-scale sharded staging (SURVEY §5.8 + §7.4 composed): each FEED
+    GROUP stages only its own host-sharded corpus shard and partitions it
+    over the group's OWN data-axis coords; the global ``[D, ...]`` arrays
+    are assembled from process-local blocks with
+    ``jax.make_array_from_process_local_data`` — no host ever materializes
+    the full corpus (the point of sharded staging at java-large scale).
+
+    ``staged_local`` must be host-staged (``device="host"``) from the
+    items of THIS process's feed-group shard
+    (``load_corpus(shard=feed_groups(mesh))``), with the same seed across
+    the group's member processes — replicas of the same data coords must
+    contribute identical blocks. Method task only, like host-sharded
+    feeding (the variable expansion is data-dependent per shard).
+
+    Single-process meshes delegate to :func:`shard_staged` (identical
+    semantics, no collective needed).
+    """
+    import jax as _jax
+
+    if _jax.process_count() == 1:
+        return shard_staged(staged_local, mesh)
+    if staged_local.remap_ids is not None and len(
+        np.asarray(staged_local.remap_ids)
+    ):
+        raise ValueError(
+            "multi-process sharded staging supports the method task only; "
+            "stage the variable task replicated or use the host pipeline"
+        )
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from code2vec_tpu.parallel.distributed import feed_groups
+
+    group, n_groups = feed_groups(mesh)
+    n_shards = int(mesh.shape["data"])
+    if n_shards % n_groups:
+        raise ValueError(
+            f"data axis {n_shards} not divisible by {n_groups} feed groups"
+        )
+    local_d = n_shards // n_groups
+    # feed_groups guarantees contiguous, equal, ascending coord ranges, so
+    # group g owns data coords [g*local_d, (g+1)*local_d)
+    ctx_all = np.asarray(staged_local.contexts)
+    rs_all = np.asarray(staged_local.row_splits).astype(np.int64)
+    labels_all = np.asarray(staged_local.labels)
+    counts = np.diff(rs_all)
+    groups_local = partition_items_balanced(counts, local_d)
+
+    # one allgather settles everything cross-process: the global caps
+    # (uniform block shapes are a GLOBAL property) and every coord's
+    # item/context counts (each process contributes its group's coords,
+    # zeros elsewhere). Packed so staging costs a single host barrier.
+    local_items_cap = max((len(g) for g in groups_local), default=1)
+    local_ctx_cap = max((int(counts[g].sum()) for g in groups_local), default=1)
+    contrib = np.zeros(n_shards, np.int64)
+    contrib[group * local_d : (group + 1) * local_d] = [
+        len(g) for g in groups_local
+    ]
+    ctx_contrib = np.zeros(n_shards, np.int64)
+    ctx_contrib[group * local_d : (group + 1) * local_d] = [
+        int(counts[g].sum()) for g in groups_local
+    ]
+    gathered = multihost_utils.process_allgather(np.concatenate([
+        np.asarray([local_items_cap, local_ctx_cap], np.int64),
+        contrib, ctx_contrib,
+    ]))  # [n_processes, 2 + 2 * n_shards]
+    items_cap = max(int(gathered[:, 0].max()), 1)
+    ctx_cap = max(int(gathered[:, 1].max()), 1)
+    _check_shard_ctx_cap(ctx_cap, n_shards)
+    all_counts = gathered[:, 2 : 2 + n_shards]
+    all_ctx = gathered[:, 2 + n_shards :]
+    # replica processes of the same coords MUST have contributed identical
+    # counts — a mismatch means divergent staging (e.g. an rng seeded by
+    # process instead of by group), which would assemble silently wrong
+    # shards; catch it here where the invariant is cheap to check
+    for name, arr in (("item", all_counts), ("context", all_ctx)):
+        nonzero_disagree = (
+            (arr != arr.max(axis=0, keepdims=True)) & (arr != 0)
+        )
+        if nonzero_disagree.any():
+            raise ValueError(
+                f"feed-group replicas disagree on per-shard {name} counts "
+                f"({arr.tolist()}); group members must stage the SAME "
+                "shard with the SAME seed (seed the staging rng by feed "
+                "group, not by process)"
+            )
+    shard_counts = all_counts.max(axis=0)
+    total_contexts = int(all_ctx.max(axis=0).sum())
+
+    contexts, row_splits, labels, _ = _csr_blocks(
+        groups_local, counts, rs_all, ctx_all, labels_all, None,
+        items_cap, ctx_cap,
+    )
+
+    def put(x, spec):
+        return _jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), x
+        )
+
+    return ShardedStagedCorpus(
+        contexts=put(contexts, P("data", None, None)),
+        row_splits=put(row_splits, P("data", None)),
+        labels=put(labels, P("data", None)),
+        n_items=int(shard_counts.sum()),
+        shard_counts=shard_counts,
+        items_cap=items_cap,
+        total_contexts=total_contexts,
     )
 
 

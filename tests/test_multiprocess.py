@@ -104,6 +104,31 @@ def test_two_process_host_sharded_training(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_sharded_staging(tmp_path):
+    """feed_groups x ShardedStagedCorpus, cross-process (VERDICT r4 weak
+    #5): 2 processes x 2 local devices, mesh data=4 — each process loads
+    and host-stages ONLY its feed group's corpus shard (~half the items),
+    `shard_staged_multiprocess` assembles the global [4, ...] staged
+    arrays from process-local blocks, and ShardedEpochRunner trains
+    scanned chunks over the cross-process mesh in lockstep."""
+    results = _run_group(
+        tmp_path,
+        2,
+        extra_env=dict(MP_SHARD_STAGED="1", MP_DATA_AXIS="4"),
+    )
+    for pid in range(2):
+        r = results[pid]
+        assert r["n_groups"] == 2
+        # the host staged only its shard, not the 96-item corpus
+        assert r["local_items"] < 96
+        assert r["local_staged_items"] == r["local_items"]
+        assert r["global_items"] == 96
+    assert results[0]["feed_group"] != results[1]["feed_group"]
+    assert results[0]["local_items"] + results[1]["local_items"] == 96
+    _assert_lockstep(results, 2)
+
+
+@pytest.mark.slow
 def test_four_process_tensor_parallel_training(tmp_path):
     """4 processes x 1 device, mesh data=2 x model=2: with one device per
     process each model pair straddles TWO processes, so the row-sharded
