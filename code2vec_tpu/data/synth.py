@@ -55,6 +55,15 @@ SPECS = {
     "tiny": SynthSpec(n_methods=200, n_terminals=300, n_paths=250, n_labels=12,
                       mean_contexts=30.0, signature_size=20),
     "small": SynthSpec(),
+    # the head-to-head operating point (VERDICT r4 weak-#3): sized so both
+    # implementations land MID-RANGE subtoken F1 — at "small" both sides
+    # saturate >=0.95 where a multi-point quality regression could hide;
+    # here the weaker signal (0.45 vs 0.8) and 10x label space keep the
+    # task genuinely discriminating
+    "parity10k": SynthSpec(
+        n_methods=10_000, n_terminals=4_000, n_paths=3_000, n_labels=600,
+        mean_contexts=60.0, signal=0.45, signature_size=30,
+    ),
     "top11": SynthSpec(
         n_methods=605_945,
         n_terminals=360_631,
