@@ -25,6 +25,13 @@ actual measurement in a killable child under BENCH_DEADLINE seconds
 (default 1200) and retries once on CPU if the child hangs or dies — the
 axon tunnel can wedge *after* init succeeds, which no in-process guard can
 escape. Set BENCH_SUPERVISED=1 to run the measurement directly.
+
+Modes: the default measures the device-epoch flagship path and emits a
+per-step host/H2D/compute attribution dict in the detail JSON
+(BENCH_ATTR_CHUNKS fenced chunks after the measured window).
+``--prefetch-ab`` instead A/Bs the HOST input pipeline — synchronous feed
+vs the double-buffered prefetcher (train/prefetch.py) on one spec — and
+reports both steps/sec plus the attribution split (see _prefetch_ab).
 """
 
 from __future__ import annotations
@@ -37,6 +44,28 @@ import sys
 import time
 
 import numpy as np
+
+
+def _metric_id() -> tuple[str, str]:
+    """(metric, unit) for this invocation's mode — failure records must be
+    keyed to the benchmark that actually ran, or a crashed --prefetch-ab
+    run gets logged against the device-epoch headline metric."""
+    if "--prefetch-ab" in sys.argv[1:]:
+        return "host_pipeline_steps_per_sec", "steps/sec"
+    return "path_contexts_per_sec_per_chip", "contexts/sec"
+
+
+def _failure_record(error: str) -> str:
+    metric, unit = _metric_id()
+    return json.dumps(
+        {
+            "metric": metric,
+            "value": None,
+            "unit": unit,
+            "vs_baseline": None,
+            "error": error,
+        }
+    )
 
 
 def _extract_metric(payload: dict) -> tuple[float, str | None] | None:
@@ -91,16 +120,42 @@ def _extract_metric(payload: dict) -> tuple[float, str | None] | None:
     return None if value is None else (value, backend)
 
 
+def _extract_metric_name(payload: dict) -> str | None:
+    """The metric NAME a BENCH_r*.json recorded, scanning the same places
+    _extract_metric takes the value from; None when the record predates
+    metric labels (those are device-epoch headline rounds)."""
+    candidates = [payload, payload.get("parsed") or {}]
+    tail = payload.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                candidates.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    for obj in candidates:
+        if isinstance(obj, dict) and isinstance(obj.get("metric"), str):
+            return obj["metric"]
+    return None
+
+
 def _previous_benchmark(current_backend: str) -> float | None:
-    """Newest successful prior round measured on the SAME kind of backend.
+    """Newest successful prior round measured on the SAME kind of backend
+    AND the same metric.
 
     A fell-back CPU round must not become the baseline for a healthy device
     run (a ~2000x vs_baseline is no signal at all), and vice versa — so
     rounds are compared like-for-like: cpu against cpu, device against
     device. Rounds without a backend label predate the CPU fallback and are
-    device numbers.
+    device numbers. A --prefetch-ab round records steps/sec under its own
+    metric name — comparing that against contexts/sec would be a
+    meaningless cross-unit ratio, so mismatched-metric rounds are skipped
+    (unlabeled legacy rounds count as the headline metric).
     """
     want_cpu = current_backend == "cpu"
+    want_metric = _metric_id()[0]
     best = None
     best_round = -1
     for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json")):
@@ -113,6 +168,9 @@ def _previous_benchmark(current_backend: str) -> float | None:
         except (json.JSONDecodeError, OSError):
             continue
         if not isinstance(payload, dict) or payload.get("rc", 0) != 0:
+            continue
+        recorded = _extract_metric_name(payload) or "path_contexts_per_sec_per_chip"
+        if recorded != want_metric:
             continue
         metric = _extract_metric(payload)
         if metric is None:
@@ -287,15 +345,7 @@ def _supervise() -> int:
         # (leading newline: the killed child may have left a partial line)
         sys.stdout.write("\n")
         print(
-            json.dumps(
-                {
-                    "metric": "path_contexts_per_sec_per_chip",
-                    "value": None,
-                    "unit": "contexts/sec",
-                    "vs_baseline": None,
-                    "error": f"supervisor terminated by signal {signum}",
-                }
-            ),
+            _failure_record(f"supervisor terminated by signal {signum}"),
             flush=True,
         )
         sys.stdout.flush()
@@ -331,7 +381,9 @@ def _supervise() -> int:
             # grandchild holding the tunnel — killing only the direct child
             # would orphan it as a stray concurrent tunnel client
             proc = subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__)],
+                # forward argv: mode flags (--prefetch-ab) select the
+                # measurement inside the supervised child
+                [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
                 env=env,
                 start_new_session=True,
             )
@@ -354,15 +406,7 @@ def _supervise() -> int:
                 return 0
             print(f"bench: attempt {i + 1} exited rc={last_rc}", file=sys.stderr, flush=True)
         print(
-            json.dumps(
-                {
-                    "metric": "path_contexts_per_sec_per_chip",
-                    "value": None,
-                    "unit": "contexts/sec",
-                    "vs_baseline": None,
-                    "error": f"all bench attempts failed (last rc={last_rc})",
-                }
-            ),
+            _failure_record(f"all bench attempts failed (last rc={last_rc})"),
             flush=True,
         )
         return 1
@@ -371,15 +415,7 @@ def _supervise() -> int:
         # have left a partial line — hence the leading newline)
         sys.stdout.write("\n")
         print(
-            json.dumps(
-                {
-                    "metric": "path_contexts_per_sec_per_chip",
-                    "value": None,
-                    "unit": "contexts/sec",
-                    "vs_baseline": None,
-                    "error": "supervisor interrupted (SIGINT)",
-                }
-            ),
+            _failure_record("supervisor interrupted (SIGINT)"),
             flush=True,
         )
         return 130
@@ -447,6 +483,212 @@ def _init_backend():
 
     jax.config.update("jax_platforms", "cpu")
     return jax, jax.default_backend(), True
+
+
+def _prefetch_ab() -> None:
+    """``--prefetch-ab``: sync-vs-prefetch A/B over the HOST input pipeline.
+
+    The headline bench measures the device-epoch path (corpus staged to
+    HBM); this mode measures the other feed — the host-epoch path that
+    multi-host runs and unstaged corpora use — where every step gathers a
+    ``[B, L]`` batch on host and transfers it. Three passes over identical
+    batches (same epoch, same per-arm shuffle seed): an ATTRIBUTED pass
+    (block_until_ready-fenced steps → host-build / H2D / compute split),
+    then a timed SYNCHRONOUS pass, then a timed PREFETCH pass
+    (train/prefetch.py, depth ``BENCH_PREFETCH``). The win lands as a
+    recorded A/B on one spec, not a claim: detail JSON carries both
+    steps/sec numbers and the attribution dict, and the metric line's
+    ``vs_baseline`` field is the prefetch/sync speedup.
+    """
+    jax, backend, fell_back = _init_backend()
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.pipeline import (
+        build_epoch,
+        build_method_epoch,
+        iter_batches,
+        iter_streaming_batches,
+    )
+    from code2vec_tpu.data.synth import (
+        SynthSpec,
+        corpus_data_from_raw,
+        generate_corpus_data,
+    )
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.prefetch import StepProfiler, device_batches
+    from code2vec_tpu.train.step import create_train_state, make_train_step
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    # recipe: top11 shape on a device backend; the CPU fallback shrinks the
+    # MODEL (not the host work) so the host-build/compute ratio stays
+    # representative of a device run — on CPU the full-size step is seconds
+    # of compute and any feed-side win would drown in run-to-run noise
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        if name in os.environ:
+            return int(os.environ[name])
+        return cpu_default if fell_back or backend == "cpu" else device_default
+
+    batch_size = knob("BENCH_BATCH", 1024, 256)
+    bag = knob("BENCH_BAG", 200, 64)
+    steps = knob("BENCH_AB_STEPS", 30, 24)
+    embed_size = knob("BENCH_EMBED", 100, 8)
+    encode_size = knob("BENCH_ENCODE", 100, 16)
+    depth = int(os.environ.get("BENCH_PREFETCH", 2))
+    attr_steps = int(os.environ.get("BENCH_PROFILE_STEPS", min(8, steps)))
+
+    # enough methods for `steps` full batches per arm. Vocab scale follows
+    # the backend: top11 on device; shrunk on CPU, where the dense Adam RMW
+    # over a 360k-row table is seconds of compute that the feed-side A/B is
+    # not about (host gather/pad cost is independent of vocab size)
+    spec = SynthSpec(
+        n_methods=max(batch_size * steps, 2048),
+        n_terminals=knob("BENCH_AB_TERMINALS", 360_631, 20_000),
+        n_paths=knob("BENCH_AB_PATHS", 342_845, 20_000),
+        n_labels=knob("BENCH_AB_LABELS", 8_000, 800),
+        mean_contexts=120.0,
+        max_contexts=400,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(data.label_vocab),
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,
+        dropout_prob=0.25,
+        dtype=jnp.float32,
+    )
+    config = TrainConfig(
+        batch_size=batch_size,
+        max_path_length=bag,
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
+    )
+
+    class_weights = jnp.ones(model_config.label_count, jnp.float32)
+    example = next(
+        iter_batches(
+            build_method_epoch(
+                data, np.arange(batch_size), bag, np.random.default_rng(0)
+            ),
+            batch_size,
+            rng=None,
+            pad_final=False,
+        )
+    )
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+    train_step = make_train_step(model_config, class_weights)
+    item_idx = np.arange(data.n_items)
+
+    def make_batches():
+        # the streaming feed (loop.py's java-large configuration): per-batch
+        # host work includes the chunked epoch CONSTRUCTION, i.e. exactly
+        # the gather/pad the prefetcher exists to overlap. Fresh iterator
+        # with a fixed seed per arm -> identical batches in identical order.
+        rng = np.random.default_rng(1)
+        return iter_streaming_batches(
+            lambda idx: build_epoch(data, idx, bag, rng, False),
+            item_idx,
+            batch_size,
+            rng,
+            chunk_items=batch_size * 2,
+        )
+
+    def to_device(batch):
+        # explicit placement so the transfer runs on the producer thread
+        # in the prefetch arm (jit would otherwise copy at dispatch)
+        return jax.device_put(batch)
+
+    def one_pass(prefetch: int, profiler=None, arm_steps: int = steps):
+        nonlocal state
+        done = 0
+        t0 = time.perf_counter()
+        with device_batches(
+            make_batches(), to_device, prefetch, profiler
+        ) as stream:
+            for _, device_batch in stream:
+                s0 = time.perf_counter()
+                new_state, loss = train_step(state, device_batch)
+                state = new_state
+                float(loss)  # per-step loss sync, mirroring train/loop.py
+                if profiler is not None and profiler.sampled(done):
+                    profiler.record_compute(
+                        done, (time.perf_counter() - s0) * 1e3
+                    )
+                done += 1
+                if done >= arm_steps:
+                    break
+        return done, time.perf_counter() - t0
+
+    # compile + cache warm (not timed)
+    one_pass(prefetch=0, arm_steps=2)
+
+    profiler = StepProfiler(attr_steps)
+    one_pass(prefetch=0, profiler=profiler, arm_steps=max(attr_steps, 1))
+    attribution = profiler.summary()
+
+    # ABBA-ordered repeats with a best-of (min-time) estimate per arm:
+    # ABBA cancels monotonic drift (frequency/cache warm-up makes later
+    # arms faster), and the min is robust to the slow outliers a shared
+    # host injects — both arms run identical batches, so min time is the
+    # cleanest view of each pipeline's attainable rate
+    repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 3)), 1)
+    sync_times: list[float] = []
+    pref_times: list[float] = []
+    sync_steps = steps
+    for _ in range(repeats):
+        sync_steps, t = one_pass(prefetch=0)
+        sync_times.append(t)
+        _, t = one_pass(prefetch=depth)
+        pref_times.append(t)
+        _, t = one_pass(prefetch=depth)
+        pref_times.append(t)
+        _, t = one_pass(prefetch=0)
+        sync_times.append(t)
+    sync_sps = sync_steps / min(sync_times)
+    pref_sps = sync_steps / min(pref_times)
+    speedup = pref_sps / sync_sps
+
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": backend,
+                    "mode": "prefetch_ab",
+                    "batch": batch_size,
+                    "bag": bag,
+                    "steps": sync_steps,
+                    "prefetch_depth": depth,
+                    "sync_steps_per_sec": round(sync_sps, 3),
+                    "prefetch_steps_per_sec": round(pref_sps, 3),
+                    "speedup": round(speedup, 4),
+                    "attribution": attribution,
+                }
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "host_pipeline_steps_per_sec",
+                "value": round(pref_sps, 3),
+                "unit": "steps/sec",
+                # in AB mode the baseline IS the same-spec synchronous arm
+                "vs_baseline": round(speedup, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
 
 
 def main() -> None:
@@ -614,13 +856,15 @@ def main() -> None:
         span = chunk * runner.per_shard
         valid = np.ones((runner.n_shards, span), np.float32)
 
-        def run(state, key):
+        def make_rows():
             # max(counts, 1): an empty shard (n_items < data_axis) still
             # needs a valid row bound; its rows are all-PAD row 0
-            rows = rng.integers(
+            return rng.integers(
                 0, np.maximum(staged.shard_counts[:, None], 1),
                 (runner.n_shards, span),
             ).astype(np.int32)
+
+        def run(state, key, rows):
             key, sub = jax.random.split(key)
             state, loss = run_chunk(
                 state, staged.contexts, staged.row_splits, staged.labels,
@@ -641,8 +885,10 @@ def main() -> None:
         run_chunk = runner._train_chunk(chunk)
         n_valid = chunk * batch_size
 
-        def run(state, key):
-            rows = rng.integers(0, data.n_items, n_valid).astype(np.int32)
+        def make_rows():
+            return rng.integers(0, data.n_items, n_valid).astype(np.int32)
+
+        def run(state, key, rows):
             key, sub = jax.random.split(key)
             state, loss = run_chunk(
                 state, staged.contexts, staged.row_splits, staged.labels,
@@ -657,16 +903,48 @@ def main() -> None:
     # and a compile-tainted (clearly labeled cpu) number beats none.
     min_warmup = 1 if fell_back else 2
     for _ in range(max(warmup, min_warmup)):
-        state, loss, key = run(state, key)
+        state, loss, key = run(state, key, make_rows())
     jax.block_until_ready(loss)
 
     n_chunks = -(-steps // chunk)
     steps = n_chunks * chunk
     t0 = time.perf_counter()
     for _ in range(n_chunks):
-        state, loss, key = run(state, key)
+        state, loss, key = run(state, key, make_rows())
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
+
+    # per-step attribution probe: a few FENCED chunks after the measured
+    # window (fencing must never taint the throughput number), splitting
+    # wall time into host row-gen / H2D / device compute — the breakdown
+    # three VERDICT rounds asked for behind the headline ms/step. Under a
+    # mesh the rows transfer is folded into the dispatch (an explicitly
+    # placed array would fight the chunk's in_shardings), flagged below.
+    attr_chunks = int(os.environ.get("BENCH_ATTR_CHUNKS", 3))
+    attribution = None
+    if attr_chunks > 0:
+        host_ms = h2d_ms = comp_ms = 0.0
+        for _ in range(attr_chunks):
+            a0 = time.perf_counter()
+            rows = make_rows()
+            a1 = time.perf_counter()
+            if mesh is None:
+                rows = jax.block_until_ready(jax.device_put(rows))
+            a2 = time.perf_counter()
+            state, loss, key = run(state, key, rows)
+            jax.block_until_ready(loss)
+            a3 = time.perf_counter()
+            host_ms += (a1 - a0) * 1e3
+            h2d_ms += (a2 - a1) * 1e3
+            comp_ms += (a3 - a2) * 1e3
+        denom = attr_chunks * chunk
+        attribution = {
+            "host_build_ms": round(host_ms / denom, 4),
+            "h2d_ms": round(h2d_ms / denom, 4),
+            "compute_ms": round(comp_ms / denom, 4),
+            "profiled_steps": denom,
+            "h2d_folded_into_compute": mesh is not None,
+        }
 
     # per-chip normalization keeps the metric comparable across mesh sizes
     # (a meshed run measures aggregate throughput over mesh.size chips)
@@ -699,6 +977,7 @@ def main() -> None:
                     "encoder_impl": model_config.encoder_impl,
                     "use_pallas": model_config.use_pallas,
                     "sample_prefetch": sample_prefetch,
+                    "attribution": attribution,
                 }
             }
         ),
@@ -723,21 +1002,13 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_SUPERVISED", "").strip() != "1":
         sys.exit(_supervise())
     try:
-        main()
+        if "--prefetch-ab" in sys.argv[1:]:
+            _prefetch_ab()
+        else:
+            main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver
         import traceback
 
         traceback.print_exc()
-        print(
-            json.dumps(
-                {
-                    "metric": "path_contexts_per_sec_per_chip",
-                    "value": None,
-                    "unit": "contexts/sec",
-                    "vs_baseline": None,
-                    "error": f"{type(exc).__name__}: {exc}",
-                }
-            ),
-            flush=True,
-        )
+        print(_failure_record(f"{type(exc).__name__}: {exc}"), flush=True)
         sys.exit(1)

@@ -167,6 +167,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="stream epochs in chunks of this many rows "
                         "instead of materializing [N, L] tensors (bounds "
                         "host RSS at java-large scale; 0 = materialize)")
+    parser.add_argument("--prefetch_batches", type=int, default=0,
+                        help="host-epoch input pipeline: build + transfer "
+                        "this many batches ahead of compute on a background "
+                        "thread (0 = synchronous; identical batches in "
+                        "identical order)")
+    parser.add_argument("--profile_steps", type=int, default=0,
+                        help="fence the first N train steps of each epoch "
+                        "and log the host-build / H2D / compute wall-time "
+                        "split (0 = off)")
     parser.add_argument("--device_chunk_batches", type=int, default=16,
                         help="batches per device-epoch dispatch")
     parser.add_argument("--shard_staged_corpus", action="store_true",
@@ -258,6 +267,8 @@ def config_from_args(args: argparse.Namespace):
         shard_staged_corpus=args.shard_staged_corpus,
         stream_chunk_items=args.stream_chunk_items,
         device_chunk_batches=args.device_chunk_batches,
+        prefetch_batches=args.prefetch_batches,
+        profile_steps=args.profile_steps,
     )
 
 

@@ -302,21 +302,34 @@ def iter_batches(
     dropped (training-style).
     """
     n = len(epoch)
-    order = rng.permutation(n) if rng is not None else np.arange(n)
+    order = rng.permutation(n) if rng is not None else None
     stop = n if pad_final else (n - n % batch_size)
     for lo in range(0, stop, batch_size):
-        idx = order[lo : lo + batch_size]
-        valid = len(idx)
-        if valid < batch_size:
-            idx = np.concatenate([idx, np.zeros(batch_size - valid, idx.dtype)])
+        hi = min(lo + batch_size, n)
+        valid = hi - lo
+        if order is None and valid == batch_size:
+            # sequential full batches (the eval path): contiguous slices are
+            # numpy VIEWS — skips the per-batch gather copy, which dominates
+            # eval's host-build time. Consumers never mutate batches.
+            def take(a, lo=lo, hi=hi):
+                return a[lo:hi]
+        else:
+            idx = order[lo:hi] if order is not None else np.arange(lo, hi)
+            if valid < batch_size:
+                idx = np.concatenate(
+                    [idx, np.zeros(batch_size - valid, idx.dtype)]
+                )
+
+            def take(a, idx=idx):
+                return a[idx]
         mask = np.zeros(batch_size, np.float32)
         mask[:valid] = 1.0
         yield {
-            "ids": epoch.ids[idx],
-            "starts": epoch.starts[idx],
-            "paths": epoch.paths[idx],
-            "ends": epoch.ends[idx],
-            "labels": epoch.labels[idx],
+            "ids": take(epoch.ids),
+            "starts": take(epoch.starts),
+            "paths": take(epoch.paths),
+            "ends": take(epoch.ends),
+            "labels": take(epoch.labels),
             "example_mask": mask,
         }
 

@@ -18,6 +18,7 @@ group's members replicas that load identical shards.
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 
@@ -28,6 +29,12 @@ from jax.sharding import Mesh
 from code2vec_tpu.parallel.shardings import batch_shardings
 
 logger = logging.getLogger(__name__)
+
+# the batch assemblers below run once per train/eval STEP (and, with
+# --prefetch_batches, on the input-pipeline producer thread) — rebuilding
+# the six NamedShardings per call is pure per-step host overhead, and the
+# layout is a function of the mesh alone. Mesh is hashable; memoize.
+_cached_batch_shardings = functools.lru_cache(maxsize=8)(batch_shardings)
 
 
 def initialize_from_env() -> bool:
@@ -63,8 +70,11 @@ def global_batch(mesh: Mesh, full_batch: dict[str, np.ndarray]) -> dict[str, jax
     ``make_array_from_callback`` lets each host serve exactly the slices its
     addressable devices need, for *any* batch sharding — data-sharded,
     replicated, or mixed — with no per-process divisibility constraint.
+
+    Process-local (no collective), so the prefetch producer thread
+    (train/prefetch.py) may call it off the main thread.
     """
-    shardings = batch_shardings(mesh)
+    shardings = _cached_batch_shardings(mesh)
     if jax.process_count() == 1:
         return {k: jax.device_put(v, shardings[k]) for k, v in full_batch.items()}
     return {
@@ -86,8 +96,12 @@ def local_to_global_batch(
     owns global rows [g*feed, (g+1)*feed); the processes replicating a
     group (model/ctx axes spanning processes) supply identical sub-batches
     for the same rows.
+
+    Process-local (``make_array_from_process_local_data`` assembles from
+    local blocks without a collective), so the prefetch producer thread
+    (train/prefetch.py) may call it off the main thread.
     """
-    shardings = batch_shardings(mesh)
+    shardings = _cached_batch_shardings(mesh)
     if jax.process_count() == 1:
         return {k: jax.device_put(v, shardings[k]) for k, v in local_batch.items()}
     return {

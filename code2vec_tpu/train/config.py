@@ -88,6 +88,18 @@ class TrainConfig:
     # of materializing the whole [N, L] epoch (0 = materialize). Bounds host
     # RSS at java-large scale — see docs/ARCHITECTURE.md memory budget
     stream_chunk_items: int = 0
+    # host-epoch input pipeline (train/prefetch.py): a background thread
+    # builds + transfers this many batches ahead of compute (0 = synchronous).
+    # Identical batches in the identical order — the overlap is free of
+    # semantic drift. The host pipeline is the only multi-host path, so this
+    # is also the pod-scale lever; device_epoch runs ignore it (they have
+    # their own on-device sample_prefetch).
+    prefetch_batches: int = 0
+    # step-time attribution (train/prefetch.py:StepProfiler): fence the
+    # first N train steps of each epoch with block_until_ready and log the
+    # host-build / H2D / device-compute split (0 = off). The first profiled
+    # step of a run includes XLA compile in compute_ms.
+    profile_steps: int = 0
 
     # checkpoint/resume (framework extension; the reference cannot resume,
     # SURVEY.md §5.4)

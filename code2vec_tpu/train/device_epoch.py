@@ -491,9 +491,10 @@ def shard_staged_multiprocess(
     groups_local = partition_items_balanced(counts, local_d)
 
     # one allgather settles everything cross-process: the global caps
-    # (uniform block shapes are a GLOBAL property) and every coord's
+    # (uniform block shapes are a GLOBAL property), every coord's
     # item/context counts (each process contributes its group's coords,
-    # zeros elsewhere). Packed so staging costs a single host barrier.
+    # zeros elsewhere), and the contributor's feed-group id. Packed so
+    # staging costs a single host barrier.
     local_items_cap = max((len(g) for g in groups_local), default=1)
     local_ctx_cap = max((int(counts[g].sum()) for g in groups_local), default=1)
     contrib = np.zeros(n_shards, np.int64)
@@ -505,29 +506,32 @@ def shard_staged_multiprocess(
         int(counts[g].sum()) for g in groups_local
     ]
     gathered = multihost_utils.process_allgather(np.concatenate([
-        np.asarray([local_items_cap, local_ctx_cap], np.int64),
+        np.asarray([local_items_cap, local_ctx_cap, group], np.int64),
         contrib, ctx_contrib,
-    ]))  # [n_processes, 2 + 2 * n_shards]
+    ]))  # [n_processes, 3 + 2 * n_shards]
     items_cap = max(int(gathered[:, 0].max()), 1)
     ctx_cap = max(int(gathered[:, 1].max()), 1)
     _check_shard_ctx_cap(ctx_cap, n_shards)
-    all_counts = gathered[:, 2 : 2 + n_shards]
-    all_ctx = gathered[:, 2 + n_shards :]
-    # replica processes of the same coords MUST have contributed identical
-    # counts — a mismatch means divergent staging (e.g. an rng seeded by
-    # process instead of by group), which would assemble silently wrong
-    # shards; catch it here where the invariant is cheap to check
-    for name, arr in (("item", all_counts), ("context", all_ctx)):
-        nonzero_disagree = (
-            (arr != arr.max(axis=0, keepdims=True)) & (arr != 0)
-        )
-        if nonzero_disagree.any():
-            raise ValueError(
-                f"feed-group replicas disagree on per-shard {name} counts "
-                f"({arr.tolist()}); group members must stage the SAME "
-                "shard with the SAME seed (seed the staging rng by feed "
-                "group, not by process)"
-            )
+    proc_groups = gathered[:, 2]
+    all_counts = gathered[:, 3 : 3 + n_shards]
+    all_ctx = gathered[:, 3 + n_shards :]
+    # replica processes of the same feed group MUST have contributed
+    # identical count vectors — a mismatch means divergent staging (e.g. an
+    # rng seeded by process instead of by group), which would assemble
+    # silently wrong shards. Exact per-group equality, NOT a nonzero
+    # heuristic: a replica staging zero items/contexts for a coord its
+    # group owns while a peer stages >0 is precisely the divergence this
+    # guard exists to catch.
+    for g in np.unique(proc_groups):
+        members = np.flatnonzero(proc_groups == g)
+        for name, arr in (("item", all_counts), ("context", all_ctx)):
+            if not (arr[members] == arr[members[0]][None, :]).all():
+                raise ValueError(
+                    f"feed-group {int(g)} replicas disagree on per-shard "
+                    f"{name} counts ({arr[members].tolist()}); group "
+                    "members must stage the SAME shard with the SAME seed "
+                    "(seed the staging rng by feed group, not by process)"
+                )
     shard_counts = all_counts.max(axis=0)
     total_contexts = int(all_ctx.max(axis=0).sum())
 

@@ -43,6 +43,7 @@ from code2vec_tpu.metrics import evaluate
 from code2vec_tpu.models.code2vec import Code2VecConfig
 from code2vec_tpu.sinks import MetricSink, logging_sink  # re-export: canonical home is sinks
 from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.prefetch import StepProfiler, device_batches
 from code2vec_tpu.train.step import (
     create_train_state,
     make_eval_step,
@@ -163,6 +164,39 @@ def class_weights_from(config: TrainConfig, data: CorpusData) -> jnp.ndarray:
     return jnp.asarray(1.0 / np.maximum(freq, 1.0))
 
 
+
+
+def _train_pass(
+    config: TrainConfig,
+    state,
+    train_step,
+    batches,
+    to_device,
+    profiler: StepProfiler | None = None,
+):
+    """One epoch of train steps over the host pipeline; returns
+    ``(state, train_loss)``.
+
+    ``config.prefetch_batches > 0`` feeds the steps from the background
+    double-buffered producer (train/prefetch.py): batch construction and
+    the ``to_device`` transfer run ahead of compute, with identical batches
+    in the identical order — the loss trajectory is bitwise that of the
+    synchronous path. ``profiler`` attributes per-step wall time into
+    host-build / H2D / compute buckets on its sampled steps.
+    """
+    train_loss = 0.0
+    step = 0
+    with device_batches(
+        batches, to_device, config.prefetch_batches, profiler
+    ) as stream:
+        for _, device_batch in stream:
+            t0 = time.perf_counter()
+            state, loss = train_step(state, device_batch)
+            train_loss += float(loss)  # blocks on the step's loss
+            if profiler is not None and profiler.sampled(step):
+                profiler.record_compute(step, (time.perf_counter() - t0) * 1e3)
+            step += 1
+    return state, train_loss
 
 
 def train(
@@ -312,14 +346,19 @@ def train(
 
         def to_device(batch):
             return local_to_global_batch(mesh, batch)
-    elif mesh is not None and n_hosts > 1:
+    elif mesh is not None:
+        # single- or multi-process: global_batch covers both (one process
+        # is a cached-sharding device_put). Explicit placement — vs letting
+        # jit copy at dispatch — means the prefetch producer starts the
+        # real H2D transfer ahead of compute and the profiler's h2d_ms
+        # measures it instead of silently folding it into compute_ms.
         from code2vec_tpu.parallel.distributed import global_batch
 
         def to_device(batch):
             return global_batch(mesh, batch)
     else:
         def to_device(batch):
-            return batch  # jit in_shardings place host arrays directly
+            return jax.device_put(batch)
 
     # every host must run the same number of (collective) steps; the split
     # is a random permutation, so per-group membership is hypergeometric —
@@ -471,6 +510,21 @@ def train(
     # refreshes metas from checkpoints that predate the field
     meta.vocab_pad_multiple = model_config.vocab_pad_multiple
 
+    # step-time attribution (train/prefetch.py): the host-pipeline loops
+    # stamp every step and fence the first --profile_steps train steps of
+    # each epoch; device-epoch runs dispatch whole chunks, so the per-step
+    # host/H2D/compute split does not apply there
+    profiler = None
+    if config.profile_steps > 0:
+        if use_device_epoch:
+            logger.warning(
+                "--profile_steps attributes the host input pipeline; "
+                "device-epoch mode dispatches fused chunks and is not "
+                "profiled per step"
+            )
+        else:
+            profiler = StepProfiler(config.profile_steps)
+
     f1 = 0.0
     start_epoch = meta.epoch
     epoch = start_epoch
@@ -480,6 +534,8 @@ def train(
             if profile_dir is not None and epoch == start_epoch + 1:
                 jax.profiler.start_trace(profile_dir)
             epoch_start = time.perf_counter()
+            if profiler is not None:
+                profiler.reset()
 
             train_epoch = None  # host epoch arrays, built lazily in device mode
             test_epoch = None
@@ -535,10 +591,10 @@ def train(
                     test_batches = pad_batch_stream(
                         test_batches, synced_steps(global_test), template
                     )
-                train_loss = 0.0
-                for batch in train_batches:
-                    state, loss = train_step(state, to_device(batch))
-                    train_loss += float(loss)
+                state, train_loss = _train_pass(
+                    config, state, train_step, train_batches, to_device,
+                    profiler,
+                )
                 test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
                     config, data, state, eval_step, test_batches, to_device,
                     gather_processes=sharded_feed,
@@ -561,10 +617,10 @@ def train(
                         synced_steps(global_train),
                         empty_batch(feed_batch, config.max_path_length),
                     )
-                train_loss = 0.0
-                for batch in train_batches:
-                    state, loss = train_step(state, to_device(batch))
-                    train_loss += float(loss)
+                state, train_loss = _train_pass(
+                    config, state, train_step, train_batches, to_device,
+                    profiler,
+                )
 
                 test_epoch = build_epoch(
                     data,
@@ -597,6 +653,18 @@ def train(
                 "f1": f1,
                 "epoch_seconds": time.perf_counter() - epoch_start,
             }
+            if profiler is not None:
+                attribution = profiler.summary()
+                if attribution is not None:
+                    metrics.update(attribution)
+                    logger.info(
+                        "step-time attribution (first %d train steps): "
+                        "host_build %.2f ms | h2d %.2f ms | compute %.2f ms",
+                        attribution["profiled_steps"],
+                        attribution["host_build_ms"],
+                        attribution["h2d_ms"],
+                        attribution["compute_ms"],
+                    )
             epochs_completed += 1
             meta.history.append({"epoch": epoch, **metrics})
             for sink in sinks:
@@ -773,17 +841,23 @@ def _evaluate_batches(
 
     test_loss = 0.0
     expected, actual = [], []
-    for batch in batches:
-        out = eval_step(state, to_device(batch))
-        test_loss += float(out["loss"])
-        valid = batch["example_mask"].astype(bool)
-        preds = allgather_to_host(out["preds"])
-        if gather_processes and len(preds) != len(valid):
-            feed = len(valid)
-            lo = feed_group[0] * feed
-            preds = preds[lo : lo + feed]
-        expected.append(batch["labels"][valid])
-        actual.append(preds[valid])
+    # the host batch rides along with its device placement so labels and
+    # the example mask stay host-side (no device round-trip); prefetching
+    # overlaps eval batch construction with the forward passes
+    with device_batches(
+        batches, to_device, config.prefetch_batches
+    ) as stream:
+        for batch, device_batch in stream:
+            out = eval_step(state, device_batch)
+            test_loss += float(out["loss"])
+            valid = batch["example_mask"].astype(bool)
+            preds = allgather_to_host(out["preds"])
+            if gather_processes and len(preds) != len(valid):
+                feed = len(valid)
+                lo = feed_group[0] * feed
+                preds = preds[lo : lo + feed]
+            expected.append(batch["labels"][valid])
+            actual.append(preds[valid])
     expected = np.concatenate(expected) if expected else np.zeros(0, np.int32)
     actual = np.concatenate(actual) if actual else np.zeros(0, np.int32)
     if gather_processes and _jax.process_count() > 1:

@@ -596,18 +596,23 @@ class TestParallelExtraction:
             dataset.mkdir()
             (dataset / "methods.txt").write_text("\n".join(rows) + "\n")
             result = extract_dataset(
-                str(dataset), str(src), extra_args=["--jobs", str(jobs)],
+                str(dataset), str(src), method_declarations="decls.txt",
+                extra_args=["--jobs", str(jobs)],
             )
-            blobs = {a: (dataset / a).read_bytes()
-                     for a in self.ARTIFACTS if a != "decls.txt"}
+            blobs = {a: (dataset / a).read_bytes() for a in self.ARTIFACTS}
             return blobs, result.stderr
 
         seq_blobs, seq_err = run("seq", jobs=1)
         par_blobs, par_err = run("par", jobs=4)
+        # decls.txt included: it dumps per-row method SOURCE, the artifact
+        # most exposed to the sub-group re-parse, so the split's
+        # invisibility must hold for it byte-for-byte too
         assert par_blobs == seq_blobs
         assert par_err == seq_err
         # every named row extracted, every missingN row warned, in order
         assert seq_blobs["corpus.txt"].count(b"label:pick") == 2050
+        # one "#<id>\t<file>#<name>" decl header per extracted row
+        assert seq_blobs["decls.txt"].count(b"Gen.java#pick\n") == 2050
         assert seq_err.count("WARNING: method not found.") == 2050
         first, last = seq_err.index("missing1\n"), seq_err.index("missing4099")
         assert first < last
