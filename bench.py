@@ -485,6 +485,32 @@ def _init_backend():
     return jax, jax.default_backend(), True
 
 
+def _bench_tracer(jax):
+    """BENCH_TRACE_DIR=<dir>: record the measurement as Chrome-trace spans
+    (obs/trace.py) — the staged/warmup/measure phases plus whatever the
+    instrumented layers (prefetch producer, epoch builds) emit. The whole
+    effect is the process-wide set_tracer install plus an atexit export —
+    so the trace survives a FAILING measurement too (the run most worth
+    inspecting). No-op when unset."""
+    trace_dir = os.environ.get("BENCH_TRACE_DIR", "").strip()
+    if not trace_dir:
+        return
+    import atexit
+
+    from code2vec_tpu.obs.trace import Tracer, set_tracer
+
+    tracer = Tracer(process_index=jax.process_index())
+    set_tracer(tracer)
+
+    def _export():
+        try:
+            tracer.export_dir(trace_dir)
+        except Exception:
+            pass  # never replace the bench's own exit path
+
+    atexit.register(_export)
+
+
 def _prefetch_ab() -> None:
     """``--prefetch-ab``: sync-vs-prefetch A/B over the HOST input pipeline.
 
@@ -501,6 +527,7 @@ def _prefetch_ab() -> None:
     ``vs_baseline`` field is the prefetch/sync speedup.
     """
     jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
     import jax.numpy as jnp
 
     from code2vec_tpu.data.pipeline import (
@@ -656,6 +683,8 @@ def _prefetch_ab() -> None:
     pref_sps = sync_steps / min(pref_times)
     speedup = pref_sps / sync_sps
 
+    from code2vec_tpu.obs.runtime import memory_snapshot
+
     print(
         json.dumps(
             {
@@ -670,6 +699,7 @@ def _prefetch_ab() -> None:
                     "prefetch_steps_per_sec": round(pref_sps, 3),
                     "speedup": round(speedup, 4),
                     "attribution": attribution,
+                    "memory": memory_snapshot(),
                 }
             }
         ),
@@ -693,6 +723,7 @@ def _prefetch_ab() -> None:
 
 def main() -> None:
     jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
     import jax.numpy as jnp
 
     from code2vec_tpu.data.pipeline import iter_batches, build_method_epoch
@@ -896,23 +927,27 @@ def main() -> None:
             )
             return state, loss, key
 
+    from code2vec_tpu.obs.trace import get_tracer
+
     key = jax.random.PRNGKey(1)
     # chunks, not steps; includes compile. Floor at 2 so the steady-state
     # window never starts on the compile chunk — except in the emergency
     # fallback, where every chunk counts against the supervisor's budget
     # and a compile-tainted (clearly labeled cpu) number beats none.
     min_warmup = 1 if fell_back else 2
-    for _ in range(max(warmup, min_warmup)):
-        state, loss, key = run(state, key, make_rows())
-    jax.block_until_ready(loss)
+    with get_tracer().span("bench_warmup", category="bench"):
+        for _ in range(max(warmup, min_warmup)):
+            state, loss, key = run(state, key, make_rows())
+        jax.block_until_ready(loss)
 
     n_chunks = -(-steps // chunk)
     steps = n_chunks * chunk
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        state, loss, key = run(state, key, make_rows())
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - t0
+    with get_tracer().span("bench_measure", category="bench", chunks=n_chunks):
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            state, loss, key = run(state, key, make_rows())
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
 
     # per-step attribution probe: a few FENCED chunks after the measured
     # window (fencing must never taint the throughput number), splitting
@@ -953,6 +988,10 @@ def main() -> None:
     previous = _previous_benchmark(backend)
     vs_baseline = contexts_per_sec / previous if previous else 1.0
 
+    from code2vec_tpu.obs.runtime import memory_snapshot
+
+    memory = memory_snapshot()
+
     # The driver captures the merged stdout/stderr stream and parses the LAST
     # JSON line into BENCH_rN.json's `parsed` field — so the detail line goes
     # first (stderr) and the headline metric is the final thing printed.
@@ -978,6 +1017,7 @@ def main() -> None:
                     "use_pallas": model_config.use_pallas,
                     "sample_prefetch": sample_prefetch,
                     "attribution": attribution,
+                    "memory": memory,
                 }
             }
         ),

@@ -219,6 +219,16 @@ def build_parser() -> argparse.ArgumentParser:
                         help="write a jax.profiler trace of epoch 2 here")
     parser.add_argument("--tensorboard_dir", type=str, default="runs",
                         help="scalar log dir for --env tensorboard")
+    parser.add_argument("--events_dir", type=str, default=None,
+                        help="write a per-process JSONL event log here "
+                             "(run manifest first, then typed epoch/"
+                             "step_sample/checkpoint/eval/recompile/error "
+                             "events — obs/events.py)")
+    parser.add_argument("--trace_dir", type=str, default=None,
+                        help="write a Chrome trace_event JSON here (spans "
+                             "from the extractor, input pipeline, prefetch "
+                             "producer, train/eval/checkpoint phases; view "
+                             "in Perfetto — obs/trace.py)")
     return parser
 
 
@@ -326,8 +336,6 @@ def main(argv: list[str] | None = None) -> None:
         logger.info("--gpu/--num_workers are no-ops on this framework: "
                     "JAX selects the device (current: %s)", _backend_name())
 
-    from code2vec_tpu.data.reader import load_corpus
-
     config = config_from_args(args)
     if args.synthetic is not None:
         import tempfile
@@ -345,6 +353,61 @@ def main(argv: list[str] | None = None) -> None:
         args.corpus_path = paths["corpus"]
         args.path_idx_path = paths["path_idx"]
         args.terminal_idx_path = paths["terminal_idx"]
+
+    # telemetry (code2vec_tpu.obs): installed BEFORE corpus load so the
+    # data-layer spans (native parse, epoch builds) land in the trace; the
+    # CLI owns the lifecycle (train() writes the manifest, we export/close)
+    events, tracer = _telemetry_from_args(args)
+    try:
+        _run(args, config, events, tracer)
+    finally:
+        # best-effort: a failing export/close must neither mask the real
+        # exception unwinding through here nor skip the remaining cleanup
+        if tracer is not None:
+            from code2vec_tpu.obs.trace import set_tracer
+
+            set_tracer(None)  # back to the inert NullTracer
+            try:
+                path = tracer.export_dir(args.trace_dir)
+                logger.info(
+                    "chrome trace written to %s — open in Perfetto "
+                    "(ui.perfetto.dev) or chrome://tracing", path)
+            except Exception:
+                logger.warning(
+                    "could not write chrome trace to %s", args.trace_dir,
+                    exc_info=True)
+        if events is not None:
+            if events.path is not None:
+                logger.info("event log written to %s", events.path)
+            try:
+                events.close()
+            except Exception:
+                logger.warning("could not close event log", exc_info=True)
+
+
+def _telemetry_from_args(args: argparse.Namespace):
+    """(EventLog | None, Tracer | None) from --events_dir / --trace_dir.
+    The Tracer is also installed process-wide (obs.trace.set_tracer) so
+    instrumented layers pick it up via get_tracer()."""
+    # neither constructor touches the JAX backend (process indices resolve
+    # lazily at first write/export) — multi-host runs must reach
+    # jax.distributed.initialize with the backend still uninitialized
+    events = tracer = None
+    if args.events_dir:
+        from code2vec_tpu.obs.events import EventLog
+
+        events = EventLog(args.events_dir)
+    if args.trace_dir:
+        from code2vec_tpu.obs.trace import Tracer, set_tracer
+
+        tracer = Tracer()
+        set_tracer(tracer)
+    return events, tracer
+
+
+def _run(args: argparse.Namespace, config, events, tracer) -> None:
+    from code2vec_tpu.data.reader import load_corpus
+
     shard = None
     if args.host_shard_corpus:
         import jax
@@ -397,7 +460,7 @@ def main(argv: list[str] | None = None) -> None:
 
         study = find_optimal_hyperparams(
             data, config, n_trials=args.num_trials, seed=args.random_seed,
-            sampler=args.hpo_sampler)
+            sampler=args.hpo_sampler, events=events)
         best = study.best_trial
         logger.info("Number of finished trials: %d", len(study.trials))
         logger.info("Best trial value: %s", best.value)
@@ -430,6 +493,8 @@ def main(argv: list[str] | None = None) -> None:
         test_result_path=args.test_result_path,
         sinks=sinks_from_args(args),
         profile_dir=args.profile_dir,
+        events=events,
+        tracer=tracer,
     )
     logger.info("done: best_f1=%s after %d epochs", result.best_f1,
                 result.epochs_run)

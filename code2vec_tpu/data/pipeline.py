@@ -27,6 +27,7 @@ import numpy as np
 
 from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX
 from code2vec_tpu.data.reader import CorpusData
+from code2vec_tpu.obs.trace import get_tracer
 
 
 @dataclass
@@ -114,6 +115,17 @@ def build_method_epoch(
     method's own ``@method_0`` token replaced by ``@question``
     (model/dataset_builder.py:122-150)."""
     n = len(item_idx)
+    with get_tracer().span("build_method_epoch", category="data", items=n):
+        return _build_method_epoch(data, item_idx, max_contexts, rng)
+
+
+def _build_method_epoch(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    max_contexts: int,
+    rng: np.random.Generator,
+) -> EpochArrays:
+    n = len(item_idx)
     flat, row, col = _segment_subsample(data.row_splits, item_idx, max_contexts, rng)
 
     starts = np.full((n, max_contexts), PAD_INDEX, np.int32)
@@ -180,6 +192,21 @@ def build_variable_epoch(
     vectorized inner ops; corpora are method-bounded so this is not the
     per-context hot path.
     """
+    with get_tracer().span(
+        "build_variable_epoch", category="data", items=len(item_idx)
+    ):
+        return _build_variable_epoch(
+            data, item_idx, max_contexts, rng, shuffle_variable_indexes
+        )
+
+
+def _build_variable_epoch(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    max_contexts: int,
+    rng: np.random.Generator,
+    shuffle_variable_indexes: bool = False,
+) -> EpochArrays:
     variable_indexes = data.variable_indexes
     perm_map = None
     if not shuffle_variable_indexes and len(variable_indexes):
@@ -377,7 +404,11 @@ def iter_streaming_batches(
         return rest
 
     for lo in range(0, len(order), chunk_items):
-        chunk = epoch_builder(item_idx[order[lo : lo + chunk_items]])
+        chunk_idx = item_idx[order[lo : lo + chunk_items]]
+        with get_tracer().span(
+            "stream_chunk", category="data", items=len(chunk_idx)
+        ):
+            chunk = epoch_builder(chunk_idx)
         if carry is not None and len(carry):
             chunk = _concat_epochs([carry, chunk])
         final = lo + chunk_items >= len(order)

@@ -97,16 +97,19 @@ def build_extractor(force: bool = False) -> str:
             f"{os.path.join(_PKG_DIR, '_native')}); reinstall the package "
             "from a wheel built with setup.py, or run from a repo checkout"
         )
-    os.makedirs(build_dir, exist_ok=True)
-    generator = ["-G", "Ninja"] if shutil.which("ninja") else []
-    subprocess.run(
-        ["cmake", "-S", src_dir, "-B", build_dir, *generator],
-        check=True,
-        capture_output=True,
-    )
-    subprocess.run(
-        ["cmake", "--build", build_dir], check=True, capture_output=True
-    )
+    from code2vec_tpu.obs.trace import get_tracer
+
+    with get_tracer().span("extractor_build", category="extract"):
+        os.makedirs(build_dir, exist_ok=True)
+        generator = ["-G", "Ninja"] if shutil.which("ninja") else []
+        subprocess.run(
+            ["cmake", "-S", src_dir, "-B", build_dir, *generator],
+            check=True,
+            capture_output=True,
+        )
+        subprocess.run(
+            ["cmake", "--build", build_dir], check=True, capture_output=True
+        )
     return binary
 
 
@@ -232,6 +235,8 @@ def extract_dataset(
     extra_args: list[str] = (),
 ) -> subprocess.CompletedProcess:
     """Run the CLI over <dataset_dir>/methods.txt (createDataset parity)."""
+    from code2vec_tpu.obs.trace import get_tracer
+
     cmd = [
         build_extractor(),
         dataset_dir,
@@ -244,7 +249,10 @@ def extract_dataset(
     if method_declarations:
         cmd += ["--method-declarations", method_declarations]
     cmd += list(extra_args)
-    return subprocess.run(cmd, check=True, capture_output=True, text=True)
+    with get_tracer().span(
+        "extract_dataset", category="extract", dataset_dir=dataset_dir
+    ):
+        return subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
 class _C2vCorpus(ctypes.Structure):
@@ -275,13 +283,16 @@ def parse_corpus_native(path: str):
     """
     import numpy as np
 
+    from code2vec_tpu.obs.trace import get_tracer
+
     lib = _load_library()
     if not hasattr(lib.c2v_parse_corpus, "_configured"):
         lib.c2v_parse_corpus.restype = ctypes.POINTER(_C2vCorpus)
         lib.c2v_parse_corpus.argtypes = [ctypes.c_char_p]
         lib.c2v_free_corpus.argtypes = [ctypes.POINTER(_C2vCorpus)]
         lib.c2v_parse_corpus._configured = True
-    ptr = lib.c2v_parse_corpus(os.fspath(path).encode())
+    with get_tracer().span("parse_corpus_native", category="extract"):
+        ptr = lib.c2v_parse_corpus(os.fspath(path).encode())
     if not ptr:
         raise RuntimeError(
             "native corpus parse failed: "

@@ -349,6 +349,7 @@ def find_optimal_hyperparams(
     seed: int = 0,
     pruner: MedianPruner | None = None,
     sampler: TPESampler | RandomSampler | str | None = None,
+    events=None,
 ) -> Study:
     """The ``--find_hyperparams`` entry (reference: main.py:429-488).
 
@@ -357,12 +358,26 @@ def find_optimal_hyperparams(
     objective value is ``1 - best_f1``. Checkpoint/vector export is
     suppressed during search, as in the reference (``trial is not None``
     guards, main.py:226-231).
+
+    ``events``: a shared ``obs.events.EventLog`` for the whole search.
+    The manifest is written once, up front, with the BASE config; each
+    trial then opens with a ``trial`` event carrying its number and
+    sampled params — events are strictly ordered, so everything between
+    one ``trial`` marker and the next belongs to that trial — and closes
+    with a ``trial_result`` event (state + objective value).
     """
     from code2vec_tpu.train.loop import StopTraining, train
+
+    if events is not None:
+        events.write_manifest(
+            config=base_config, search={"n_trials": n_trials, "seed": seed}
+        )
 
     def objective(trial: Trial) -> float:
         config = sample_train_config(trial, base_config)
         logger.info("trial %d config: %s", trial.number, trial.params)
+        if events is not None:
+            events.emit("trial", number=trial.number, params=dict(trial.params))
         pruned = False
 
         def report_fn(epoch: int, f1: float) -> None:
@@ -372,7 +387,14 @@ def find_optimal_hyperparams(
                 pruned = True
                 raise StopTraining  # caught by the train loop; ends the run
 
-        result = train(config, data, report_fn=report_fn)
+        result = train(config, data, report_fn=report_fn, events=events)
+        if events is not None:
+            events.emit(
+                "trial_result",
+                number=trial.number,
+                state="pruned" if pruned else "complete",
+                value=1.0 - result.best_f1,
+            )
         if pruned:
             raise TrialPruned
         return 1.0 - result.best_f1

@@ -1,0 +1,44 @@
+"""Run-level telemetry: structured events, span tracing, runtime health.
+
+Three small, stdlib-only layers (no accelerator coupling — safe to import
+before a backend exists):
+
+- :mod:`code2vec_tpu.obs.events` — a per-process JSONL event log opened with
+  a run manifest, followed by typed events (``epoch``, ``step_sample``,
+  ``checkpoint_saved``, ``eval``, ``recompile``, ``error``). The metric
+  sinks (``code2vec_tpu.sinks``) are consumers of the SAME stream, so the
+  epoch metrics a sink reports and the event log records cannot disagree.
+- :mod:`code2vec_tpu.obs.trace` — a thread-safe span API
+  (``with tracer.span("host_build"): ...``) exportable as a Chrome
+  ``trace_event`` JSON viewable in Perfetto / ``chrome://tracing``, with
+  per-process tracks for multi-host runs.
+- :mod:`code2vec_tpu.obs.runtime` — a counters/gauges registry, a
+  ``jax.jit`` recompile detector, and a host/device memory sampler.
+
+Surfaced as ``--events_dir`` / ``--trace_dir`` on the training CLI and
+``BENCH_TRACE_DIR`` on the benchmark.
+"""
+
+from code2vec_tpu.obs.events import EventLog, metric_record, run_manifest, sink_consumer
+from code2vec_tpu.obs.runtime import (
+    RecompileDetector,
+    RuntimeHealth,
+    host_rss_bytes,
+    memory_snapshot,
+)
+from code2vec_tpu.obs.trace import NullTracer, Tracer, get_tracer, set_tracer
+
+__all__ = [
+    "EventLog",
+    "metric_record",
+    "run_manifest",
+    "sink_consumer",
+    "NullTracer",
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "RecompileDetector",
+    "RuntimeHealth",
+    "host_rss_bytes",
+    "memory_snapshot",
+]

@@ -1,0 +1,304 @@
+"""Structured run-level event log: one JSONL file per process.
+
+The log opens with a **run manifest** (run id, config dict, process
+index/count, mesh shape, device kind, package version) and then carries
+typed events with monotonic timestamps. Schema of every line::
+
+    {"event": <type>, "seq": N, "t_ms": <monotonic ms since log open>,
+     "unix_time": <wall clock>, ...event fields...}
+
+``seq`` is strictly increasing per process — emitters on other threads
+(the prefetch producer, HPO callbacks) serialize through one lock, so the
+file order IS the emission order.
+
+Event types written by the train loop (``train/loop.py``): ``manifest``,
+``epoch`` (the full per-epoch metrics dict + a memory snapshot),
+``best_f1``, ``step_sample`` (per profiled step: host-build / H2D /
+compute ms), ``eval``, ``checkpoint_saved``, ``recompile``
+(obs.runtime.RecompileDetector), ``error``.
+
+**Sinks are consumers of this stream**: ``sink_consumer`` adapts the
+``(epoch, metrics)`` metric sinks (``code2vec_tpu.sinks``) into an event
+consumer, and the train loop emits metrics ONLY as events — so the sink
+output and the event log derive from the same dict and cannot disagree.
+Consumers receive the raw (unsanitized) event; the file gets the
+strict-JSON form: non-finite floats serialize as ``null`` plus a sibling
+``<key>_raw`` string (see :func:`metric_record` for the per-metric-line
+shape the sinks use).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import uuid
+from typing import Callable
+
+__all__ = ["EventLog", "metric_record", "run_manifest", "sink_consumer"]
+
+
+def resolve_process_index() -> int:
+    """This process's index in the pod, 0 when no backend is available.
+    THE one lazy probe shared by the event-log filename, the tracer's pid
+    track, and the manifest fallback — so the three artifacts can never
+    disagree on which process they label."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _scalar(value):
+    """Unwrap numpy scalars (``np.float32`` etc.) to Python scalars; pass
+    everything else through."""
+    if hasattr(value, "item") and not isinstance(
+        value, (str, bytes, dict, list, tuple)
+    ):
+        try:
+            return value.item()
+        except (TypeError, ValueError):
+            return value
+    return value
+
+
+def sanitize(obj):
+    """Recursively convert ``obj`` into strict-JSON values.
+
+    ``json.dumps`` happily prints bare ``NaN``/``Infinity`` — tokens the
+    JSON grammar does not have, which strict parsers reject. Non-finite
+    floats become ``null``; inside dicts a sibling ``<key>_raw`` string
+    (``"nan"`` / ``"inf"`` / ``"-inf"``) preserves the original value.
+    Unknown objects fall back to ``str``.
+    """
+    if isinstance(obj, dict):
+        out = {}
+        for key, value in obj.items():
+            key = str(key)
+            value = _scalar(value)
+            if isinstance(value, float) and not math.isfinite(value):
+                out[key] = None
+                out[key + "_raw"] = repr(value)
+            else:
+                out[key] = sanitize(value)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [sanitize(v) for v in obj]
+    obj = _scalar(obj)
+    if obj is None or isinstance(obj, (str, int, bool)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    return str(obj)
+
+
+def metric_record(name: str, value) -> dict:
+    """The ``{"metric": name, "value": value}`` line shape the floyd and
+    logging sinks emit, made strict-JSON: a non-finite value serializes as
+    ``null`` with the original preserved in a string ``"raw"`` field."""
+    value = _scalar(value)
+    record = {"metric": name, "value": value}
+    if isinstance(value, float) and not math.isfinite(value):
+        record["value"] = None
+        record["raw"] = repr(value)
+    return record
+
+
+def _shared_run_id(process_count: int) -> str:
+    """One run id for the whole run. ``C2V_RUN_ID`` pins it; otherwise a
+    timestamped random id — BROADCAST from process 0 on multi-host runs
+    (clock skew and per-process uuids would otherwise give one pod run N
+    uncorrelatable ids across its per-process logs/traces). Safe as a
+    collective: every process writes its manifest at the same point of
+    train(). Falls back to a local id if the broadcast fails."""
+    pinned = os.environ.get("C2V_RUN_ID")
+    if pinned:
+        return pinned
+    run_id = f"{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:8]}"
+    if process_count > 1:
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            raw = np.frombuffer(
+                run_id.encode("ascii").ljust(32, b" ")[:32], dtype=np.uint8
+            )
+            raw = np.asarray(multihost_utils.broadcast_one_to_all(raw))
+            run_id = raw.tobytes().decode("ascii").strip()
+        except Exception:  # pragma: no cover - exotic backend
+            pass
+    return run_id
+
+
+def run_manifest(config=None, mesh=None, **extra) -> dict:
+    """Collect the run manifest: package version, process identity, device
+    kind, mesh shape, and the config as a plain dict.
+
+    Imports jax lazily — by the time anything writes a manifest the
+    backend is up (the caller is the train loop / bench), and keeping the
+    import out of module scope lets tests build logs without a backend.
+    """
+    import dataclasses
+
+    import code2vec_tpu
+
+    manifest = {
+        "package": "code2vec-tpu",
+        "package_version": code2vec_tpu.__version__,
+        "started_unix": time.time(),
+    }
+    try:
+        from code2vec_tpu.parallel.distributed import process_info
+
+        manifest.update(process_info())
+    except Exception:  # pragma: no cover - no backend available
+        manifest.update(
+            {"process_index": resolve_process_index(), "process_count": 1}
+        )
+    manifest["run_id"] = _shared_run_id(manifest["process_count"])
+    if mesh is not None:
+        manifest["mesh_shape"] = dict(mesh.shape)
+    else:
+        manifest["mesh_shape"] = None
+    if config is not None:
+        if dataclasses.is_dataclass(config):
+            config = dataclasses.asdict(config)
+        manifest["config"] = dict(config)
+    manifest.update(extra)
+    return manifest
+
+
+class EventLog:
+    """Thread-safe JSONL event log + in-process event dispatcher.
+
+    ``events_dir=None`` builds a dispatch-only log (no file): the train
+    loop always emits through an EventLog so sinks stay consumers of the
+    event stream whether or not ``--events_dir`` was given.
+
+    The file opens lazily on the first emit, in APPEND mode: constructing
+    a log never touches the JAX backend (the lazy ``process_index``
+    resolution must not pre-empt ``jax.distributed.initialize`` on
+    multi-host runs), and a ``--resume``d run extends the previous run's
+    log — its new manifest line marks the new segment — instead of
+    truncating the recorded history.
+    """
+
+    def __init__(
+        self,
+        events_dir: str | None = None,
+        process_index: int | None = None,
+        run_id: str | None = None,
+    ):
+        self.process_index = process_index
+        self.run_id = run_id
+        self.path: str | None = None
+        self._events_dir = events_dir
+        self._file = None
+        self._closed = False
+        # RLock: a consumer may emit follow-up events from inside dispatch
+        self._lock = threading.RLock()
+        self._consumers: list[Callable[[dict], None]] = []
+        self._seq = 0
+        self._t0 = time.monotonic()
+        self._manifest_written = False
+
+    def _ensure_open(self):
+        """Open the per-process JSONL on first use (append mode)."""
+        if self._events_dir is None or self._closed or self._file is not None:
+            return self._file
+        if self.process_index is None:
+            self.process_index = resolve_process_index()
+        os.makedirs(self._events_dir, exist_ok=True)
+        self.path = os.path.join(
+            self._events_dir, f"events-p{self.process_index}.jsonl"
+        )
+        self._file = open(self.path, "a", encoding="utf-8")
+        return self._file
+
+    @property
+    def observed(self) -> bool:
+        """Whether emissions go anywhere — a backing file or at least one
+        consumer. The train loop skips manifest construction (which
+        includes a cross-host run-id broadcast on pods) when nobody would
+        see it."""
+        return self._events_dir is not None or bool(self._consumers)
+
+    # ---- consumers -----------------------------------------------------
+    def subscribe(self, consumer: Callable[[dict], None]) -> Callable:
+        """Register ``consumer(event_dict)``; returns it for unsubscribe."""
+        with self._lock:
+            self._consumers.append(consumer)
+        return consumer
+
+    def unsubscribe(self, consumer: Callable[[dict], None]) -> None:
+        with self._lock:
+            if consumer in self._consumers:
+                self._consumers.remove(consumer)
+
+    # ---- emission ------------------------------------------------------
+    def emit(self, event: str, **fields) -> dict:
+        """Append one typed event; dispatch the RAW record to consumers,
+        write the sanitized strict-JSON form to the file. Serialized under
+        one lock so file order == emission order across threads."""
+        with self._lock:
+            record = {
+                "event": event,
+                "seq": self._seq,
+                "t_ms": round((time.monotonic() - self._t0) * 1e3, 3),
+                "unix_time": time.time(),
+                **fields,
+            }
+            self._seq += 1
+            out = self._ensure_open()
+            if out is not None:
+                out.write(json.dumps(sanitize(record), allow_nan=False) + "\n")
+                out.flush()  # events are low-rate; survive crashes
+            for consumer in tuple(self._consumers):
+                consumer(record)
+        return record
+
+    def write_manifest(self, config=None, mesh=None, **extra) -> dict | None:
+        """Emit the run manifest as the log's first event (idempotent —
+        only the first call writes)."""
+        with self._lock:
+            if self._manifest_written:
+                return None
+            self._manifest_written = True
+            manifest = run_manifest(config=config, mesh=mesh, **extra)
+            if self.run_id is None:
+                self.run_id = manifest["run_id"]
+            else:
+                manifest["run_id"] = self.run_id
+            return self.emit("manifest", **manifest)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+
+def sink_consumer(sinks) -> Callable[[dict], None]:
+    """Adapt ``(epoch, metrics)`` metric sinks into an event consumer.
+
+    ``epoch`` and ``best_f1`` events carry an ``epoch`` + ``metrics`` pair;
+    each registered sink sees exactly the dict the event was emitted with
+    (NaNs intact — strict-JSON handling is each sink's own concern)."""
+
+    def consume(event: dict) -> None:
+        if event.get("event") in ("epoch", "best_f1") and "metrics" in event:
+            for sink in sinks:
+                sink(event["epoch"], event["metrics"])
+
+    return consume

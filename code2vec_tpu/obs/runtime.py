@@ -1,0 +1,224 @@
+"""Runtime health: counters/gauges, recompile detection, memory sampling.
+
+Two detectors wired into the train loop (``train/loop.py``):
+
+- :class:`RecompileDetector` — reads each tracked jitted step function's
+  ``jax.jit`` cache size (``fn._cache_size()``) at epoch boundaries. The
+  first observation is the warmup baseline (the expected initial compile);
+  any later growth means batch-shape/dtype churn recompiled the step —
+  counted, logged as a warning, and emitted as a ``recompile`` event.
+  Steady-shape runs report 0 recompiles after warmup.
+- :func:`memory_snapshot` — host RSS (``/proc/self/statm``; peak via
+  ``resource``) always, plus ``device.memory_stats()`` where the backend
+  implements it (TPU/GPU; CPU returns None). Recorded into the ``epoch``
+  event and ``bench.py``'s detail JSON.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "RuntimeHealth",
+    "RecompileDetector",
+    "host_rss_bytes",
+    "device_memory_stats",
+    "memory_snapshot",
+]
+
+
+class Counter:
+    """A monotonically increasing count (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """A last-written-wins measurement (thread-safe by assignment)."""
+
+    def __init__(self) -> None:
+        self._value: float | None = None
+
+    def set(self, value) -> None:
+        self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+
+class RuntimeHealth:
+    """Named counters/gauges registry; one per run, snapshot on demand."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+            }
+
+
+class RecompileDetector:
+    """Count post-warmup ``jax.jit`` cache misses per tracked step function.
+
+    The jitted train/eval steps are traced once per (shape, dtype)
+    signature; static batch shapes are the suite's invariant (SURVEY §7).
+    A growing cache after the first observation means something is feeding
+    shape-churned batches — each growth is a silent recompile costing
+    seconds. ``track`` ignores functions without a ``_cache_size`` probe
+    (injected non-jitted steps), so wiring is unconditional.
+    """
+
+    def __init__(self, events=None, health: RuntimeHealth | None = None):
+        self._events = events
+        self._counter = (
+            health.counter("recompiles") if health is not None else Counter()
+        )
+        # name -> [fn, last observed cache size or None (pre-warmup)]
+        self._tracked: dict[str, list] = {}
+
+    def track(self, name: str, fn):
+        if callable(getattr(fn, "_cache_size", None)):
+            self._tracked[name] = [fn, None]
+        return fn
+
+    @property
+    def recompile_count(self) -> int:
+        return self._counter.value
+
+    def check(self, epoch: int | None = None) -> int:
+        """Observe every tracked function once; returns the number of NEW
+        post-warmup compiles found this check."""
+        new = 0
+        for name, slot in self._tracked.items():
+            fn, last = slot
+            try:
+                size = int(fn._cache_size())
+            except Exception:  # pragma: no cover - probe API drift
+                continue
+            if last is None:
+                slot[1] = size  # warmup: the expected initial compile(s)
+                continue
+            if size > last:
+                delta = size - last
+                new += delta
+                self._counter.inc(delta)
+                # also a zero-duration mark on the trace timeline, so the
+                # recompile is visible next to the step spans it stalled
+                from code2vec_tpu.obs.trace import get_tracer
+
+                get_tracer().instant(
+                    "recompile", category="health", fn=name, delta=delta
+                )
+                logger.warning(
+                    "recompile detected: %s jit cache grew %d -> %d "
+                    "(batch shape/dtype churn?); each recompile stalls the "
+                    "step for the full XLA compile",
+                    name,
+                    last,
+                    size,
+                )
+                if self._events is not None:
+                    fields = {"fn": name, "cache_size": size, "delta": delta}
+                    if epoch is not None:
+                        fields["epoch"] = epoch
+                    self._events.emit("recompile", **fields)
+                slot[1] = size
+        return new
+
+
+def host_rss_bytes() -> int | None:
+    """Current resident set size, or None off-Linux."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):  # pragma: no cover - non-Linux
+        return None
+
+
+def _host_peak_rss_bytes() -> int | None:
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports ru_maxrss in KiB; macOS/BSD report bytes
+        return peak * 1024 if sys.platform.startswith("linux") else peak
+    except Exception:  # pragma: no cover - platform without resource
+        return None
+
+
+def device_memory_stats() -> dict | None:
+    """Aggregate ``memory_stats()`` over local devices; None when the
+    backend doesn't report (CPU) or jax isn't up yet."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        # inside the guard: some backends raise (UNIMPLEMENTED) instead of
+        # returning None, and the per-epoch sampler must never kill a run
+        stats = [d.memory_stats() for d in devices]
+    except Exception:
+        return None
+    if any(s is None for s in stats):
+        return None
+    out = {
+        "device_kind": devices[0].device_kind,
+        "n_devices": len(devices),
+    }
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        values = [s.get(key) for s in stats]
+        if all(v is not None for v in values):
+            out[key] = int(sum(values))
+    return out
+
+
+def memory_snapshot(health: RuntimeHealth | None = None) -> dict:
+    """One host+device memory sample; mirrors into ``health`` gauges when
+    given. Called at epoch boundaries and from bench.py's detail block."""
+    snap: dict = {
+        "host_rss_bytes": host_rss_bytes(),
+        "host_peak_rss_bytes": _host_peak_rss_bytes(),
+    }
+    device = device_memory_stats()
+    if device is not None:
+        snap["device"] = device
+    if health is not None:
+        for key in ("host_rss_bytes", "host_peak_rss_bytes"):
+            if snap[key] is not None:
+                health.gauge(key).set(snap[key])
+        if device is not None and "bytes_in_use" in device:
+            health.gauge("device_bytes_in_use").set(device["bytes_in_use"])
+    return snap

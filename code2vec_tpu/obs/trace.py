@@ -1,0 +1,212 @@
+"""Span tracing with Chrome ``trace_event`` export.
+
+``with tracer.span("host_build", queue_depth=2): ...`` records a complete
+("X"-phase) event — name, start, duration, process/thread track, args —
+into an in-memory buffer; :meth:`Tracer.export_dir` writes the standard
+Chrome trace JSON (``{"traceEvents": [...]}``), loadable in Perfetto or
+``chrome://tracing``. Multi-host runs write one file per process
+(``trace-p<i>.json``) whose events carry ``pid = process_index`` plus a
+``process_name`` metadata event, so merged traces keep one track per host.
+
+Instrumented layers fetch the process-wide tracer via :func:`get_tracer`
+(the extractor, ``data/pipeline.py``, the prefetch producer thread,
+``train/loop.py``, ``bench.py``); with no tracer installed they get the
+:class:`NullTracer`, whose ``span`` returns a shared ``nullcontext`` —
+cheap enough for per-batch call sites.
+
+Thread-safe: spans may close concurrently on any thread (the prefetch
+producer records ``host_build``/``h2d`` while the main thread records
+``train_step``); each thread gets its own trace row (``tid``), named after
+``threading.Thread.name`` via ``thread_name`` metadata events.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from code2vec_tpu.obs.events import sanitize
+
+__all__ = ["NullTracer", "Tracer", "get_tracer", "set_tracer"]
+
+
+class Tracer:
+    """Collect spans; export once at end of run.
+
+    ``process_index=None`` (the default) defers resolution to export time
+    (``jax.process_index()``): a tracer is per-process so the pid is one
+    value, and resolving it lazily means constructing a tracer never
+    initializes the JAX backend — which must not happen before
+    ``jax.distributed.initialize`` on multi-host runs.
+
+    ``max_events`` bounds memory on very long runs (a java-large epoch is
+    ~16k steps; per-batch producer spans add up). Overflow is counted, not
+    silent: the exported JSON carries ``dropped_events`` metadata.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        process_index: int | None = None,
+        process_name: str | None = None,
+        max_events: int = 1_000_000,
+    ):
+        self.process_index = process_index
+        self.process_name = process_name
+        self.max_events = int(max_events)
+        self._events: list[dict] = []
+        # (os thread ident, thread name) -> synthetic trace tid. CPython
+        # reuses idents as soon as a thread dies — and the prefetcher
+        # spawns a fresh producer per epoch — so the raw ident would let a
+        # later thread inherit a dead stranger's track label; keying by
+        # (ident, name) gives every distinctly-named occupant its own row
+        self._tids: dict[tuple[int, str], int] = {}
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        # wall-clock anchor for the monotonic span clock: exported ts are
+        # µs since the unix epoch, so per-host trace files land on one
+        # shared time axis (aligned up to NTP skew) when merged
+        self._wall_t0_us = time.time() * 1e6
+
+    def _resolve_process_index(self) -> int:
+        if self.process_index is None:
+            from code2vec_tpu.obs.events import resolve_process_index
+
+            self.process_index = resolve_process_index()
+        return int(self.process_index)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, category: str = "run", **args):
+        """Record the wrapped block as a complete trace event. Args are
+        evaluated at entry (e.g. queue depth at enqueue time)."""
+        ts = self._now_us()
+        try:
+            yield self
+        finally:
+            self._record(name, category, ts, self._now_us() - ts, args)
+
+    def instant(self, name: str, category: str = "run", **args) -> None:
+        """A zero-duration mark (Chrome "i" phase) — e.g. a recompile."""
+        self._record(name, category, self._now_us(), None, args)
+
+    def _record(self, name, category, ts, dur, args) -> None:
+        thread_key = (threading.get_ident(), threading.current_thread().name)
+        # pid is stamped at export (one tracer = one process) so recording
+        # never has to resolve the process index
+        event = {
+            "name": name,
+            "cat": category,
+            "ph": "X" if dur is not None else "i",
+            "ts": round(ts, 3),
+        }
+        if dur is not None:
+            event["dur"] = round(dur, 3)
+        else:
+            event["s"] = "t"
+        if args:
+            event["args"] = sanitize(args)
+        with self._lock:
+            tid = self._tids.get(thread_key)
+            if tid is None:
+                tid = len(self._tids)
+                self._tids[thread_key] = tid
+            event["tid"] = tid
+            if len(self._events) < self.max_events:
+                self._events.append(event)
+            else:
+                self._dropped += 1
+
+    # ---- export --------------------------------------------------------
+    def chrome_trace(self) -> dict:
+        """The full Chrome trace object: per-process / per-thread naming
+        metadata first, then the recorded events in timestamp order."""
+        pid = self._resolve_process_index()
+        with self._lock:
+            # epoch-anchored integer µs: whole-µs resolution is plenty (the
+            # cross-host alignment bound is NTP skew), and it keeps the
+            # offset exact in float64 JSON numbers
+            events = [
+                dict(e, pid=pid, ts=round(self._wall_t0_us + e["ts"]))
+                for e in self._events
+            ]
+            thread_names = {tid: key[1] for key, tid in self._tids.items()}
+            dropped = self._dropped
+        events.sort(key=lambda e: e["ts"])
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": self.process_name or f"process {pid}"},
+            }
+        ]
+        for tid, tname in thread_names.items():
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        trace = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        if dropped:
+            trace["dropped_events"] = dropped
+        return trace
+
+    def export(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def export_dir(self, trace_dir: str) -> str:
+        """Write ``<trace_dir>/trace-p<process_index>.json`` (one file per
+        process on multi-host runs)."""
+        os.makedirs(trace_dir, exist_ok=True)
+        return self.export(
+            os.path.join(
+                trace_dir, f"trace-p{self._resolve_process_index()}.json"
+            )
+        )
+
+
+class NullTracer:
+    """The no-tracing default: ``span`` hands back one shared reusable
+    ``nullcontext`` — per-batch call sites pay a method call, nothing
+    else."""
+
+    enabled = False
+    _NULL = contextlib.nullcontext()
+
+    def span(self, name: str, category: str = "run", **args):
+        return self._NULL
+
+    def instant(self, name: str, category: str = "run", **args) -> None:
+        return None
+
+
+NULL_TRACER = NullTracer()
+_current: Tracer | NullTracer = NULL_TRACER
+
+
+def get_tracer() -> Tracer | NullTracer:
+    """The process-wide tracer (NullTracer unless :func:`set_tracer` ran)."""
+    return _current
+
+
+def set_tracer(tracer: Tracer | NullTracer | None):
+    """Install ``tracer`` (None restores the NullTracer); returns the
+    previous tracer so tests/tools can restore it."""
+    global _current
+    previous = _current
+    _current = tracer if tracer is not None else NULL_TRACER
+    return previous

@@ -63,6 +63,21 @@ def initialize_from_env() -> bool:
     return False
 
 
+def process_info() -> dict:
+    """This process's identity block for telemetry manifests
+    (obs.events.run_manifest): who am I in the pod, on what hardware.
+    Initializes the backend if nothing has yet."""
+    devices = jax.local_devices()
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else None,
+        "local_device_count": len(devices),
+        "global_device_count": jax.device_count(),
+    }
+
+
 def global_batch(mesh: Mesh, full_batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:
     """Assemble a global device batch when every host holds the FULL batch
     (the loop's epochs are seeded identically on all processes).

@@ -4,7 +4,17 @@ Floyd-style JSON lines on stdout, and TensorBoard scalars.
 
 Sinks are plain callables ``(epoch, metrics_dict) -> None`` so the train
 loop stays backend-agnostic; compose any number of them via the ``sinks``
-tuple of :func:`code2vec_tpu.train.loop.train`.
+tuple of :func:`code2vec_tpu.train.loop.train`. The loop dispatches them as
+consumers of the run event stream (``code2vec_tpu.obs.events``), so sink
+output and the ``--events_dir`` JSONL log derive from the same metrics
+dict. A sink may expose a ``close()`` attribute; the train loop calls it in
+its ``finally`` block (the TensorBoard writer needs the final flush).
+
+JSON hygiene: training can legitimately produce non-finite metrics (a
+diverged ``train_loss`` is ``nan``/``inf``); ``json.dumps`` would print
+bare ``NaN``/``Infinity`` — not JSON — so the line sinks serialize them as
+``null`` with the original in a string ``"raw"`` field
+(:func:`code2vec_tpu.obs.events.metric_record`).
 """
 
 from __future__ import annotations
@@ -13,6 +23,8 @@ import json
 import logging
 import sys
 from typing import Callable
+
+from code2vec_tpu.obs.events import metric_record
 
 logger = logging.getLogger(__name__)
 
@@ -24,21 +36,23 @@ def logging_sink(epoch: int, metrics: dict[str, float]) -> None:
     sink (reference emits the same shape, main.py:183-205)."""
     logger.info("epoch %d", epoch)
     for name, value in metrics.items():
-        logger.info('{"metric": "%s", "value": %s}', name, value)
+        logger.info("%s", json.dumps(metric_record(name, value)))
 
 
 def floyd_sink(epoch: int, metrics: dict[str, float]) -> None:
     """One ``{"metric": name, "value": value}`` JSON line per metric on
     stdout (reference ``--env floyd``, main.py:183-190)."""
     for name, value in metrics.items():
-        sys.stdout.write(json.dumps({"metric": name, "value": value}) + "\n")
+        sys.stdout.write(json.dumps(metric_record(name, value)) + "\n")
     sys.stdout.flush()
 
 
 def tensorboard_sink(log_dir: str) -> MetricSink:
     """TensorBoard scalar sink (reference ``--env tensorboard``,
     main.py:152-155,199-205): each metric becomes a scalar series keyed by
-    its name, stepped by epoch.
+    its name, stepped by epoch. The returned sink carries a ``close()``
+    attribute closing the writer (final flush); the train loop calls it on
+    exit.
 
     Requires ``tensorboardX`` (present in this image); raises ImportError
     with a clear message otherwise — the import is deferred exactly like the
@@ -59,4 +73,5 @@ def tensorboard_sink(log_dir: str) -> MetricSink:
             writer.add_scalar(name, value, epoch)
         writer.flush()
 
+    sink.close = writer.close
     return sink

@@ -8,13 +8,18 @@ checkpoint + vector export, ``print_sample`` every N epochs, early stop when
 
 Extensions over the reference: seeded split, resumable checkpoints, an
 injectable ``report_fn`` for HPO pruning, metric sinks (stdout JSON /
-logging / TensorBoard), and optional jax.profiler tracing.
+logging / TensorBoard), optional jax.profiler tracing, and the run-level
+telemetry subsystem (``code2vec_tpu.obs``): every metric emission goes
+through one event stream (sinks are consumers of it), phases are traced
+as Chrome-trace spans, and a recompile detector + memory sampler watch
+runtime health at epoch boundaries.
 """
 
 from __future__ import annotations
 
 import logging
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -41,6 +46,13 @@ from code2vec_tpu.data.pipeline import (
 from code2vec_tpu.data.reader import CorpusData
 from code2vec_tpu.metrics import evaluate
 from code2vec_tpu.models.code2vec import Code2VecConfig
+from code2vec_tpu.obs.events import EventLog, sink_consumer
+from code2vec_tpu.obs.runtime import (
+    RecompileDetector,
+    RuntimeHealth,
+    memory_snapshot,
+)
+from code2vec_tpu.obs.trace import get_tracer, set_tracer
 from code2vec_tpu.sinks import MetricSink, logging_sink  # re-export: canonical home is sinks
 from code2vec_tpu.train.config import TrainConfig
 from code2vec_tpu.train.prefetch import StepProfiler, device_batches
@@ -51,6 +63,10 @@ from code2vec_tpu.train.step import (
 )
 
 logger = logging.getLogger(__name__)
+
+# nullcontext is reusable/reentrant; one shared instance keeps the
+# unsampled-step path of _train_pass allocation-free
+_NO_SPAN = nullcontext()
 
 
 @dataclass
@@ -173,6 +189,8 @@ def _train_pass(
     batches,
     to_device,
     profiler: StepProfiler | None = None,
+    tracer=None,
+    epoch: int | None = None,
 ):
     """One epoch of train steps over the host pipeline; returns
     ``(state, train_loss)``.
@@ -182,20 +200,36 @@ def _train_pass(
     the ``to_device`` transfer run ahead of compute, with identical batches
     in the identical order — the loss trajectory is bitwise that of the
     synchronous path. ``profiler`` attributes per-step wall time into
-    host-build / H2D / compute buckets on its sampled steps.
+    host-build / H2D / compute buckets on its sampled steps. Tracing: the
+    whole pass is one ``train_pass`` span; step 0 (the compile-bearing
+    step) and the profiler-sampled steps get ``train_step`` spans — never
+    every step, so a 16k-step epoch doesn't flood the trace.
     """
+    tracer = tracer or get_tracer()
     train_loss = 0.0
     step = 0
-    with device_batches(
-        batches, to_device, config.prefetch_batches, profiler
-    ) as stream:
-        for _, device_batch in stream:
-            t0 = time.perf_counter()
-            state, loss = train_step(state, device_batch)
-            train_loss += float(loss)  # blocks on the step's loss
-            if profiler is not None and profiler.sampled(step):
-                profiler.record_compute(step, (time.perf_counter() - t0) * 1e3)
-            step += 1
+    with tracer.span("train_pass", category="train", epoch=epoch):
+        with device_batches(
+            batches, to_device, config.prefetch_batches, profiler
+        ) as stream:
+            for _, device_batch in stream:
+                sampled = profiler is not None and profiler.sampled(step)
+                span = (
+                    tracer.span("train_step", category="train", step=step)
+                    if step == 0 or sampled
+                    else _NO_SPAN
+                )
+                with span:
+                    t0 = time.perf_counter()
+                    state, loss = train_step(state, device_batch)
+                    train_loss += float(loss)  # blocks on the step's loss
+                if sampled:
+                    profiler.record_compute(
+                        step, (time.perf_counter() - t0) * 1e3
+                    )
+                step += 1
+    if profiler is not None:
+        profiler.observe_epoch_length(step)
     return state, train_loss
 
 
@@ -211,12 +245,23 @@ def train(
     train_step=None,
     eval_step=None,
     profile_dir: str | None = None,
+    events: EventLog | None = None,
+    tracer=None,
 ) -> TrainResult:
     """Run the full training loop on a loaded corpus.
 
     ``initial_state``/``train_step``/``eval_step`` may be injected (the HPO
     driver reuses jitted steps across trials; the parallel driver passes
     sharded variants).
+
+    ``events``/``tracer`` wire the run into the telemetry subsystem
+    (``code2vec_tpu.obs``; the CLI builds them from ``--events_dir`` /
+    ``--trace_dir``). Defaults: a dispatch-only EventLog (no file) — the
+    sinks are ALWAYS driven as consumers of the event stream — and the
+    process-wide tracer (a no-op unless one was installed). The caller
+    owns closing/exporting both. Sinks exposing ``close()`` (e.g.
+    ``tensorboard_sink``) ARE closed by this function's finally block —
+    pass close-less sinks to share one across train() calls.
     """
     # task selection is fixed at corpus-load time; catch silent mismatches
     # between the config's task flags and what the corpus was loaded with
@@ -231,6 +276,13 @@ def train(
             f"infer_variable={data.infer_variable}; pass matching flags to "
             "load_corpus"
         )
+
+    if events is None:
+        events = EventLog()  # dispatch-only: sinks still ride the stream
+    if tracer is None:
+        tracer = get_tracer()
+    health = RuntimeHealth()
+    recompile_detector = RecompileDetector(events=events, health=health)
 
     np_rng = np.random.default_rng(config.random_seed)
     jax_rng = jax.random.PRNGKey(config.random_seed)
@@ -271,6 +323,23 @@ def train(
     # mesh parallelism: any axis > 1 switches to sharded steps; the step
     # math is identical (see parallel.step), XLA inserts the collectives
     mesh = build_mesh(config)
+    # the event log's first line: run id, config, process identity, mesh
+    # shape, device kind, package version (idempotent if the caller wrote
+    # one already — e.g. the HPO driver stamps the search's BASE config).
+    # Skipped for an unobserved dispatch-only log: manifest construction
+    # is not free (run-id broadcast on pods, config asdict)
+    if events.observed:
+        events.write_manifest(
+            config=config,
+            mesh=mesh,
+            corpus={
+                "n_items": data.n_items,
+                "terminal_vocab": len(data.terminal_vocab),
+                "path_vocab": len(data.path_vocab),
+                "label_vocab": len(data.label_vocab),
+                "shard": data.shard,
+            },
+        )
     if mesh is not None:
         from code2vec_tpu.parallel.shardings import shard_state
         from code2vec_tpu.parallel.step import (
@@ -296,6 +365,13 @@ def train(
         )
     if eval_step is None:
         eval_step = make_eval_step(model_config, class_weights)
+
+    # recompile watch: static [B, L] shapes are the design invariant —
+    # jit-cache growth after the warmup compile means shape churn is
+    # silently recompiling the step (seconds each). Checked per epoch;
+    # non-jitted injected steps are ignored by track().
+    recompile_detector.track("train_step", train_step)
+    recompile_detector.track("eval_step", eval_step)
 
     # multi-host feeding:
     # - replicated corpus (data.shard is None): every process builds the
@@ -426,19 +502,22 @@ def train(
 
             def stage_host(item_idx):
                 # parts stay host-side; ONE device transfer at the end
-                parts = []
-                if data.infer_method:
-                    parts.append(
-                        stage_method_corpus(data, item_idx, np_rng, device="host")
-                    )
-                if data.infer_variable:
-                    parts.append(
-                        stage_variable_corpus(data, item_idx, np_rng, device="host")
-                    )
-                staged = parts[0]
-                for p in parts[1:]:
-                    staged = concat_staged(staged, p)
-                return staged
+                with tracer.span(
+                    "stage_corpus", category="train", items=len(item_idx)
+                ):
+                    parts = []
+                    if data.infer_method:
+                        parts.append(
+                            stage_method_corpus(data, item_idx, np_rng, device="host")
+                        )
+                    if data.infer_variable:
+                        parts.append(
+                            stage_variable_corpus(data, item_idx, np_rng, device="host")
+                        )
+                    staged = parts[0]
+                    for p in parts[1:]:
+                        staged = concat_staged(staged, p)
+                    return staged
 
             def stage(item_idx):
                 return place_staged(stage_host(item_idx), device=corpus_placement)
@@ -511,9 +590,11 @@ def train(
     meta.vocab_pad_multiple = model_config.vocab_pad_multiple
 
     # step-time attribution (train/prefetch.py): the host-pipeline loops
-    # stamp every step and fence the first --profile_steps train steps of
-    # each epoch; device-epoch runs dispatch whole chunks, so the per-step
-    # host/H2D/compute split does not apply there
+    # fence ~--profile_steps train steps of each epoch — the first N on
+    # epoch 0, then strided across the whole epoch once its length is
+    # known, so tail steps are attributable too; device-epoch runs
+    # dispatch whole chunks, so the per-step host/H2D/compute split does
+    # not apply there
     profiler = None
     if config.profile_steps > 0:
         if use_device_epoch:
@@ -529,6 +610,21 @@ def train(
     start_epoch = meta.epoch
     epoch = start_epoch
     epochs_completed = 0
+    # sinks consume the SAME event stream the JSONL log records, so the
+    # two can never disagree. Subscribed HERE — immediately before the
+    # try whose finally unsubscribes — so no exception path can leave the
+    # consumer attached (a shared EventLog across HPO trials must not
+    # accumulate duplicate consumers); every sink-visible event (epoch /
+    # best_f1) is emitted inside the loop below
+    sinks_on_stream = events.subscribe(sink_consumer(sinks))
+    # a caller-supplied tracer must serve the WHOLE stack: the deeper
+    # layers (pipeline builds, the prefetch producer, recompile marks)
+    # fetch the process-wide tracer, so install it for the loop — every
+    # get_tracer()-dependent span in train() fires inside it — and
+    # restore in the same finally (no exception path can leak the
+    # install). The CLI pre-installs, making this a no-op there.
+    restore_tracer = tracer is not get_tracer()
+    previous_tracer = set_tracer(tracer) if restore_tracer else None
     try:
         for epoch in range(start_epoch, config.max_epoch):
             if profile_dir is not None and epoch == start_epoch + 1:
@@ -543,20 +639,36 @@ def train(
                 jax_rng, train_key, eval_key = jax.random.split(jax_rng, 3)
                 if sharded_train_runner is not None:
                     runner, staged = sharded_train_runner
-                    state, train_loss, _ = runner.run_train_epoch(
-                        state, staged, np_rng, train_key
-                    )
-                    test_loss, preds, _ = runner.run_eval_epoch(
-                        state, staged_test, eval_key
-                    )
+                    with tracer.span(
+                        "train_pass", category="train", epoch=epoch,
+                        mode="device_epoch",
+                    ):
+                        state, train_loss, _ = runner.run_train_epoch(
+                            state, staged, np_rng, train_key
+                        )
+                    with tracer.span(
+                        "eval_pass", category="eval", epoch=epoch,
+                        mode="device_epoch",
+                    ):
+                        test_loss, preds, _ = runner.run_eval_epoch(
+                            state, staged_test, eval_key
+                        )
                     expected = sharded_test_expected
                 else:
-                    state, train_loss, _ = device_runner.run_train_epoch(
-                        state, staged_train, np_rng, train_key
-                    )
-                    test_loss, preds, _ = device_runner.run_eval_epoch(
-                        state, staged_test, eval_key
-                    )
+                    with tracer.span(
+                        "train_pass", category="train", epoch=epoch,
+                        mode="device_epoch",
+                    ):
+                        state, train_loss, _ = device_runner.run_train_epoch(
+                            state, staged_train, np_rng, train_key
+                        )
+                    with tracer.span(
+                        "eval_pass", category="eval", epoch=epoch,
+                        mode="device_epoch",
+                    ):
+                        test_loss, preds, _ = device_runner.run_eval_epoch(
+                            state, staged_test, eval_key
+                        )
                     # staged labels: per-EXAMPLE (one per @var alias in
                     # the variable task), not per-item
                     expected = np.asarray(staged_test.labels)
@@ -593,12 +705,13 @@ def train(
                     )
                 state, train_loss = _train_pass(
                     config, state, train_step, train_batches, to_device,
-                    profiler,
+                    profiler, tracer=tracer, epoch=epoch,
                 )
                 test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
                     config, data, state, eval_step, test_batches, to_device,
                     gather_processes=sharded_feed,
                     feed_group=(feed_group, n_feed_groups),
+                    tracer=tracer, epoch=epoch,
                 )
             else:
                 train_epoch = build_epoch(
@@ -619,7 +732,7 @@ def train(
                     )
                 state, train_loss = _train_pass(
                     config, state, train_step, train_batches, to_device,
-                    profiler,
+                    profiler, tracer=tracer, epoch=epoch,
                 )
 
                 test_epoch = build_epoch(
@@ -642,6 +755,7 @@ def train(
                     config, data, state, eval_step, test_batches, to_device,
                     gather_processes=sharded_feed,
                     feed_group=(feed_group, n_feed_groups),
+                    tracer=tracer, epoch=epoch,
                 )
 
             metrics = {
@@ -658,17 +772,42 @@ def train(
                 if attribution is not None:
                     metrics.update(attribution)
                     logger.info(
-                        "step-time attribution (first %d train steps): "
-                        "host_build %.2f ms | h2d %.2f ms | compute %.2f ms",
+                        "step-time attribution (%d sampled train steps, "
+                        "stride %d): host_build %.2f ms | h2d %.2f ms | "
+                        "compute %.2f ms",
                         attribution["profiled_steps"],
+                        profiler.stride,
                         attribution["host_build_ms"],
                         attribution["h2d_ms"],
                         attribution["compute_ms"],
                     )
+                for rec in profiler.per_step():
+                    events.emit("step_sample", epoch=epoch, **rec)
             epochs_completed += 1
             meta.history.append({"epoch": epoch, **metrics})
-            for sink in sinks:
-                sink(epoch, metrics)
+            # sliced from the SAME dict the epoch event carries — a
+            # renamed metric fails loudly here instead of diverging
+            events.emit("eval", epoch=epoch, metrics={
+                k: metrics[k]
+                for k in ("test_loss", "accuracy", "precision", "recall", "f1")
+            })
+            # recompile check FIRST (warmup = epoch 0's expected compiles;
+            # growth on any later epoch is shape churn and emits a
+            # `recompile` warning event) so this epoch's own recompiles are
+            # already in the health counters its epoch event reports
+            recompile_detector.check(epoch)
+            # the sinks consume this SAME emission (sink_consumer above) —
+            # the epoch event and every sink's output share one dict.
+            # memory_snapshot mirrors into the health gauges first, so the
+            # health block carries current gauges + cumulative counters
+            memory = memory_snapshot(health)
+            events.emit(
+                "epoch",
+                epoch=epoch,
+                metrics=metrics,
+                memory=memory,
+                health=health.snapshot(),
+            )
 
             if report_fn is not None:
                 report_fn(epoch, f1)  # may raise StopTraining (HPO pruning)
@@ -702,8 +841,7 @@ def train(
                 )
 
             if meta.best_f1 is None or meta.best_f1 < f1:
-                for sink in sinks:
-                    sink(epoch, {"best_f1": f1})
+                events.emit("best_f1", epoch=epoch, metrics={"best_f1": f1})
                 meta.best_f1 = f1
                 if sharded_feed and vectors_path is not None:
                     logger.warning(
@@ -717,18 +855,21 @@ def train(
                         train_epoch = host_epoch(train_idx)
                     if test_epoch is None:
                         test_epoch = host_epoch(test_idx)
-                    export_mod.write_code_vectors(
-                        data,
-                        state,
-                        eval_step,
-                        train_epoch,
-                        test_epoch,
-                        config.batch_size,
-                        vectors_path,
-                        config.encode_size,
-                        test_result_path,
-                        to_device,
-                    )
+                    with tracer.span(
+                        "export_vectors", category="export", epoch=epoch
+                    ):
+                        export_mod.write_code_vectors(
+                            data,
+                            state,
+                            eval_step,
+                            train_epoch,
+                            test_epoch,
+                            config.batch_size,
+                            vectors_path,
+                            config.encode_size,
+                            test_result_path,
+                            to_device,
+                        )
                 save_slot = (
                     "best" if report_fn is None and out_dir is not None else None
                 )
@@ -762,7 +903,19 @@ def train(
 
             if save_slot is not None:
                 meta.epoch = epoch + 1
-                save_checkpoint(out_dir, state, meta, slot=save_slot)
+                with tracer.span(
+                    "checkpoint_save", category="checkpoint",
+                    epoch=epoch, slot=save_slot,
+                ):
+                    ckpt_path = save_checkpoint(
+                        out_dir, state, meta, slot=save_slot
+                    )
+                events.emit(
+                    "checkpoint_saved",
+                    epoch=epoch,
+                    slot=save_slot,
+                    path=ckpt_path,
+                )
 
             if meta.bad_count > config.early_stop_patience:
                 logger.info(
@@ -778,7 +931,30 @@ def train(
                 break
     except StopTraining:
         pass
+    except Exception as exc:
+        try:
+            events.emit(
+                "error", epoch=epoch, error=f"{type(exc).__name__}: {exc}"
+            )
+        except Exception:  # telemetry must not mask the real failure
+            logger.warning("could not emit error event", exc_info=True)
+        raise
     finally:
+        if restore_tracer:
+            set_tracer(previous_tracer)
+        events.unsubscribe(sinks_on_stream)
+        # sinks with buffered backends expose close() (tensorboard_sink:
+        # the SummaryWriter's final flush must not depend on interpreter
+        # exit); best-effort so one failing sink can't mask the result
+        for sink in sinks:
+            closer = getattr(sink, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    logger.warning("sink close() failed", exc_info=True)
+        # last: may raise (e.g. profile_dir on a full disk) — the telemetry
+        # cleanup above must already have run by then
         if profile_dir is not None and epoch > start_epoch:
             jax.profiler.stop_trace()
 
@@ -821,6 +997,8 @@ def _evaluate_batches(
     to_device=lambda batch: batch,
     gather_processes: bool = False,
     feed_group: tuple[int, int] = (0, 1),
+    tracer=None,
+    epoch: int | None = None,
 ) -> tuple[float, float, float, float, float]:
     """Test pass: accumulate per-batch mean losses (reference semantics,
     main.py:283-284) and pooled predictions, then dispatch the matcher.
@@ -839,25 +1017,27 @@ def _evaluate_batches(
 
     from code2vec_tpu.parallel.distributed import allgather_to_host
 
+    tracer = tracer or get_tracer()
     test_loss = 0.0
     expected, actual = [], []
     # the host batch rides along with its device placement so labels and
     # the example mask stay host-side (no device round-trip); prefetching
     # overlaps eval batch construction with the forward passes
-    with device_batches(
-        batches, to_device, config.prefetch_batches
-    ) as stream:
-        for batch, device_batch in stream:
-            out = eval_step(state, device_batch)
-            test_loss += float(out["loss"])
-            valid = batch["example_mask"].astype(bool)
-            preds = allgather_to_host(out["preds"])
-            if gather_processes and len(preds) != len(valid):
-                feed = len(valid)
-                lo = feed_group[0] * feed
-                preds = preds[lo : lo + feed]
-            expected.append(batch["labels"][valid])
-            actual.append(preds[valid])
+    with tracer.span("eval_pass", category="eval", epoch=epoch):
+        with device_batches(
+            batches, to_device, config.prefetch_batches
+        ) as stream:
+            for batch, device_batch in stream:
+                out = eval_step(state, device_batch)
+                test_loss += float(out["loss"])
+                valid = batch["example_mask"].astype(bool)
+                preds = allgather_to_host(out["preds"])
+                if gather_processes and len(preds) != len(valid):
+                    feed = len(valid)
+                    lo = feed_group[0] * feed
+                    preds = preds[lo : lo + feed]
+                expected.append(batch["labels"][valid])
+                actual.append(preds[valid])
     expected = np.concatenate(expected) if expected else np.zeros(0, np.int32)
     actual = np.concatenate(actual) if actual else np.zeros(0, np.int32)
     if gather_processes and _jax.process_count() > 1:

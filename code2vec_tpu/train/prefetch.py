@@ -24,15 +24,18 @@ thread that runs ``depth`` batches ahead of compute behind a bounded queue:
   thread.
 
 :class:`StepProfiler` attributes wall time per step into host-build /
-H2D-transfer / device-compute buckets on the first ``sample_steps`` steps
-only: producer-side ``perf_counter`` stamps plus ``block_until_ready``
-fencing on those steps, nothing on the rest — so steady-state pipelining is
-not perturbed by the measurement. Surfaced as ``--profile_steps`` (cli.py),
-logged per epoch by the train loop, and emitted in bench.py's JSON detail.
+H2D-transfer / device-compute buckets on ~``sample_steps`` STRIDED sample
+steps per epoch: producer-side ``perf_counter`` stamps plus
+``block_until_ready`` fencing on those steps, nothing on the rest — so
+steady-state pipelining is not perturbed by the measurement. Surfaced as
+``--profile_steps`` (cli.py), logged per epoch by the train loop, emitted
+as ``step_sample`` events (obs/events.py), and carried in bench.py's JSON
+detail.
 """
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -40,19 +43,49 @@ from typing import Callable, Iterable, Iterator
 
 import jax
 
+from code2vec_tpu.obs.trace import get_tracer
+
 __all__ = ["HostPrefetcher", "StepProfiler", "device_batches"]
+
+_NO_SPAN = contextlib.nullcontext()
+_SPAN_WARMUP_STEPS = 8
+_SPAN_STRIDE = 64
+
+
+def _span_step(step: int, profiler: "StepProfiler | None") -> bool:
+    """Whether this step's host_build/h2d get trace spans. SAMPLED — the
+    first steps, every ``_SPAN_STRIDE``-th after, and the profiler's
+    fenced steps — because a java-large epoch is ~16k steps and per-batch
+    spans would flood the tracer's bounded buffer (dropping exactly the
+    late-run events a trace exists to show). Mirrors the train loop's
+    train_step span policy."""
+    return (
+        step < _SPAN_WARMUP_STEPS
+        or step % _SPAN_STRIDE == 0
+        or (profiler is not None and profiler.sampled(step))
+    )
 
 
 class StepProfiler:
     """Per-step wall-time attribution: host-build / H2D / device-compute.
 
-    Only the first ``sample_steps`` steps are recorded: ``host_build_ms``
+    ~``sample_steps`` steps per epoch are recorded: ``host_build_ms``
     (time building the numpy batch), ``h2d_ms`` (time in ``to_device``,
     fenced with ``jax.block_until_ready`` so it measures the real transfer
     rather than async dispatch), and ``compute_ms`` (the fenced step).
-    Later steps carry no stamps at all — a java-large epoch is ~16k steps,
-    and unread records would be pure producer-side overhead. Note the
-    first sampled step of a run includes XLA compile in ``compute_ms``.
+    Unsampled steps carry no stamps at all — a java-large epoch is ~16k
+    steps, and unread records would be pure producer-side overhead. Note
+    the first sampled step of a run includes XLA compile in ``compute_ms``.
+
+    Sampling is STRIDED: the first epoch (stride 1, epoch length unknown)
+    fences the first ``sample_steps`` steps; the loop reports each epoch's
+    length via :meth:`observe_epoch_length`, and from the next
+    :meth:`reset` on the samples spread every ``len // sample_steps``
+    steps across the WHOLE epoch — so tail-of-epoch steps (allocator
+    drift, shrinking streaming chunks) are attributable, not just warmup.
+    :meth:`sampled` stays a pure function of the step index, so the
+    producer and consumer threads agree on the sample set without
+    coordination.
 
     The producer thread writes host/H2D stamps and the consumer writes
     compute stamps, but never for the same key and never concurrently with
@@ -62,12 +95,22 @@ class StepProfiler:
 
     def __init__(self, sample_steps: int = 0):
         self.sample_steps = int(sample_steps)
+        self.stride = 1
+        self._next_stride = 1
         self._host: dict[int, tuple[float, float]] = {}
         self._compute: dict[int, float] = {}
 
     def sampled(self, step: int) -> bool:
         """Whether ``step`` gets block_until_ready fencing."""
-        return step < self.sample_steps
+        if self.sample_steps <= 0:
+            return False
+        return step % self.stride == 0 and step // self.stride < self.sample_steps
+
+    def observe_epoch_length(self, n_steps: int) -> None:
+        """Record the just-finished epoch's step count; the NEXT
+        :meth:`reset` spreads the samples across that many steps."""
+        if self.sample_steps > 0 and n_steps > 0:
+            self._next_stride = max(1, n_steps // self.sample_steps)
 
     def record_host(self, step: int, host_build_ms: float, h2d_ms: float) -> None:
         self._host[step] = (host_build_ms, h2d_ms)
@@ -106,6 +149,7 @@ class StepProfiler:
     def reset(self) -> None:
         self._host.clear()
         self._compute.clear()
+        self.stride = self._next_stride
 
 
 class _End:
@@ -168,21 +212,41 @@ class HostPrefetcher:
     def _produce(self) -> None:
         it = iter(self._batches)
         step = 0
+        tracer = get_tracer()
         try:
             while not self._stop.is_set():
-                t0 = time.perf_counter()
-                try:
-                    batch = next(it)
-                except StopIteration:
+                # span args are evaluated at entry: qsize() IS the queue
+                # depth at this enqueue attempt (how far ahead we run)
+                spanned = _span_step(step, self._profiler)
+                depth = self._queue.qsize()
+                batch = _End  # sentinel: a yielded None must NOT end the epoch
+                with (
+                    tracer.span("host_build", step=step, queue_depth=depth)
+                    if spanned
+                    else _NO_SPAN
+                ):
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        pass
+                if batch is _End:
                     self._put(_End)
                     return
                 t1 = time.perf_counter()
-                device_batch = self._to_device(batch)
-                if self._profiler is not None and self._profiler.sampled(step):
-                    jax.block_until_ready(device_batch)
-                    self._profiler.record_host(
-                        step, (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
-                    )
+                with (
+                    tracer.span("h2d", step=step, queue_depth=depth)
+                    if spanned
+                    else _NO_SPAN
+                ):
+                    device_batch = self._to_device(batch)
+                    if self._profiler is not None and self._profiler.sampled(step):
+                        jax.block_until_ready(device_batch)
+                        self._profiler.record_host(
+                            step,
+                            (t1 - t0) * 1e3,
+                            (time.perf_counter() - t1) * 1e3,
+                        )
                 if not self._put((batch, device_batch)):
                     return
                 step += 1
@@ -253,15 +317,21 @@ class _SyncBatches:
         return self
 
     def __next__(self) -> tuple[dict, dict]:
+        tracer = get_tracer()
+        spanned = _span_step(self._step, self._profiler)
         t0 = time.perf_counter()
-        batch = next(self._it)  # StopIteration ends the epoch
+        with (
+            tracer.span("host_build", step=self._step) if spanned else _NO_SPAN
+        ):
+            batch = next(self._it)  # StopIteration ends the epoch
         t1 = time.perf_counter()
-        device_batch = self._to_device(batch)
-        if self._profiler is not None and self._profiler.sampled(self._step):
-            jax.block_until_ready(device_batch)
-            self._profiler.record_host(
-                self._step, (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
-            )
+        with tracer.span("h2d", step=self._step) if spanned else _NO_SPAN:
+            device_batch = self._to_device(batch)
+            if self._profiler is not None and self._profiler.sampled(self._step):
+                jax.block_until_ready(device_batch)
+                self._profiler.record_host(
+                    self._step, (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
+                )
         self._step += 1
         return batch, device_batch
 

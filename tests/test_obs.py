@@ -1,0 +1,699 @@
+"""The run-level telemetry subsystem (code2vec_tpu/obs/): the structured
+event log (manifest completeness, strict-JSON hygiene, ordering under
+threads), Chrome-trace span export, the runtime-health detectors, the
+strided StepProfiler, and the end-to-end acceptance run: a CPU train with
+an events dir + trace dir produces a manifest-first JSONL whose epoch
+events match the sink-reported metrics exactly, and a Chrome trace
+carrying spans from the prefetch producer thread, the train step, and
+eval — with zero recompiles after warmup on the steady-shape path.
+"""
+
+import json
+import math
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.obs.events import (
+    EventLog,
+    metric_record,
+    run_manifest,
+    sanitize,
+    sink_consumer,
+)
+from code2vec_tpu.obs.runtime import (
+    RecompileDetector,
+    RuntimeHealth,
+    host_rss_bytes,
+    memory_snapshot,
+)
+from code2vec_tpu.obs.trace import NullTracer, Tracer, get_tracer, set_tracer
+
+
+def strict_loads(line: str):
+    """json.loads that REJECTS the bare NaN/Infinity tokens json.dumps
+    leaks by default — the property the sanitizers exist to guarantee."""
+    def refuse(token):
+        raise AssertionError(f"non-JSON constant {token!r} in output")
+
+    return json.loads(line, parse_constant=refuse)
+
+
+@pytest.fixture()
+def installed_tracer():
+    tracer = Tracer(process_index=0)
+    previous = set_tracer(tracer)
+    yield tracer
+    set_tracer(previous)
+
+
+class TestSanitize:
+    def test_nonfinite_dict_values_null_with_raw(self):
+        out = sanitize({"a": float("nan"), "b": float("inf"), "c": 1.5})
+        assert out == {"a": None, "a_raw": "nan", "b": None, "b_raw": "inf", "c": 1.5}
+
+    def test_numpy_scalars_unwrap(self):
+        out = sanitize({"x": np.float32("nan"), "y": np.int64(3)})
+        assert out["x"] is None and out["x_raw"] == "nan" and out["y"] == 3
+
+    def test_unknown_objects_stringify(self):
+        assert isinstance(sanitize({"d": object()})["d"], str)
+
+    def test_metric_record_shapes(self):
+        assert metric_record("f1", 0.5) == {"metric": "f1", "value": 0.5}
+        assert metric_record("loss", float("nan")) == {
+            "metric": "loss", "value": None, "raw": "nan",
+        }
+        assert metric_record("loss", float("-inf"))["raw"] == "-inf"
+
+
+class TestMetricSinks:
+    """Satellite regression: the line sinks must never print bare
+    NaN/Infinity (invalid JSON) for non-finite metric values."""
+
+    def test_floyd_sink_nonfinite_is_strict_json(self, capsys):
+        from code2vec_tpu.sinks import floyd_sink
+
+        floyd_sink(0, {"train_loss": float("nan"), "f1": 0.25})
+        lines = [strict_loads(l) for l in capsys.readouterr().out.splitlines()]
+        assert {"metric": "train_loss", "value": None, "raw": "nan"} in lines
+        assert {"metric": "f1", "value": 0.25} in lines
+
+    def test_logging_sink_nonfinite_is_strict_json(self, caplog):
+        import logging
+
+        from code2vec_tpu.sinks import logging_sink
+
+        with caplog.at_level(logging.INFO, logger="code2vec_tpu.sinks"):
+            logging_sink(1, {"test_loss": float("inf"), "f1": 1.0})
+        payloads = [
+            strict_loads(r.getMessage())
+            for r in caplog.records
+            if r.getMessage().startswith("{")
+        ]
+        assert {"metric": "test_loss", "value": None, "raw": "inf"} in payloads
+        assert {"metric": "f1", "value": 1.0} in payloads
+
+    def test_tensorboard_sink_has_close(self, tmp_path):
+        pytest.importorskip("tensorboardX")
+        from code2vec_tpu.sinks import tensorboard_sink
+
+        sink = tensorboard_sink(str(tmp_path))
+        sink(0, {"f1": 0.5})
+        assert callable(sink.close)
+        sink.close()
+
+
+class TestEventLog:
+    def test_manifest_is_first_line_and_complete(self, tmp_path):
+        from code2vec_tpu.train.config import TrainConfig
+
+        with EventLog(str(tmp_path)) as log:
+            log.write_manifest(config=TrainConfig(batch_size=64))
+            log.emit("epoch", epoch=0, metrics={"f1": 0.1})
+        lines = [strict_loads(l) for l in open(log.path, encoding="utf-8")]
+        manifest = lines[0]
+        assert manifest["event"] == "manifest"
+        for key in (
+            "run_id", "config", "process_index", "process_count",
+            "mesh_shape", "device_kind", "package_version",
+        ):
+            assert key in manifest, key
+        assert manifest["config"]["batch_size"] == 64
+        assert manifest["process_count"] == 1
+
+    def test_manifest_idempotent(self, tmp_path):
+        with EventLog(str(tmp_path)) as log:
+            assert log.write_manifest() is not None
+            assert log.write_manifest() is None
+
+    def test_manifest_records_mesh_shape(self):
+        from code2vec_tpu.parallel.mesh import make_mesh
+
+        manifest = run_manifest(mesh=make_mesh(data=2, model=2))
+        assert manifest["mesh_shape"] == {"data": 2, "model": 2, "ctx": 1}
+
+    def test_event_ordering_under_threads(self, tmp_path):
+        """Emitters on background threads (the prefetch producer pattern)
+        must serialize: seq strictly increasing in file order, no
+        interleaved/lost lines."""
+        log = EventLog(str(tmp_path))
+        n_threads, per_thread = 8, 50
+
+        def emitter(tid):
+            for i in range(per_thread):
+                log.emit("step_sample", thread=tid, i=i)
+
+        threads = [
+            threading.Thread(target=emitter, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        log.close()
+        lines = [strict_loads(l) for l in open(log.path, encoding="utf-8")]
+        assert len(lines) == n_threads * per_thread
+        seqs = [l["seq"] for l in lines]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        t_ms = [l["t_ms"] for l in lines]
+        assert t_ms == sorted(t_ms)  # monotonic stamps in file order
+
+    def test_consumers_get_raw_values_file_gets_sanitized(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        seen = []
+        log.subscribe(lambda e: seen.append(e))
+        log.emit("epoch", epoch=0, metrics={"loss": float("nan")})
+        log.close()
+        assert math.isnan(seen[0]["metrics"]["loss"])  # raw to consumers
+        line = strict_loads(open(log.path, encoding="utf-8").readline())
+        assert line["metrics"]["loss"] is None
+        assert line["metrics"]["loss_raw"] == "nan"
+
+    def test_append_mode_preserves_previous_run(self, tmp_path):
+        # a --resume'd run must extend the log (its manifest marks the
+        # new segment), not truncate the recorded history
+        with EventLog(str(tmp_path)) as log:
+            log.write_manifest()
+            log.emit("epoch", epoch=0, metrics={"f1": 0.1})
+        with EventLog(str(tmp_path)) as resumed:
+            resumed.write_manifest()
+        lines = [strict_loads(l) for l in open(resumed.path, encoding="utf-8")]
+        assert [l["event"] for l in lines] == ["manifest", "epoch", "manifest"]
+
+    def test_construction_is_lazy_no_file_until_emit(self, tmp_path):
+        # constructing must not open the file (nor resolve the process
+        # index / touch the backend) — multi-host runs build the log
+        # before jax.distributed.initialize
+        log = EventLog(str(tmp_path), process_index=None)
+        assert log.path is None and not list(tmp_path.iterdir())
+        log.emit("epoch", epoch=0, metrics={})
+        assert log.path is not None
+        log.close()
+
+    def test_run_id_pinned_by_env(self, monkeypatch):
+        monkeypatch.setenv("C2V_RUN_ID", "pinned-run")
+        assert run_manifest()["run_id"] == "pinned-run"
+
+    def test_unsubscribe_stops_dispatch(self):
+        log = EventLog()  # dispatch-only, no file
+        seen = []
+        consumer = log.subscribe(lambda e: seen.append(e))
+        log.emit("epoch", epoch=0, metrics={})
+        log.unsubscribe(consumer)
+        log.emit("epoch", epoch=1, metrics={})
+        assert len(seen) == 1
+
+    def test_sink_consumer_routes_epoch_and_best_f1_only(self):
+        calls = []
+        consume = sink_consumer((lambda e, m: calls.append((e, m)),))
+        consume({"event": "epoch", "epoch": 3, "metrics": {"f1": 0.5}})
+        consume({"event": "best_f1", "epoch": 3, "metrics": {"best_f1": 0.5}})
+        consume({"event": "checkpoint_saved", "epoch": 3})
+        consume({"event": "eval", "epoch": 3, "metrics": {"f1": 0.5}})
+        assert calls == [(3, {"f1": 0.5}), (3, {"best_f1": 0.5})]
+
+
+class TestTracer:
+    def test_chrome_trace_is_valid_and_complete(self, tmp_path):
+        tracer = Tracer(process_index=2, process_name="host 2")
+        with tracer.span("outer", category="test", epoch=0):
+            with tracer.span("inner", step=1, queue_depth=2):
+                pass
+        done = threading.Event()
+
+        def producer():
+            with tracer.span("host_build", step=0):
+                pass
+            done.set()
+
+        threading.Thread(target=producer, name="c2v-host-prefetch").start()
+        assert done.wait(5.0)
+        path = tracer.export_dir(str(tmp_path))
+        trace = json.load(open(path, encoding="utf-8"))
+        events = trace["traceEvents"]
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert {s["name"] for s in spans} == {"outer", "inner", "host_build"}
+        for s in spans:
+            assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(s)
+            assert s["pid"] == 2
+        # per-process + per-thread track naming for multi-host merges
+        meta = [e for e in events if e.get("ph") == "M"]
+        assert any(
+            m["name"] == "process_name" and m["args"]["name"] == "host 2"
+            for m in meta
+        )
+        assert any(
+            m["name"] == "thread_name" and m["args"]["name"] == "c2v-host-prefetch"
+            for m in meta
+        )
+        # the producer span sits on its own thread track
+        main_tid = next(s["tid"] for s in spans if s["name"] == "outer")
+        prod_tid = next(s["tid"] for s in spans if s["name"] == "host_build")
+        assert main_tid != prod_tid
+        assert path.endswith("trace-p2.json")
+
+    def test_span_args_and_nesting(self):
+        tracer = Tracer()
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+        trace = tracer.chrome_trace()
+        spans = {e["name"]: e for e in trace["traceEvents"] if e.get("ph") == "X"}
+        # inner nests inside outer on the same track (duration containment;
+        # ±2 µs slack for the epoch-anchored whole-µs ts rounding)
+        assert spans["inner"]["ts"] >= spans["outer"]["ts"] - 2
+        assert (
+            spans["inner"]["ts"] + spans["inner"]["dur"]
+            <= spans["outer"]["ts"] + spans["outer"]["dur"] + 2
+        )
+        # ts is anchored to the unix epoch so multi-host files merge on
+        # one time axis
+        import time as _time
+
+        assert abs(spans["outer"]["ts"] / 1e6 - _time.time()) < 300
+
+    def test_max_events_drop_is_counted(self):
+        tracer = Tracer(max_events=2)
+        for i in range(5):
+            with tracer.span(f"s{i}"):
+                pass
+        trace = tracer.chrome_trace()
+        assert len([e for e in trace["traceEvents"] if e.get("ph") == "X"]) == 2
+        assert trace["dropped_events"] == 3
+
+    def test_span_propagates_stop_iteration(self):
+        # _SyncBatches wraps next() in a span; the epoch-ending
+        # StopIteration must survive the context manager
+        tracer = Tracer()
+        it = iter([])
+        with pytest.raises(StopIteration):
+            with tracer.span("host_build"):
+                next(it)
+        assert [e["name"] for e in tracer.chrome_trace()["traceEvents"]
+                if e.get("ph") == "X"] == ["host_build"]
+
+    def test_reused_thread_idents_get_distinct_named_tracks(self):
+        # CPython reuses thread idents once a thread dies (one producer
+        # thread per epoch hits this constantly); two differently-named
+        # occupants of one ident must land on distinct, correctly-named
+        # trace rows
+        tracer = Tracer(process_index=0)
+
+        def spanner():
+            with tracer.span("work"):
+                pass
+
+        for name in ("alpha", "beta"):
+            t = threading.Thread(target=spanner, name=name)
+            t.start()
+            t.join()
+        trace = tracer.chrome_trace()
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert len({s["tid"] for s in spans}) == 2
+        labels = {
+            m["tid"]: m["args"]["name"]
+            for m in trace["traceEvents"]
+            if m.get("name") == "thread_name"
+        }
+        assert set(labels.values()) >= {"alpha", "beta"}
+
+    def test_null_tracer_default_and_set_restore(self):
+        assert isinstance(get_tracer(), NullTracer)
+        tracer = Tracer()
+        previous = set_tracer(tracer)
+        try:
+            assert get_tracer() is tracer
+        finally:
+            set_tracer(previous)
+        assert isinstance(get_tracer(), NullTracer)
+        with get_tracer().span("ignored", step=1):
+            pass  # inert and reusable
+
+
+class TestRuntimeHealth:
+    def test_counters_and_gauges_snapshot(self):
+        health = RuntimeHealth()
+        health.counter("recompiles").inc()
+        health.counter("recompiles").inc(2)
+        health.gauge("rss").set(123)
+        snap = health.snapshot()
+        assert snap["counters"]["recompiles"] == 3
+        assert snap["gauges"]["rss"] == 123
+
+    def test_host_rss_positive_on_linux(self):
+        rss = host_rss_bytes()
+        assert rss is not None and rss > 0
+
+    def test_memory_snapshot_feeds_gauges(self):
+        health = RuntimeHealth()
+        snap = memory_snapshot(health)
+        assert snap["host_rss_bytes"] > 0
+        assert snap["host_peak_rss_bytes"] >= snap["host_rss_bytes"] // 2
+        assert health.snapshot()["gauges"]["host_rss_bytes"] == snap["host_rss_bytes"]
+        # CPU backend reports no device.memory_stats() — key absent, not null
+        assert "device" not in snap or snap["device"] is not None
+
+
+class TestRecompileDetector:
+    def test_fires_on_shape_change_silent_on_steady_state(self):
+        events = EventLog()
+        seen = []
+        events.subscribe(lambda e: seen.append(e))
+        health = RuntimeHealth()
+        detector = RecompileDetector(events=events, health=health)
+        fn = jax.jit(lambda x: x + 1)
+        detector.track("step", fn)
+
+        fn(jnp.ones(4))
+        assert detector.check(epoch=0) == 0  # warmup baseline
+        fn(jnp.ones(4))
+        fn(jnp.ones(4))
+        assert detector.check(epoch=1) == 0  # steady shapes: silent
+        assert detector.recompile_count == 0
+
+        tracer = Tracer(process_index=0)
+        previous = set_tracer(tracer)
+        try:
+            fn(jnp.ones(8))  # forced batch-shape churn
+            assert detector.check(epoch=2) == 1
+        finally:
+            set_tracer(previous)
+        # the recompile also lands as an instant mark on the trace timeline
+        marks = [
+            e for e in tracer.chrome_trace()["traceEvents"]
+            if e.get("ph") == "i" and e["name"] == "recompile"
+        ]
+        assert len(marks) == 1 and marks[0]["args"]["fn"] == "step"
+        assert detector.recompile_count == 1
+        assert health.snapshot()["counters"]["recompiles"] == 1
+        recompile = [e for e in seen if e["event"] == "recompile"]
+        assert len(recompile) == 1
+        assert recompile[0]["fn"] == "step" and recompile[0]["epoch"] == 2
+        # and back to silence
+        fn(jnp.ones(8))
+        assert detector.check(epoch=3) == 0
+
+    def test_non_jitted_functions_ignored(self):
+        detector = RecompileDetector()
+        detector.track("plain", lambda x: x)
+        assert detector.check() == 0
+        assert detector._tracked == {}
+
+
+class TestProducerSpanSampling:
+    def test_span_steps_are_sampled_not_per_batch(self):
+        from code2vec_tpu.train.prefetch import StepProfiler, _span_step
+
+        # warmup + stride, never every step (16k-step epochs must not
+        # flood the bounded trace buffer)
+        spanned = [s for s in range(1000) if _span_step(s, None)]
+        assert set(range(8)) <= set(spanned)
+        assert 64 in spanned and 65 not in spanned
+        assert len(spanned) < 40
+        # profiler-fenced steps are always spanned
+        prof = StepProfiler(sample_steps=1)
+        prof.observe_epoch_length(1000)
+        prof.reset()
+        assert all(_span_step(s, prof) for s in range(1000) if prof.sampled(s))
+
+
+class TestStridedProfiler:
+    def test_first_epoch_is_first_n(self):
+        from code2vec_tpu.train.prefetch import StepProfiler
+
+        prof = StepProfiler(sample_steps=3)
+        assert [s for s in range(10) if prof.sampled(s)] == [0, 1, 2]
+
+    def test_stride_spreads_samples_across_epoch(self):
+        from code2vec_tpu.train.prefetch import StepProfiler
+
+        prof = StepProfiler(sample_steps=4)
+        prof.observe_epoch_length(100)
+        prof.reset()
+        assert prof.stride == 25
+        sampled = [s for s in range(100) if prof.sampled(s)]
+        assert sampled == [0, 25, 50, 75]  # tail steps attributable too
+
+    def test_sample_count_bounded_even_past_estimate(self):
+        from code2vec_tpu.train.prefetch import StepProfiler
+
+        prof = StepProfiler(sample_steps=4)
+        prof.observe_epoch_length(100)
+        prof.reset()
+        # epoch ran longer than estimated: still at most sample_steps
+        assert sum(prof.sampled(s) for s in range(1000)) == 4
+
+    def test_summary_shape_unchanged(self):
+        from code2vec_tpu.train.prefetch import StepProfiler
+
+        prof = StepProfiler(sample_steps=2)
+        prof.observe_epoch_length(8)
+        prof.reset()
+        for s in range(8):
+            if prof.sampled(s):
+                prof.record_host(s, 1.0, 2.0)
+                prof.record_compute(s, 3.0)
+        summary = prof.summary()
+        assert set(summary) == {
+            "host_build_ms", "h2d_ms", "compute_ms", "profiled_steps",
+        }
+        assert summary["profiled_steps"] == 2
+
+
+@pytest.fixture(scope="module")
+def tiny_corpus(tmp_path_factory):
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+
+    out = tmp_path_factory.mktemp("tiny_obs")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    return load_corpus(paths["corpus"], paths["path_idx"], paths["terminal_idx"])
+
+
+class TestTrainTelemetryEndToEnd:
+    """The acceptance criterion: a CPU train with events + tracing."""
+
+    def test_train_run_events_and_trace(self, tiny_corpus, tmp_path):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        cfg = TrainConfig(
+            max_epoch=2, batch_size=32, encode_size=32,
+            terminal_embed_size=16, path_embed_size=16, max_path_length=16,
+            print_sample_cycle=0, prefetch_batches=2, profile_steps=2,
+            checkpoint_cycle=1,
+        )
+        events = EventLog(str(tmp_path / "events"))
+        tracer = Tracer()
+        previous = set_tracer(tracer)
+        sink_calls = []
+
+        class ClosableSink:
+            closed = False
+
+            def __call__(self, epoch, metrics):
+                sink_calls.append((epoch, dict(metrics)))
+
+            def close(self):
+                self.closed = True
+
+        sink = ClosableSink()
+        (tmp_path / "ckpt").mkdir()
+        try:
+            train(
+                cfg, tiny_corpus, out_dir=str(tmp_path / "ckpt"),
+                sinks=(sink,), events=events, tracer=tracer,
+            )
+        finally:
+            set_tracer(previous)
+        events.close()
+        trace_path = tracer.export_dir(str(tmp_path / "trace"))
+
+        # (a) JSONL log: strict-JSON, manifest first, epoch events match
+        # the sink-reported metrics exactly
+        lines = [
+            strict_loads(l)
+            for l in open(events.path, encoding="utf-8")
+        ]
+        assert lines[0]["event"] == "manifest"
+        assert lines[0]["config"]["batch_size"] == 32
+        types = [l["event"] for l in lines]
+        for expected in ("epoch", "step_sample", "eval", "checkpoint_saved"):
+            assert expected in types, expected
+        epoch_events = [l for l in lines if l["event"] == "epoch"]
+        assert len(epoch_events) == 2
+        for event in epoch_events:
+            sink_metrics = next(
+                m for e, m in sink_calls
+                if e == event["epoch"] and "train_loss" in m
+            )
+            assert event["metrics"] == sink_metrics
+            assert event["memory"]["host_rss_bytes"] > 0
+            # the health block REPORTS the steady-shape recompile count
+            assert event["health"]["counters"].get("recompiles", 0) == 0
+            assert event["health"]["gauges"]["host_rss_bytes"] > 0
+        # steady shapes: the recompile detector stayed silent after warmup
+        assert not [l for l in lines if l["event"] == "recompile"]
+        # the train loop's finally closed the closable sink
+        assert sink.closed
+
+        # (b) Chrome trace: loads, and carries spans from the prefetch
+        # producer thread, the train step, and eval
+        trace = json.load(open(trace_path, encoding="utf-8"))
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        names = {s["name"] for s in spans}
+        assert {"host_build", "h2d", "train_step", "eval_pass"} <= names
+        producer_tids = {s["tid"] for s in spans if s["name"] == "host_build"}
+        step_tids = {s["tid"] for s in spans if s["name"] == "train_step"}
+        assert producer_tids and step_tids and not (producer_tids & step_tids)
+        # every span well-formed (B/E are unused; X events need ts + dur)
+        for s in spans:
+            assert s["dur"] >= 0 and s["ts"] >= 0
+
+    def test_failures_clean_up_stream_consumer_and_sinks(self, tiny_corpus):
+        """A raising run must emit an `error` event, unsubscribe the sink
+        consumer from a caller-owned EventLog (no duplicate dispatch on
+        the next train() over the same log), and close closable sinks; a
+        SETUP failure (before any sink-visible event) must not leave a
+        consumer attached either."""
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        cfg = TrainConfig(
+            max_epoch=1, batch_size=32, encode_size=16,
+            terminal_embed_size=8, path_embed_size=8, max_path_length=8,
+            print_sample_cycle=0,
+        )
+        closed = []
+        def sink(epoch, metrics):
+            pass
+        sink.close = lambda: closed.append(True)
+
+        # mid-loop failure: report_fn raises a non-StopTraining error
+        events = EventLog()
+        seen = []
+        events.subscribe(lambda e: seen.append(e))
+        def boom(epoch, f1):
+            raise RuntimeError("boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            train(cfg, tiny_corpus, sinks=(sink,), report_fn=boom, events=events)
+        assert any(e["event"] == "error" for e in seen)
+        assert len(events._consumers) == 1  # only this test's observer
+        assert closed == [True]
+
+        # setup failure: task-flag mismatch raises before any emission
+        events2 = EventLog()
+        bad = cfg.with_updates(infer_method_name=False, infer_variable_name=True)
+        with pytest.raises(ValueError, match="task flags"):
+            train(bad, tiny_corpus, events=events2)
+        assert events2._consumers == []
+
+    def test_passed_tracer_serves_whole_stack_without_global_install(
+        self, tiny_corpus
+    ):
+        """train(tracer=...) without set_tracer must still capture the
+        deeper layers' spans (they fetch the process-wide tracer): the
+        loop installs the passed tracer for the run and restores after."""
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        cfg = TrainConfig(
+            max_epoch=1, batch_size=32, encode_size=16,
+            terminal_embed_size=8, path_embed_size=8, max_path_length=8,
+            print_sample_cycle=0, prefetch_batches=2,
+        )
+        tracer = Tracer(process_index=0)
+        assert isinstance(get_tracer(), NullTracer)
+        train(cfg, tiny_corpus, tracer=tracer)
+        assert isinstance(get_tracer(), NullTracer)  # restored
+        names = {
+            e["name"]
+            for e in tracer.chrome_trace()["traceEvents"]
+            if e.get("ph") == "X"
+        }
+        assert {"host_build", "build_method_epoch", "train_pass"} <= names
+
+    def test_hpo_search_shares_one_event_log(self, tiny_corpus, tmp_path):
+        """--find_hyperparams --events_dir: every trial's events land in
+        ONE log (regression: the HPO path used to drop the CLI's EventLog
+        on the floor), with one manifest and no duplicate sink dispatch
+        left behind by per-trial subscribe/unsubscribe."""
+        import code2vec_tpu.hpo as hpo_mod
+        from code2vec_tpu.train.config import TrainConfig
+
+        base = TrainConfig(
+            max_epoch=1, batch_size=16, max_path_length=16,
+            terminal_embed_size=8, path_embed_size=8,
+            print_sample_cycle=0, early_stop_patience=100,
+        )
+        original = hpo_mod.sample_train_config
+        hpo_mod.sample_train_config = lambda trial, cfg: cfg.with_updates(
+            lr=trial.suggest_float("adam_lr", 1e-3, 1e-2, log=True),
+        )
+        events = EventLog(str(tmp_path))
+        try:
+            hpo_mod.find_optimal_hyperparams(
+                tiny_corpus, base, n_trials=2, seed=0, events=events
+            )
+        finally:
+            hpo_mod.sample_train_config = original
+        events.close()
+        lines = [strict_loads(l) for l in open(events.path, encoding="utf-8")]
+        types = [l["event"] for l in lines]
+        assert types.count("manifest") == 1 and types[0] == "manifest"
+        # the single manifest carries the BASE config, not trial 0's sample
+        assert lines[0]["config"]["batch_size"] == 16
+        assert lines[0]["search"]["n_trials"] == 2
+        # trial markers segment the stream: trial → (its events) → result
+        assert types.count("trial") == 2 and types.count("trial_result") == 2
+        assert [l["number"] for l in lines if l["event"] == "trial"] == [0, 1]
+        assert "adam_lr" in next(
+            l for l in lines if l["event"] == "trial"
+        )["params"]
+        assert types.count("epoch") == 2  # one per trial (1 epoch each)
+        assert events._consumers == []  # each trial unsubscribed its sinks
+
+    def test_cli_flags_reach_telemetry(self):
+        from code2vec_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["--events_dir", "/tmp/e", "--trace_dir", "/tmp/t"]
+        )
+        assert args.events_dir == "/tmp/e" and args.trace_dir == "/tmp/t"
+        assert build_parser().parse_args([]).events_dir is None
+
+    def test_cli_end_to_end_writes_event_log_and_trace(self, tmp_path):
+        from code2vec_tpu.cli import main
+
+        out = tmp_path / "out"
+        main([
+            "--synthetic", "tiny",
+            "--model_path", str(out),
+            "--vectors_path", str(out / "code.vec"),
+            "--max_epoch", "1",
+            "--encode_size", "16",
+            "--terminal_embed_size", "8",
+            "--path_embed_size", "8",
+            "--max_path_length", "8",
+            "--print_sample_cycle", "0",
+            "--events_dir", str(tmp_path / "events"),
+            "--trace_dir", str(tmp_path / "trace"),
+        ])
+        lines = [
+            strict_loads(l)
+            for l in open(tmp_path / "events" / "events-p0.jsonl", encoding="utf-8")
+        ]
+        assert lines[0]["event"] == "manifest"
+        assert any(l["event"] == "epoch" for l in lines)
+        trace = json.load(
+            open(tmp_path / "trace" / "trace-p0.json", encoding="utf-8")
+        )
+        names = {e.get("name") for e in trace["traceEvents"]}
+        assert "train_step" in names and "eval_pass" in names
+        # the CLI restores the process-wide tracer state is NOT required —
+        # but a second run must not crash on a stale tracer
+        assert json.dumps(trace)  # serializable round-trip

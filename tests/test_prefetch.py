@@ -113,6 +113,22 @@ class TestFailureAndShutdown:
             with pytest.raises(ValueError, match="bad sharding"):
                 next(iter(pf))
 
+    def test_yielded_none_is_not_end_of_stream(self):
+        # a buggy builder yielding None must fail loudly in to_device,
+        # not be mistaken for iterator exhaustion (silent truncation)
+        def batches():
+            yield {"x": np.zeros(2)}
+            yield None
+
+        def to_device(batch):
+            return {k: v for k, v in batch.items()}
+
+        with HostPrefetcher(batches(), to_device, depth=2) as pf:
+            it = iter(pf)
+            next(it)
+            with pytest.raises(AttributeError):
+                next(it)
+
     def test_bounded_queue_backpressure(self):
         events = []
         pf = HostPrefetcher(
