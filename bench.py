@@ -32,6 +32,17 @@ per-step host/H2D/compute attribution dict in the detail JSON
 ``--prefetch-ab`` instead A/Bs the HOST input pipeline — synchronous feed
 vs the double-buffered prefetcher (train/prefetch.py) on one spec — and
 reports both steps/sec plus the attribution split (see _prefetch_ab).
+``--bucket-ab`` A/Bs length-aware bucketed batching (data/pipeline.py)
+against the fixed-L feed on an identical skewed synth corpus, same ABBA
+best-of protocol, reporting the wall-clock speedup at equal real-context
+throughput accounting (see _bucket_ab).
+
+Metric honesty: the headline counts REAL path contexts (summed batch
+masks / staged row counts), not padded slots — bag lengths are heavy-
+tailed, so at fixed L the majority of B x L slots can be PAD, and
+crediting them inflated the metric by exactly the padding waste. Detail
+blocks carry ``pad_efficiency`` (real/padded) and ``padded_slots_per_sec``
+(the pre-change accounting) so rounds across the change stay comparable.
 """
 
 from __future__ import annotations
@@ -52,6 +63,8 @@ def _metric_id() -> tuple[str, str]:
     run gets logged against the device-epoch headline metric."""
     if "--prefetch-ab" in sys.argv[1:]:
         return "host_pipeline_steps_per_sec", "steps/sec"
+    if "--bucket-ab" in sys.argv[1:]:
+        return "bucketed_real_contexts_per_sec", "contexts/sec"
     return "path_contexts_per_sec_per_chip", "contexts/sec"
 
 
@@ -141,9 +154,9 @@ def _extract_metric_name(payload: dict) -> str | None:
     return None
 
 
-def _previous_benchmark(current_backend: str) -> float | None:
+def _previous_benchmark(current_backend: str) -> tuple[float, bool] | None:
     """Newest successful prior round measured on the SAME kind of backend
-    AND the same metric.
+    AND the same metric: (value, padded_accounting).
 
     A fell-back CPU round must not become the baseline for a healthy device
     run (a ~2000x vs_baseline is no signal at all), and vice versa — so
@@ -153,6 +166,12 @@ def _previous_benchmark(current_backend: str) -> float | None:
     metric name — comparing that against contexts/sec would be a
     meaningless cross-unit ratio, so mismatched-metric rounds are skipped
     (unlabeled legacy rounds count as the headline metric).
+
+    ``padded_accounting``: the headline changed semantics from padded slots
+    to real contexts; a round that predates the change (no pad_efficiency
+    anywhere in its record) stored a padded-slot number, and vs_baseline
+    must divide the SAME quantity into it or the accounting change reads
+    as a phantom ~pad_efficiency× perf regression.
     """
     want_cpu = current_backend == "cpu"
     want_metric = _metric_id()[0]
@@ -180,7 +199,8 @@ def _previous_benchmark(current_backend: str) -> float | None:
             continue
         if int(m.group(1)) > best_round:
             best_round = int(m.group(1))
-            best = value
+            padded = "pad_efficiency" not in json.dumps(payload)
+            best = (value, padded)
     return best
 
 
@@ -198,6 +218,19 @@ def _mu_dtype_from_env() -> str:
         f"BENCH_ADAM_MU_DTYPE={raw!r}: expected float32/f32/fp32 or "
         "bfloat16/bf16"
     )
+
+
+def _recipe_knob(
+    name: str, device_default: int, cpu_default: int,
+    fell_back: bool, backend: str,
+) -> int:
+    """An int recipe knob: env override, else a backend-sized default —
+    the CPU fallback shrinks the recipe so a fallback run still finishes
+    inside the bench deadline. Shared by every A/B mode so the
+    CPU-fallback default logic cannot diverge between them."""
+    if name in os.environ:
+        return int(os.environ[name])
+    return cpu_default if fell_back or backend == "cpu" else device_default
 
 
 def _env_float(name: str, default: float) -> float:
@@ -554,9 +587,7 @@ def _prefetch_ab() -> None:
     # representative of a device run — on CPU the full-size step is seconds
     # of compute and any feed-side win would drown in run-to-run noise
     def knob(name: str, device_default: int, cpu_default: int) -> int:
-        if name in os.environ:
-            return int(os.environ[name])
-        return cpu_default if fell_back or backend == "cpu" else device_default
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
 
     batch_size = knob("BENCH_BATCH", 1024, 256)
     bag = knob("BENCH_BAG", 200, 64)
@@ -657,6 +688,20 @@ def _prefetch_ab() -> None:
     # compile + cache warm (not timed)
     one_pass(prefetch=0, arm_steps=2)
 
+    # real-context accounting: both arms feed IDENTICAL batches, so one
+    # untimed pass over the same stream counts the non-PAD slots the
+    # timed passes actually process (PAD paths are index 0)
+    real_slots = 0
+    accounting = make_batches()
+    for done, b in enumerate(accounting):
+        if done >= steps:
+            break
+        valid_rows = b["example_mask"].astype(bool)
+        real_slots += int((b["paths"][valid_rows] != 0).sum())
+    close = getattr(accounting, "close", None)
+    if close is not None:
+        close()
+
     profiler = StepProfiler(attr_steps)
     one_pass(prefetch=0, profiler=profiler, arm_steps=max(attr_steps, 1))
     attribution = profiler.summary()
@@ -697,6 +742,18 @@ def _prefetch_ab() -> None:
                     "prefetch_depth": depth,
                     "sync_steps_per_sec": round(sync_sps, 3),
                     "prefetch_steps_per_sec": round(pref_sps, 3),
+                    "pad_efficiency": round(
+                        real_slots / (sync_steps * batch_size * bag), 4
+                    ) if sync_steps else None,
+                    "sync_real_contexts_per_sec": round(
+                        real_slots / min(sync_times), 1
+                    ),
+                    "prefetch_real_contexts_per_sec": round(
+                        real_slots / min(pref_times), 1
+                    ),
+                    "padded_slots_per_sec": round(
+                        sync_steps * batch_size * bag / min(pref_times), 1
+                    ),
                     "speedup": round(speedup, 4),
                     "attribution": attribution,
                     "memory": memory_snapshot(),
@@ -713,6 +770,205 @@ def _prefetch_ab() -> None:
                 "value": round(pref_sps, 3),
                 "unit": "steps/sec",
                 # in AB mode the baseline IS the same-spec synchronous arm
+                "vs_baseline": round(speedup, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _bucket_ab() -> None:
+    """``--bucket-ab``: fixed-L vs length-aware bucketed batching A/B.
+
+    Same host-pipeline harness as ``--prefetch-ab`` and the same ABBA
+    best-of protocol, on an identically skewed synth corpus (lognormal
+    bag lengths, ``BENCH_LENGTH_SIGMA``): both arms train on the SAME
+    epoch arrays (one context subsample, shared), the fixed arm through
+    ``iter_batches`` at bag ``L`` and the bucketed arm through
+    ``iter_bucketed_batches`` over the histogram-derived ladder. Each arm
+    processes every example exactly once per pass, so equal real-context
+    work — the wall-clock ratio IS the padding waste recovered. The
+    metric line reports the bucketed arm's real-context throughput with
+    ``vs_baseline`` = the bucketed/fixed speedup; detail carries both
+    arms' real-context and padded-slot rates plus ``pad_efficiency``, and
+    the recompile detector (budgeted to the ladder) confirms the bucket
+    shapes cost exactly their expected compiles.
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.pipeline import (
+        build_method_epoch,
+        derive_bucket_ladder,
+        epoch_context_counts,
+        iter_batches,
+        iter_bucketed_batches,
+        pad_stats,
+    )
+    from code2vec_tpu.data.synth import (
+        SynthSpec,
+        corpus_data_from_raw,
+        generate_corpus_data,
+    )
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.obs.runtime import RecompileDetector, memory_snapshot
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state, make_train_step
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    batch_size = knob("BENCH_BATCH", 1024, 128)
+    bag = knob("BENCH_BAG", 200, 48)
+    steps = knob("BENCH_AB_STEPS", 30, 10)  # full fixed-L batches per pass
+    embed_size = knob("BENCH_EMBED", 100, 8)
+    encode_size = knob("BENCH_ENCODE", 100, 16)
+    mean_ctx = knob("BENCH_AB_MEAN_CTX", 60, 16)
+    sigma = _env_float("BENCH_LENGTH_SIGMA", 1.0)
+
+    # the skew IS the experiment: lognormal lengths (sigma >= 0.6 per the
+    # acceptance protocol) with a mean well under the bag, so fixed-L pads
+    # most slots; max_contexts 2x bag exercises the top bucket's subsample
+    spec = SynthSpec(
+        n_methods=max(batch_size * steps, 2048),
+        n_terminals=knob("BENCH_AB_TERMINALS", 360_631, 20_000),
+        n_paths=knob("BENCH_AB_PATHS", 342_845, 20_000),
+        n_labels=knob("BENCH_AB_LABELS", 8_000, 800),
+        mean_contexts=float(mean_ctx),
+        length_sigma=sigma,
+        max_contexts=2 * bag,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+    ladder = derive_bucket_ladder(np.diff(data.row_splits), bag)
+
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(data.label_vocab),
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,
+        dropout_prob=0.25,
+        dtype=jnp.float32,
+    )
+    config = TrainConfig(
+        batch_size=batch_size,
+        max_path_length=bag,
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
+    )
+    class_weights = jnp.ones(model_config.label_count, jnp.float32)
+
+    # ONE shared context subsample: both arms see identical per-example
+    # rows; the bucketed arm just stops padding them to the full bag
+    rng = np.random.default_rng(0)
+    epoch = build_method_epoch(data, np.arange(data.n_items), bag, rng)
+    counts = epoch_context_counts(epoch)
+    real_total = int(counts.sum())
+    _, fixed_slots = pad_stats(counts, (bag,), batch_size)
+    _, bucket_slots = pad_stats(counts, ladder, batch_size)
+
+    example = next(iter_batches(epoch, batch_size, rng=None, pad_final=False))
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+    train_step = make_train_step(model_config, class_weights)
+    detector = RecompileDetector()
+    # the ladder's top width IS the fixed width, so the two arms share
+    # len(ladder) step shapes total — the whole expected compile budget
+    detector.track("train_step", train_step, expected_compiles=len(ladder))
+
+    def one_pass(batches) -> tuple[int, float]:
+        nonlocal state
+        n = 0
+        t0 = time.perf_counter()
+        for b in batches:
+            state, loss = train_step(state, jax.device_put(b))
+            float(loss)  # per-step loss sync, mirroring train/loop.py
+            n += 1
+        return n, time.perf_counter() - t0
+
+    def fixed_batches():
+        return iter_batches(epoch, batch_size, rng=None, pad_final=True)
+
+    def bucketed_batches():
+        # fresh seeded rng per pass -> identical batches every pass
+        return iter_bucketed_batches(
+            epoch, ladder, batch_size, rng=np.random.default_rng(2),
+            pad_final=True,
+        )
+
+    # warmup: compile every ladder width + the fixed width (not timed)
+    one_pass(fixed_batches())
+    one_pass(bucketed_batches())
+    detector.check()  # within budget: counts nothing
+
+    repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 3)), 1)
+    fixed_times: list[float] = []
+    bucket_times: list[float] = []
+    fixed_steps = bucket_steps = 0
+    for _ in range(repeats):
+        fixed_steps, t = one_pass(fixed_batches())
+        fixed_times.append(t)
+        bucket_steps, t = one_pass(bucketed_batches())
+        bucket_times.append(t)
+        bucket_steps, t = one_pass(bucketed_batches())
+        bucket_times.append(t)
+        fixed_steps, t = one_pass(fixed_batches())
+        fixed_times.append(t)
+    recompiles = detector.check()  # post-warmup churn would show here
+    speedup = min(fixed_times) / min(bucket_times)
+    bucket_rps = real_total / min(bucket_times)
+
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": backend,
+                    "mode": "bucket_ab",
+                    "batch": batch_size,
+                    "bag": bag,
+                    "ladder": list(ladder),
+                    "length_sigma": sigma,
+                    "mean_contexts": mean_ctx,
+                    "n_methods": spec.n_methods,
+                    "fixed_steps": fixed_steps,
+                    "bucketed_steps": bucket_steps,
+                    "pad_efficiency_fixed": round(real_total / fixed_slots, 4),
+                    "pad_efficiency_bucketed": round(
+                        real_total / bucket_slots, 4
+                    ),
+                    "fixed_real_contexts_per_sec": round(
+                        real_total / min(fixed_times), 1
+                    ),
+                    "bucketed_real_contexts_per_sec": round(bucket_rps, 1),
+                    "fixed_padded_slots_per_sec": round(
+                        fixed_slots / min(fixed_times), 1
+                    ),
+                    "bucketed_padded_slots_per_sec": round(
+                        bucket_slots / min(bucket_times), 1
+                    ),
+                    "speedup": round(speedup, 4),
+                    "post_warmup_recompiles": recompiles,
+                    "memory": memory_snapshot(),
+                }
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "bucketed_real_contexts_per_sec",
+                "value": round(bucket_rps, 1),
+                "unit": "contexts/sec",
+                # in AB mode the baseline IS the same-spec fixed-L arm
                 "vs_baseline": round(speedup, 4),
                 "backend": backend,
             }
@@ -869,9 +1125,16 @@ def main() -> None:
     sample_prefetch = os.environ.get(
         "BENCH_SAMPLE_PREFETCH", "0"
     ).strip().lower() in ("1", "true", "yes", "on")
+    # real-context accounting: the device sampler fills min(count, bag)
+    # slots per sampled row — everything else in the [B, bag] batch is PAD.
+    # Summed over the measured rows this is the work actually done, vs the
+    # B x bag x steps padded-slot credit the headline used to claim.
+    item_counts = np.diff(data.row_splits)
+    counts_capped = np.minimum(item_counts, bag).astype(np.int64)
     if shard_staged:
         from code2vec_tpu.train.device_epoch import (
             ShardedEpochRunner,
+            partition_items_balanced,
             stage_method_corpus_sharded,
         )
 
@@ -886,6 +1149,16 @@ def main() -> None:
         run_chunk = runner._train_chunk(chunk)
         span = chunk * runner.per_shard
         valid = np.ones((runner.n_shards, span), np.float32)
+        # the same deterministic snake partition shard_staged used, so a
+        # shard-local row index maps back to its item's context count
+        groups = partition_items_balanced(item_counts, runner.n_shards)
+        counts_mat = np.zeros((runner.n_shards, staged.items_cap), np.int64)
+        for s, g in enumerate(groups):
+            counts_mat[s, : len(g)] = counts_capped[g]
+        shard_ids = np.arange(runner.n_shards)[:, None]
+
+        def real_of(rows) -> int:
+            return int(counts_mat[shard_ids, rows].sum())
 
         def make_rows():
             # max(counts, 1): an empty shard (n_items < data_axis) still
@@ -916,6 +1189,10 @@ def main() -> None:
         run_chunk = runner._train_chunk(chunk)
         n_valid = chunk * batch_size
 
+        def real_of(rows) -> int:
+            # staging preserves item order, so row i IS item i
+            return int(counts_capped[rows].sum())
+
         def make_rows():
             return rng.integers(0, data.n_items, n_valid).astype(np.int32)
 
@@ -942,10 +1219,15 @@ def main() -> None:
 
     n_chunks = -(-steps // chunk)
     steps = n_chunks * chunk
+    measured_real = 0  # real (non-PAD) context slots in the measured window
     with get_tracer().span("bench_measure", category="bench", chunks=n_chunks):
         t0 = time.perf_counter()
         for _ in range(n_chunks):
-            state, loss, key = run(state, key, make_rows())
+            rows = make_rows()
+            # a numpy gather-sum over the chunk's rows, ~µs against ms-scale
+            # dispatches — the honest numerator costs nothing measurable
+            measured_real += real_of(rows)
+            state, loss, key = run(state, key, rows)
         jax.block_until_ready(loss)
         elapsed = time.perf_counter() - t0
 
@@ -982,11 +1264,24 @@ def main() -> None:
         }
 
     # per-chip normalization keeps the metric comparable across mesh sizes
-    # (a meshed run measures aggregate throughput over mesh.size chips)
+    # (a meshed run measures aggregate throughput over mesh.size chips).
+    # The headline counts REAL contexts; padded_slots_per_sec keeps the
+    # pre-change accounting visible next to it.
     n_chips = 1 if mesh is None else mesh.size
-    contexts_per_sec = batch_size * bag * steps / elapsed / n_chips
+    padded_slots = batch_size * bag * steps
+    padded_slots_per_sec = padded_slots / elapsed / n_chips
+    contexts_per_sec = measured_real / elapsed / n_chips
+    pad_efficiency = measured_real / padded_slots if padded_slots else 1.0
     previous = _previous_benchmark(backend)
-    vs_baseline = contexts_per_sec / previous if previous else 1.0
+    if previous is None:
+        vs_baseline = 1.0
+    else:
+        prev_value, prev_padded = previous
+        # like-for-like: a pre-honesty round stored padded slots, so divide
+        # padded slots into it — not real contexts, which would print the
+        # accounting change as a phantom ~pad_efficiency× regression
+        current = padded_slots_per_sec if prev_padded else contexts_per_sec
+        vs_baseline = current / prev_value if prev_value else 1.0
 
     from code2vec_tpu.obs.runtime import memory_snapshot
 
@@ -1001,6 +1296,9 @@ def main() -> None:
                 "detail": {
                     "backend": backend,
                     "steps_per_sec": round(steps / elapsed, 3),
+                    "real_contexts_per_sec": round(contexts_per_sec, 1),
+                    "padded_slots_per_sec": round(padded_slots_per_sec, 1),
+                    "pad_efficiency": round(pad_efficiency, 4),
                     "batch": batch_size,
                     "bag": bag,
                     "mesh": None if mesh is None else dict(mesh.shape),
@@ -1044,6 +1342,8 @@ if __name__ == "__main__":
     try:
         if "--prefetch-ab" in sys.argv[1:]:
             _prefetch_ab()
+        elif "--bucket-ab" in sys.argv[1:]:
+            _bucket_ab()
         else:
             main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver

@@ -15,6 +15,7 @@ import shutil
 from dataclasses import asdict, dataclass, field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -268,6 +269,9 @@ def restore_checkpoint(
         params=restored["params"],
         opt_state=restored["opt_state"],
         dropout_rng=dropout_rng,
-        step=int(restored["step"]),
+        # int32 array, not a weak Python int: a weak-typed step would trace
+        # one extra jit-cache entry on the first post-resume step (see
+        # create_train_state) and overflow the bucketed recompile budget
+        step=jnp.asarray(int(restored["step"]), jnp.int32),
     )
     return new_state, saved_meta

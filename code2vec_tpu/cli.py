@@ -163,6 +163,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="each process loads only its round-robin share "
                         "of the corpus (multi-host pods; context arrays "
                         "are held 1/n_hosts per host)")
+    parser.add_argument("--bucketed", action="store_true", default=False,
+                        help="length-aware bucketed batching: partition "
+                        "each epoch by real context count into a static "
+                        "ladder of bag widths and run [B, L_b] batches per "
+                        "bucket — stops paying embedding/attention/HBM "
+                        "cost for PAD slots on skewed corpora (exactly "
+                        "len(ladder) step compiles)")
+    parser.add_argument("--bucket_ladder", type=str, default="",
+                        help="comma list of bag widths ending at "
+                        "--max_path_length (e.g. 25,50,100,200); empty = "
+                        "derive a geometric ladder from the corpus length "
+                        "histogram (see tools/corpus_stats.py)")
     parser.add_argument("--stream_chunk_items", type=int, default=0,
                         help="stream epochs in chunks of this many rows "
                         "instead of materializing [N, L] tensors (bounds "
@@ -275,6 +287,8 @@ def config_from_args(args: argparse.Namespace):
         checkpoint_cycle=args.checkpoint_cycle,
         device_epoch=args.device_epoch,
         shard_staged_corpus=args.shard_staged_corpus,
+        bucketed=args.bucketed,
+        bucket_ladder=args.bucket_ladder,
         stream_chunk_items=args.stream_chunk_items,
         device_chunk_batches=args.device_chunk_batches,
         prefetch_batches=args.prefetch_batches,

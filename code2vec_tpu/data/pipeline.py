@@ -361,6 +361,173 @@ def iter_batches(
         }
 
 
+# ---------------------------------------------------------------------------
+# Length-aware bucketed batching
+#
+# Bag lengths are heavy-tailed (data/synth.py models them as lognormal), so
+# padding every example to one fixed ``max_contexts`` makes PAD slots the
+# majority of the embedding gathers, attention FLOPs, and HBM traffic per
+# step on a skewed corpus. The bucketizer partitions examples by REAL
+# context count into a small static ladder of bag widths (geometric,
+# capped at ``max_contexts``) and emits ``[B, L_b]`` batches per bucket:
+# jit caches per shape, so a run compiles exactly ``len(ladder)`` step
+# variants and then reuses them forever. Because PAD positions carry zero
+# attention weight (ops.attention masks them to -inf), an example's
+# forward pass is identical at any bag width >= its real count — the
+# per-example loss multiset over an epoch is invariant to bucketing
+# (tests/test_bucketing.py enforces this).
+# ---------------------------------------------------------------------------
+
+
+def derive_bucket_ladder(
+    counts: np.ndarray,
+    max_contexts: int,
+    max_buckets: int = 4,
+    min_fraction: float = 0.05,
+    min_width: int = 8,
+) -> tuple[int, ...]:
+    """A geometric ladder of bag widths capped at ``max_contexts``, pruned
+    by the corpus length histogram.
+
+    Candidate widths halve down from ``max_contexts`` (e.g. 200 -> {25, 50,
+    100, 200}); a narrow width is kept only if at least ``min_fraction`` of
+    the examples would land in its bucket — sparse buckets just add a
+    compile without saving meaningful padding. The top width is always
+    ``max_contexts`` so long bags are never truncated relative to the
+    fixed-width path.
+    """
+    if max_buckets < 1:
+        raise ValueError(f"max_buckets must be >= 1, got {max_buckets}")
+    widths: list[int] = []
+    w = int(max_contexts)
+    while len(widths) < max_buckets and w >= min_width:
+        widths.append(w)
+        nxt = -(-w // 2)
+        if nxt == w:
+            break
+        w = nxt
+    widths = sorted(set(widths))
+    counts = np.minimum(np.asarray(counts), max_contexts)
+    if len(counts) and len(widths) > 1:
+        kept: list[int] = []
+        prev = 0
+        for width in widths[:-1]:
+            frac = ((counts > prev) & (counts <= width)).mean()
+            if frac >= min_fraction:
+                kept.append(width)
+                prev = width
+        kept.append(widths[-1])
+        widths = kept
+    return tuple(widths)
+
+
+def parse_bucket_ladder(spec: str, max_contexts: int) -> tuple[int, ...] | None:
+    """Parse a ``--bucket_ladder`` comma list (e.g. ``"25,50,100,200"``);
+    None for an empty spec (= derive from the corpus). The top width must
+    equal ``max_contexts``: a ladder topping below it would silently
+    truncate long bags relative to the fixed-width path."""
+    if spec is None or not spec.strip():
+        return None
+    try:
+        widths = sorted({int(tok) for tok in spec.split(",") if tok.strip()})
+    except ValueError as exc:
+        raise ValueError(f"malformed bucket ladder {spec!r}: {exc}") from None
+    if not widths or widths[0] < 1:
+        raise ValueError(f"bucket ladder widths must be >= 1, got {spec!r}")
+    if widths[-1] != max_contexts:
+        raise ValueError(
+            f"bucket ladder must end at max_contexts ({max_contexts}) so "
+            f"long bags are not truncated; got top width {widths[-1]}"
+        )
+    return tuple(widths)
+
+
+def assign_buckets(counts: np.ndarray, ladder: tuple[int, ...]) -> np.ndarray:
+    """Bucket index per example: the smallest ladder width holding its
+    (capped) real context count."""
+    arr = np.asarray(ladder)
+    return np.searchsorted(arr, np.minimum(counts, arr[-1]), side="left")
+
+
+def epoch_context_counts(epoch: EpochArrays) -> np.ndarray:
+    """Real (non-PAD) contexts per example. Epoch rows fill contiguously
+    from position 0 and PAD paths are index 0, so this is exact."""
+    return (epoch.paths != PAD_INDEX).sum(axis=1)
+
+
+def pad_stats(
+    counts: np.ndarray,
+    ladder: tuple[int, ...],
+    batch_size: int,
+    pad_final: bool = True,
+) -> tuple[int, int]:
+    """(real context slots, padded slots) for one epoch of bucketed batches
+    — the ``pad_efficiency`` accounting. A single-width ladder gives the
+    fixed-``L`` numbers."""
+    counts = np.minimum(np.asarray(counts), ladder[-1])
+    bucket_of = assign_buckets(counts, ladder)
+    real = int(counts.sum())
+    slots = 0
+    for b, width in enumerate(ladder):
+        n_b = int((bucket_of == b).sum())
+        n_batches = -(-n_b // batch_size) if pad_final else n_b // batch_size
+        slots += n_batches * batch_size * width
+    return real, slots
+
+
+def iter_bucketed_batches(
+    epoch: EpochArrays,
+    ladder: tuple[int, ...],
+    batch_size: int,
+    rng: np.random.Generator | None = None,
+    pad_final: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield static-shape ``[B, L_b]`` batches, one width per bucket.
+
+    Same contract as :func:`iter_batches` — every batch has exactly
+    ``batch_size`` rows, the final partial batch OF EACH BUCKET is padded
+    with a repeated row and masked via ``example_mask`` — except the bag
+    width varies over the (static) ladder. Examples keep their full
+    subsampled context rows (bucket width >= real count by construction),
+    so the forward math per example matches the fixed-width path exactly.
+
+    ``rng`` drives both the within-bucket shuffle and the deterministic
+    bucket interleave (a seeded permutation of the batch schedule);
+    ``rng=None`` (eval) emits buckets sequentially in ladder order.
+    """
+    bucket_of = assign_buckets(epoch_context_counts(epoch), ladder)
+    plans: list[tuple[int, np.ndarray]] = []
+    for b, width in enumerate(ladder):
+        members = np.flatnonzero(bucket_of == b)
+        if rng is not None:
+            members = members[rng.permutation(len(members))]
+        stop = (
+            len(members)
+            if pad_final
+            else len(members) - len(members) % batch_size
+        )
+        for lo in range(0, stop, batch_size):
+            plans.append((width, members[lo : lo + batch_size]))
+    if rng is not None:
+        plans = [plans[i] for i in rng.permutation(len(plans))]
+    for width, idx in plans:
+        valid = len(idx)
+        if valid < batch_size:
+            idx = np.concatenate(
+                [idx, np.full(batch_size - valid, idx[0], idx.dtype)]
+            )
+        mask = np.zeros(batch_size, np.float32)
+        mask[:valid] = 1.0
+        yield {
+            "ids": epoch.ids[idx],
+            "starts": epoch.starts[idx, :width],
+            "paths": epoch.paths[idx, :width],
+            "ends": epoch.ends[idx, :width],
+            "labels": epoch.labels[idx],
+            "example_mask": mask,
+        }
+
+
 def iter_streaming_batches(
     epoch_builder,
     item_idx: np.ndarray,

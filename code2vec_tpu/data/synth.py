@@ -44,6 +44,10 @@ class SynthSpec:
     n_labels: int = 60
     n_vars: int = 12  # @var_0..@var_{n-1} terminal tokens
     mean_contexts: float = 60.0  # per-method bag size (lognormal-ish)
+    # lognormal sigma of the per-method bag-size distribution: 0.0 is a
+    # (clipped) constant-length corpus, larger values grow the heavy tail —
+    # the length-skew knob the bucketed-batching A/B and tests dial
+    length_sigma: float = 0.6
     max_contexts: int = 400
     signal: float = 0.8  # fraction of a bag drawn from the label's signature
     signature_size: int = 40
@@ -188,7 +192,9 @@ def generate_corpus_data(spec: SynthSpec) -> RawCorpus:
 
     label_ids = rng.integers(0, spec.n_labels, spec.n_methods, dtype=np.int64)
     counts = np.clip(
-        rng.lognormal(np.log(spec.mean_contexts), 0.6, spec.n_methods).astype(np.int64),
+        rng.lognormal(
+            np.log(spec.mean_contexts), spec.length_sigma, spec.n_methods
+        ).astype(np.int64),
         3,
         spec.max_contexts,
     )

@@ -96,6 +96,14 @@ class RecompileDetector:
     shape-churned batches — each growth is a silent recompile costing
     seconds. ``track`` ignores functions without a ``_cache_size`` probe
     (injected non-jitted steps), so wiring is unconditional.
+
+    ``expected_compiles``: a per-function compile BUDGET for functions that
+    legitimately serve several static shapes — length-aware bucketed
+    batching compiles the step once per ladder width. Cache growth up to
+    the budget counts as warmup and stays silent at every check (not just
+    the first); only growth beyond ``max(budget, observed)`` fires the
+    ``recompile`` warning/event. Without it the first observation is the
+    baseline, as before.
     """
 
     def __init__(self, events=None, health: RuntimeHealth | None = None):
@@ -103,12 +111,22 @@ class RecompileDetector:
         self._counter = (
             health.counter("recompiles") if health is not None else Counter()
         )
-        # name -> [fn, last observed cache size or None (pre-warmup)]
+        # name -> [fn, last observed cache size or None (pre-warmup)];
+        # budgeted fns start at their budget instead of None — the ladder's
+        # compiles are expected whenever they happen, so there is no
+        # first-observation grace to confuse with real churn
         self._tracked: dict[str, list] = {}
 
-    def track(self, name: str, fn):
+    def track(self, name: str, fn, expected_compiles: int | None = None):
         if callable(getattr(fn, "_cache_size", None)):
-            self._tracked[name] = [fn, None]
+            baseline = None
+            if expected_compiles is not None:
+                if expected_compiles < 1:
+                    raise ValueError(
+                        f"expected_compiles must be >= 1, got {expected_compiles}"
+                    )
+                baseline = int(expected_compiles)
+            self._tracked[name] = [fn, baseline]
         return fn
 
     @property

@@ -26,15 +26,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from code2vec_tpu.parallel.shardings import batch_shardings
+from code2vec_tpu.parallel.shardings import cached_batch_shardings
 
 logger = logging.getLogger(__name__)
 
 # the batch assemblers below run once per train/eval STEP (and, with
 # --prefetch_batches, on the input-pipeline producer thread) — rebuilding
 # the six NamedShardings per call is pure per-step host overhead, and the
-# layout is a function of the mesh alone. Mesh is hashable; memoize.
-_cached_batch_shardings = functools.lru_cache(maxsize=8)(batch_shardings)
+# layout is a function of the mesh alone (shape-free: every bucket width
+# of a bucketed run shares it). The cache now lives in parallel.shardings
+# so every placement site shares ONE memo; this alias keeps the
+# historical local name.
+_cached_batch_shardings = cached_batch_shardings
 
 
 def initialize_from_env() -> bool:

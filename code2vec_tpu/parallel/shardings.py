@@ -17,6 +17,7 @@ inserts automatically under jit.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -86,9 +87,21 @@ def batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     }
 
 
+@functools.lru_cache(maxsize=16)
+def cached_batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """The batch-layout NamedShardings, cached per mesh — the canonical
+    accessor for every per-batch placement site (train loop ``to_device``,
+    the prefetch producer, device-epoch constraints). NamedShardings are
+    shape-free, so ALL bag widths of a bucketed run (every ``[B, L_b]`` in
+    the ladder) reuse the same cached dict: switching bucket widths
+    mid-epoch costs no sharding reconstruction. Callers must treat the
+    returned dict as immutable."""
+    return batch_shardings(mesh)
+
+
 def shard_batch(mesh: Mesh, batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:
     """Place a host batch onto the mesh with the batch layout above."""
-    shardings = batch_shardings(mesh)
+    shardings = cached_batch_shardings(mesh)
     return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
 
 

@@ -84,6 +84,20 @@ class TrainConfig:
     # depend on it — pin it explicitly to resume a run under a different
     # model_axis (the restore validates and explains a mismatch)
     vocab_pad_multiple: int = 0
+    # length-aware bucketed batching (data/pipeline.py bucketizer): partition
+    # each epoch's examples by REAL context count into a static ladder of bag
+    # widths and emit [B, L_b] batches per bucket — on a skewed corpus most
+    # steps stop paying embedding gathers / attention FLOPs / HBM traffic
+    # for PAD slots. jit caches per shape, so a run compiles exactly
+    # len(ladder) step variants (the recompile detector is budgeted
+    # accordingly). Per-example forward math is unchanged (PAD carries zero
+    # attention weight), so the per-example loss multiset is invariant.
+    # Host pipeline and device_epoch; not composable with host-sharded
+    # feeding, streaming epochs, or shard_staged_corpus.
+    bucketed: bool = False
+    # comma list of bag widths ending at max_path_length (e.g. "25,50,100,200");
+    # empty = derive a geometric ladder from the corpus length histogram
+    bucket_ladder: str = ""
     # streaming epochs: build at most this many epoch rows at a time instead
     # of materializing the whole [N, L] epoch (0 = materialize). Bounds host
     # RSS at java-large scale — see docs/ARCHITECTURE.md memory budget

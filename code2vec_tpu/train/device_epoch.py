@@ -711,9 +711,11 @@ class EpochRunner:
         self.shuffle_variable_ids = shuffle_variable_ids
         self.sample_prefetch = sample_prefetch
         if mesh is not None:
-            from code2vec_tpu.parallel.shardings import batch_shardings
+            from code2vec_tpu.parallel.shardings import cached_batch_shardings
 
-            self._batch_shardings = batch_shardings(mesh)
+            # shape-free, mesh-keyed: every bucket width's runner reuses
+            # the same NamedSharding dict
+            self._batch_shardings = cached_batch_shardings(mesh)
         self._raw_train = build_train_step_fn(
             model_config, class_weights, table_update
         )
@@ -894,6 +896,176 @@ class EpochRunner:
         )
 
 
+@dataclass
+class BucketedStagedCorpus:
+    """A staged corpus partitioned by context count into a static ladder of
+    bag widths (data.pipeline's bucketizer applied at staging): one
+    :class:`StagedCorpus` per non-empty bucket, each sampled/scanned at its
+    own width by :class:`BucketedEpochRunner`. Rows keep their full context
+    lists (bucket width only bounds the SAMPLED window, exactly like the
+    fixed-width runner's ``bag``)."""
+
+    buckets: list[tuple[int, StagedCorpus]]  # (bag width, staged rows)
+
+    @property
+    def n_items(self) -> int:
+        return sum(s.n_items for _, s in self.buckets)
+
+    @property
+    def n_contexts(self) -> int:
+        return sum(s.n_contexts for _, s in self.buckets)
+
+    @property
+    def contexts(self):
+        """First bucket's context array (device/placement introspection)."""
+        return self.buckets[0][1].contexts
+
+    def host_labels(self) -> np.ndarray:
+        """Labels in bucket-concatenation order — the ``expected`` array
+        matching :meth:`BucketedEpochRunner.run_eval_epoch`'s preds."""
+        return np.concatenate(
+            [np.asarray(s.labels) for _, s in self.buckets]
+        ) if self.buckets else np.zeros(0, np.int32)
+
+
+def bucket_staged(
+    staged: StagedCorpus,
+    ladder: tuple[int, ...],
+    device: Any | None = None,
+) -> BucketedStagedCorpus:
+    """Partition a HOST-staged corpus's rows by context count into ladder
+    buckets and place each bucket on ``device``. Rows with more contexts
+    than the top width land in the top bucket (the rotation-window sampler
+    subsamples them, same as the fixed-width path). Empty buckets are
+    dropped — they would only cost a compile — except the top one, which
+    is always staged (possibly with zero rows) so an empty split behaves
+    like the fixed-width path: placement introspection (``.contexts``)
+    works and the runners fall through their empty chunk plans."""
+    from code2vec_tpu.data.pipeline import assign_buckets
+
+    rs = np.asarray(staged.row_splits).astype(np.int64)
+    ctx = np.asarray(staged.contexts)
+    labels = np.asarray(staged.labels)
+    flags = (
+        None if staged.remap_flags is None else np.asarray(staged.remap_flags)
+    )
+    counts = np.diff(rs)
+    bucket_of = assign_buckets(counts, ladder)
+    out: list[tuple[int, StagedCorpus]] = []
+    for b, width in enumerate(ladder):
+        members = np.flatnonzero(bucket_of == b)
+        if not len(members) and width != ladder[-1]:
+            continue
+        flat, _, _ = flat_context_indices(rs, members)
+        sub_splits = np.zeros(len(members) + 1, np.int64)
+        np.cumsum(counts[members], out=sub_splits[1:])
+        sub = StagedCorpus(
+            contexts=ctx[flat],
+            row_splits=sub_splits,
+            labels=labels[members],
+            n_items=len(members),
+            remap_ids=(
+                None
+                if staged.remap_ids is None
+                else np.asarray(staged.remap_ids)
+            ),
+            remap_flags=None if flags is None else flags[members],
+        )
+        out.append((width, place_staged(sub, device=device)))
+    return BucketedStagedCorpus(buckets=out)
+
+
+class BucketedEpochRunner:
+    """Bucketed counterpart of :class:`EpochRunner`: one scanned sub-epoch
+    per ladder width per epoch, each at its bucket's ``[B, L_b]`` shape —
+    so every step pays for the bag its examples actually need instead of
+    the worst-case width. Compiles exactly one chunk program per
+    (width, chunk length): the ladder is the whole compile budget.
+
+    Drop-in for the loop's ``(runner, staged)`` protocol: ``run_train_epoch``
+    / ``run_eval_epoch`` take a :class:`BucketedStagedCorpus` where the
+    fixed runner takes a :class:`StagedCorpus`. The train-pass bucket order
+    is drawn from the epoch rng (seeded-deterministic interleave at bucket
+    granularity); eval runs buckets in ladder order so preds align with
+    :meth:`BucketedStagedCorpus.host_labels`.
+    """
+
+    def __init__(
+        self,
+        model_config: Code2VecConfig,
+        class_weights: jnp.ndarray,
+        batch_size: int,
+        ladder: tuple[int, ...],
+        chunk_batches: int = 16,
+        mesh=None,
+        shuffle_variable_ids: bool = False,
+        sample_prefetch: bool = False,
+        table_update: str = "dense",
+    ):
+        self.ladder = tuple(ladder)
+        self._runners = {
+            width: EpochRunner(
+                model_config,
+                class_weights,
+                batch_size,
+                width,
+                chunk_batches,
+                mesh=mesh,
+                shuffle_variable_ids=shuffle_variable_ids,
+                sample_prefetch=sample_prefetch,
+                table_update=table_update,
+            )
+            for width in self.ladder
+        }
+
+    def run_train_epoch(
+        self,
+        state,
+        corpus: BucketedStagedCorpus,
+        rng: np.random.Generator,
+        key: jax.Array,
+    ) -> tuple[Any, float, int]:
+        """One training epoch over all buckets; returns (state, summed
+        loss, n_batches). The per-bucket sub-epochs shuffle their own rows
+        (the same seeded rng the fixed runner uses)."""
+        total_loss = 0.0
+        n_batches = 0
+        for i in rng.permutation(len(corpus.buckets)):
+            width, staged = corpus.buckets[int(i)]
+            key, sub_key = jax.random.split(key)
+            state, loss, nb = self._runners[width].run_train_epoch(
+                state, staged, rng, sub_key
+            )
+            total_loss += loss
+            n_batches += nb
+        return state, total_loss, n_batches
+
+    def run_eval_epoch(
+        self,
+        state,
+        corpus: BucketedStagedCorpus,
+        key: jax.Array,
+    ) -> tuple[float, np.ndarray, np.ndarray]:
+        """One eval pass, buckets in ladder order; preds/max_logits align
+        with :meth:`BucketedStagedCorpus.host_labels`."""
+        total_loss = 0.0
+        preds: list[np.ndarray] = []
+        max_logits: list[np.ndarray] = []
+        for width, staged in corpus.buckets:
+            key, sub_key = jax.random.split(key)
+            loss, p, m = self._runners[width].run_eval_epoch(
+                state, staged, sub_key
+            )
+            total_loss += loss
+            preds.append(p)
+            max_logits.append(m)
+        return (
+            total_loss,
+            np.concatenate(preds) if preds else np.zeros(0, np.int64),
+            np.concatenate(max_logits) if max_logits else np.zeros(0, np.float32),
+        )
+
+
 class ShardedEpochRunner:
     """Scanned train epochs over a :class:`ShardedStagedCorpus`.
 
@@ -956,7 +1128,10 @@ class ShardedEpochRunner:
         each shard's block samples its own rows, outputs concatenate over
         the data axis into the global [B, bag] batch."""
         if self._sampler_cache is None:
-            from jax import shard_map
+            try:
+                from jax import shard_map
+            except ImportError:  # moved to top level after jax 0.4.x
+                from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
 
             bag, mesh = self.bag, self.mesh

@@ -36,11 +36,16 @@ from code2vec_tpu.checkpoint import (
 )
 from code2vec_tpu.data.pipeline import (
     build_epoch,
+    derive_bucket_ladder,
     empty_batch,
+    epoch_context_counts,
     iter_batches,
+    iter_bucketed_batches,
     iter_streaming_batches,
     oov_rate,
     pad_batch_stream,
+    pad_stats,
+    parse_bucket_ladder,
     split_items,
 )
 from code2vec_tpu.data.reader import CorpusData
@@ -284,6 +289,39 @@ def train(
     health = RuntimeHealth()
     recompile_detector = RecompileDetector(events=events, health=health)
 
+    # length-aware bucketed batching: resolve the static ladder of bag
+    # widths once at startup — explicit --bucket_ladder, or a geometric
+    # ladder derived from the corpus length histogram (the per-method
+    # counts; the variable task reuses it, its rows are subsets of method
+    # bags). The ladder is the run's whole compile budget: the recompile
+    # detector below is budgeted to len(ladder) expected compiles per step
+    # function, so the bucket shapes count as warmup, not shape churn.
+    bucket_ladder: tuple[int, ...] | None = None
+    if config.bucket_ladder and not config.bucketed:
+        raise ValueError(
+            "--bucket_ladder was given but --bucketed is off — the ladder "
+            "would be silently ignored; add --bucketed or drop the ladder"
+        )
+    if config.bucketed:
+        if config.stream_chunk_items:
+            raise ValueError(
+                "--bucketed does not compose with --stream_chunk_items: "
+                "streaming epochs emit fixed-shape chunked batches; drop "
+                "one of the two flags"
+            )
+        bucket_ladder = parse_bucket_ladder(
+            config.bucket_ladder, config.max_path_length
+        )
+        if bucket_ladder is None:
+            bucket_ladder = derive_bucket_ladder(
+                np.diff(data.row_splits), config.max_path_length
+            )
+        logger.info(
+            "bucketed batching: ladder %s (%d step compiles expected)",
+            list(bucket_ladder),
+            len(bucket_ladder),
+        )
+
     np_rng = np.random.default_rng(config.random_seed)
     jax_rng = jax.random.PRNGKey(config.random_seed)
 
@@ -369,9 +407,16 @@ def train(
     # recompile watch: static [B, L] shapes are the design invariant —
     # jit-cache growth after the warmup compile means shape churn is
     # silently recompiling the step (seconds each). Checked per epoch;
-    # non-jitted injected steps are ignored by track().
-    recompile_detector.track("train_step", train_step)
-    recompile_detector.track("eval_step", eval_step)
+    # non-jitted injected steps are ignored by track(). Bucketed runs
+    # legitimately compile once per ladder width, so the budget makes
+    # those count as warmup while anything beyond still fires.
+    expected_compiles = len(bucket_ladder) if bucket_ladder else None
+    recompile_detector.track(
+        "train_step", train_step, expected_compiles=expected_compiles
+    )
+    recompile_detector.track(
+        "eval_step", eval_step, expected_compiles=expected_compiles
+    )
 
     # multi-host feeding:
     # - replicated corpus (data.shard is None): every process builds the
@@ -388,6 +433,13 @@ def train(
     #   identically.
     n_hosts = jax.process_count()
     sharded_feed = data.shard is not None and n_hosts > 1
+    if bucket_ladder is not None and sharded_feed:
+        # every host must dispatch identical collective shapes in lockstep;
+        # a per-host bucket interleave would have to be globally coordinated
+        raise ValueError(
+            "--bucketed does not compose with host-sharded feeding; load "
+            "the corpus unsharded or drop --bucketed"
+        )
     feed_batch = config.batch_size
     feed_group = 0
     n_feed_groups = 1
@@ -471,8 +523,10 @@ def train(
         if jax.process_count() == 1:
             use_device_epoch = True
             from code2vec_tpu.train.device_epoch import (
+                BucketedEpochRunner,
                 EpochRunner,
                 ShardedEpochRunner,
+                bucket_staged,
                 concat_staged,
                 place_staged,
                 shard_staged,
@@ -480,7 +534,26 @@ def train(
                 stage_variable_corpus,
             )
 
-            if not config.shard_staged_corpus:
+            if config.bucketed and config.shard_staged_corpus:
+                raise ValueError(
+                    "--bucketed does not compose with --shard_staged_corpus "
+                    "yet; drop one of the two flags"
+                )
+            if config.bucketed:
+                # one scanned sub-epoch per ladder width per epoch; each
+                # bucket samples/steps at its own [B, L_b] shape
+                device_runner = BucketedEpochRunner(
+                    model_config,
+                    class_weights,
+                    config.batch_size,
+                    bucket_ladder,
+                    config.device_chunk_batches,
+                    mesh=mesh,
+                    shuffle_variable_ids=config.shuffle_variable_indexes,
+                    sample_prefetch=config.sample_prefetch,
+                    table_update=config.table_update,
+                )
+            elif not config.shard_staged_corpus:
                 # the replicated runner is unused in sharded-staging mode;
                 # don't build it (and its step closures) there
                 device_runner = EpochRunner(
@@ -553,9 +626,35 @@ def train(
                 # static for the run: fetch the shard-order labels once,
                 # not once per epoch
                 sharded_test_expected = staged_test.flat_labels()
+            elif config.bucketed:
+                staged_train = bucket_staged(
+                    stage_host(train_idx), bucket_ladder,
+                    device=corpus_placement,
+                )
+                staged_test = bucket_staged(
+                    stage_host(test_idx), bucket_ladder,
+                    device=corpus_placement,
+                )
+                device_test_expected = staged_test.host_labels()
+                # pad accounting is corpus-static on device: the sampler
+                # fills min(count, width) slots per row every epoch
+                device_train_pad = pad_stats(
+                    np.concatenate([
+                        np.diff(np.asarray(jax.device_get(s.row_splits)))
+                        for _, s in staged_train.buckets
+                    ]) if staged_train.buckets else np.zeros(0, np.int64),
+                    bucket_ladder,
+                    config.batch_size,
+                )
             else:
                 staged_train = stage(train_idx)
                 staged_test = stage(test_idx)
+                device_test_expected = np.asarray(staged_test.labels)
+                device_train_pad = pad_stats(
+                    np.diff(np.asarray(jax.device_get(staged_train.row_splits))),
+                    (config.max_path_length,),
+                    config.batch_size,
+                )
             logger.info(
                 "device epochs: staged %d train / %d test contexts to %s",
                 sharded_train_runner[1].n_contexts
@@ -625,6 +724,10 @@ def train(
     # install). The CLI pre-installs, making this a no-op there.
     restore_tracer = tracer is not get_tracer()
     previous_tracer = set_tracer(tracer) if restore_tracer else None
+    # host-path pad accounting cache, (n_rows, real, slots): per-row counts
+    # are min(raw row count, bag) regardless of which contexts the per-epoch
+    # subsample picked, so the O(N*L) scan need not repeat every epoch
+    host_train_pad: tuple[int, int, int] | None = None
     try:
         for epoch in range(start_epoch, config.max_epoch):
             if profile_dir is not None and epoch == start_epoch + 1:
@@ -635,6 +738,7 @@ def train(
 
             train_epoch = None  # host epoch arrays, built lazily in device mode
             test_epoch = None
+            pad_efficiency = None  # real contexts / padded slots this epoch
             if use_device_epoch:
                 jax_rng, train_key, eval_key = jax.random.split(jax_rng, 3)
                 if sharded_train_runner is not None:
@@ -670,8 +774,11 @@ def train(
                             state, staged_test, eval_key
                         )
                     # staged labels: per-EXAMPLE (one per @var alias in
-                    # the variable task), not per-item
-                    expected = np.asarray(staged_test.labels)
+                    # the variable task), not per-item; fetched once at
+                    # staging (bucketed stagings concatenate per bucket)
+                    expected = device_test_expected
+                    real, slots = device_train_pad
+                    pad_efficiency = real / slots if slots else 1.0
                 accuracy, precision, recall, f1 = evaluate(
                     config.eval_method, expected, preds, data.label_vocab
                 )
@@ -721,9 +828,29 @@ def train(
                     np_rng,
                     config.shuffle_variable_indexes,
                 )
-                train_batches = iter_batches(
-                    train_epoch, feed_batch, rng=np_rng, pad_final=True
-                )
+                if bucket_ladder is not None:
+                    # [B, L_b] batches per bucket, seeded interleave; the
+                    # per-example rows are identical to the fixed-L path
+                    # (bucket width >= real count), so the loss semantics
+                    # are unchanged — only the padding is gone
+                    train_batches = iter_bucketed_batches(
+                        train_epoch, bucket_ladder, feed_batch,
+                        rng=np_rng, pad_final=True,
+                    )
+                else:
+                    train_batches = iter_batches(
+                        train_epoch, feed_batch, rng=np_rng, pad_final=True
+                    )
+                n_rows = len(train_epoch.ids)
+                if host_train_pad is None or host_train_pad[0] != n_rows:
+                    real, slots = pad_stats(
+                        epoch_context_counts(train_epoch),
+                        bucket_ladder or (config.max_path_length,),
+                        feed_batch,
+                    )
+                    host_train_pad = (n_rows, real, slots)
+                _, real, slots = host_train_pad
+                pad_efficiency = real / slots if slots else 1.0
                 if sharded_feed:
                     train_batches = pad_batch_stream(
                         train_batches,
@@ -742,9 +869,18 @@ def train(
                     np_rng,
                     config.shuffle_variable_indexes,
                 )
-                test_batches = iter_batches(
-                    test_epoch, feed_batch, rng=None, pad_final=True
-                )
+                if bucket_ladder is not None:
+                    # rng=None: buckets run sequentially in ladder order —
+                    # eval metrics are order-invariant, so they match the
+                    # fixed-L pass bitwise (tests/test_bucketing.py)
+                    test_batches = iter_bucketed_batches(
+                        test_epoch, bucket_ladder, feed_batch,
+                        rng=None, pad_final=True,
+                    )
+                else:
+                    test_batches = iter_batches(
+                        test_epoch, feed_batch, rng=None, pad_final=True
+                    )
                 if sharded_feed:
                     test_batches = pad_batch_stream(
                         test_batches,
@@ -767,6 +903,12 @@ def train(
                 "f1": f1,
                 "epoch_seconds": time.perf_counter() - epoch_start,
             }
+            if pad_efficiency is not None:
+                # the padding-waste gauge behind the bucketed-batching win:
+                # real context slots / padded slots fed this epoch (1.0 =
+                # no wasted gathers/FLOPs/HBM traffic on PAD)
+                metrics["pad_efficiency"] = pad_efficiency
+                health.gauge("pad_efficiency").set(pad_efficiency)
             if profiler is not None:
                 attribution = profiler.summary()
                 if attribution is not None:

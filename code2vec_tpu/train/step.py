@@ -128,9 +128,16 @@ def create_train_state(
         config.weight_decay,
         mu_dtype=config.adam_mu_dtype,
     )
-    return TrainState.create(
+    state = TrainState.create(
         apply_fn=model.apply, params=params, tx=tx, dropout_rng=dropout_rng
     )
+    # flax initializes `step` as a weak-typed Python int while the step
+    # returned by apply_gradients is a strong int32 array — so every jitted
+    # step function silently compiled TWICE per batch shape (once for the
+    # fresh state, once for every state after it). Normalize at creation:
+    # one compile per shape, and the recompile detector's per-shape budget
+    # (bucketed runs: one compile per ladder width) is exact.
+    return state.replace(step=jnp.asarray(state.step, jnp.int32))
 
 
 def weighted_nll(

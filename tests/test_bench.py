@@ -79,7 +79,7 @@ class TestPreviousBenchmark:
             "glob",
             lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
         )
-        assert bench._previous_benchmark("tpu") == 3.0
+        assert bench._previous_benchmark("tpu") == (3.0, True)
 
     def test_failed_and_valueless_rounds_skipped(self, bench, tmp_path, monkeypatch):
         self._write(tmp_path, "BENCH_r01.json", {"rc": 0, "parsed": {"value": 5.0}})
@@ -91,7 +91,7 @@ class TestPreviousBenchmark:
             "glob",
             lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
         )
-        assert bench._previous_benchmark("tpu") == 5.0
+        assert bench._previous_benchmark("tpu") == (5.0, True)
 
     def test_cpu_fallback_round_cannot_poison_device_baseline(
         self, bench, tmp_path, monkeypatch
@@ -114,9 +114,9 @@ class TestPreviousBenchmark:
             "glob",
             lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
         )
-        assert bench._previous_benchmark("tpu") == 6955072.6
+        assert bench._previous_benchmark("tpu") == (6955072.6, True)
         # and a cpu run compares like-for-like against the cpu round
-        assert bench._previous_benchmark("cpu") == 103955.6
+        assert bench._previous_benchmark("cpu") == (103955.6, True)
 
     def test_unlabeled_round_counts_as_device(self, bench, tmp_path, monkeypatch):
         self._write(tmp_path, "BENCH_r02.json", {"rc": 0, "parsed": {"value": 7.0}})
@@ -125,12 +125,34 @@ class TestPreviousBenchmark:
             "glob",
             lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
         )
-        assert bench._previous_benchmark("tpu") == 7.0
+        assert bench._previous_benchmark("tpu") == (7.0, True)
         assert bench._previous_benchmark("cpu") is None
 
     def test_no_prior_rounds(self, bench, monkeypatch):
         monkeypatch.setattr(bench.glob, "glob", lambda pattern: [])
         assert bench._previous_benchmark("tpu") is None
+
+    def test_post_honesty_round_flagged_as_real_accounting(
+        self, bench, tmp_path, monkeypatch
+    ):
+        # a round whose record carries pad_efficiency stored a REAL-context
+        # headline; vs_baseline must divide real contexts into it, while a
+        # pre-change round (no pad_efficiency anywhere) gets padded slots
+        self._write(
+            tmp_path,
+            "BENCH_r06.json",
+            {
+                "rc": 0,
+                "parsed": {"value": 9.0, "backend": "tpu"},
+                "tail": '{"detail": {"backend": "tpu", "pad_efficiency": 0.61}}',
+            },
+        )
+        monkeypatch.setattr(
+            bench.glob,
+            "glob",
+            lambda pattern: [str(p) for p in tmp_path.glob("BENCH_r*.json")],
+        )
+        assert bench._previous_benchmark("tpu") == (9.0, False)
 
 
 class TestInitBackendFallback:

@@ -403,6 +403,40 @@ class TestRecompileDetector:
         assert detector.check() == 0
         assert detector._tracked == {}
 
+    def test_expected_compile_budget_stays_silent(self):
+        """A budgeted multi-shape fn (bucketed batching: one compile per
+        ladder width) stays silent up to its budget — even when the
+        shapes arrive across several checks — and an over-budget compile
+        still fires the recompile event."""
+        events = EventLog()
+        seen = []
+        events.subscribe(lambda e: seen.append(e))
+        detector = RecompileDetector(events=events)
+        fn = jax.jit(lambda x: x * 2)
+        detector.track("bucketed_step", fn, expected_compiles=3)
+
+        fn(jnp.ones(4))
+        fn(jnp.ones(8))
+        assert detector.check(epoch=0) == 0  # 2 of 3 budgeted compiles
+        fn(jnp.ones(16))  # the third ladder width, an epoch later
+        assert detector.check(epoch=1) == 0  # still within budget
+        assert detector.recompile_count == 0
+
+        fn(jnp.ones(32))  # over budget: genuine shape churn
+        assert detector.check(epoch=2) == 1
+        assert detector.recompile_count == 1
+        fired = [e for e in seen if e["event"] == "recompile"]
+        assert len(fired) == 1
+        assert fired[0]["fn"] == "bucketed_step" and fired[0]["epoch"] == 2
+        # and silent again at the new steady state
+        fn(jnp.ones(32))
+        assert detector.check(epoch=3) == 0
+
+    def test_expected_compile_budget_validated(self):
+        detector = RecompileDetector()
+        with pytest.raises(ValueError, match="expected_compiles"):
+            detector.track("step", jax.jit(lambda x: x), expected_compiles=0)
+
 
 class TestProducerSpanSampling:
     def test_span_steps_are_sampled_not_per_batch(self):

@@ -49,9 +49,36 @@ def test_rehearse_java_large_tiny_end_to_end(tmp_path):
     assert finals and all(math.isfinite(v) for v in finals)
 
 
+def test_corpus_stats_end_to_end(tmp_path):
+    """corpus_stats must parse an L1 corpus, print the histogram, and end
+    with a machine-parsable JSON line whose ladder the --bucketed path can
+    consume directly."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(TOOLS, ".."))
+    from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+
+    paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "corpus_stats.py"),
+         paths["corpus"], "--max_contexts", "32", "--batch_size", "32"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.join(TOOLS, ".."),
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+    stats = json.loads(out.stdout.strip().splitlines()[-1])
+    assert stats["n_methods"] == SPECS["tiny"].n_methods
+    assert stats["ladder"][-1] == 32
+    assert 0.0 < stats["pad_efficiency_fixed"] <= 1.0
+    assert stats["pad_efficiency_bucketed"] >= stats["pad_efficiency_fixed"] - 1e-9
+    # the suggested flags appear verbatim for copy-paste
+    assert "--bucket_ladder" in out.stdout
+
+
 @pytest.mark.parametrize(
     "script", ["run_tpu_ablation.py", "bench_ctx.py", "rehearse_java_large.py",
-               "parity_vs_reference.py"]
+               "parity_vs_reference.py", "corpus_stats.py"]
 )
 def test_tool_argparse_help(script):
     """--help exercises import + argparse without touching a backend.

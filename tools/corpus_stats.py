@@ -1,0 +1,129 @@
+"""Context-length histogram + suggested bucket ladder for a corpus file.
+
+The length-aware bucketed batching path (data/pipeline.py, --bucketed)
+derives its geometric ladder from the corpus ``row_splits`` histogram at
+startup; this tool runs the same derivation OFFLINE so an operator can
+inspect the length distribution, see how much of the fixed-``L`` feed is
+PAD, and pin an explicit ``--bucket_ladder`` before a long run.
+
+Reads only the corpus text (a lightweight line scan — no vocab files, no
+jax, no package import cost beyond the ladder helper), so it works on any
+L1-format corpus including ones whose index files live elsewhere.
+
+Usage:
+    python tools/corpus_stats.py dataset/corpus.txt --max_contexts 200
+
+Prints a per-bucket occupancy table, length percentiles, the pad-efficiency
+a fixed-L feed would get vs the suggested ladder, and one final JSON line
+(machine-parsable: {"n_methods", "percentiles", "ladder", ...}).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: the package
+
+from code2vec_tpu.data.pipeline import (  # noqa: E402
+    assign_buckets,
+    derive_bucket_ladder,
+    pad_stats,
+)
+
+
+def context_counts(corpus_path: str) -> np.ndarray:
+    """Per-method path-context counts from an L1 corpus file.
+
+    State machine over the record format (SURVEY.md §2.4): a ``paths:``
+    line opens the context block; every following line is one context row
+    until ``vars:`` or the record-separating blank line closes it. Matches
+    the full parsers' row accounting without building any arrays.
+    """
+    counts: list[int] = []
+    n: int | None = None
+    with open(corpus_path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if n is None:
+                if line.startswith("paths:"):
+                    n = 0
+            elif not line or line.startswith("vars:"):
+                counts.append(n)
+                n = None
+            else:
+                n += 1
+    if n is not None:  # no trailing blank line after the last record
+        counts.append(n)
+    return np.asarray(counts, np.int64)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="context-length histogram + suggested bucket ladder"
+    )
+    parser.add_argument("corpus_path", help="L1 corpus.txt")
+    parser.add_argument("--max_contexts", type=int, default=200,
+                        help="the run's bag size (--max_path_length); the "
+                             "ladder tops out here")
+    parser.add_argument("--max_buckets", type=int, default=4,
+                        help="ladder size cap (= expected step compiles)")
+    parser.add_argument("--batch_size", type=int, default=1024,
+                        help="batch size for the pad-efficiency estimate")
+    args = parser.parse_args(argv)
+
+    counts = context_counts(args.corpus_path)
+    if not len(counts):
+        print(json.dumps({"error": "no records found", "n_methods": 0}))
+        return
+    ladder = derive_bucket_ladder(
+        counts, args.max_contexts, max_buckets=args.max_buckets
+    )
+    capped = np.minimum(counts, args.max_contexts)
+    bucket_of = assign_buckets(capped, ladder)
+
+    pcts = [50, 75, 90, 95, 99]
+    percentiles = {
+        str(p): int(np.percentile(counts, p)) for p in pcts
+    }
+    print(f"{len(counts)} methods, context counts "
+          f"min={counts.min()} max={counts.max()} mean={counts.mean():.1f}")
+    print("percentiles: " + "  ".join(
+        f"p{p}={percentiles[str(p)]}" for p in pcts))
+    print()
+    print(f"{'bucket':>10} {'methods':>10} {'share':>7} {'real/slot':>10}")
+    prev = 0
+    for b, width in enumerate(ladder):
+        members = capped[bucket_of == b]
+        share = len(members) / len(counts)
+        fill = members.mean() / width if len(members) else 0.0
+        print(f"{prev + 1:>4}-{width:<5} {len(members):>10} "
+              f"{share:>6.1%} {fill:>9.1%}")
+        prev = width
+
+    real, fixed_slots = pad_stats(counts, (args.max_contexts,), args.batch_size)
+    _, ladder_slots = pad_stats(counts, ladder, args.batch_size)
+    fixed_eff = real / fixed_slots if fixed_slots else 1.0
+    ladder_eff = real / ladder_slots if ladder_slots else 1.0
+    print()
+    print(f"pad efficiency at fixed L={args.max_contexts}: {fixed_eff:.1%}"
+          f"  |  bucketed over {list(ladder)}: {ladder_eff:.1%}")
+    print(f"suggested: --bucketed --bucket_ladder "
+          f"{','.join(str(w) for w in ladder)}")
+    print(json.dumps({
+        "n_methods": int(len(counts)),
+        "total_contexts": int(counts.sum()),
+        "percentiles": percentiles,
+        "ladder": list(ladder),
+        "pad_efficiency_fixed": round(fixed_eff, 4),
+        "pad_efficiency_bucketed": round(ladder_eff, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
