@@ -675,7 +675,7 @@ def _prefetch_ab() -> None:
                 s0 = time.perf_counter()
                 new_state, loss = train_step(state, device_batch)
                 state = new_state
-                float(loss)  # per-step loss sync, mirroring train/loop.py
+                float(loss)  # deliberate per-step sync: bounds step latency and keeps timings comparable across rounds  # jaxlint: disable=JX007
                 if profiler is not None and profiler.sampled(done):
                     profiler.record_compute(
                         done, (time.perf_counter() - s0) * 1e3
@@ -889,7 +889,7 @@ def _bucket_ab() -> None:
         t0 = time.perf_counter()
         for b in batches:
             state, loss = train_step(state, jax.device_put(b))
-            float(loss)  # per-step loss sync, mirroring train/loop.py
+            float(loss)  # deliberate per-step sync: bounds step latency and keeps timings comparable across rounds  # jaxlint: disable=JX007
             n += 1
         return n, time.perf_counter() - t0
 

@@ -1,0 +1,51 @@
+"""Static analysis for JAX footguns + trace-time step contracts.
+
+Three layers, one defect class: bugs that never raise — they surface as
+mystery recompiles, silent host syncs in the step loop, or multi-host
+hangs, usually at step 10k on a real pod instead of in review.
+
+- :mod:`code2vec_tpu.analysis.jaxlint` — pure-``ast`` lint rules
+  (JX001-JX007): weak-typed literals entering jitted state/carries, host
+  syncs and impurity inside traced bodies, tracer branching, missing
+  donation, set-iteration-order pytree hazards, per-step host syncs in
+  step loops.
+- :mod:`code2vec_tpu.analysis.sharding_check` — every ``PartitionSpec``
+  literal cross-validated against the mesh module's declared axis names
+  (SC001-SC003).
+- :mod:`code2vec_tpu.analysis.contracts` — ``@shape_contract``:
+  shape/dtype/weakness validation of step-function inputs at trace time
+  (zero steady-state cost); wired into ``train/step.py``,
+  ``train/device_epoch.py``, ``parallel/step.py``, and ``ops/``.
+
+Run the static layers with ``python -m code2vec_tpu.analysis`` (thin
+wrapper: ``tools/jaxlint.py``); CI runs the same entry point against the
+checked-in baseline (``analysis/baseline.json``). The lint layers import
+only the stdlib — no jax — so the whole pass costs parse time.
+"""
+
+from code2vec_tpu.analysis.jaxlint import (  # noqa: F401
+    RECOMPILE_HINT_RULES,
+    RULES,
+    Finding,
+    Rule,
+    lint_paths,
+    lint_source,
+)
+from code2vec_tpu.analysis.sharding_check import (  # noqa: F401
+    check_paths,
+    check_source,
+    declared_axes,
+)
+
+# the contract layer imports numpy (and, lazily, jax) — loaded on demand
+# (PEP 562) so `python -m code2vec_tpu.analysis` stays runnable on a bare
+# interpreter with zero third-party installs (the CI job relies on this)
+_CONTRACT_EXPORTS = ("ArgSpec", "ContractError", "shape_contract", "spec")
+
+
+def __getattr__(name: str):
+    if name in _CONTRACT_EXPORTS:
+        from code2vec_tpu.analysis import contracts
+
+        return getattr(contracts, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,240 @@
+"""``python -m code2vec_tpu.analysis`` — run jaxlint + the sharding checker.
+
+Pure stdlib (no jax, no numpy): the whole pass costs parse time, so the
+CI job runs it on a bare interpreter in seconds. Exit status is 1 iff
+any NEW finding exists — one that is neither inline-suppressed
+(``# jaxlint: disable=JXnnn``) nor recorded in the baseline file
+(``analysis/baseline.json``; regenerate with ``--write-baseline``).
+
+``--diff-only [REF]`` restricts the scan to ``.py`` files changed vs
+``REF`` (default: the merge base with ``origin/main``, else ``HEAD~1``)
+plus uncommitted/untracked files — the fast CI mode. An unresolvable ref
+falls back to the full scan rather than silently passing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from code2vec_tpu.analysis import jaxlint
+from code2vec_tpu.analysis.sharding_check import check_source, declared_axes
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_PATHS = ("code2vec_tpu", "tools", "bench.py", "main.py")
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+DEFAULT_MESH = "code2vec_tpu/parallel/mesh.py"
+
+
+def _git(root: Path, *args: str) -> str:
+    return subprocess.run(
+        ["git", "-C", str(root), *args],
+        check=True,
+        capture_output=True,
+        text=True,
+    ).stdout
+
+
+def changed_py_files(root: Path, ref: str | None) -> list[Path] | None:
+    """Repo-relative ``.py`` files changed vs ``ref`` + working-tree
+    changes + untracked files; None when git state can't be read (the
+    caller falls back to a full scan)."""
+    try:
+        if not ref:
+            try:
+                ref = _git(root, "merge-base", "origin/main", "HEAD").strip()
+            except subprocess.CalledProcessError:
+                ref = "HEAD~1"
+        names = set(_git(root, "diff", "--name-only", ref).splitlines())
+        names |= set(_git(root, "diff", "--name-only", "--cached").splitlines())
+        names |= set(
+            _git(
+                root, "ls-files", "--others", "--exclude-standard"
+            ).splitlines()
+        )
+    except (subprocess.CalledProcessError, OSError):
+        return None
+    return [Path(n) for n in sorted(names) if n.endswith(".py")]
+
+
+def _severity_counts(findings: list[jaxlint.Finding]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for f in findings:
+        out[f.severity] = out.get(f.severity, 0) + 1
+    return out
+
+
+def run(
+    paths: list[Path],
+    root: Path,
+    baseline_path: Path,
+    mesh_file: Path | None,
+) -> list[jaxlint.Finding]:
+    # one read + one ast.parse per file, shared by the lint and the
+    # sharding checker — parse time is the whole cost of this tool
+    axis_decls = (
+        declared_axes(mesh_file.read_text()) if mesh_file is not None else None
+    )
+    findings: list[jaxlint.Finding] = []
+    for file in jaxlint.iter_py_files(paths):
+        try:
+            rel = file.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = file.as_posix()
+        source = file.read_text()
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError:
+            tree = None  # lint_source reparses to emit JX000
+        findings += jaxlint.lint_source(source, rel, tree=tree)
+        if axis_decls is not None and tree is not None:
+            findings += check_source(source, rel, axis_decls, tree=tree)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    jaxlint.apply_baseline(findings, jaxlint.load_baseline(baseline_path))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m code2vec_tpu.analysis",
+        description="JAX-footgun lint + sharding-contract check",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to scan (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--root",
+        type=Path,
+        default=REPO_ROOT,
+        help="repo root for relative finding paths (default: the package's)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=DEFAULT_BASELINE,
+        help="baseline file of accepted pre-existing findings",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="record the current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--diff-only",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="REF",
+        help="scan only .py files changed vs REF (default: merge-base with "
+        "origin/main, else HEAD~1) — the fast CI mode",
+    )
+    parser.add_argument(
+        "--mesh-file",
+        type=Path,
+        default=None,
+        help=f"mesh-axis declarations for SC rules (default: {DEFAULT_MESH})",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit findings as a JSON document"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = parser.parse_args(argv)
+
+    if args.diff_only is not None and args.write_baseline:
+        # a baseline written from a restricted scan would drop every
+        # accepted fingerprint in the unscanned files
+        parser.error("--write-baseline needs the full scan; drop --diff-only")
+
+    if args.list_rules:
+        for rule in jaxlint.RULES.values():
+            print(f"{rule.id} [{rule.severity:7}] {rule.name}: {rule.summary}")
+            print(f"       fix: {rule.hint}")
+        return 0
+
+    root = args.root.resolve()
+    scan = [
+        root / p for p in (args.paths or DEFAULT_PATHS) if (root / p).exists()
+    ]
+    mesh_file = args.mesh_file if args.mesh_file is not None else root / DEFAULT_MESH
+    if not mesh_file.exists():
+        mesh_file = None
+
+    if args.diff_only is not None:
+        changed = changed_py_files(root, args.diff_only or None)
+        if changed is None:
+            print(
+                "jaxlint: --diff-only could not read git state; running the "
+                "full scan",
+                file=sys.stderr,
+            )
+        elif mesh_file is not None and any(
+            (root / c).resolve() == mesh_file.resolve() for c in changed
+        ):
+            # a mesh-axis rename/removal invalidates PartitionSpecs in
+            # UNCHANGED files; restricting to the diff would pass the PR
+            # and break the full scan on main
+            print(
+                "jaxlint: mesh declarations changed; running the full scan",
+                file=sys.stderr,
+            )
+        else:
+            scan_files = {
+                f.resolve() for f in jaxlint.iter_py_files(scan)
+            }
+            scan = [
+                root / c for c in changed if (root / c).resolve() in scan_files
+            ]
+            if not scan:
+                print("jaxlint: no changed files in scope; nothing to do")
+                return 0
+
+    findings = run(scan, root, args.baseline, mesh_file)
+
+    if args.write_baseline:
+        jaxlint.write_baseline(
+            [f for f in findings if not f.suppressed], args.baseline
+        )
+        print(f"jaxlint: baseline written to {args.baseline}")
+        return 0
+
+    new = [f for f in findings if not f.suppressed and not f.baselined]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "tool": "jaxlint",
+                    "findings": [f.to_json() for f in findings],
+                    "summary": {
+                        "total": len(findings),
+                        "new": len(new),
+                        "baselined": sum(1 for f in findings if f.baselined),
+                        "suppressed": sum(1 for f in findings if f.suppressed),
+                        "by_severity": _severity_counts(new),
+                    },
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.text())
+        print(
+            f"jaxlint: {len(new)} new finding(s), "
+            f"{sum(1 for f in findings if f.baselined)} baselined, "
+            f"{sum(1 for f in findings if f.suppressed)} suppressed "
+            f"({len(findings)} total)"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

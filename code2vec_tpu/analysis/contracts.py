@@ -1,0 +1,291 @@
+"""Trace-time shape/dtype/weakness contracts for jitted step functions.
+
+``@shape_contract`` validates a function's inputs when its Python body
+runs — which under ``jit``/``scan``/``shard_map`` is exactly once per
+trace (one per static shape signature). After the jit cache hit the
+wrapper never executes again, so the steady-state cost is zero: no host
+sync, no per-step Python, nothing staged into the compiled program. A
+violation raises :class:`ContractError` at trace time — where the bad
+batch/state is still attributable to its producer — instead of
+surfacing 10k steps later as a mystery recompile or a wrong-dtype carry.
+
+The weakness check is the trace-time twin of jaxlint's JX001: a
+weak-typed scalar (flax's fresh ``step``, a bare Python literal in a
+carry) keys the jit cache differently from the strong array the step
+returns, silently doubling compiles per shape (the PR-4 bug class).
+
+Specs
+-----
+Each argument spec is one of:
+
+- ``"B,L"`` — a shape pattern: comma-separated dims, each an int literal
+  (exact), a symbol (``B``/``L``/... — all uses of one symbol must bind
+  the same size within a single call), or ``?`` (any). ``""`` means
+  rank-0 scalar. Symbols bind per call: bucketed runs trace once per
+  ladder width and each trace binds its own ``L`` — the contract
+  validates internal consistency at every width without pinning one.
+- a dtype (``jnp.int32``) — dtype-only check.
+- ``("B,L", jnp.int32)`` — shape + dtype.
+- :func:`spec` for the full form: ``spec("B,L", "int", allow_weak=True)``.
+  ``dtype`` accepts a concrete dtype, a tuple of dtypes, or a category
+  (``"int"`` / ``"float"`` / ``"bool"``).
+- a dict — for dict-valued args (a batch) the entries are checked by
+  key; for other objects (a TrainState) by attribute. Missing keys are
+  violations; extra keys are ignored.
+- ``None`` — skip this argument.
+
+Any checked value must be strong-typed unless its spec passes
+``allow_weak=True``.
+
+Example::
+
+    @shape_contract(state={"step": spec("", jnp.int32)},
+                    batch={"starts": ("B,L", "int")})
+    def train_step(state, batch): ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = ["ContractError", "ArgSpec", "spec", "shape_contract"]
+
+
+class ContractError(TypeError):
+    """A step-function input violated its shape/dtype/weakness contract."""
+
+
+_CATEGORIES = {
+    "int": np.integer,
+    "float": np.floating,
+    "bool": np.bool_,
+}
+
+
+def _in_category(dtype: np.dtype, category: str) -> bool:
+    """Category membership via jax's extended dtype lattice when available
+    — numpy's ``issubdtype`` does not know the ml_dtypes floats (bfloat16
+    compute is a supported recipe), so the plain-numpy check is only the
+    no-jax fallback."""
+    try:
+        import jax.numpy as jnp
+
+        by_cat = {"int": jnp.integer, "float": jnp.floating, "bool": jnp.bool_}
+        return bool(jnp.issubdtype(dtype, by_cat[category]))
+    except ImportError:  # pragma: no cover - contracts without jax
+        return bool(np.issubdtype(dtype, _CATEGORIES[category]))
+_WILDCARDS = {"?", "_"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ArgSpec:
+    dims: tuple | None  # ints / symbol strs / wildcards; None = any shape
+    dtypes: tuple | str | None  # dtype tuple, category str, or None
+    allow_weak: bool = False
+
+
+def spec(shape: str | None = None, dtype=None, *, allow_weak: bool = False) -> ArgSpec:
+    """Build one argument spec; see the module docstring for the forms."""
+    dims = None
+    if shape is not None:
+        shape = shape.strip()
+        if shape == "":
+            dims = ()
+        else:
+            dims = tuple(
+                int(tok) if tok.lstrip("-").isdigit() else tok
+                for tok in (t.strip() for t in shape.split(","))
+            )
+    dtypes: tuple | str | None = None
+    if dtype is not None:
+        if isinstance(dtype, str):
+            if dtype not in _CATEGORIES:
+                raise ValueError(
+                    f"dtype category must be one of {sorted(_CATEGORIES)}, "
+                    f"got {dtype!r}"
+                )
+            dtypes = dtype
+        elif isinstance(dtype, (tuple, list)):
+            dtypes = tuple(np.dtype(d) for d in dtype)
+        else:
+            dtypes = (np.dtype(dtype),)
+    return ArgSpec(dims=dims, dtypes=dtypes, allow_weak=allow_weak)
+
+
+def _coerce(s: Any):
+    """Shorthand -> ArgSpec (or dict of them, or None)."""
+    if s is None or isinstance(s, ArgSpec):
+        return s
+    if isinstance(s, Mapping):
+        return {k: _coerce(v) for k, v in s.items()}
+    if isinstance(s, str):
+        return spec(shape=s)
+    if isinstance(s, (tuple, list)):
+        return spec(*s)
+    try:
+        return spec(dtype=np.dtype(s))
+    except TypeError:
+        raise TypeError(f"cannot interpret {s!r} as a contract spec") from None
+
+
+def _aval(value) -> tuple[tuple, np.dtype, bool]:
+    """(shape, dtype, weak_type) of a value — tracers included, so the
+    check works on the abstract values jit hands the traced body."""
+    try:
+        import jax
+
+        aval = jax.core.get_aval(value)
+        return (
+            tuple(aval.shape),
+            np.dtype(aval.dtype),
+            bool(getattr(aval, "weak_type", False)),
+        )
+    except Exception:
+        arr = np.asarray(value)
+        return arr.shape, arr.dtype, isinstance(value, (bool, int, float, complex))
+
+
+def _check_value(fn_name: str, where: str, value, s: ArgSpec, env: dict) -> None:
+    shape, dtype, weak = _aval(value)
+    if s.dims is not None:
+        if len(shape) != len(s.dims):
+            raise ContractError(
+                f"{fn_name}: {where} has rank {len(shape)} (shape {shape}), "
+                f"contract expects rank {len(s.dims)} ({_dims_str(s.dims)})"
+            )
+        for i, d in enumerate(s.dims):
+            if isinstance(d, int):
+                if shape[i] != d:
+                    raise ContractError(
+                        f"{fn_name}: {where} dim {i} is {shape[i]}, "
+                        f"contract pins it to {d}"
+                    )
+            elif d in _WILDCARDS:
+                continue
+            else:
+                bound = env.setdefault(d, shape[i])
+                if bound != shape[i]:
+                    raise ContractError(
+                        f"{fn_name}: {where} dim {i} ({d}) is {shape[i]} but "
+                        f"{d}={bound} was bound by an earlier argument — "
+                        "inconsistent shapes within one call"
+                    )
+    if s.dtypes is not None:
+        if isinstance(s.dtypes, str):
+            ok = _in_category(dtype, s.dtypes)
+            expect = f"category {s.dtypes!r}"
+        else:
+            ok = dtype in s.dtypes
+            expect = "/".join(str(d) for d in s.dtypes)
+        if not ok:
+            raise ContractError(
+                f"{fn_name}: {where} has dtype {dtype}, contract expects "
+                f"{expect}"
+            )
+    if weak and not s.allow_weak:
+        raise ContractError(
+            f"{fn_name}: {where} is WEAK-typed (a bare Python scalar or a "
+            "dtype-less literal). Weak values key the jit cache differently "
+            "from the strong arrays a step returns, so the function "
+            "silently compiles twice per shape — give it an explicit dtype "
+            "(e.g. jnp.asarray(x, jnp.int32)). [jaxlint JX001]"
+        )
+
+
+def _dims_str(dims: tuple) -> str:
+    return ",".join(str(d) for d in dims) if dims else "scalar"
+
+
+def _check_arg(fn_name: str, where: str, value, s, env: dict) -> None:
+    if s is None:
+        return
+    if isinstance(s, dict):
+        is_map = isinstance(value, Mapping)
+        for key, sub in s.items():
+            if is_map:
+                if key not in value:
+                    raise ContractError(
+                        f"{fn_name}: {where} is missing required key {key!r}"
+                    )
+                item = value[key]
+            else:
+                try:
+                    item = getattr(value, key)
+                except AttributeError:
+                    raise ContractError(
+                        f"{fn_name}: {where} has no attribute {key!r} "
+                        "required by its contract"
+                    ) from None
+            _check_arg(fn_name, f"{where}[{key!r}]", item, sub, env)
+        return
+    _check_value(fn_name, where, value, s, env)
+
+
+def shape_contract(*pos_specs, **named_specs):
+    """Decorator: validate the wrapped function's inputs at trace time.
+
+    Positional specs align with positional parameters; keyword specs
+    bind by parameter name (and also cover keyword calls). The wrapper
+    counts its own executions in ``.contract_checks`` — under jit that
+    is the TRACE count, which is how tests assert the check adds no
+    steady-state work.
+    """
+    pos = [_coerce(s) for s in pos_specs]
+    named = {k: _coerce(v) for k, v in named_specs.items()}
+
+    def decorate(fn):
+        fn_name = getattr(fn, "__name__", "<fn>")
+        try:
+            params = [
+                p.name
+                for p in inspect.signature(fn).parameters.values()
+                if p.kind
+                in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                )
+            ]
+        except (TypeError, ValueError):  # builtins / C callables
+            params = []
+        by_index: dict[int, Any] = {
+            i: s for i, s in enumerate(pos) if s is not None
+        }
+        names_of: dict[int, str] = {
+            i: name for i, name in enumerate(params)
+        }
+        for name, s in named.items():
+            if name in params:
+                idx = params.index(name)
+                if idx in by_index:
+                    raise TypeError(
+                        f"shape_contract: parameter {name!r} of {fn_name} "
+                        "has both a positional and a named spec"
+                    )
+                by_index[idx] = s
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            wrapper.contract_checks += 1
+            env: dict = {}
+            for i, value in enumerate(args):
+                s = by_index.get(i)
+                if s is not None:
+                    _check_arg(
+                        fn_name, names_of.get(i, f"arg{i}"), value, s, env
+                    )
+            for key, value in kwargs.items():
+                s = named.get(key)
+                if s is not None:
+                    _check_arg(fn_name, key, value, s, env)
+            return fn(*args, **kwargs)
+
+        wrapper.contract_checks = 0
+        wrapper.__contract__ = (tuple(pos), dict(named))
+        return wrapper
+
+    return decorate

@@ -1,0 +1,986 @@
+"""jaxlint: a Python-AST static-analysis pass for JAX footguns.
+
+The defect classes this catches are the ones that never raise — they show up
+as mystery recompiles (PR-4's weak-`int32` flax ``step`` double-compiled
+every batch shape), multi-host hangs (pytree structure diverging across
+processes), or a silently serialized device (host syncs in the step loop).
+Pure stdlib (``ast``) — no jax import — so the CI job and the
+``python -m code2vec_tpu.analysis`` runner cost parse time only.
+
+Rules
+-----
+- **JX000 parse-error** (error): the file does not parse; nothing else in
+  it can be checked. The SyntaxError message is the finding's snippet, so
+  distinct syntax errors fingerprint separately.
+- **JX001 weak-type-literal** (warning): a bare Python scalar literal
+  entering jitted state/carries — ``lax.scan``/``while_loop``/``fori_loop``
+  carry inits, or ``jnp.array/asarray/full`` without an explicit ``dtype``.
+  Weak-typed scalars key the jit cache differently from the strong-typed
+  arrays a step returns, so the same function silently compiles twice per
+  shape (the PR-4 recompile bug class).
+- **JX002 host-sync-in-trace** (error): ``float()``/``int()``/``bool()``
+  on traced values, ``.item()``/``.tolist()``, ``np.asarray``/``np.array``
+  of traced values, ``jax.device_get``, or ``print`` inside a
+  ``@jit``/``scan``/``shard_map`` body. These either fail at trace time or
+  freeze a trace-time constant into the compiled program.
+- **JX003 tracer-branch** (error): Python ``if``/``while`` branching on a
+  traced function's array arguments (``is None``/``isinstance``/shape
+  attribute tests excluded — those are static). Branch on tracers with
+  ``lax.cond``/``jnp.where``, or lift the value to a static argument.
+- **JX004 impure-trace** (error): ``time.*``/stdlib ``random``/
+  ``np.random``/``datetime.now``/``uuid``/``os.urandom`` inside a traced
+  body — the value freezes at trace time and silently never changes again.
+- **JX005 missing-donate** (info): a jitted function that returns an
+  updated version of one of its arguments (``state = state.apply_gradients(
+  ...); return state``) without ``donate_argnums`` — the old buffers stay
+  live across the step, doubling peak HBM for the state.
+- **JX006 set-iteration-order** (warning): iterating a ``set`` to build
+  containers — set order varies across processes (hash randomization), so
+  a pytree built from it can diverge across hosts (collective hangs) or
+  across runs (cache-key churn). Sort first.
+- **JX007 host-sync-step-loop** (warning): ``float()``/``.item()`` inside
+  a loop that also invokes a step function — one device round-trip per
+  step serializes host and device; accumulate device-side and sync once
+  per epoch.
+
+Each finding carries a stable fingerprint (rule | file | source-line text)
+so a checked-in baseline survives unrelated line shifts. Suppress a single
+line with ``# jaxlint: disable=JX001`` (or a bare ``disable`` for all
+rules); suppress pre-existing debt with the baseline file
+(``--write-baseline``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "RECOMPILE_HINT_RULES",
+    "lint_source",
+    "lint_paths",
+    "fingerprint",
+    "load_baseline",
+    "write_baseline",
+    "apply_baseline",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str  # "error" | "warning" | "info"
+    summary: str
+    hint: str
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "JX000",
+            "parse-error",
+            "error",
+            "file does not parse — nothing in it can be checked",
+            "fix the SyntaxError; the file is unanalyzed until it parses",
+        ),
+        Rule(
+            "JX001",
+            "weak-type-literal",
+            "warning",
+            "weak-typed scalar literal entering jitted state/carries",
+            "give the literal an explicit dtype (jnp.asarray(x, jnp.int32), "
+            "jnp.float32(x)) so the carry/state dtype is strong and the jit "
+            "cache keys stably",
+        ),
+        Rule(
+            "JX002",
+            "host-sync-in-trace",
+            "error",
+            "host-sync conversion of a traced value inside a traced body",
+            "move the conversion outside the jitted function, or use "
+            "jax.debug.print / jax.debug.callback for trace-safe inspection",
+        ),
+        Rule(
+            "JX003",
+            "tracer-branch",
+            "error",
+            "Python control flow branching on a traced value",
+            "use jax.lax.cond / jnp.where, or mark the argument static "
+            "(static_argnums) if it is genuinely compile-time",
+        ),
+        Rule(
+            "JX004",
+            "impure-trace",
+            "error",
+            "impure host call (time/random/uuid) inside a traced body",
+            "the value freezes at trace time; thread PRNG keys / timestamps "
+            "in as arguments instead",
+        ),
+        Rule(
+            "JX005",
+            "missing-donate",
+            "info",
+            "jitted function returns an updated argument without donation",
+            "pass donate_argnums so XLA aliases the old buffers instead of "
+            "keeping both copies live (peak-HBM halves for the state)",
+        ),
+        Rule(
+            "JX006",
+            "set-iteration-order",
+            "warning",
+            "iteration over a set feeding container construction",
+            "iterate sorted(...) — set order varies across processes/runs, "
+            "which diverges pytree structure (collective hangs, cache churn)",
+        ),
+        Rule(
+            "JX007",
+            "host-sync-step-loop",
+            "warning",
+            "per-step host sync (float()/.item()) inside a step loop",
+            "append the device scalar to a list and convert once after the "
+            "loop — one sync per epoch instead of one per step",
+        ),
+        Rule(
+            "SC001",
+            "undeclared-mesh-axis",
+            "error",
+            "PartitionSpec references an axis the mesh does not declare",
+            "use one of the declared mesh axis names (parallel/mesh.py "
+            "AXES) — an undeclared axis fails only at run time, on the pod",
+        ),
+        Rule(
+            "SC002",
+            "duplicate-spec-axis",
+            "error",
+            "the same mesh axis appears twice in one PartitionSpec",
+            "a mesh axis may shard at most one dimension of an array; drop "
+            "one of the duplicate references",
+        ),
+        Rule(
+            "SC003",
+            "ctx-axis-on-params",
+            "warning",
+            "context axis used in a parameter/state sharding rule",
+            "the ctx axis shards the bag dimension of batches; vocab tables "
+            "and encoder params must shard over model/data or replicate",
+        ),
+    )
+}
+
+# the lint rules whose defect class surfaces at run time as silent jit-cache
+# growth; obs.runtime.RecompileDetector stamps these ids into its
+# `recompile` warning/event so the telemetry links back to the static pass
+RECOMPILE_HINT_RULES: dict[str, str] = {
+    "JX001": "weak-typed scalar entering jitted state/carries (dtype churn)",
+    "JX006": "set-order-dependent pytree construction (structure churn)",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?:=(?P<ids>[A-Za-z0-9_,\s]+))?"
+)
+
+# --------------------------------------------------------------------------
+# findings
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix
+    line: int
+    col: int
+    message: str
+    snippet: str  # stripped source line (fingerprint component)
+    suppressed: bool = False
+    baselined: bool = False
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule].severity
+
+    @property
+    def name(self) -> str:
+        return RULES[self.rule].name
+
+    @property
+    def hint(self) -> str:
+        return RULES[self.rule].hint
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "name": self.name,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+            "snippet": self.snippet,
+            "fingerprint": fingerprint(self),
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+    def text(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.severity}] {self.message}\n    {self.snippet}\n"
+            f"    fix: {self.hint}"
+        )
+
+
+def fingerprint(finding: Finding) -> str:
+    """Line-shift-stable identity: rule + file + the flagged source line.
+    Identical lines in one file share a fingerprint; the baseline stores a
+    COUNT per fingerprint, so k pre-existing occurrences stay suppressed
+    while a (k+1)-th new one fails."""
+    return f"{finding.rule}|{finding.path}|{finding.snippet}"
+
+
+# --------------------------------------------------------------------------
+# import + name resolution helpers
+
+
+def _collect_imports(tree: ast.Module) -> dict[str, str]:
+    """Bound name -> dotted module/object path, for disambiguating
+    ``jax.random`` from stdlib ``random`` and resolving aliases
+    (``import jax.numpy as jnp``, ``from jax.sharding import
+    PartitionSpec as P``)."""
+    table: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    table[alias.asname] = alias.name
+                else:
+                    table[alias.name.split(".")[0]] = alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for alias in node.names:
+                table[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return table
+
+
+def _dotted(node: ast.AST, imports: dict[str, str]) -> str | None:
+    """Resolve ``jnp.asarray`` / ``jax.lax.scan`` / ``scan`` to a dotted
+    path through the import table; None when the root is not a plain name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Call):  # f(...)(...) — resolve the inner target
+        return _dotted(node.func, imports)
+    if not isinstance(node, ast.Name):
+        return None
+    root = imports.get(node.id, node.id)
+    return ".".join([root] + list(reversed(parts)))
+
+
+def _tail(path: str | None) -> str:
+    return path.rsplit(".", 1)[-1] if path else ""
+
+
+_JIT_TAILS = {"jit", "pjit"}
+_TRACE_TAILS = _JIT_TAILS | {
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+    "custom_jvp",
+    "shard_map",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "associative_scan",
+}
+
+
+def _is_jax_path(path: str | None) -> bool:
+    return bool(path) and (path.split(".")[0] == "jax" or path in _TRACE_TAILS)
+
+
+def _trace_entry(path: str | None) -> bool:
+    """Does calling this transform trace its function arguments?"""
+    if not path:
+        return False
+    return _tail(path) in _TRACE_TAILS and path.split(".")[0] == "jax"
+
+
+def _jit_like(node: ast.AST, imports: dict[str, str]) -> ast.Call | bool | None:
+    """Classify a decorator / call target as jit-family. Returns the
+    ``partial(...)`` call node when wrapped (so donate kwargs can be read
+    off it), True for a bare jit reference, None otherwise."""
+    if isinstance(node, ast.Call):
+        path = _dotted(node.func, imports)
+        if _tail(path) == "partial" and node.args:
+            inner = _dotted(node.args[0], imports)
+            if _tail(inner) in _JIT_TAILS and _is_jax_path(inner):
+                return node
+            return None
+        if _tail(path) in _JIT_TAILS and _is_jax_path(path):
+            return node
+        return None
+    path = _dotted(node, imports)
+    if _tail(path) in _JIT_TAILS and _is_jax_path(path):
+        return True
+    return None
+
+
+# --------------------------------------------------------------------------
+# the per-module linter
+
+
+class _ModuleLint:
+    def __init__(self, tree: ast.Module, rel_path: str, lines: list[str]):
+        self.tree = tree
+        self.path = rel_path
+        self.lines = lines
+        self.imports = _collect_imports(tree)
+        self.findings: list[Finding] = []
+        self._flagged: set[tuple[str, int, int]] = set()
+        # name -> FunctionDef nodes anywhere in the module (scope-blind —
+        # a lint over-approximation, precise enough at module granularity)
+        self.fn_defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fn_defs.setdefault(node.name, []).append(node)
+
+    # -- plumbing --------------------------------------------------------
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if (rule, line, col) in self._flagged:
+            return
+        self._flagged.add((rule, line, col))
+        snippet = (
+            self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        )
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.path,
+                line=line,
+                col=col,
+                message=message,
+                snippet=snippet,
+            )
+        )
+
+    def run(self) -> list[Finding]:
+        traced = self._traced_functions()
+        seen: set[int] = set()
+        for root, reason in traced:
+            if id(root) in seen:
+                continue
+            self._walk_traced(root, self._params_of(root), reason, seen)
+        self._check_weak_literals()
+        self._check_missing_donate()
+        self._check_set_iteration()
+        self._check_step_loops()
+        return self.findings
+
+    # -- traced-context discovery ----------------------------------------
+
+    def _traced_functions(self) -> list[tuple[ast.AST, str]]:
+        """(function node, why-it-is-traced) for every trace root in the
+        module: jit-family decorators, plus functions/lambdas passed by
+        name to jax transforms (jit/scan/shard_map/...). Tracing is NOT
+        propagated through ordinary calls — module-local precision beats
+        interprocedural false positives for a lint pass."""
+        roots: list[tuple[ast.AST, str]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if _jit_like(deco, self.imports) is not None:
+                        roots.append((node, "@jit"))
+            elif isinstance(node, ast.Call):
+                path = _dotted(node.func, self.imports)
+                if not _trace_entry(path):
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        for fn in self.fn_defs.get(arg.id, ()):
+                            roots.append((fn, _tail(path)))
+                    elif isinstance(arg, ast.Lambda):
+                        roots.append((arg, _tail(path)))
+        return roots
+
+    @staticmethod
+    def _params_of(fn: ast.AST) -> set[str]:
+        args = fn.args
+        names = [
+            a.arg
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        ]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return {n for n in names if n != "self"}
+
+    def _walk_traced(
+        self, node: ast.AST, params: set[str], reason: str, seen: set[int]
+    ) -> None:
+        """Visit a traced function body; nested functions extend the live
+        traced-parameter set (their closures capture enclosing tracers)."""
+        seen.add(id(node))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                self._walk_traced(
+                    child, params | self._params_of(child), reason, seen
+                )
+                continue
+            self._check_traced_node(child, params, reason)
+            self._walk_traced(child, params, reason, seen)
+
+    # -- dynamic-value analysis ------------------------------------------
+
+    _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+    _STATIC_FNS = {"len", "isinstance", "hasattr", "callable", "getattr", "type"}
+
+    def _dynamic(self, node: ast.AST, params: set[str]) -> bool:
+        """Could this expression hold a tracer rooted at a traced param?
+        Shape/dtype accesses and identity/isinstance tests are static."""
+        if isinstance(node, ast.Name):
+            return node.id in params
+        if isinstance(node, ast.Attribute):
+            if node.attr in self._SHAPE_ATTRS:
+                return False
+            return self._dynamic(node.value, params)
+        if isinstance(node, ast.Call):
+            fn_path = _dotted(node.func, self.imports)
+            if _tail(fn_path) in self._STATIC_FNS:
+                return False
+            children: list[ast.AST] = list(node.args) + [
+                kw.value for kw in node.keywords
+            ]
+            if not isinstance(node.func, ast.Name):
+                children.append(node.func)
+            return any(self._dynamic(c, params) for c in children)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return any(
+                self._dynamic(c, params)
+                for c in [node.left] + list(node.comparators)
+            )
+        if isinstance(node, ast.Constant):
+            return False
+        return any(
+            self._dynamic(c, params) for c in ast.iter_child_nodes(node)
+        )
+
+    # -- rules inside traced bodies --------------------------------------
+
+    _SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+    _SYNC_METHODS = {"item", "tolist"}
+    _NUMPY_ROOTS = {"numpy", "onp"}
+
+    def _check_traced_node(
+        self, node: ast.AST, params: set[str], reason: str
+    ) -> None:
+        if isinstance(node, ast.Call):
+            self._check_host_sync(node, params, reason)
+            self._check_impurity(node, reason)
+        elif isinstance(node, (ast.If, ast.While)):
+            if self._dynamic(node.test, params):
+                kind = "while" if isinstance(node, ast.While) else "if"
+                self.emit(
+                    "JX003",
+                    node,
+                    f"`{kind}` branches on a traced value inside a "
+                    f"{reason}-traced function — raises at trace time or "
+                    "bakes in one branch",
+                )
+
+    def _check_host_sync(
+        self, node: ast.Call, params: set[str], reason: str
+    ) -> None:
+        func = node.func
+        path = _dotted(func, self.imports)
+        if (
+            isinstance(func, ast.Name)
+            and func.id in self._SYNC_BUILTINS
+            and node.args
+            and self._dynamic(node.args[0], params)
+        ):
+            self.emit(
+                "JX002",
+                node,
+                f"`{func.id}()` forces a traced value to host inside a "
+                f"{reason}-traced body",
+            )
+        elif (
+            isinstance(func, ast.Attribute) and func.attr in self._SYNC_METHODS
+        ):
+            self.emit(
+                "JX002",
+                node,
+                f"`.{func.attr}()` inside a {reason}-traced body is a "
+                "host sync (or trace-time failure)",
+            )
+        elif (
+            path
+            and path.split(".")[0] in self._NUMPY_ROOTS
+            and _tail(path) in {"array", "asarray"}
+            and node.args
+            and self._dynamic(node.args[0], params)
+        ):
+            self.emit(
+                "JX002",
+                node,
+                f"`{_tail(path)}` materializes a traced value as numpy "
+                f"inside a {reason}-traced body",
+            )
+        elif path == "jax.device_get":
+            self.emit(
+                "JX002",
+                node,
+                f"`jax.device_get` inside a {reason}-traced body",
+            )
+        elif isinstance(func, ast.Name) and func.id == "print":
+            self.emit(
+                "JX002",
+                node,
+                f"`print` inside a {reason}-traced body runs at trace time "
+                "only (use jax.debug.print)",
+            )
+
+    _IMPURE = {
+        "time": {
+            "time",
+            "perf_counter",
+            "monotonic",
+            "time_ns",
+            "perf_counter_ns",
+            "monotonic_ns",
+        },
+        "random": None,  # any attr of stdlib random
+        "secrets": None,
+        "uuid": None,
+    }
+
+    def _check_impurity(self, node: ast.Call, reason: str) -> None:
+        path = _dotted(node.func, self.imports)
+        if not path:
+            return
+        parts = path.split(".")
+        root, tail = parts[0], parts[-1]
+        impure = (
+            root in self._IMPURE
+            and (self._IMPURE[root] is None or tail in self._IMPURE[root])
+        )
+        # numpy's global RNG (np.random.*) — jax.random is keyed and pure
+        impure = impure or (
+            root in self._NUMPY_ROOTS and len(parts) >= 3 and parts[1] == "random"
+        )
+        impure = impure or path.endswith("datetime.now") or path == "os.urandom"
+        if impure:
+            self.emit(
+                "JX004",
+                node,
+                f"`{path}` inside a {reason}-traced body freezes its value "
+                "at trace time",
+            )
+
+    # -- JX001: weak scalar literals into carries/arrays -----------------
+
+    _WEAK_CTORS = {"array", "asarray", "full"}
+    _CARRY_ARG = {"scan": (1, "init"), "while_loop": (2, "init_val"),
+                  "fori_loop": (3, "init_val")}
+
+    def _check_weak_literals(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = _dotted(node.func, self.imports)
+            if not path:
+                continue
+            parts = path.split(".")
+            tail = parts[-1]
+            if (
+                tail in self._WEAK_CTORS
+                and "numpy" in parts[:-1]
+                and parts[0] == "jax"
+            ):
+                self._check_weak_ctor(node, tail)
+            elif tail in self._CARRY_ARG and parts[0] == "jax":
+                pos, kw = self._CARRY_ARG[tail]
+                init = None
+                if len(node.args) > pos:
+                    init = node.args[pos]
+                else:
+                    init = next(
+                        (k.value for k in node.keywords if k.arg == kw), None
+                    )
+                if init is not None:
+                    for lit in self._bare_literals(init):
+                        self.emit(
+                            "JX001",
+                            lit,
+                            f"bare `{lit.value!r}` in a `{tail}` carry init "
+                            "is weak-typed — the first iteration's output "
+                            "dtype won't match and the carry re-promotes "
+                            "(or jit recompiles)",
+                        )
+
+    def _check_weak_ctor(self, node: ast.Call, tail: str) -> None:
+        has_dtype = any(k.arg == "dtype" for k in node.keywords)
+        value_pos = 1 if tail == "full" else 0
+        has_dtype = has_dtype or len(node.args) > value_pos + 1
+        if has_dtype or len(node.args) <= value_pos:
+            return
+        value = node.args[value_pos]
+        if isinstance(value, ast.Constant) and isinstance(
+            value.value, (int, float)
+        ) and not isinstance(value.value, bool):
+            self.emit(
+                "JX001",
+                node,
+                f"`jnp.{tail}` of a scalar literal without `dtype` builds a "
+                "weak-typed array — entering jitted state/carries it keys "
+                "the cache differently from the strong array a step returns",
+            )
+
+    @staticmethod
+    def _bare_literals(node: ast.AST) -> list[ast.Constant]:
+        """Numeric literals sitting directly in the init expression or its
+        tuple/list/dict containers — calls (jnp.zeros(...)) are opaque."""
+        out: list[ast.Constant] = []
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, ast.Constant):
+                if isinstance(cur.value, (int, float)) and not isinstance(
+                    cur.value, bool
+                ):
+                    out.append(cur)
+            elif isinstance(cur, (ast.Tuple, ast.List)):
+                stack.extend(cur.elts)
+            elif isinstance(cur, ast.Dict):
+                stack.extend(cur.values)
+        return out
+
+    # -- JX005: missing donate_argnums -----------------------------------
+
+    def _check_missing_donate(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    jit = _jit_like(deco, self.imports)
+                    if jit is None:
+                        continue
+                    kws = jit.keywords if isinstance(jit, ast.Call) else []
+                    if any(
+                        k.arg in ("donate_argnums", "donate_argnames")
+                        for k in kws
+                    ):
+                        continue
+                    if self._returns_updated_arg(node):
+                        # anchor on the decorator line so an inline
+                        # suppression sits next to the `@jax.jit` it excuses
+                        self.emit(
+                            "JX005",
+                            deco,
+                            f"jitted `{node.name}` returns an updated "
+                            "version of an argument but donates nothing",
+                        )
+            elif isinstance(node, ast.Call):
+                path = _dotted(node.func, self.imports)
+                if not (
+                    _tail(path) in _JIT_TAILS and _is_jax_path(path)
+                ):
+                    continue
+                if any(
+                    k.arg in ("donate_argnums", "donate_argnames")
+                    for k in node.keywords
+                ):
+                    continue
+                if not node.args or not isinstance(node.args[0], ast.Name):
+                    continue
+                for fn in self.fn_defs.get(node.args[0].id, ()):
+                    if self._returns_updated_arg(fn):
+                        self.emit(
+                            "JX005",
+                            node,
+                            f"`jax.jit({node.args[0].id})` — the function "
+                            "returns an updated argument but donates nothing",
+                        )
+                        break
+
+    def _returns_updated_arg(self, fn: ast.AST) -> bool:
+        params = self._params_of(fn)
+        reassigned: set[str] = set()
+        returns: list[ast.Return] = []
+        for sub in self._body_nodes(fn):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            reassigned.add(n.id)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)) and isinstance(
+                sub.target, ast.Name
+            ):
+                reassigned.add(sub.target.id)
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                returns.append(sub)
+        for ret in returns:
+            elts = (
+                ret.value.elts
+                if isinstance(ret.value, ast.Tuple)
+                else [ret.value]
+            )
+            for e in elts:
+                if (
+                    isinstance(e, ast.Name)
+                    and e.id in params
+                    and e.id in reassigned
+                ):
+                    return True
+                if (
+                    isinstance(e, ast.Call)
+                    and isinstance(e.func, ast.Attribute)
+                    and e.func.attr in {"replace", "apply_gradients"}
+                    and isinstance(e.func.value, ast.Name)
+                    and e.func.value.id in params
+                ):
+                    return True
+        return False
+
+    @staticmethod
+    def _body_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+        """Walk a function body without descending into nested functions."""
+        return _ModuleLint._body_nodes_of_stmts(
+            list(ast.iter_child_nodes(fn))
+        )
+
+    # -- JX006: set iteration feeding containers -------------------------
+
+    def _is_set_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            return _tail(_dotted(node.func, self.imports)) in {
+                "set",
+                "frozenset",
+            }
+        return False
+
+    def _check_set_iteration(self) -> None:
+        for node in ast.walk(self.tree):
+            iters: list[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if self._is_set_expr(it):
+                    self.emit(
+                        "JX006",
+                        it,
+                        "iterating a set: order varies across processes "
+                        "(hash randomization) — containers/pytrees built "
+                        "from it diverge across hosts",
+                    )
+
+    # -- JX007: per-step host syncs in step loops ------------------------
+
+    def _check_step_loops(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = [
+                n
+                for stmt in node.body
+                for n in self._body_nodes_of_stmts([stmt])
+            ]
+            is_step_loop = any(
+                isinstance(n, ast.Call)
+                and "step" in _tail(_dotted(n.func, self.imports)).lower()
+                and len(n.args) + len(n.keywords) >= 2
+                for n in body
+            )
+            if not is_step_loop:
+                continue
+            for n in body:
+                if not isinstance(n, ast.Call):
+                    continue
+                func = n.func
+                if isinstance(func, ast.Name) and func.id == "float" and n.args:
+                    self.emit(
+                        "JX007",
+                        n,
+                        "`float()` in a step loop blocks the host on the "
+                        "device every iteration",
+                    )
+                elif isinstance(func, ast.Attribute) and func.attr == "item":
+                    self.emit(
+                        "JX007",
+                        n,
+                        "`.item()` in a step loop blocks the host on the "
+                        "device every iteration",
+                    )
+
+    @staticmethod
+    def _body_nodes_of_stmts(stmts: list[ast.AST]) -> Iterable[ast.AST]:
+        stack = list(stmts)
+        while stack:
+            cur = stack.pop()
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield cur
+            stack.extend(ast.iter_child_nodes(cur))
+
+
+# --------------------------------------------------------------------------
+# file-level driving
+
+
+def _apply_suppressions(findings: list[Finding], lines: list[str]) -> None:
+    for f in findings:
+        if not (0 < f.line <= len(lines)):
+            continue
+        m = _SUPPRESS_RE.search(lines[f.line - 1])
+        if not m:
+            continue
+        ids = m.group("ids")
+        if ids is None or f.rule in {
+            s.strip().upper() for s in ids.split(",")
+        }:
+            f.suppressed = True
+
+
+def lint_source(
+    source: str, rel_path: str, tree: ast.Module | None = None
+) -> list[Finding]:
+    """Lint one file's source; returns findings with inline suppressions
+    applied (suppressed findings are kept, marked). Pass ``tree`` to reuse
+    an already-parsed AST (the CLI parses each file once for both the lint
+    and the sharding checker)."""
+    lines = source.splitlines()
+    try:
+        if tree is None:
+            tree = ast.parse(source, filename=rel_path)
+    except SyntaxError as exc:
+        # the message doubles as the snippet so each distinct syntax error
+        # fingerprints separately (a baselined one can't mask the next)
+        return [
+            Finding(
+                rule="JX000",
+                path=rel_path,
+                line=exc.lineno or 1,
+                col=exc.offset or 0,
+                message=f"file does not parse: {exc.msg}",
+                snippet=str(exc.msg or ""),
+            )
+        ]
+    findings = _ModuleLint(tree, rel_path, lines).run()
+    _apply_suppressions(findings, lines)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Iterable[Path]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    # dedupe, keep order
+    seen: set[Path] = set()
+    uniq = []
+    for p in out:
+        rp = p.resolve()
+        if rp not in seen:
+            seen.add(rp)
+            uniq.append(p)
+    return uniq
+
+
+def lint_paths(
+    paths: Iterable[Path], root: Path | None = None
+) -> list[Finding]:
+    """Lint every ``.py`` under ``paths``; finding paths are relative to
+    ``root`` (posix) so fingerprints are machine-independent."""
+    root = Path(root) if root is not None else Path.cwd()
+    findings: list[Finding] = []
+    for file in iter_py_files(paths):
+        try:
+            rel = file.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = file.as_posix()
+        findings.extend(lint_source(file.read_text(), rel))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: Path) -> dict[str, int]:
+    """fingerprint -> allowed occurrence count; empty when absent."""
+    path = Path(path)
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {str(k): int(v) for k, v in data.get("fingerprints", {}).items()}
+
+
+def write_baseline(findings: list[Finding], path: Path) -> None:
+    counts: dict[str, int] = {}
+    for f in findings:
+        if f.suppressed:
+            continue
+        fp = fingerprint(f)
+        counts[fp] = counts.get(fp, 0) + 1
+    Path(path).write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "tool": "jaxlint",
+                "fingerprints": dict(sorted(counts.items())),
+            },
+            indent=2,
+        )
+        + "\n"
+    )
+
+
+def apply_baseline(findings: list[Finding], baseline: dict[str, int]) -> None:
+    """Mark the first N occurrences of each baselined fingerprint; anything
+    beyond the recorded count stays a NEW finding."""
+    remaining = dict(baseline)
+    for f in findings:
+        if f.suppressed:
+            continue
+        fp = fingerprint(f)
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            f.baselined = True

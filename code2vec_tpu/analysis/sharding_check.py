@@ -1,0 +1,292 @@
+"""Sharding-contract checker: PartitionSpec literals vs the declared mesh.
+
+An invalid ``PartitionSpec`` is a run-time-only failure class — and on a
+real pod it fails *late* (at the first dispatch that touches the spec, 20
+minutes into staging) or worse, silently replicates. This pass
+cross-validates every ``PartitionSpec``/``P`` literal in the scanned files
+against the axis names the mesh module declares (``parallel/mesh.py``'s
+``AXIS_* = "..."`` constants), entirely statically:
+
+- **SC001**: a spec references an axis name the mesh does not declare
+  (typo'd ``"bath"``, stale axis after a mesh refactor).
+- **SC002**: the same axis appears twice in one spec — a mesh axis may
+  shard at most one dimension of an array.
+- **SC003**: the ``ctx`` axis appears in a spec built inside a function
+  whose name marks it as a parameter/state sharding rule — the context
+  axis shards the bag dimension of *batches*; partitioning vocab tables or
+  encoder params over it over-partitions known-small dims.
+
+Axis names are resolved through a small constant propagation: string
+literals, ``None``, names assigned from either, ``AXIS_*`` names imported
+from the mesh module, and ``a if cond else b`` over resolvable branches.
+Anything else (helper-call results, arbitrary expressions) is UNKNOWN and
+skipped — the checker never guesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from code2vec_tpu.analysis.jaxlint import (
+    Finding,
+    _apply_suppressions,
+    _collect_imports,
+    _dotted,
+    _tail,
+)
+
+__all__ = ["declared_axes", "check_source", "check_paths"]
+
+_UNKNOWN = object()
+
+
+def declared_axes(mesh_source: str) -> dict[str, str]:
+    """Parse the mesh module for ``AXIS_<ROLE> = "<name>"`` declarations.
+    Returns ``{"AXIS_DATA": "data", ...}`` — the var names matter too
+    (SC003 keys off ``AXIS_CTX``'s value)."""
+    tree = ast.parse(mesh_source)
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("AXIS_")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _axis_env(
+    tree: ast.Module, imports: dict[str, str], axis_decls: dict[str, str]
+) -> dict[str, frozenset]:
+    """Name -> possible axis values (strings / None), or UNKNOWN-bearing.
+    One flat pass over every assignment in the file — scope-blind, which
+    is safe: a name bound to two different resolvable values yields the
+    union, and any unresolvable binding poisons it to UNKNOWN."""
+    env: dict[str, object] = {}
+    # names imported from the mesh module resolve to their declared values
+    for bound, target in imports.items():
+        leaf = target.rsplit(".", 1)[-1]
+        if leaf in axis_decls and ".mesh." in f".{target}":
+            env[bound] = frozenset({axis_decls[leaf]})
+
+    def resolve(node: ast.AST, depth: int = 0) -> object:
+        if depth > 8:
+            return _UNKNOWN
+        if isinstance(node, ast.Constant) and (
+            node.value is None or isinstance(node.value, str)
+        ):
+            return frozenset({node.value})
+        if isinstance(node, ast.Name):
+            return env.get(node.id, _UNKNOWN)
+        if isinstance(node, ast.IfExp):
+            a = resolve(node.body, depth + 1)
+            b = resolve(node.orelse, depth + 1)
+            if a is _UNKNOWN or b is _UNKNOWN:
+                return _UNKNOWN
+            return a | b
+        return _UNKNOWN
+
+    # iterate to a small fixed point so chained aliases resolve regardless
+    # of their order in the file
+    for _ in range(3):
+        changed = False
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            name = node.targets[0].id
+            val = resolve(node.value)
+            prev = env.get(name)
+            if val is _UNKNOWN:
+                if name not in env:
+                    env[name] = _UNKNOWN
+                    changed = True
+                continue
+            merged = val if prev in (None, _UNKNOWN) else prev | val
+            # a name with BOTH resolvable and unresolvable bindings stays
+            # unknown only if it was never resolvable; prefer the union of
+            # what we can see (lint-grade, not a type system)
+            if prev is _UNKNOWN:
+                merged = val
+            if merged != prev:
+                env[name] = merged
+                changed = True
+        if not changed:
+            break
+    return {k: v for k, v in env.items()}
+
+
+def _spec_arg_values(node: ast.AST, env: dict) -> list[object]:
+    """Possible axis values of ONE PartitionSpec positional arg: a list of
+    frozensets (one per axis slot — tuple args shard one dim over several
+    axes) or UNKNOWN entries."""
+    if isinstance(node, ast.Tuple):
+        out: list[object] = []
+        for elt in node.elts:
+            out.extend(_spec_arg_values(elt, env))
+        return out
+    if isinstance(node, ast.Constant) and (
+        node.value is None or isinstance(node.value, str)
+    ):
+        return [frozenset({node.value})]
+    if isinstance(node, ast.Name):
+        return [env.get(node.id, _UNKNOWN)]
+    if isinstance(node, ast.IfExp):
+        a = _spec_arg_values(node.body, env)
+        b = _spec_arg_values(node.orelse, env)
+        if len(a) == len(b) == 1 and a[0] is not _UNKNOWN and b[0] is not _UNKNOWN:
+            return [a[0] | b[0]]
+        return [_UNKNOWN]
+    return [_UNKNOWN]
+
+
+def check_source(
+    source: str,
+    rel_path: str,
+    axis_decls: dict[str, str],
+    tree: ast.Module | None = None,
+) -> list[Finding]:
+    """Run SC001-SC003 over one file. ``axis_decls`` comes from
+    :func:`declared_axes` (or a test-supplied mapping). Pass ``tree`` to
+    reuse an already-parsed AST."""
+    lines = source.splitlines()
+    try:
+        if tree is None:
+            tree = ast.parse(source, filename=rel_path)
+    except SyntaxError:
+        return []  # jaxlint already reports unparseable files
+    imports = _collect_imports(tree)
+    env = _axis_env(tree, imports, axis_decls)
+    declared = set(axis_decls.values())
+    ctx_axis = axis_decls.get("AXIS_CTX")
+    findings: list[Finding] = []
+
+    # map each PartitionSpec call to its innermost enclosing function name
+    # chain (SC003 context)
+    parents: dict[int, str] = {}
+
+    def tag(node: ast.AST, fn_chain: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            chain = fn_chain
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                chain = f"{fn_chain}.{child.name}" if fn_chain else child.name
+            parents[id(child)] = chain
+            tag(child, chain)
+
+    tag(tree, "")
+
+    flagged: set[tuple[str, int, int]] = set()
+
+    def emit(rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        # same (rule, line, col) dedup as _ModuleLint.emit: one spec
+        # repeating a bad axis is one defect, not one per slot (duplicates
+        # would also inflate the fingerprint's baseline count)
+        if (rule, line, col) in flagged:
+            return
+        flagged.add((rule, line, col))
+        snippet = (
+            lines[line - 1].strip() if 0 < line <= len(lines) else ""
+        )
+        findings.append(
+            Finding(
+                rule=rule,
+                path=rel_path,
+                line=line,
+                col=col,
+                message=message,
+                snippet=snippet,
+            )
+        )
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _tail(_dotted(node.func, imports)) != "PartitionSpec":
+            continue
+        slots = []
+        for arg in node.args:
+            slots.extend(_spec_arg_values(arg, env))
+        definite: list[str] = []
+        for values in slots:
+            if values is _UNKNOWN:
+                continue
+            for v in values:
+                if v is None:
+                    continue
+                if v not in declared:
+                    emit(
+                        "SC001",
+                        node,
+                        f"PartitionSpec references axis {v!r} but the mesh "
+                        f"declares only {sorted(declared)}",
+                    )
+                if len(values) == 1:
+                    definite.append(v)
+        dups = {v for v in definite if definite.count(v) > 1}
+        for v in sorted(dups):
+            emit(
+                "SC002",
+                node,
+                f"axis {v!r} appears {definite.count(v)} times in one "
+                "PartitionSpec — a mesh axis shards at most one dimension",
+            )
+        chain = parents.get(id(node), "")
+        if (
+            ctx_axis is not None
+            and ctx_axis in definite
+            and any(k in chain.lower() for k in ("param", "state"))
+        ):
+            emit(
+                "SC003",
+                node,
+                f"ctx axis {ctx_axis!r} in `{chain}` — parameter/state "
+                "sharding rules must not partition over the context axis",
+            )
+
+    _apply_suppressions(findings, lines)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def check_paths(
+    paths: Iterable[Path],
+    root: Path | None = None,
+    axis_decls: dict[str, str] | None = None,
+    mesh_file: Path | None = None,
+) -> list[Finding]:
+    """Check every ``.py`` under ``paths``. Axis declarations come from
+    ``axis_decls``, else from ``mesh_file``, else from the first
+    ``parallel/mesh.py`` found under the scanned paths; no mesh found →
+    no findings (nothing to validate against)."""
+    from code2vec_tpu.analysis.jaxlint import iter_py_files
+
+    root = Path(root) if root is not None else Path.cwd()
+    files = iter_py_files(paths)
+    if axis_decls is None:
+        if mesh_file is None:
+            mesh_file = next(
+                (f for f in files if f.as_posix().endswith("parallel/mesh.py")),
+                None,
+            )
+        if mesh_file is None:
+            return []
+        axis_decls = declared_axes(Path(mesh_file).read_text())
+    findings: list[Finding] = []
+    for file in files:
+        try:
+            rel = file.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = file.as_posix()
+        findings.extend(check_source(file.read_text(), rel, axis_decls))
+    return findings

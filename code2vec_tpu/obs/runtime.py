@@ -87,6 +87,19 @@ class RuntimeHealth:
             }
 
 
+def _lint_hints() -> dict[str, str]:
+    """jaxlint rule ids whose defect class surfaces as silent jit-cache
+    growth, so the `recompile` warning/event links runtime telemetry back
+    to the static pass. Guarded: obs must stay usable even if the analysis
+    package is stripped from a deployment."""
+    try:
+        from code2vec_tpu.analysis.jaxlint import RECOMPILE_HINT_RULES
+
+        return dict(RECOMPILE_HINT_RULES)
+    except Exception:  # pragma: no cover - partial install
+        return {}
+
+
 class RecompileDetector:
     """Count post-warmup ``jax.jit`` cache misses per tracked step function.
 
@@ -157,16 +170,28 @@ class RecompileDetector:
                 get_tracer().instant(
                     "recompile", category="health", fn=name, delta=delta
                 )
+                hints = _lint_hints()
+                hint_suffix = (
+                    " Likely static causes: "
+                    + "; ".join(
+                        f"{rid}: {why}" for rid, why in hints.items()
+                    )
+                    + " — run `python -m code2vec_tpu.analysis` to locate"
+                    if hints
+                    else ""
+                )
                 logger.warning(
                     "recompile detected: %s jit cache grew %d -> %d "
                     "(batch shape/dtype churn?); each recompile stalls the "
-                    "step for the full XLA compile",
+                    "step for the full XLA compile.%s",
                     name,
                     last,
                     size,
+                    hint_suffix,
                 )
                 if self._events is not None:
-                    fields = {"fn": name, "cache_size": size, "delta": delta}
+                    fields = {"fn": name, "cache_size": size, "delta": delta,
+                              "lint_hints": sorted(hints)}
                     if epoch is not None:
                         fields["epoch"] = epoch
                     self._events.emit("recompile", **fields)

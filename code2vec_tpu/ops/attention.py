@@ -15,10 +15,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from code2vec_tpu.analysis.contracts import shape_contract, spec
+
 # Same sentinel the reference uses for masked scores (model/model.py:12).
 NINF = -3.4e38
 
+# trace-time input contract shared by the pool implementations (XLA,
+# streaming, Pallas): symbols bind per call, so B/L/E must agree across
+# the three arguments but are free across calls (bucketed widths each
+# trace once). Checked once per trace — zero steady-state cost.
+POOL_CONTRACT = {
+    "contexts": spec("B,L,E", "float"),
+    "mask": spec("B,L"),
+    "attn_param": spec("E", "float"),
+}
 
+
+@shape_contract(scores=spec("B,L"), mask=spec("B,L"))
 def masked_attention_weights(scores: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Softmax over the bag axis with PAD positions masked out.
 
@@ -33,6 +46,7 @@ def masked_attention_weights(scores: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndar
     return jax.nn.softmax(masked, axis=-1)
 
 
+@shape_contract(**POOL_CONTRACT)
 def attention_pool(
     contexts: jnp.ndarray,  # [B, L, E]
     mask: jnp.ndarray,  # [B, L] (1 = real, 0 = PAD)
@@ -45,6 +59,7 @@ def attention_pool(
     return code_vector, attention
 
 
+@shape_contract(**POOL_CONTRACT)
 def streaming_attention_pool(
     contexts: jnp.ndarray,  # [B, l, E] (l = local shard of L when sharded)
     mask: jnp.ndarray,  # [B, l]

@@ -28,6 +28,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from code2vec_tpu.analysis.contracts import shape_contract, spec
+
 GRAD_MODES = ("dense", "segment", "segment_sorted")
 
 
@@ -59,6 +61,10 @@ def _lookup_segment_bwd(compute_dtype, sort, res, g):
 _lookup_segment.defvjp(_lookup_segment_fwd, _lookup_segment_bwd)
 
 
+# ids may be any rank ([B,L] contexts, [N] flat), but MUST be a strong
+# integer array — a weak int (a Python literal, flax's fresh counters)
+# entering the gather re-keys the jit cache per call site (JX001)
+@shape_contract(table=spec("V,E", "float"), ids=spec(None, "int"))
 def embedding_lookup(
     table: jnp.ndarray,  # f32 [vocab, dim]
     ids: jnp.ndarray,  # int [...]

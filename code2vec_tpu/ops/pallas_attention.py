@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from code2vec_tpu.ops.attention import NINF
+from code2vec_tpu.analysis.contracts import shape_contract
+from code2vec_tpu.ops.attention import NINF, POOL_CONTRACT
 
 _BLOCK_B = 8
 _LANE = 128
@@ -205,6 +206,7 @@ def _pool_bwd(block_b, interpret, residuals, grads):
 _pool.defvjp(_pool_fwd, _pool_bwd)
 
 
+@shape_contract(**POOL_CONTRACT)
 def pallas_attention_pool(
     contexts: jnp.ndarray,  # [B, L, E]
     mask: jnp.ndarray,  # [B, L]

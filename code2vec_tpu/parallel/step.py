@@ -21,6 +21,7 @@ from code2vec_tpu.train.step import (
     TrainState,
     build_eval_step_fn,
     build_train_step_fn,
+    contract_step,
 )
 
 
@@ -29,10 +30,14 @@ def make_parallel_train_step(
     table_update: str = "dense",
 ):
     """jit the train step with explicit mesh shardings; ``state`` supplies
-    the pytree structure for the annotations."""
+    the pytree structure for the annotations. The same trace-time contract
+    as the single-chip step applies (tracing sees GLOBAL shapes, so the
+    [B, L] patterns hold unchanged under any mesh)."""
     state_sh = state_shardings(mesh, state)
     return jax.jit(
-        build_train_step_fn(model_config, class_weights, table_update),
+        contract_step(
+            build_train_step_fn(model_config, class_weights, table_update)
+        ),
         in_shardings=(state_sh, batch_shardings(mesh)),
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=(0,),
@@ -52,7 +57,7 @@ def make_parallel_eval_step(
         "attention": row,
     }
     return jax.jit(
-        build_eval_step_fn(model_config, class_weights),
+        contract_step(build_eval_step_fn(model_config, class_weights)),
         in_shardings=(state_shardings(mesh, state), batch_shardings(mesh)),
         out_shardings=out_sh,
     )

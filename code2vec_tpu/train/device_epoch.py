@@ -47,7 +47,11 @@ from code2vec_tpu import PAD_INDEX, QUESTION_TOKEN_INDEX
 from code2vec_tpu.data.pipeline import flat_context_indices
 from code2vec_tpu.data.reader import CorpusData
 from code2vec_tpu.models.code2vec import Code2VecConfig
-from code2vec_tpu.train.step import build_eval_step_fn, build_train_step_fn
+from code2vec_tpu.train.step import (
+    build_eval_step_fn,
+    build_train_step_fn,
+    contract_step,
+)
 
 
 @dataclass
@@ -716,10 +720,16 @@ class EpochRunner:
             # shape-free, mesh-keyed: every bucket width's runner reuses
             # the same NamedSharding dict
             self._batch_shardings = cached_batch_shardings(mesh)
-        self._raw_train = build_train_step_fn(
+        # contract-checked once per chunk trace (the scan body traces once
+        # per chunk shape) — the on-device sampler's batches obey the same
+        # [B, bag] contract as host batches, so a sampler regression fails
+        # at trace time, not as a recompile storm
+        self._raw_train = contract_step(build_train_step_fn(
             model_config, class_weights, table_update
+        ))
+        self._raw_eval = contract_step(
+            build_eval_step_fn(model_config, class_weights)
         )
-        self._raw_eval = build_eval_step_fn(model_config, class_weights)
         self._train_chunks: dict[int, Callable] = {}
         self._eval_chunks: dict[int, Callable] = {}
 
@@ -1115,10 +1125,15 @@ class ShardedEpochRunner:
         self.bag = bag
         self.chunk_batches = chunk_batches
         self.mesh = mesh
-        self._raw_train = build_train_step_fn(
+        # same trace-time contract as the replicated runner: the shard_map
+        # sampler emits the GLOBAL [B, bag] batch, so the shared patterns
+        # hold unchanged on the multi-host path
+        self._raw_train = contract_step(build_train_step_fn(
             model_config, class_weights, table_update
+        ))
+        self._raw_eval = contract_step(
+            build_eval_step_fn(model_config, class_weights)
         )
-        self._raw_eval = build_eval_step_fn(model_config, class_weights)
         self._train_chunks: dict[int, Callable] = {}
         self._eval_chunks: dict[int, Callable] = {}
         self._sampler_cache = None

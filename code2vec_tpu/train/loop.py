@@ -73,6 +73,13 @@ logger = logging.getLogger(__name__)
 # unsampled-step path of _train_pass allocation-free
 _NO_SPAN = nullcontext()
 
+# the train pass accumulates per-step losses DEVICE-side and syncs once per
+# epoch (a per-step float() would serialize host and device — jaxlint
+# JX007); this window bounds how far the host may run ahead of the device
+# (each in-flight step pins its batch buffers, so an unbounded dispatch
+# queue is an HBM leak on slow steps). 2 = classic double buffering.
+_LOSS_SYNC_WINDOW = 2
+
 
 @dataclass
 class TrainResult:
@@ -211,7 +218,7 @@ def _train_pass(
     every step, so a 16k-step epoch doesn't flood the trace.
     """
     tracer = tracer or get_tracer()
-    train_loss = 0.0
+    losses: list = []  # device scalars; ONE host sync after the last step
     step = 0
     with tracer.span("train_pass", category="train", epoch=epoch):
         with device_batches(
@@ -224,17 +231,34 @@ def _train_pass(
                     if step == 0 or sampled
                     else _NO_SPAN
                 )
+                if sampled and losses:
+                    # drain the ≤W-step dispatch backlog before timing:
+                    # otherwise compute_ms for a sampled step would also
+                    # cover prior in-flight steps' device work
+                    jax.block_until_ready(losses[-1])
                 with span:
                     t0 = time.perf_counter()
                     state, loss = train_step(state, device_batch)
-                    train_loss += float(loss)  # blocks on the step's loss
+                    if step == 0 or sampled:
+                        # deliberate sampled-only sync: the compile span
+                        # and compute_ms must cover the device work, which
+                        # async dispatch would otherwise hide
+                        jax.block_until_ready(loss)
                 if sampled:
                     profiler.record_compute(
                         step, (time.perf_counter() - t0) * 1e3
                     )
+                losses.append(loss)
+                if step >= _LOSS_SYNC_WINDOW:
+                    # wait on the loss from W steps AGO — host stays ≤W
+                    # steps ahead of the device without ever idling it
+                    jax.block_until_ready(losses[step - _LOSS_SYNC_WINDOW])
                 step += 1
     if profiler is not None:
         profiler.observe_epoch_length(step)
+    # sequential float64 accumulation — bitwise-identical to the old
+    # per-step `train_loss += float(loss)` trajectory
+    train_loss = float(sum(map(float, jax.device_get(losses))))
     return state, train_loss
 
 
@@ -1160,7 +1184,7 @@ def _evaluate_batches(
     from code2vec_tpu.parallel.distributed import allgather_to_host
 
     tracer = tracer or get_tracer()
-    test_loss = 0.0
+    losses: list = []  # device scalars; converted once after the pass
     expected, actual = [], []
     # the host batch rides along with its device placement so labels and
     # the example mask stay host-side (no device round-trip); prefetching
@@ -1171,7 +1195,7 @@ def _evaluate_batches(
         ) as stream:
             for batch, device_batch in stream:
                 out = eval_step(state, device_batch)
-                test_loss += float(out["loss"])
+                losses.append(out["loss"])
                 valid = batch["example_mask"].astype(bool)
                 preds = allgather_to_host(out["preds"])
                 if gather_processes and len(preds) != len(valid):
@@ -1180,6 +1204,8 @@ def _evaluate_batches(
                     preds = preds[lo : lo + feed]
                 expected.append(batch["labels"][valid])
                 actual.append(preds[valid])
+    # same sequential float64 accumulation the old per-batch float() did
+    test_loss = float(sum(map(float, jax.device_get(losses))))
     expected = np.concatenate(expected) if expected else np.zeros(0, np.int32)
     actual = np.concatenate(actual) if actual else np.zeros(0, np.int32)
     if gather_processes and _jax.process_count() > 1:

@@ -24,8 +24,33 @@ import jax.numpy as jnp
 import optax
 from flax.training import train_state
 
+from code2vec_tpu.analysis.contracts import shape_contract, spec
 from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
 from code2vec_tpu.train.config import TrainConfig
+
+# trace-time contract on every jitted step's inputs (analysis/contracts.py):
+# validated once per trace — zero steady-state cost — so a weak-typed
+# `step` (the PR-4 double-compile bug) or a shape-skewed batch fails AT
+# TRACE TIME with an attributable error instead of silently recompiling.
+# Symbols bind per trace: bucketed runs validate each ladder width's
+# [B, L_b] trace independently.
+STEP_STATE_CONTRACT = {"step": spec("", jnp.int32)}
+STEP_BATCH_CONTRACT = {
+    "starts": spec("B,L", "int"),
+    "paths": spec("B,L", "int"),
+    "ends": spec("B,L", "int"),
+    "labels": spec("B", "int"),
+    "example_mask": spec("B", "float"),
+}
+
+
+def contract_step(fn):
+    """Apply the shared state/batch contract to a raw ``(state, batch)``
+    step function; used by the single-chip, mesh-sharded, and
+    device-epoch jit wrappers so the four paths can't drift."""
+    return shape_contract(
+        state=STEP_STATE_CONTRACT, batch=STEP_BATCH_CONTRACT
+    )(fn)
 
 
 class TrainState(train_state.TrainState):
@@ -301,13 +326,17 @@ def make_train_step(
     class_weights: jnp.ndarray,
     table_update: str = "dense",
 ):
-    """Single-device jitted train step."""
+    """Single-device jitted train step (contract-checked at trace time)."""
     return jax.jit(
-        build_train_step_fn(model_config, class_weights, table_update),
+        contract_step(
+            build_train_step_fn(model_config, class_weights, table_update)
+        ),
         donate_argnums=(0,),
     )
 
 
 def make_eval_step(model_config: Code2VecConfig, class_weights: jnp.ndarray):
-    """Single-device jitted eval step."""
-    return jax.jit(build_eval_step_fn(model_config, class_weights))
+    """Single-device jitted eval step (contract-checked at trace time)."""
+    return jax.jit(
+        contract_step(build_eval_step_fn(model_config, class_weights))
+    )

@@ -1,0 +1,1046 @@
+"""Tests for code2vec_tpu/analysis: the jaxlint AST rules (paired
+positive/negative fixtures per rule), inline suppression + baseline
+round-trip, the JSON output schema, the sharding-contract checker against
+declared mesh axes, the CLI runner, the ``@shape_contract`` trace-time
+layer (including the no-steady-state-sync property, asserted via trace
+count), and the recompile → lint-rule correlation hint.
+
+The acceptance pincer lives in :class:`TestWeakStepPincer`: the same
+weak-typed-scalar-into-the-train-step defect is caught statically by
+jaxlint AND rejected at trace time by the step's contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.analysis import jaxlint
+from code2vec_tpu.analysis.contracts import (
+    ArgSpec,
+    ContractError,
+    shape_contract,
+    spec,
+)
+from code2vec_tpu.analysis.jaxlint import lint_source
+from code2vec_tpu.analysis.sharding_check import check_source, declared_axes
+
+REPO = Path(__file__).resolve().parents[1]
+AXES = {"AXIS_DATA": "data", "AXIS_MODEL": "model", "AXIS_CTX": "ctx"}
+
+
+def lint(src: str):
+    return lint_source(textwrap.dedent(src), "mod.py")
+
+
+def rule_ids(findings, *, include_suppressed=False):
+    return {
+        f.rule
+        for f in findings
+        if include_suppressed or not f.suppressed
+    }
+
+
+def shard(src: str, axes=None):
+    return check_source(textwrap.dedent(src), "mod.py", axes or AXES)
+
+
+# ---------------------------------------------------------------------------
+# JX000 parse-error
+
+
+class TestJX000ParseError:
+    def test_syntax_error_flagged_with_message_fingerprint(self):
+        findings = lint("def broken(:\n")
+        assert rule_ids(findings) == {"JX000"}
+        (f,) = findings
+        assert "does not parse" in f.message
+        # the SyntaxError message is the snippet, so two DIFFERENT syntax
+        # errors in the same file fingerprint separately (one baselined
+        # occurrence can't mask the next)
+        other = lint("x = (1\n")
+        assert jaxlint.fingerprint(f) != jaxlint.fingerprint(other[0])
+
+    def test_valid_file_clean(self):
+        assert "JX000" not in rule_ids(lint("x = 1\n"))
+
+
+# ---------------------------------------------------------------------------
+# JX001 weak-type-literal
+
+
+class TestJX001WeakTypeLiteral:
+    def test_scan_carry_literal_flagged(self):
+        findings = lint(
+            """
+            import jax
+
+            def run(xs):
+                return jax.lax.scan(lambda c, x: (c + x, c), 0.0, xs)
+            """
+        )
+        assert "JX001" in rule_ids(findings)
+
+    def test_dtypeless_jnp_array_scalar_flagged(self):
+        findings = lint(
+            """
+            import jax.numpy as jnp
+
+            step = jnp.array(0)
+            """
+        )
+        assert "JX001" in rule_ids(findings)
+
+    def test_strong_carry_and_explicit_dtype_clean(self):
+        findings = lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            step = jnp.array(0, jnp.int32)
+            full = jnp.full((4,), 1.0, jnp.float32)
+
+            def run(xs):
+                return jax.lax.scan(
+                    lambda c, x: (c + x, c), jnp.zeros(()), xs
+                )
+            """
+        )
+        assert "JX001" not in rule_ids(findings)
+
+    def test_fori_loop_and_while_loop_inits(self):
+        findings = lint(
+            """
+            import jax
+
+            def count(n):
+                return jax.lax.fori_loop(0, n, lambda i, c: c + i, 0)
+
+            def drain(x):
+                return jax.lax.while_loop(lambda c: c[1] > 0, step, (x, 1))
+            """
+        )
+        assert "JX001" in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# JX002 host-sync-in-trace
+
+
+class TestJX002HostSyncInTrace:
+    def test_float_of_traced_value_flagged(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+            """
+        )
+        assert "JX002" in rule_ids(findings)
+
+    def test_item_numpy_devget_print_flagged(self):
+        findings = lint(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                a = x.item()
+                b = np.asarray(x)
+                c = jax.device_get(x)
+                print(x)
+                return a, b, c
+            """
+        )
+        msgs = [f.message for f in findings if f.rule == "JX002"]
+        assert len(msgs) == 4
+
+    def test_static_conversions_clean(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                n = float(x.shape[0])  # shape access is static
+                return x * n
+
+            def host_side(x):
+                return float(x)  # not traced
+            """
+        )
+        assert "JX002" not in rule_ids(findings)
+
+    def test_fn_passed_by_name_to_jit_is_traced(self):
+        findings = lint(
+            """
+            import jax
+
+            def body(x):
+                return float(x)
+
+            step = jax.jit(body)
+            """
+        )
+        assert "JX002" in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# JX003 tracer-branch
+
+
+class TestJX003TracerBranch:
+    def test_if_on_traced_value_flagged(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """
+        )
+        assert "JX003" in rule_ids(findings)
+
+    def test_while_on_traced_value_flagged(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                while x > 0:
+                    x = x - 1
+                return x
+            """
+        )
+        assert "JX003" in rule_ids(findings)
+
+    def test_static_branches_clean(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x, flag=None):
+                if flag is None:
+                    return x
+                if x.shape[0] > 2:
+                    return x * 2
+                if isinstance(x, tuple):
+                    return x[0]
+                return x
+            """
+        )
+        assert "JX003" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# JX004 impure-trace
+
+
+class TestJX004ImpureTrace:
+    def test_time_and_np_random_flagged(self):
+        findings = lint(
+            """
+            import time
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                t = time.perf_counter()
+                r = np.random.normal()
+                return x * t + r
+            """
+        )
+        msgs = [f for f in findings if f.rule == "JX004"]
+        assert len(msgs) == 2
+
+    def test_jax_random_and_host_side_time_clean(self):
+        findings = lint(
+            """
+            import time
+            import jax
+
+            @jax.jit
+            def f(x, key):
+                return x + jax.random.normal(key, x.shape)
+
+            def wall():
+                return time.perf_counter()  # not traced
+            """
+        )
+        assert "JX004" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# JX005 missing-donate
+
+
+class TestJX005MissingDonate:
+    def test_decorated_update_without_donation_flagged(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(state, batch):
+                state = state.apply_gradients(grads=batch)
+                return state
+            """
+        )
+        assert "JX005" in rule_ids(findings)
+
+    def test_call_form_without_donation_flagged(self):
+        findings = lint(
+            """
+            import jax
+
+            def step(state, batch):
+                state = state.replace(step=state.step + 1)
+                return state
+
+            jitted = jax.jit(step)
+            """
+        )
+        assert "JX005" in rule_ids(findings)
+
+    def test_donating_variants_clean(self):
+        findings = lint(
+            """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, batch):
+                state = state.apply_gradients(grads=batch)
+                return state
+
+            def raw(state, batch):
+                return state.replace(step=state.step + 1)
+
+            jitted = jax.jit(raw, donate_argnums=(0,))
+            """
+        )
+        assert "JX005" not in rule_ids(findings)
+
+    def test_pure_function_clean(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x, y):
+                return x + y
+            """
+        )
+        assert "JX005" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# JX006 set-iteration-order
+
+
+class TestJX006SetIterationOrder:
+    def test_for_over_set_flagged(self):
+        findings = lint(
+            """
+            names = {"b", "a"}
+            out = []
+            for n in names & {"a"}:
+                out.append(n)
+            for n in set(out):
+                out.append(n)
+            """
+        )
+        # only the literal set()/set-call iterations are flagged (the
+        # binop result is opaque — lint-grade, no guessing)
+        assert "JX006" in rule_ids(findings)
+
+    def test_comprehension_over_set_flagged(self):
+        findings = lint(
+            """
+            leaves = [x for x in {"p", "q"}]
+            """
+        )
+        assert "JX006" in rule_ids(findings)
+
+    def test_sorted_set_clean(self):
+        findings = lint(
+            """
+            names = {"b", "a"}
+            out = [n for n in sorted(names)]
+            for n in sorted(set(out)):
+                out.append(n)
+            """
+        )
+        assert "JX006" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# JX007 host-sync-step-loop
+
+
+class TestJX007HostSyncStepLoop:
+    def test_per_step_float_flagged(self):
+        findings = lint(
+            """
+            def epoch(train_step, state, batches):
+                total = 0.0
+                for batch in batches:
+                    state, loss = train_step(state, batch)
+                    total += float(loss)
+                return state, total
+            """
+        )
+        assert "JX007" in rule_ids(findings)
+
+    def test_per_step_item_flagged(self):
+        findings = lint(
+            """
+            def epoch(eval_step, state, batches):
+                out = []
+                for batch in batches:
+                    res = eval_step(state, batch)
+                    out.append(res.item())
+                return out
+            """
+        )
+        assert "JX007" in rule_ids(findings)
+
+    def test_accumulate_then_sync_once_clean(self):
+        findings = lint(
+            """
+            def epoch(train_step, state, batches):
+                losses = []
+                for batch in batches:
+                    state, loss = train_step(state, batch)
+                    losses.append(loss)
+                return state, float(sum(map(float, losses)) / len(losses))
+            """
+        )
+        assert "JX007" not in rule_ids(findings)
+
+    def test_float_in_non_step_loop_clean(self):
+        findings = lint(
+            """
+            def parse(rows):
+                return [float(r) for r in rows]
+
+            def walk(rows):
+                out = 0.0
+                for r in rows:
+                    out += float(r)
+                return out
+            """
+        )
+        assert "JX007" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+
+
+class TestSuppressionAndBaseline:
+    SRC = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """
+
+    def test_inline_suppression_by_id(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)  # jaxlint: disable=JX002
+            """
+        )
+        assert "JX002" in rule_ids(findings, include_suppressed=True)
+        assert "JX002" not in rule_ids(findings)
+
+    def test_bare_disable_suppresses_all(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)  # jaxlint: disable
+            """
+        )
+        assert all(f.suppressed for f in findings)
+
+    def test_other_id_does_not_suppress(self):
+        findings = lint(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)  # jaxlint: disable=JX001
+            """
+        )
+        assert "JX002" in rule_ids(findings)
+
+    def test_baseline_round_trip(self, tmp_path):
+        findings = lint(self.SRC)
+        assert findings and not any(f.baselined for f in findings)
+        bl = tmp_path / "baseline.json"
+        jaxlint.write_baseline(findings, bl)
+        loaded = jaxlint.load_baseline(bl)
+        again = lint(self.SRC)
+        jaxlint.apply_baseline(again, loaded)
+        assert all(f.baselined for f in again)
+
+    def test_baseline_counts_not_blanket(self, tmp_path):
+        findings = lint(self.SRC)
+        bl = tmp_path / "baseline.json"
+        jaxlint.write_baseline(findings, bl)
+        # the same defect introduced a SECOND time is a new finding: the
+        # baseline stores per-fingerprint counts, not blanket rule passes
+        doubled = lint(
+            self.SRC
+            + """
+            @jax.jit
+            def g(y):
+                return float(y)
+            """
+        )
+        jaxlint.apply_baseline(doubled, jaxlint.load_baseline(bl))
+        jx002 = [f for f in doubled if f.rule == "JX002"]
+        assert sum(f.baselined for f in jx002) == 1
+        assert sum(not f.baselined for f in jx002) == 1
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert jaxlint.load_baseline(tmp_path / "nope.json") == {}
+
+    def test_fingerprint_survives_line_shift(self):
+        a = lint(self.SRC)[0]
+        shifted = lint("\n\n\n" + textwrap.dedent(self.SRC))[0]
+        assert a.line != shifted.line
+        assert jaxlint.fingerprint(a) == jaxlint.fingerprint(shifted)
+
+
+# ---------------------------------------------------------------------------
+# sharding checker
+
+
+class TestShardingChecker:
+    def test_undeclared_axis_flagged(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            row = P("bath", None)
+            """
+        )
+        assert {f.rule for f in findings} == {"SC001"}
+        assert "'bath'" in findings[0].message
+
+    def test_repeated_bad_axis_emits_once(self):
+        # one spec repeating an undeclared axis is ONE defect — duplicate
+        # identical findings would also inflate the baseline count
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            row = P("bogus", "bogus")
+            """
+        )
+        assert [f.rule for f in findings if f.rule == "SC001"] == ["SC001"]
+
+    def test_declared_axes_clean(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            batch = P("data", None)
+            both = P("data", "model")
+            repl = P(None)
+            """
+        )
+        assert findings == []
+
+    def test_axis_resolved_through_mesh_constant(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            from code2vec_tpu.parallel.mesh import AXIS_DATA
+
+            ok = P(AXIS_DATA)
+            """
+        )
+        assert findings == []
+
+    def test_duplicate_axis_flagged(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            bad = P("data", "data")
+            """
+        )
+        assert {f.rule for f in findings} == {"SC002"}
+
+    def test_tuple_slot_duplicate_flagged(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            bad = P(("data", "model"), "model")
+            """
+        )
+        assert {f.rule for f in findings} == {"SC002"}
+
+    def test_ctx_axis_in_param_rules_flagged(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            def param_sharding_rules():
+                return {"table": P("ctx", None)}
+            """
+        )
+        assert {f.rule for f in findings} == {"SC003"}
+
+    def test_ctx_axis_on_batch_clean(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            def batch_shardings():
+                return {"starts": P("data", "ctx")}
+            """
+        )
+        assert findings == []
+
+    def test_unresolvable_names_are_skipped(self):
+        findings = shard(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            def make(axis):
+                return P(axis)  # helper arg: UNKNOWN, never guessed
+            """
+        )
+        assert findings == []
+
+    def test_real_mesh_module_declares_axes(self):
+        decls = declared_axes(
+            (REPO / "code2vec_tpu" / "parallel" / "mesh.py").read_text()
+        )
+        assert decls["AXIS_CTX"] == "ctx"
+        assert set(decls.values()) >= {"data", "model", "ctx"}
+
+
+# ---------------------------------------------------------------------------
+# CLI runner
+
+
+class TestRunnerCLI:
+    def _write(self, tmp_path, body):
+        f = tmp_path / "snippet.py"
+        f.write_text(textwrap.dedent(body))
+        return f
+
+    def _run(self, tmp_path, *extra):
+        from code2vec_tpu.analysis.__main__ import main
+
+        return main(
+            [
+                str(tmp_path),
+                "--root",
+                str(tmp_path),
+                "--baseline",
+                str(tmp_path / "baseline.json"),
+                *extra,
+            ]
+        )
+
+    def test_clean_tree_exits_zero(self, tmp_path, capsys):
+        self._write(tmp_path, "x = 1\n")
+        assert self._run(tmp_path) == 0
+        assert "0 new finding(s)" in capsys.readouterr().out
+
+    def test_finding_exits_one_with_hint(self, tmp_path, capsys):
+        self._write(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+            """,
+        )
+        assert self._run(tmp_path) == 1
+        out = capsys.readouterr().out
+        assert "JX002" in out and "fix:" in out and "snippet.py:" in out
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys):
+        self._write(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+            """,
+        )
+        assert self._run(tmp_path, "--write-baseline") == 0
+        capsys.readouterr()
+        assert self._run(tmp_path) == 0
+        assert "1 baselined" in capsys.readouterr().out
+
+    def test_json_schema(self, tmp_path, capsys):
+        self._write(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+            """,
+        )
+        assert self._run(tmp_path, "--json") == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == 1 and doc["tool"] == "jaxlint"
+        assert set(doc["summary"]) == {
+            "total", "new", "baselined", "suppressed", "by_severity",
+        }
+        (finding,) = [f for f in doc["findings"] if f["rule"] == "JX002"]
+        assert set(finding) == {
+            "rule", "name", "severity", "path", "line", "col", "message",
+            "hint", "snippet", "fingerprint", "suppressed", "baselined",
+        }
+        assert finding["severity"] == "error"
+        assert finding["path"] == "snippet.py"
+
+    def test_list_rules(self, tmp_path, capsys):
+        assert self._run(tmp_path, "--list-rules") == 0
+        out = capsys.readouterr().out
+        for rid in jaxlint.RULES:
+            assert rid in out
+
+    def test_repo_runs_clean(self, capsys):
+        """Acceptance: `python -m code2vec_tpu.analysis` on this repo has
+        zero unsuppressed, unbaselined findings."""
+        from code2vec_tpu.analysis.__main__ import main
+
+        assert main([]) == 0, capsys.readouterr().out
+
+    def test_diff_only_out_of_scope_is_noop(self, tmp_path, capsys):
+        # a tmp 'repo' with no git at all: --diff-only falls back to the
+        # full scan (never silently passes)
+        self._write(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+            """,
+        )
+        assert self._run(tmp_path, "--diff-only", "HEAD") == 1
+        err = capsys.readouterr().err
+        assert "full scan" in err
+
+    def test_diff_only_write_baseline_rejected(self, tmp_path, capsys):
+        # a baseline written from a restricted scan would drop accepted
+        # fingerprints in every unscanned file
+        with pytest.raises(SystemExit) as exc:
+            self._run(tmp_path, "--diff-only", "HEAD", "--write-baseline")
+        assert exc.value.code == 2
+        assert "full scan" in capsys.readouterr().err
+
+    def test_diff_only_mesh_change_triggers_full_scan(self, tmp_path, capsys):
+        # renaming a mesh axis invalidates PartitionSpecs in UNCHANGED
+        # files — --diff-only must widen to the full scan, or the PR job
+        # passes and the push job on main breaks
+        mesh = tmp_path / "parallel" / "mesh.py"
+        mesh.parent.mkdir()
+        mesh.write_text('AXIS_DATA = "data"\n')
+        stale = tmp_path / "shardings.py"
+        stale.write_text(
+            "from jax.sharding import PartitionSpec\n"
+            'SPEC = PartitionSpec("data")\n'
+        )
+
+        def git(*a):
+            subprocess.run(
+                ["git", "-C", str(tmp_path), *a],
+                check=True,
+                capture_output=True,
+            )
+
+        git("init", "-q")
+        git("add", "-A")
+        git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+        mesh.write_text('AXIS_DATA = "rows"\n')  # stale.py left untouched
+        rc = self._run(
+            tmp_path, "--diff-only", "HEAD", "--mesh-file", str(mesh)
+        )
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "SC001" in captured.out and "shardings.py" in captured.out
+        assert "full scan" in captured.err
+
+    def test_tools_wrapper_smoke(self):
+        res = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "jaxlint.py"),
+             "--list-rules"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert res.returncode == 0 and "JX001" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# trace-time contracts
+
+
+class TestShapeContract:
+    def test_spec_parsing(self):
+        s = spec("B,L", "int")
+        assert s.dims == ("B", "L") and s.dtypes == "int"
+        assert spec("").dims == ()
+        assert spec("4,?").dims == (4, "?")
+        assert isinstance(spec(dtype=jnp.int32), ArgSpec)
+        with pytest.raises(ValueError, match="category"):
+            spec("B", "quaternion")
+
+    def test_pass_and_rank_mismatch(self):
+        @shape_contract(x=spec("B,L", "int"))
+        def f(x):
+            return x.sum()
+
+        f(jnp.zeros((2, 3), jnp.int32))
+        with pytest.raises(ContractError, match="rank"):
+            f(jnp.zeros((2, 3, 4), jnp.int32))
+
+    def test_dtype_category_and_exact(self):
+        @shape_contract(x=spec("B", "float"), y=spec("B", jnp.int32))
+        def f(x, y):
+            return x, y
+
+        f(jnp.zeros(3, jnp.bfloat16), jnp.zeros(3, jnp.int32))
+        with pytest.raises(ContractError, match="dtype"):
+            f(jnp.zeros(3, jnp.int32), jnp.zeros(3, jnp.int32))
+        with pytest.raises(ContractError, match="dtype"):
+            f(jnp.zeros(3), jnp.zeros(3, jnp.int16))
+
+    def test_symbols_bind_consistently_within_call(self):
+        @shape_contract(a="B,L", b="B")
+        def f(a, b):
+            return a, b
+
+        f(jnp.zeros((2, 5)), jnp.zeros(2))
+        # a fresh call may bind different sizes (bucketed widths)...
+        f(jnp.zeros((4, 9)), jnp.zeros(4))
+        # ...but within one call the symbol must agree
+        with pytest.raises(ContractError, match="B=2"):
+            f(jnp.zeros((2, 5)), jnp.zeros(3))
+
+    def test_exact_dim_pin(self):
+        @shape_contract(x="3,?")
+        def f(x):
+            return x
+
+        f(jnp.zeros((3, 7)))
+        with pytest.raises(ContractError, match="pins"):
+            f(jnp.zeros((4, 7)))
+
+    def test_weak_rejected_strong_accepted(self):
+        @shape_contract(x=spec("", "int"))
+        def f(x):
+            return x + 1
+
+        f(jnp.asarray(0, jnp.int32))
+        with pytest.raises(ContractError, match="WEAK"):
+            f(jnp.asarray(0))  # dtype-less: weak int32
+
+    def test_allow_weak_opt_in(self):
+        @shape_contract(x=spec("", "int", allow_weak=True))
+        def f(x):
+            return x + 1
+
+        f(jnp.asarray(0))
+
+    def test_dict_and_attribute_contracts(self):
+        @shape_contract(batch={"ids": spec("B,L", "int")})
+        def f(batch):
+            return batch["ids"]
+
+        f({"ids": jnp.zeros((2, 3), jnp.int32), "extra": 1})
+        with pytest.raises(ContractError, match="missing required key"):
+            f({"other": jnp.zeros((2, 3), jnp.int32)})
+
+        class Carrier:
+            step = jnp.asarray(7, jnp.int32)
+
+        @shape_contract(state={"step": spec("", jnp.int32)})
+        def g(state):
+            return state.step
+
+        g(Carrier())
+        with pytest.raises(ContractError, match="no attribute"):
+            g(object())
+
+    def test_checked_once_per_trace_no_steady_state_sync(self):
+        """Under jit the wrapper body runs at TRACE time only: same-shape
+        calls hit the jit cache and never re-enter the contract check —
+        the zero-steady-state-cost property."""
+
+        @shape_contract(x=spec("B,L", "float"))
+        def f(x):
+            return x * 2.0
+
+        jf = jax.jit(f)
+        for _ in range(4):
+            jf(jnp.ones((2, 3))).block_until_ready()
+        assert f.contract_checks == 1
+        # a new static shape is a new trace: checked exactly once more
+        jf(jnp.ones((2, 5))).block_until_ready()
+        assert f.contract_checks == 2
+
+    def test_violation_raises_at_trace_time_under_jit(self):
+        @shape_contract(x=spec("B,L", "int"))
+        def f(x):
+            return x.sum()
+
+        with pytest.raises(ContractError, match="dtype"):
+            jax.jit(f)(jnp.ones((2, 3), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pincer: weak scalar into the jitted train step
+
+
+class TestWeakStepPincer:
+    FIXTURE = """
+        import jax
+        import jax.numpy as jnp
+
+        def resume(state, train_step, batches):
+            # restoring a counter without a dtype: weak int32 — the jit
+            # cache sees a different signature than the strong int32 the
+            # step returns, so every shape compiles twice
+            state = state.replace(step=jnp.array(0))
+            for b in batches:
+                state, loss = train_step(state, b)
+            return state
+        """
+
+    def _state_and_step(self):
+        from code2vec_tpu.models.code2vec import Code2VecConfig
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state, make_train_step
+
+        mc = Code2VecConfig(
+            terminal_count=30,
+            path_count=20,
+            label_count=5,
+            terminal_embed_size=8,
+            path_embed_size=6,
+            encode_size=16,
+        )
+        rng = np.random.default_rng(0)
+        B, L = 4, 6
+        batch = {
+            "starts": jnp.asarray(
+                rng.integers(1, 30, (B, L)).astype(np.int32)
+            ),
+            "paths": jnp.asarray(rng.integers(1, 20, (B, L)).astype(np.int32)),
+            "ends": jnp.asarray(rng.integers(1, 30, (B, L)).astype(np.int32)),
+            "labels": jnp.asarray(rng.integers(0, 5, B).astype(np.int32)),
+            "example_mask": jnp.ones((B,), jnp.float32),
+        }
+        state = create_train_state(
+            TrainConfig(batch_size=B), mc, jax.random.PRNGKey(0), batch
+        )
+        step = make_train_step(mc, jnp.ones((5,), jnp.float32))
+        return state, step, batch
+
+    def test_static_arm_jaxlint_flags_the_fixture(self):
+        findings = lint(self.FIXTURE)
+        assert "JX001" in rule_ids(findings)
+
+    def test_dynamic_arm_contract_rejects_at_trace_time(self):
+        state, step, batch = self._state_and_step()
+        # healthy state passes (and the loss is finite)
+        new_state, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+        # the PR-4 defect, resurrected deliberately: a weak-typed counter
+        weak = state.replace(step=jnp.asarray(0))
+        with pytest.raises(ContractError, match=r"WEAK.*JX001"):
+            step(weak, batch)
+
+    def test_shape_skew_rejected_at_trace_time(self):
+        state, step, batch = self._state_and_step()
+        skewed = dict(batch, labels=jnp.zeros((7,), jnp.int32))
+        with pytest.raises(ContractError, match="B="):
+            step(state, skewed)
+
+
+# ---------------------------------------------------------------------------
+# recompile → lint-rule correlation hint
+
+
+class TestRecompileHint:
+    class _Events:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, kind, **fields):
+            self.events.append((kind, fields))
+
+    class _FakeJit:
+        def __init__(self):
+            self.size = 1
+
+        def _cache_size(self):
+            return self.size
+
+    def test_recompile_event_carries_lint_hints(self):
+        from code2vec_tpu.obs.runtime import RecompileDetector
+
+        events = self._Events()
+        det = RecompileDetector(events=events)
+        fn = self._FakeJit()
+        det.track("train_step", fn)
+        assert det.check() == 0  # warmup observation
+        fn.size = 3
+        assert det.check() == 2
+        (kind, fields), = events.events
+        assert kind == "recompile"
+        assert fields["lint_hints"] == sorted(jaxlint.RECOMPILE_HINT_RULES)
+        assert "JX001" in fields["lint_hints"]
+
+    def test_hint_rules_exist_in_rule_table(self):
+        for rid in jaxlint.RECOMPILE_HINT_RULES:
+            assert rid in jaxlint.RULES

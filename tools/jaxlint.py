@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Thin wrapper for the static-analysis runner; equivalent to
+``python -m code2vec_tpu.analysis`` (see that module for flags). Kept as
+a tool entry point so `tools/` is the one place operators look for
+repo drives. Pure stdlib — runs without the jax environment."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from code2vec_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

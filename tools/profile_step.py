@@ -188,7 +188,9 @@ grads = jax.jit(jax.grad(loss_fn))(state.params, batch, key)
 jax.block_until_ready(grads)
 
 
-@jax.jit
+# re-invoked with the SAME state to time the update in isolation;
+# donation would poison the caller's buffers
+@jax.jit  # jaxlint: disable=JX005
 def adam_only(state, grads):
     return state.apply_gradients(grads=grads)
 
