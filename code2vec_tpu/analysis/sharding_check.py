@@ -37,7 +37,12 @@ from code2vec_tpu.analysis.jaxlint import (
     _tail,
 )
 
-__all__ = ["declared_axes", "check_source", "check_paths"]
+__all__ = [
+    "declared_axes",
+    "check_source",
+    "check_paths",
+    "validate_runtime_spec",
+]
 
 _UNKNOWN = object()
 
@@ -257,6 +262,42 @@ def check_source(
     _apply_suppressions(findings, lines)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def validate_runtime_spec(
+    entries, declared: Iterable[str], context: str = "spec"
+) -> list[str]:
+    """SC001/SC002 semantics applied to one *live* spec at restore time.
+
+    The static pass above validates PartitionSpec literals in source; the
+    mesh-reshape restore path (checkpoint.py) deserializes specs from a
+    checkpoint sidecar and re-binds them to a *new* mesh — axis names that
+    were valid at save time may not exist anymore. ``entries`` is the
+    sidecar form (one item per dim: None, an axis name, or a list of
+    names); ``declared`` is the new mesh's axis-name set. Returns
+    human-readable problems (empty = valid), so the caller can fail with
+    guidance instead of a late XLA sharding error.
+    """
+    declared = set(declared)
+    problems: list[str] = []
+    flat: list[str] = []
+    for entry in entries:
+        if entry is None:
+            continue
+        flat.extend(entry if isinstance(entry, (list, tuple)) else [entry])
+    for axis in dict.fromkeys(flat):  # stable de-dup
+        if axis not in declared:
+            problems.append(
+                f"{context}: axis {axis!r} is not declared by the restore "
+                f"mesh (axes: {sorted(declared)}) [SC001]"
+            )
+        if flat.count(axis) > 1:
+            problems.append(
+                f"{context}: axis {axis!r} appears {flat.count(axis)} times "
+                "in one PartitionSpec — a mesh axis shards at most one "
+                "dimension [SC002]"
+            )
+    return problems
 
 
 def check_paths(

@@ -1,9 +1,29 @@
-"""Checkpoint save/restore via orbax.
+"""Checkpoint save/restore via orbax — the elastic-training substrate.
 
 The reference only ever writes ``torch.save(state_dict)`` on a new best F1
 and has no load path at all (main.py:231; SURVEY.md §5.4). TPU pod runs get
-preempted, so this framework treats resume as first-class: params, optimizer
-state, RNG, epoch counter, and the early-stop bookkeeping all round-trip.
+preempted and *resized*, so this framework treats resume as first-class:
+
+- params, optimizer state, RNG, step counter, and the early-stop bookkeeping
+  all round-trip; :class:`TrainMeta` additionally carries a **data cursor**
+  (epoch + step-in-epoch + host RNG state) so ``--resume`` can restart
+  *inside* an epoch (train/loop.py replays the epoch stream to the cursor);
+- every save is **atomic**: arrays and sidecars are staged under a ``tmp.``
+  prefix and published with one ``os.replace`` — a crash mid-save can never
+  leave a partial dir that restore would select (restore additionally skips
+  dirs missing orbax's commit marker, so even foreign partials are ignored);
+- each slot dir carries its own ``train_meta.json`` sidecar, so a
+  ``prefer_best`` restore gets the bookkeeping that matches the restored
+  arrays (the old single top-level file — still written for compatibility —
+  belonged to the newest save of *either* slot);
+- a ``shardings.json`` sidecar records the PartitionSpec of every leaf plus
+  the mesh shape; restore re-binds those specs to the *current* mesh
+  (parallel/shardings.py), so a run killed on one topology resumes on
+  another — the migration primitive;
+- :class:`CheckpointWriter` gives the train loop **async** saves: the loop
+  blocks only for the device-to-host snapshot, persistence runs on a
+  background thread with at-most-one save in flight, and persist failures
+  re-raise into the loop at the next save (or at shutdown).
 """
 
 from __future__ import annotations
@@ -12,6 +32,7 @@ import json
 import logging
 import os
 import shutil
+import threading
 from dataclasses import asdict, dataclass, field
 
 import jax
@@ -19,10 +40,26 @@ import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from code2vec_tpu import faultinject
+
 logger = logging.getLogger(__name__)
 
 CHECKPOINT_DIR = "code2vec_ckpt"
 META_FILE = "train_meta.json"
+SHARDINGS_FILE = "shardings.json"
+# staging prefix for in-progress saves; never matches a slot prefix, so
+# `_latest_step_dir` cannot select one even before the completeness check
+TMP_PREFIX = "tmp."
+# completeness markers: our own (written with the sidecars, just before
+# the atomic publish — so restore-ability never hinges on orbax
+# internals), plus the files orbax itself writes only once a checkpoint
+# is committed (local FS writes _CHECKPOINT_METADATA at finalize; GCS
+# uses commit_success.txt) — which keep checkpoints from older saves of
+# this framework restorable
+_OWN_COMMIT_MARKER = "c2v_commit"
+_COMMIT_MARKERS = (
+    _OWN_COMMIT_MARKER, "_CHECKPOINT_METADATA", "commit_success.txt"
+)
 
 
 @dataclass
@@ -50,6 +87,11 @@ class TrainMeta:
     # structurally different opt_state (train/table_opt.py), so a mismatch
     # is caught here with guidance, not an orbax structure error
     table_update: str | None = None
+    # mid-epoch data cursor (None = the save was an epoch boundary):
+    # {"epoch", "step", "np_rng_state", "partial_train_loss",
+    #  "bucket_positions"} — train/loop.py captures it at each mid-epoch
+    # save and replays the host batch stream up to "step" on resume
+    cursor: dict | None = None
 
 
 def _adam_mu_dtype_name(state) -> str | None:
@@ -99,7 +141,33 @@ def _state_pytree(state) -> dict:
     }
 
 
-def _latest_step_dir(base: str, prefix: str = "step") -> str | None:
+def _stamp_meta(meta: TrainMeta, state) -> None:
+    """Record the state-derived compatibility fields on ``meta`` (shared by
+    the sync save and the async snapshot)."""
+    meta.rng_impl = _rng_impl_name(state.dropout_rng)
+    meta.adam_mu_dtype = _adam_mu_dtype_name(state) or meta.adam_mu_dtype
+    meta.table_update = _table_update_name(state)
+
+
+def _is_complete_checkpoint(path: str) -> bool:
+    """Whether ``path`` is a committed checkpoint dir: orbax's commit
+    marker must be present. A dir truncated by a crash mid-save (or a
+    leftover orbax-internal tmp dir) fails this and is skipped by restore
+    instead of selected and died on."""
+    if not os.path.isdir(path):
+        return False
+    name = os.path.basename(path)
+    if name.startswith(TMP_PREFIX) or ".orbax-checkpoint-tmp" in name:
+        return False
+    return any(
+        os.path.exists(os.path.join(path, marker))
+        for marker in _COMMIT_MARKERS
+    )
+
+
+def _latest_step_dir(
+    base: str, prefix: str = "step", complete_only: bool = True
+) -> str | None:
     if not os.path.isdir(base):
         return None
     steps = sorted(
@@ -107,7 +175,198 @@ def _latest_step_dir(base: str, prefix: str = "step") -> str | None:
         for name in os.listdir(base)
         if name.startswith(prefix + "_") and name.rsplit("_", 1)[1].isdigit()
     )
-    return os.path.join(base, steps[-1][1]) if steps else None
+    for _, name in reversed(steps):
+        path = os.path.join(base, name)
+        if not complete_only or _is_complete_checkpoint(path):
+            return path
+        logger.warning(
+            "skipping incomplete checkpoint %s (missing commit marker — "
+            "interrupted save?)", path,
+        )
+    return None
+
+
+def _slot_prefix(slot: str) -> str:
+    """Dir-name prefix for a checkpoint slot (`step_N` / `last_N`)."""
+    assert slot in ("best", "last"), slot
+    return "step" if slot == "best" else "last"
+
+
+def _slot_path(out_dir: str, slot: str, step: int) -> str:
+    """The published dir for one save — the single source of the naming
+    scheme (save, the async writer's return value, and pruning all
+    derive from it)."""
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    return os.path.join(base, f"{_slot_prefix(slot)}_{step}")
+
+
+def sweep_staging_dirs(out_dir: str) -> None:
+    """Remove orphaned ``tmp.`` staging dirs (full-size leftovers of saves
+    killed mid-persist) and crash-truncated published slot dirs (missing
+    the commit marker — e.g. left by a pre-atomic-save version). Restore
+    merely *skips* both, so without this sweep every such incident would
+    leak a checkpoint-sized dir that also warns on every later restore.
+    `_save_tree` clears a stale staging dir only when a later save lands
+    on the same step — which a signal-timed preemption save never
+    revisits — so resumed runs sweep here (CheckpointWriter init; fresh
+    runs additionally sweep via `clear_checkpoints`)."""
+    if jax.process_index() != 0:
+        return
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    if not os.path.isdir(base):
+        return
+    for name in os.listdir(base):
+        path = os.path.join(base, name)
+        stem, sep, suffix = name.rpartition("_")
+        truncated = (
+            sep
+            and stem in ("step", "last")
+            and suffix.isdigit()
+            and os.path.isdir(path)
+            and not _is_complete_checkpoint(path)
+        )
+        if name.startswith(TMP_PREFIX) or truncated:
+            logger.info(
+                "sweeping %s checkpoint dir %s",
+                "stale staging" if name.startswith(TMP_PREFIX)
+                else "crash-truncated", name,
+            )
+            shutil.rmtree(path, ignore_errors=True)
+
+
+# checkpoint dirs THIS process published or restored from. The same-step
+# sidecar-only re-save below is valid only against these: within one
+# process, arrays at one optimizer step are identical by construction
+# (params/opt-state/rng change only through optimizer steps), but a
+# complete dir left by a PREVIOUS run at a colliding step (a re-import
+# into the same model_path, a fresh run re-reaching the same best step)
+# holds different arrays and must be fully overwritten.
+_SAME_RUN_PATHS: set[str] = set()
+
+
+def _atomic_json(path: str, doc: dict) -> None:
+    """Write ``doc`` to ``path`` atomically (tmp file + one os.replace)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def _write_top_level_meta(out_dir: str, meta_dict: dict) -> None:
+    """Atomic update of the legacy top-level ``train_meta.json`` — kept
+    for compatibility (older tools and humans read it); the per-slot
+    sidecar inside the checkpoint dir is authoritative."""
+    _atomic_json(os.path.join(out_dir, META_FILE), meta_dict)
+
+
+def _update_sidecars(
+    out_dir: str, path: str, meta_dict: dict, spec_doc: dict,
+    slot: str, step: int,
+) -> str:
+    """Refresh an already-published same-step checkpoint's sidecars (each
+    an atomic file replace — a crash at any point leaves the dir complete
+    with either the old or the new doc, both valid). Skips the orbax
+    array write entirely; fires the same barriers/fault points as a full
+    save so plans and multi-host pacing see one consistent sequence."""
+    faultinject.fault_point("mid_save", slot=slot, step=step)
+    if jax.process_index() == 0:
+        logger.info("same-step re-save: refreshing sidecars of %s", path)
+        _atomic_json(os.path.join(path, META_FILE), meta_dict)
+        _atomic_json(os.path.join(path, SHARDINGS_FILE), spec_doc)
+        _write_top_level_meta(out_dir, meta_dict)
+    _sync_processes("c2v_ckpt_publish")
+    faultinject.fault_point("post_save", slot=slot, step=step)
+    return path
+
+
+def _sync_processes(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _save_tree(
+    out_dir: str,
+    tree: dict,
+    meta_dict: dict,
+    spec_doc: dict,
+    step: int,
+    slot: str,
+) -> str:
+    """Write one checkpoint atomically: orbax save into a ``tmp.``-staged
+    dir, sidecars (per-slot meta + shardings doc) into the same dir, one
+    ``os.replace`` to publish, then pruning. ``tree`` may hold device
+    arrays (sync save — orbax coordinates the multi-host write) or a host
+    snapshot (the async persist thread, single-process only)."""
+    prefix = _slot_prefix(slot)
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    os.makedirs(base, exist_ok=True)
+    previous = _latest_step_dir(base, prefix)
+    path = _slot_path(out_dir, slot, step)
+    same_run_resave = path in _SAME_RUN_PATHS and _is_complete_checkpoint(path)
+    if jax.process_count() > 1:
+        # the branch hinges on a filesystem check that cached-attribute
+        # network filesystems can answer differently per host, and hosts
+        # disagreeing here would enter different collective sequences
+        # (deadlock in the barriers) — so process 0's view decides
+        from jax.experimental import multihost_utils
+
+        same_run_resave = bool(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(1 if same_run_resave else 0, np.int32)
+            )
+        )
+    if same_run_resave:
+        # same-step re-save of THIS run's own arrays (e.g. a preempted
+        # resume re-persisting the state it just restored): only the
+        # sidecars can differ, so update them atomically IN PLACE — an
+        # rmtree+replace swap would open a window with NO published
+        # checkpoint, and a SIGKILL there destroys the only restorable
+        # save. Colliding dirs from OTHER runs (not in the set) take the
+        # full staged save and are overwritten, arrays included.
+        return _update_sidecars(out_dir, path, meta_dict, spec_doc, slot, step)
+    tmp = os.path.join(base, f"{TMP_PREFIX}{prefix}_{step}")
+    if jax.process_index() == 0 and os.path.exists(tmp):
+        shutil.rmtree(tmp)  # stale staging dir from an interrupted save
+    # all processes must observe the cleared staging dir before the
+    # collective orbax save targets it
+    _sync_processes("c2v_ckpt_stage")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(tmp, tree)
+    faultinject.fault_point("mid_save", slot=slot, step=step)
+    # orbax coordinates the multi-host array save; sidecars, the atomic
+    # publish, and pruning are process-0-only
+    if jax.process_index() == 0:
+        with open(os.path.join(tmp, META_FILE), "w") as f:
+            json.dump(meta_dict, f)
+        with open(os.path.join(tmp, SHARDINGS_FILE), "w") as f:
+            json.dump(spec_doc, f)
+        with open(os.path.join(tmp, _OWN_COMMIT_MARKER), "w"):
+            pass  # our completeness marker (see _COMMIT_MARKERS)
+        if os.path.exists(path):
+            # an INCOMPLETE dir (crash-truncated — restore skips it
+            # already, removing it destroys nothing restorable) or a
+            # complete dir from ANOTHER run (a deliberate overwrite);
+            # this run's own complete dirs took the sidecar path above
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+        _write_top_level_meta(out_dir, meta_dict)
+        if previous is not None and previous != path:
+            shutil.rmtree(previous, ignore_errors=True)
+        if slot == "best":
+            # a newer best supersedes any older periodic save: prune
+            # `last_N` with N <= this step so dead checkpoints don't
+            # accumulate (restore picks max-N, which is now this one)
+            stale = _latest_step_dir(base, "last")
+            if stale is not None and int(stale.rsplit("_", 1)[1]) <= step:
+                shutil.rmtree(stale, ignore_errors=True)
+    # other processes must not race ahead (e.g. into a restore or the next
+    # save's staging) before the publish is visible
+    _sync_processes("c2v_ckpt_publish")
+    _SAME_RUN_PATHS.add(path)
+    faultinject.fault_point("post_save", slot=slot, step=step)
+    return path
 
 
 def save_checkpoint(out_dir: str, state, meta: TrainMeta, slot: str = "best") -> str:
@@ -118,42 +377,172 @@ def save_checkpoint(out_dir: str, state, meta: TrainMeta, slot: str = "best") ->
     preemption-safety saves). Each slot prunes only its own older dirs, so
     a periodic save never deletes the best model.
 
-    Preemption-safe: each save goes to a fresh directory and older ones are
-    pruned only after the new one is fully written, so a crash mid-save
-    never leaves the run without a restorable checkpoint.
+    Preemption-safe twice over: the arrays and sidecars are staged under a
+    ``tmp.`` prefix and published with one atomic ``os.replace``, and older
+    saves are pruned only after the publish — a crash at ANY point leaves
+    either the previous complete checkpoint or both.
     """
-    assert slot in ("best", "last"), slot
-    prefix = "step" if slot == "best" else "last"
-    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
-    os.makedirs(base, exist_ok=True)
-    previous = _latest_step_dir(base, prefix)
-    meta.rng_impl = _rng_impl_name(state.dropout_rng)
-    meta.adam_mu_dtype = _adam_mu_dtype_name(state) or meta.adam_mu_dtype
-    meta.table_update = _table_update_name(state)
-    path = os.path.join(base, f"{prefix}_{int(state.step)}")
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, _state_pytree(state))
-    # orbax coordinates the multi-host array save; the sidecar metadata and
-    # pruning are process-0-only
-    if jax.process_index() == 0:
-        meta_tmp = os.path.join(out_dir, META_FILE + ".tmp")
-        with open(meta_tmp, "w") as f:
-            json.dump(asdict(meta), f)
-        os.replace(meta_tmp, os.path.join(out_dir, META_FILE))
-        if previous is not None and previous != path:
-            shutil.rmtree(previous, ignore_errors=True)
-        if slot == "best":
-            # a newer best supersedes any older periodic save: prune
-            # `last_N` with N <= this step so dead checkpoints don't
-            # accumulate (restore picks max-N, which is now this one)
-            stale = _latest_step_dir(base, "last")
-            if stale is not None and int(stale.rsplit("_", 1)[1]) <= int(
-                state.step
+    from code2vec_tpu.parallel.shardings import pytree_spec_doc
+
+    faultinject.fault_point("pre_save", slot=slot)
+    _stamp_meta(meta, state)
+    tree = _state_pytree(state)
+    return _save_tree(
+        out_dir, tree, asdict(meta), pytree_spec_doc(tree),
+        int(state.step), slot,
+    )
+
+
+def snapshot_state(state, meta: TrainMeta) -> tuple[dict, dict, dict, int]:
+    """Device-to-host snapshot for an async save: the only phase the train
+    loop blocks on. Returns ``(host_tree, meta_dict, spec_doc, step)`` —
+    all host-side and immutable w.r.t. further training steps, so the
+    persist thread races nothing. Requires every leaf to be process-
+    addressable (single-process; multi-process saves stay synchronous)."""
+    from code2vec_tpu.parallel.shardings import pytree_spec_doc
+
+    _stamp_meta(meta, state)
+    tree = _state_pytree(state)
+    spec_doc = pytree_spec_doc(tree)
+    # device_get blocks until in-flight steps producing `state` finish —
+    # this IS the snapshot cost the loop pays; the disk write is not
+    host_tree = jax.device_get(tree)
+    return host_tree, asdict(meta), spec_doc, int(state.step)
+
+
+class CheckpointWriter:
+    """The train loop's save orchestrator: sync or async, one interface.
+
+    Async mode (``--async_checkpoint``): :meth:`save` snapshots device
+    state to host (``checkpoint_save.snapshot`` span), hands the snapshot
+    to a background persist thread (``checkpoint_save.persist`` span,
+    emitted on that thread's trace track), and returns — the next train
+    step overlaps the disk write. **At most one save is in flight**: a new
+    save first waits out the previous persist, so checkpoints can never
+    interleave and the loop self-throttles if persistence is slower than
+    the save cadence. A persist failure is stored and re-raised into the
+    loop at the next :meth:`save`/:meth:`finish` — checkpoint corruption
+    must fail the run, not a daemon thread.
+
+    Multi-process runs force sync mode: the orbax array save is collective
+    and a host snapshot would need every leaf process-addressable.
+
+    Sync mode runs the same phases inline (the snapshot span then measures
+    zero — sync saves hand device arrays straight to orbax).
+    """
+
+    def __init__(
+        self,
+        out_dir: str,
+        async_save: bool = False,
+        events=None,
+        tracer=None,
+    ):
+        from code2vec_tpu.obs.trace import get_tracer
+
+        self.out_dir = out_dir
+        self.events = events
+        self.tracer = tracer or get_tracer()
+        if async_save and jax.process_count() > 1:
+            logger.warning(
+                "--async_checkpoint is single-process only (the orbax "
+                "array save is collective on pods); using synchronous saves"
+            )
+            async_save = False
+        self.async_save = async_save
+        self._thread: threading.Thread | None = None
+        self._failure: BaseException | None = None
+        self._lock = threading.Lock()
+        sweep_staging_dirs(out_dir)
+
+    # ---- failure propagation -------------------------------------------
+    def check(self) -> None:
+        """Re-raise a stored persist failure into the caller."""
+        with self._lock:
+            failure, self._failure = self._failure, None
+        if failure is not None:
+            raise failure
+
+    def wait(self) -> None:
+        """Block until no save is in flight (does NOT check for failure)."""
+        thread = self._thread
+        if thread is not None:
+            thread.join()
+            self._thread = None
+
+    def finish(self) -> None:
+        """Drain the in-flight save and surface any failure — the loop's
+        normal-completion barrier."""
+        self.wait()
+        self.check()
+
+    def close(self) -> None:
+        """finally-block variant: drain, log (don't raise) failures, so an
+        exception already unwinding is never masked."""
+        self.wait()
+        with self._lock:
+            failure, self._failure = self._failure, None
+        if failure is not None:
+            logger.error("async checkpoint persist failed", exc_info=failure)
+
+    # ---- saving ---------------------------------------------------------
+    def save(self, state, meta: TrainMeta, slot: str, **event_fields) -> str:
+        """Save ``state``/``meta`` into ``slot``; returns the final path
+        (for async saves: the path the in-flight persist will publish)."""
+        # at-most-one in flight + propagate the previous save's failure
+        self.wait()
+        self.check()
+        if not self.async_save:
+            with self.tracer.span(
+                "checkpoint_save.persist", category="checkpoint",
+                slot=slot, mode="sync", **event_fields,
             ):
-                shutil.rmtree(stale, ignore_errors=True)
-    return path
+                path = save_checkpoint(self.out_dir, state, meta, slot=slot)
+            self._emit(slot, path, int(state.step), False, event_fields)
+            return path
+
+        faultinject.fault_point("pre_save", slot=slot)
+        with self.tracer.span(
+            "checkpoint_save.snapshot", category="checkpoint",
+            slot=slot, **event_fields,
+        ):
+            host_tree, meta_dict, spec_doc, step = snapshot_state(state, meta)
+        path = _slot_path(self.out_dir, slot, step)
+        self._thread = threading.Thread(
+            target=self._persist,
+            args=(host_tree, meta_dict, spec_doc, step, slot, event_fields),
+            name="c2v-ckpt-persist",
+            daemon=True,
+        )
+        self._thread.start()
+        return path
+
+    def _persist(
+        self, host_tree, meta_dict, spec_doc, step, slot, event_fields
+    ) -> None:
+        try:
+            with self.tracer.span(
+                "checkpoint_save.persist", category="checkpoint",
+                slot=slot, mode="async", **event_fields,
+            ):
+                path = _save_tree(
+                    self.out_dir, host_tree, meta_dict, spec_doc, step, slot
+                )
+            self._emit(slot, path, step, True, event_fields)
+        except BaseException as exc:  # noqa: BLE001 - re-raised in the loop
+            with self._lock:
+                self._failure = exc
+
+    def _emit(self, slot, path, step, was_async, event_fields) -> None:
+        if self.events is not None:
+            self.events.emit(
+                "checkpoint_saved",
+                slot=slot,
+                path=path,
+                step=step,
+                **{"async": was_async},
+                **event_fields,
+            )
 
 
 def clear_checkpoints(out_dir: str, slot: str = "last") -> None:
@@ -164,21 +553,60 @@ def clear_checkpoints(out_dir: str, slot: str = "last") -> None:
     could outrank the new run's ``best`` saves at a later ``--resume``. The
     ``best`` slot and metadata are preserved until the new run's first save
     overwrites them, so a crash before that never leaves the directory
-    without a restorable checkpoint.
+    without a restorable checkpoint. Staging (``tmp.``) leftovers from
+    crashed saves are always swept.
 
     Process-0-only under multi-host; other processes race benignly since
     they never read before the barrier implied by the first save.
     """
+    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    # a fresh run severs the same-run relationship with everything under
+    # this model_path: surviving dirs (the preserved best slot) belong to
+    # the PREVIOUS run and must never take the sidecar-only re-save path
+    _SAME_RUN_PATHS.difference_update(
+        {p for p in _SAME_RUN_PATHS if p.startswith(base + os.sep) or p == base}
+    )
     if jax.process_index() != 0:
         return
-    prefix = "step" if slot == "best" else "last"
-    base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
+    prefix = _slot_prefix(slot)
     if not os.path.isdir(base):
         return
     for name in os.listdir(base):
-        if name.startswith(prefix + "_"):
+        if name.startswith(prefix + "_") or name.startswith(TMP_PREFIX):
             logger.info("fresh run: clearing stale checkpoint %s", name)
             shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+
+
+def _slot_meta(path: str, out_dir: str) -> TrainMeta:
+    """The meta matching the checkpoint at ``path``: its per-slot sidecar
+    when present (always, for saves from this version on), else the legacy
+    single top-level file — which belonged to the newest save of either
+    slot, the documented quirk the sidecar exists to fix."""
+    sidecar = os.path.join(path, META_FILE)
+    meta_path = sidecar if os.path.exists(sidecar) else os.path.join(
+        out_dir, META_FILE
+    )
+    with open(meta_path) as f:
+        return TrainMeta(**json.load(f))
+
+
+@dataclass
+class RestoredCheckpoint:
+    """Restore result: unpacks like the historical ``(state, meta)`` tuple
+    but also carries provenance for the ``checkpoint_restored`` event."""
+
+    state: object
+    meta: TrainMeta
+    slot: str
+    path: str
+    resharded: bool
+    saved_mesh_shape: dict | None
+
+    def __iter__(self):
+        return iter((self.state, self.meta))
+
+    def __getitem__(self, index):
+        return (self.state, self.meta)[index]
 
 
 def restore_checkpoint(
@@ -186,32 +614,42 @@ def restore_checkpoint(
     state,
     vocab_pad_multiple: int | None = None,
     prefer_best: bool = False,
-) -> tuple[object, TrainMeta] | None:
+    mesh=None,
+) -> RestoredCheckpoint | None:
     """Restore into the shape of ``state``; returns None if no checkpoint.
 
-    Default (``--resume``): the newest save across both slots (the ``last``
-    periodic save when it is fresher than the ``best`` one); ``step``
-    counts optimizer steps monotonically, so the larger suffix is the
-    later save. ``prefer_best`` (the export path): the best-F1 ``step``
+    Default (``--resume``): the newest *complete* save across both slots
+    (the ``last`` periodic save when it is fresher than the ``best`` one);
+    ``step`` counts optimizer steps monotonically, so the larger suffix is
+    the later save. ``prefer_best`` (the export path): the best-F1 ``step``
     slot when present — a fresher periodic save is NOT the model the
-    in-training export would have written. Note the meta sidecar is a
-    single file owned by the newest save regardless of slot; with
-    ``prefer_best`` only the restored arrays are slot-specific.
+    in-training export would have written. Metadata comes from the chosen
+    dir's own sidecar, so the bookkeeping always matches the restored
+    arrays.
+
+    ``mesh``: the run's current mesh (or None). When the checkpoint carries
+    a ``shardings.json`` sidecar, its PartitionSpecs are validated against
+    this mesh (analysis.sharding_check.validate_runtime_spec) and re-bound
+    to it (parallel.shardings.rebind_abstract_shardings) — orbax then loads
+    every shard directly onto its new home device. ``resharded`` reports
+    whether the save-time mesh shape differs from the current one.
     """
     base = os.path.abspath(os.path.join(out_dir, CHECKPOINT_DIR))
-    meta_path = os.path.join(out_dir, META_FILE)
     best_path = _latest_step_dir(base, "step")
     candidates = [
         p for p in (best_path, _latest_step_dir(base, "last")) if p is not None
     ]
-    if not candidates or not os.path.exists(meta_path):
+    if not candidates:
         return None
     if prefer_best and best_path is not None:
         path = best_path
     else:
         path = max(candidates, key=lambda p: int(p.rsplit("_", 1)[1]))
-    with open(meta_path) as f:
-        saved_meta = TrainMeta(**json.load(f))
+    if not os.path.exists(os.path.join(path, META_FILE)) and not os.path.exists(
+        os.path.join(out_dir, META_FILE)
+    ):
+        return None
+    saved_meta = _slot_meta(path, out_dir)
     want_impl = _rng_impl_name(state.dropout_rng)
     # checkpoints from before rng_impl was recorded hold raw threefry keys
     saved_impl = saved_meta.rng_impl or "threefry2x32"
@@ -256,8 +694,78 @@ def restore_checkpoint(
         )
     template = _state_pytree(state)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    saved_mesh_shape: dict | None = None
+    resharded = False
+    spec_path = os.path.join(path, SHARDINGS_FILE)
+    if os.path.exists(spec_path):
+        with open(spec_path) as f:
+            spec_doc = json.load(f)
+        saved_mesh_shape = spec_doc.get("mesh_shape")
+        if mesh is not None:
+            from code2vec_tpu.analysis.sharding_check import (
+                validate_runtime_spec,
+            )
+            from code2vec_tpu.parallel.shardings import (
+                rebind_abstract_shardings,
+            )
+
+            problems: list[str] = []
+            for key, entries in (spec_doc.get("specs") or {}).items():
+                if entries:
+                    problems.extend(
+                        validate_runtime_spec(
+                            entries, mesh.axis_names, context=key
+                        )
+                    )
+            if problems:
+                raise ValueError(
+                    f"checkpoint in {path} carries PartitionSpecs that do "
+                    "not fit the restore mesh:\n  "
+                    + "\n  ".join(problems)
+                )
+            abstract = rebind_abstract_shardings(mesh, abstract, spec_doc)
+            resharded = saved_mesh_shape != dict(mesh.shape)
+        else:
+            resharded = saved_mesh_shape is not None
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, abstract)
+    if mesh is None:
+        # drop orbax's COMMITTED placement on the single-device path: jit
+        # keys on committed-ness, so a committed restored state would
+        # re-specialize every step fn on the first post-resume step (one
+        # full XLA compile per resume, and shape-churn noise against the
+        # bucketed recompile budget). The host round-trip is a one-time
+        # restore cost, far cheaper than the compile it avoids. Mesh runs
+        # need no fix: shard_state's device_put makes the live state just
+        # as committed as the restored one. np.array(copy) then jnp.array
+        # (copy=True): BOTH hops must copy — on CPU np.asarray/jnp.asarray
+        # are zero-copy views of the XLA buffer, and donating a
+        # buffer-sharing state into the step fn frees memory the orbax
+        # array still owns (heap corruption).
+        # every leaf is a plain-dtype array here — _state_pytree saves
+        # dropout_rng as raw key_data, and the template comes from the
+        # same function
+        restored = jax.tree.map(
+            lambda leaf: jnp.array(np.array(leaf), copy=True), restored
+        )
+    else:
+        # fresh XLA-owned buffers, same shardings: orbax's CPU restore can
+        # hand back shards that alias one host allocation — the step fn
+        # donates the state, and donating aliased buffers frees that
+        # allocation piecewise (heap corruption). Copy INSIDE jit (no
+        # donation, so outputs are newly allocated buffers): an eager
+        # per-leaf copy would reject pod restores, whose global arrays are
+        # not fully addressable by one process. Noise next to restore I/O.
+        restored = jax.jit(
+            lambda tree: jax.tree.map(jnp.copy, tree)
+        )(restored)
+    if resharded:
+        logger.info(
+            "restored checkpoint saved on mesh %s onto %s (PartitionSpecs "
+            "re-bound; arrays resharded at load)",
+            saved_mesh_shape,
+            dict(mesh.shape) if mesh is not None else "a single device",
+        )
     dropout_rng = restored["dropout_rng"]
     if jax.dtypes.issubdtype(state.dropout_rng.dtype, jax.dtypes.prng_key):
         # re-wrap with the template's impl: key-data shape differs between
@@ -274,4 +782,15 @@ def restore_checkpoint(
         # create_train_state) and overflow the bucketed recompile budget
         step=jnp.asarray(int(restored["step"]), jnp.int32),
     )
-    return new_state, saved_meta
+    # a later same-step re-save of this state (preempted resume) may take
+    # the in-place sidecar path against this dir
+    _SAME_RUN_PATHS.add(path)
+    slot = "best" if os.path.basename(path).startswith("step_") else "last"
+    return RestoredCheckpoint(
+        state=new_state,
+        meta=saved_meta,
+        slot=slot,
+        path=path,
+        resharded=resharded,
+        saved_mesh_shape=saved_mesh_shape,
+    )

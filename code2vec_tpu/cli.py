@@ -225,6 +225,22 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--checkpoint_cycle", type=int, default=0,
                         help="also checkpoint every N epochs (0 = best-F1 "
                              "only) — preemption safety for pod runs")
+    parser.add_argument("--async_checkpoint", action="store_true",
+                        default=False,
+                        help="async checkpointing: the loop blocks only for "
+                             "the device-to-host snapshot; the disk write "
+                             "overlaps the next steps on a background thread "
+                             "(single-process; pods fall back to sync saves)")
+    parser.add_argument("--checkpoint_every_steps", type=int, default=0,
+                        help="also save the last slot every N train steps "
+                             "with a mid-epoch data cursor so --resume "
+                             "restarts inside the epoch (0 = epoch-boundary "
+                             "saves only)")
+    parser.add_argument("--fault_plan", type=str, default="",
+                        help="deterministic fault injection for recovery "
+                             "drills (code2vec_tpu/faultinject.py), e.g. "
+                             "'train_step@10:sigterm,mid_save@1:raise' — "
+                             "crashes the process ON PURPOSE")
     parser.add_argument("--resume", action="store_true", default=False,
                         help="resume from the checkpoint in --model_path")
     parser.add_argument("--profile_dir", type=str, default=None,
@@ -285,6 +301,9 @@ def config_from_args(args: argparse.Namespace):
         vocab_pad_multiple=args.vocab_pad_multiple,
         resume=args.resume,
         checkpoint_cycle=args.checkpoint_cycle,
+        async_checkpoint=args.async_checkpoint,
+        checkpoint_every_steps=args.checkpoint_every_steps,
+        fault_plan=args.fault_plan,
         device_epoch=args.device_epoch,
         shard_staged_corpus=args.shard_staged_corpus,
         bucketed=args.bucketed,

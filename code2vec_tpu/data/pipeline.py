@@ -604,6 +604,53 @@ def _concat_epochs(parts: list[EpochArrays]) -> EpochArrays:
     )
 
 
+def skip_batches(
+    batches: Iterator[dict[str, np.ndarray]],
+    n: int,
+    expect_widths: dict[int, int] | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Consume the first ``n`` batches of an epoch stream — the mid-epoch
+    resume replay (train/loop.py).
+
+    Every epoch iterator here is a pure function of the epoch arrays and
+    the RNG state it was created under, so re-creating it from the
+    checkpointed cursor and discarding the first ``n`` batches puts the
+    stream *bitwise* where the interrupted run left it — including the
+    bucketed path, whose whole batch plan (bucket membership, interleave)
+    is drawn up front from the same RNG. Skipping costs host batch builds
+    only; no device work is dispatched for skipped batches.
+
+    ``expect_widths``: the cursor's recorded per-bucket positions; a
+    mismatch means the run's ladder/batching config changed since the save
+    and the cursor cannot be honored, so fail with guidance instead of
+    silently training on the wrong examples.
+    """
+    it = iter(batches)
+    seen: dict[int, int] = {}
+    for i in range(n):
+        try:
+            batch = next(it)
+        except StopIteration:
+            raise ValueError(
+                f"mid-epoch cursor points past the epoch: batch {i} of "
+                f"{n} does not exist — the corpus or batching config "
+                "changed since the checkpoint was saved; restart without "
+                "--resume (or restore the original config)"
+            ) from None
+        width = int(batch["paths"].shape[1])
+        seen[width] = seen.get(width, 0) + 1
+    if expect_widths is not None and seen != {
+        int(w): c for w, c in expect_widths.items()
+    }:
+        raise ValueError(
+            f"mid-epoch cursor bucket positions {expect_widths} do not "
+            f"match the replayed stream {seen}; the bucket ladder or batch "
+            "size changed since the checkpoint was saved — resume with the "
+            "original settings or restart without --resume"
+        )
+    return it
+
+
 def empty_batch(batch_size: int, max_contexts: int) -> dict[str, np.ndarray]:
     """A fully-masked all-PAD batch (the no-op collective step)."""
     bag = (batch_size, max_contexts)

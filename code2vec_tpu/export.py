@@ -201,10 +201,12 @@ def export_from_checkpoint(
 
     # the best-F1 slot, NOT the newest save: with --checkpoint_cycle a
     # fresher periodic "last" snapshot may exist, but the export contract
-    # is the model the in-training export would have written
+    # is the model the in-training export would have written. mesh-aware:
+    # the export pass may run on a different topology than training — the
+    # checkpointed PartitionSpecs re-bind to this mesh
     restored = restore_checkpoint(
         out_dir, state, vocab_pad_multiple=model_config.vocab_pad_multiple,
-        prefer_best=True,
+        prefer_best=True, mesh=mesh,
     )
     if restored is None:
         raise FileNotFoundError(f"no checkpoint found under {out_dir}")

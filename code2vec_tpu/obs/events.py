@@ -14,7 +14,10 @@ file order IS the emission order.
 Event types written by the train loop (``train/loop.py``): ``manifest``,
 ``epoch`` (the full per-epoch metrics dict + a memory snapshot),
 ``best_f1``, ``step_sample`` (per profiled step: host-build / H2D /
-compute ms), ``eval``, ``checkpoint_saved``, ``recompile``
+compute ms), ``eval``, ``checkpoint_saved`` (slot/path/step + whether the
+persist ran async), ``checkpoint_restored`` (slot/path/step, the save- and
+restore-time mesh shapes, and whether the arrays were resharded onto a new
+topology), ``preempted`` (clean SIGTERM exit), ``recompile``
 (obs.runtime.RecompileDetector), ``error``.
 
 **Sinks are consumers of this stream**: ``sink_consumer`` adapts the

@@ -132,3 +132,94 @@ def shard_state(mesh: Mesh, state):
         opt_state=jax.device_put(state.opt_state, sharding.opt_state),
         dropout_rng=jax.device_put(state.dropout_rng, sharding.dropout_rng),
     )
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec serialization — the mesh-reshape restore primitive
+#
+# A checkpoint that only stores arrays is bound to the topology it was saved
+# on; storing the *specs* alongside lets restore re-bind them to whatever
+# mesh the resumed run declares (checkpoint.py writes the doc as a
+# `shardings.json` sidecar, restore rebuilds NamedShardings from it). Specs
+# are mesh-shape-free — `P('model', None)` means the same thing on a 2- or
+# 4-way model axis — which is exactly why they, and not device layouts, are
+# the right thing to persist.
+# ---------------------------------------------------------------------------
+
+
+def _spec_entries(spec: P) -> list:
+    """JSON form of a PartitionSpec: one entry per dim — None, an axis
+    name, or a list of axis names (a dim sharded over several axes)."""
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def _entries_spec(entries: list) -> P:
+    return P(*(tuple(e) if isinstance(e, list) else e for e in entries))
+
+
+def pytree_spec_doc(tree: Any) -> dict:
+    """Serializable sharding doc for a (possibly host-side) pytree.
+
+    ``{"mesh_shape": {axis: size} | None, "specs": {keypath: entries|null}}``
+    — mesh_shape comes from the first NamedSharding-carrying leaf (one mesh
+    per state by construction); leaves without a NamedSharding (host numpy,
+    single-device arrays) record null and restore with the template's
+    placement.
+    """
+    specs: dict[str, list | None] = {}
+    mesh_shape: dict[str, int] | None = None
+
+    def record(path, leaf):
+        nonlocal mesh_shape
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            if mesh_shape is None:
+                mesh_shape = dict(sharding.mesh.shape)
+            specs[jax.tree_util.keystr(path)] = _spec_entries(sharding.spec)
+        else:
+            specs[jax.tree_util.keystr(path)] = None
+        return leaf
+
+    jax.tree_util.tree_map_with_path(record, tree)
+    return {"mesh_shape": mesh_shape, "specs": specs}
+
+
+def rebind_abstract_shardings(mesh: Mesh, abstract_tree: Any, doc: dict) -> Any:
+    """Re-bind a saved sharding doc onto ``mesh``: the restore target tree.
+
+    For each leaf of ``abstract_tree`` (ShapeDtypeStructs from the restore
+    template) with a recorded spec, returns a ShapeDtypeStruct whose
+    sharding is ``NamedSharding(mesh, spec)`` — the checkpointed layout
+    re-expressed on the *new* topology. Validation (axis names the new mesh
+    does not declare) is the caller's job via
+    ``analysis.sharding_check.validate_runtime_spec``; this function only
+    applies the divisibility rule: a dim whose size no longer divides the
+    (resized) axis falls back to replicated for that dim, mirroring
+    ``_spec_for_param``.
+    """
+    specs: dict[str, list | None] = doc.get("specs", {})
+
+    def rebind(path, leaf):
+        entries = specs.get(jax.tree_util.keystr(path))
+        if entries is None:
+            return leaf
+        shape = getattr(leaf, "shape", ())
+        fitted: list = []
+        for dim, entry in enumerate(entries):
+            axes = entry if isinstance(entry, list) else (
+                [] if entry is None else [entry]
+            )
+            span = 1
+            for axis in axes:
+                span *= mesh.shape[axis]
+            if axes and (dim >= len(shape) or shape[dim] % span):
+                fitted.append(None)  # indivisible on the new mesh: replicate
+            else:
+                fitted.append(entry)
+        return jax.ShapeDtypeStruct(
+            shape,
+            leaf.dtype,
+            sharding=NamedSharding(mesh, _entries_spec(fitted)),
+        )
+
+    return jax.tree_util.tree_map_with_path(rebind, abstract_tree)

@@ -56,6 +56,7 @@ def save_inference_meta(out_dir: str, config, model_config, data) -> None:
         "infer_method_name": config.infer_method_name,
         "infer_variable_name": config.infer_variable_name,
     }
+    os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, MODEL_META), "w", encoding="utf-8") as f:
         json.dump(meta, f, indent=1)
     from code2vec_tpu.formats.vocab_io import write_vocab

@@ -121,6 +121,20 @@ class TrainConfig:
     # also save every N epochs (0 = best-F1 only) — preemption safety for
     # pod runs; resume restores params/opt state/RNG/early-stop counters
     checkpoint_cycle: int = 0
+    # elastic training (checkpoint.py / train/preempt.py / faultinject.py):
+    # async checkpointing — the loop blocks only for the device-to-host
+    # snapshot; persistence runs on a background thread with at-most-one
+    # save in flight (single-process only; pods force sync saves)
+    async_checkpoint: bool = False
+    # ALSO save the `last` slot every N train steps, mid-epoch, with a data
+    # cursor (epoch, step-in-epoch, host RNG state, per-bucket positions)
+    # so --resume restarts INSIDE the epoch with bitwise-equal metrics
+    # (host pipeline only; 0 = epoch-boundary saves only)
+    checkpoint_every_steps: int = 0
+    # deterministic fault-injection plan (faultinject.py grammar, e.g.
+    # "train_step@10:sigterm,mid_save@1:raise"); empty = none. Tests and
+    # drills only — it crashes the process on purpose.
+    fault_plan: str = ""
 
     # device-resident epochs (train/device_epoch.py): stage the corpus in
     # HBM once and run whole scanned chunks of batches per dispatch, with

@@ -13,11 +13,22 @@ telemetry subsystem (``code2vec_tpu.obs``): every metric emission goes
 through one event stream (sinks are consumers of it), phases are traced
 as Chrome-trace spans, and a recompile detector + memory sampler watch
 runtime health at epoch boundaries.
+
+Elastic training (checkpoint.py + train/preempt.py + faultinject.py):
+saves go through a :class:`~code2vec_tpu.checkpoint.CheckpointWriter`
+(``--async_checkpoint`` overlaps the disk write with the next steps),
+``--checkpoint_every_steps`` adds mid-epoch cursor-bearing saves, SIGTERM
+finishes the in-flight step + saves + exits cleanly, and ``--resume``
+replays the host batch stream to the checkpointed cursor so a resumed run
+reproduces the uninterrupted run's metrics bitwise (see
+docs/ARCHITECTURE.md "Elastic training").
 """
 
 from __future__ import annotations
 
+import copy
 import logging
+import os
 import time
 from contextlib import nullcontext
 from dataclasses import dataclass, field
@@ -28,11 +39,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from code2vec_tpu import export as export_mod
+from code2vec_tpu import faultinject
 from code2vec_tpu.checkpoint import (
+    CheckpointWriter,
     TrainMeta,
     clear_checkpoints,
     restore_checkpoint,
-    save_checkpoint,
 )
 from code2vec_tpu.data.pipeline import (
     build_epoch,
@@ -46,6 +58,7 @@ from code2vec_tpu.data.pipeline import (
     pad_batch_stream,
     pad_stats,
     parse_bucket_ladder,
+    skip_batches,
     split_items,
 )
 from code2vec_tpu.data.reader import CorpusData
@@ -60,6 +73,13 @@ from code2vec_tpu.obs.runtime import (
 from code2vec_tpu.obs.trace import get_tracer, set_tracer
 from code2vec_tpu.sinks import MetricSink, logging_sink  # re-export: canonical home is sinks
 from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.preempt import (
+    PreemptionStop,
+    coordinated_stop,
+    install_sigterm_handler,
+    preemption_guard,
+    restore_sigterm_handler,
+)
 from code2vec_tpu.train.prefetch import StepProfiler, device_batches
 from code2vec_tpu.train.step import (
     create_train_state,
@@ -93,6 +113,160 @@ class TrainResult:
 class StopTraining(Exception):
     """Raised by a report_fn to end training early (the optuna-prune hook,
     reference: main.py:207-211)."""
+
+
+def _rng_state(np_rng: np.random.Generator) -> dict:
+    """JSON-serializable snapshot of the host RNG (PCG64 state is plain
+    ints; json round-trips them exactly)."""
+    return copy.deepcopy(np_rng.bit_generator.state)
+
+
+def _data_cursor(
+    epoch: int,
+    step: int,
+    feed_batch: int,
+    np_rng_state: dict,
+    jax_rng,
+    partial_train_loss: float = 0.0,
+    bucket_positions: dict | None = None,
+) -> dict:
+    """THE cursor schema — the single constructor for both mid-epoch
+    (:class:`_EpochCursorHook`) and epoch-boundary saves, so the resume
+    path always finds the same key set regardless of which save wrote
+    last. ``feed_batch`` pins the stream geometry: a replay under a
+    different batch size would keep the bag width (so the per-width check
+    alone cannot catch it) yet skip the wrong rows."""
+    return {
+        "epoch": int(epoch),
+        "step": int(step),
+        "feed_batch": int(feed_batch),
+        "np_rng_state": np_rng_state,
+        "jax_rng": [int(x) for x in np.asarray(jax_rng).ravel()],
+        "partial_train_loss": float(partial_train_loss),
+        "bucket_positions": dict(bucket_positions or {}),
+    }
+
+
+class _EpochCursorHook:
+    """Per-step bookkeeping behind mid-epoch saves and graceful preemption.
+
+    ``_train_pass`` calls :meth:`after_step` once per consumed batch. The
+    hook tracks the epoch-global step count and per-width batch positions
+    (cumulative across a resume — it starts from the replayed cursor), and
+    triggers a cursor-bearing ``last``-slot save every
+    ``checkpoint_every_steps`` steps and/or when the preemption guard is
+    set — in which case it raises :class:`PreemptionStop` AFTER the save,
+    so the loop unwinds with the checkpoint already on disk.
+
+    The cursor it writes makes the save resumable *inside* the epoch:
+    ``np_rng_state`` is the host RNG state at epoch start (everything the
+    epoch streams is a pure function of it), ``step`` is how many batches
+    were consumed, ``partial_train_loss`` is the float64 running loss with
+    the same accumulation order the uninterrupted epoch uses, and
+    ``bucket_positions`` are the per-width batch counts the replay
+    cross-checks (a ladder/batch-size change cannot be honored silently).
+
+    :meth:`after_pass` re-checks the guard once the stream ends: the
+    prefetch producer drains on SIGTERM, so a stream can end *early* —
+    without the re-check an incomplete epoch would masquerade as a
+    finished one and its metrics would go into the history.
+    """
+
+    def __init__(
+        self,
+        writer: CheckpointWriter | None,
+        meta: TrainMeta,
+        epoch: int,
+        epoch_rng_state: dict,
+        jax_rng,
+        guard,
+        feed_batch: int,
+        every_steps: int = 0,
+        skip: int = 0,
+        loss_offset: float = 0.0,
+        widths: dict[int, int] | None = None,
+        tracer=None,
+    ):
+        self.writer = writer
+        self.meta = meta
+        self.epoch = epoch
+        self.epoch_rng_state = epoch_rng_state
+        self.jax_rng = jax_rng
+        self.guard = guard
+        self.feed_batch = int(feed_batch)
+        self.every_steps = int(every_steps)
+        self.steps = int(skip)
+        self.loss_offset = float(loss_offset)
+        self.widths = {int(w): int(c) for w, c in (widths or {}).items()}
+        self.tracer = tracer or get_tracer()
+        # incremental left-fold state: the running float64 partial and how
+        # many entries of `losses` it covers
+        self._partial = float(loss_offset)
+        self._summed = 0
+
+    def _cursor(self, partial_loss: float) -> dict:
+        return _data_cursor(
+            self.epoch, self.steps, self.feed_batch, self.epoch_rng_state,
+            self.jax_rng, partial_loss, self.widths,
+        )
+
+    def _partial_loss(self, losses: list) -> float:
+        """Running float64 left-fold of the epoch's losses, STARTING from
+        the resumed offset — the identical sequence of binary additions
+        the uninterrupted epoch's total uses (chunked left folds associate
+        identically to one left fold), so the resumed total is
+        bitwise-equal. Incremental: each save fetches only the losses
+        since the previous one, not the whole epoch so far."""
+        new = losses[self._summed:]
+        self._partial = float(
+            sum(map(float, jax.device_get(new)), self._partial)
+        )
+        self._summed = len(losses)
+        return self._partial
+
+    def _save(self, state, losses) -> None:
+        partial = self._partial_loss(losses)
+        self.meta.epoch = self.epoch  # resume re-enters this epoch
+        self.meta.cursor = self._cursor(partial)
+        with self.tracer.span(
+            "checkpoint_save", category="checkpoint",
+            epoch=self.epoch, slot="last", mid_epoch=True,
+        ):
+            self.writer.save(
+                state, self.meta, "last", epoch=self.epoch, mid_epoch=True
+            )
+
+    def _should_stop(self, at_collective_point: bool) -> bool:
+        """Act on the guard — every step when single-process, but only at
+        deterministic collective points under multi-process: the flag
+        flips at signal-delivery time, which differs per process, and the
+        save it triggers is a collective orbax write (mismatched
+        participants deadlock in the commit barrier). `coordinated_stop`
+        agrees on process 0's view at points all processes reach at the
+        same step (periodic-save steps, stream end)."""
+        if self.guard is None:
+            return False
+        if jax.process_count() == 1:
+            return self.guard.requested()
+        return at_collective_point and coordinated_stop(self.guard)
+
+    def after_step(self, state, losses, width: int) -> None:
+        self.widths[width] = self.widths.get(width, 0) + 1
+        self.steps += 1
+        periodic = bool(
+            self.every_steps and self.steps % self.every_steps == 0
+        )
+        stop = self._should_stop(periodic)
+        if self.writer is not None and (stop or periodic):
+            self._save(state, losses)
+        if stop:
+            raise PreemptionStop(self.guard.reason or "requested")
+
+    def after_pass(self, state, losses) -> None:
+        if self._should_stop(True):
+            if self.writer is not None:
+                self._save(state, losses)
+            raise PreemptionStop(self.guard.reason or "requested")
 
 
 def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
@@ -203,6 +377,8 @@ def _train_pass(
     profiler: StepProfiler | None = None,
     tracer=None,
     epoch: int | None = None,
+    step_hook: _EpochCursorHook | None = None,
+    loss_offset: float = 0.0,
 ):
     """One epoch of train steps over the host pipeline; returns
     ``(state, train_loss)``.
@@ -216,15 +392,23 @@ def _train_pass(
     whole pass is one ``train_pass`` span; step 0 (the compile-bearing
     step) and the profiler-sampled steps get ``train_step`` spans — never
     every step, so a 16k-step epoch doesn't flood the trace.
+
+    ``step_hook`` (elastic training) is called after every step — it owns
+    mid-epoch checkpointing and may raise :class:`PreemptionStop`, which
+    unwinds through the stream context (producer joined, generator
+    closed). ``loss_offset`` seeds the loss accumulation on a mid-epoch
+    resume: the pass covers only the un-replayed tail of the epoch, and
+    the total is accumulated in the uninterrupted run's exact order.
     """
     tracer = tracer or get_tracer()
     losses: list = []  # device scalars; ONE host sync after the last step
     step = 0
     with tracer.span("train_pass", category="train", epoch=epoch):
         with device_batches(
-            batches, to_device, config.prefetch_batches, profiler
+            batches, to_device, config.prefetch_batches, profiler,
+            drain_on_preemption=step_hook is not None,
         ) as stream:
-            for _, device_batch in stream:
+            for host_batch, device_batch in stream:
                 sampled = profiler is not None and profiler.sampled(step)
                 span = (
                     tracer.span("train_step", category="train", step=step)
@@ -253,12 +437,27 @@ def _train_pass(
                     # wait on the loss from W steps AGO — host stays ≤W
                     # steps ahead of the device without ever idling it
                     jax.block_until_ready(losses[step - _LOSS_SYNC_WINDOW])
+                faultinject.fault_point("train_step", step=step, epoch=epoch)
+                if step_hook is not None:
+                    step_hook.after_step(
+                        state, losses, int(host_batch["paths"].shape[1])
+                    )
                 step += 1
+        if step_hook is not None:
+            # the stream may have ended EARLY (the prefetch producer drains
+            # on SIGTERM); re-check before this pass is treated as complete
+            step_hook.after_pass(state, losses)
     if profiler is not None:
-        profiler.observe_epoch_length(step)
-    # sequential float64 accumulation — bitwise-identical to the old
-    # per-step `train_loss += float(loss)` trajectory
-    train_loss = float(sum(map(float, jax.device_get(losses))))
+        # the hook's count is epoch-GLOBAL (it starts from the replayed
+        # cursor): a mid-epoch resume's tail-only `step` would otherwise
+        # shrink the sampling stride for every later full epoch
+        profiler.observe_epoch_length(
+            step if step_hook is None else step_hook.steps
+        )
+    # sequential float64 accumulation, seeded with the resumed partial sum
+    # — bitwise-identical to the old per-step `train_loss += float(loss)`
+    # trajectory of an uninterrupted epoch
+    train_loss = float(sum(map(float, jax.device_get(losses)), loss_offset))
     return state, train_loss
 
 
@@ -312,6 +511,27 @@ def train(
         tracer = get_tracer()
     health = RuntimeHealth()
     recompile_detector = RecompileDetector(events=events, health=health)
+
+    # elastic training: the fault plan (tests/drills), the SIGTERM guard
+    # (finish the in-flight step, save, exit 0 — train/preempt.py), and
+    # the save orchestrator. Each train() call (re)installs the plan from
+    # its own config/env with counters at zero — a plan never leaks from
+    # one run into the next
+    faultinject.install_plan(
+        config.fault_plan or os.environ.get(faultinject.ENV_VAR)
+    )
+    guard = preemption_guard()
+    guard.clear()
+    writer = (
+        CheckpointWriter(
+            out_dir,
+            async_save=config.async_checkpoint,
+            events=events,
+            tracer=tracer,
+        )
+        if out_dir is not None
+        else None
+    )
 
     # length-aware bucketed batching: resolve the static ladder of bag
     # widths once at startup — explicit --bucket_ladder, or a geometric
@@ -694,13 +914,68 @@ def train(
             )
 
     meta = TrainMeta()
+    resume_cursor: dict | None = None
     if config.resume and out_dir is not None:
+        # mesh-aware restore: the checkpoint's PartitionSpecs re-bind to
+        # THIS run's mesh, so a run killed on one topology resumes on
+        # another (checkpoint.py "mesh-reshape restore")
         restored = restore_checkpoint(
-            out_dir, state, vocab_pad_multiple=model_config.vocab_pad_multiple
+            out_dir, state, vocab_pad_multiple=model_config.vocab_pad_multiple,
+            mesh=mesh,
         )
         if restored is not None:
-            state, meta = restored
+            state, meta = restored.state, restored.meta
+            events.emit(
+                "checkpoint_restored",
+                slot=restored.slot,
+                path=restored.path,
+                step=int(jax.device_get(state.step)),
+                mesh_shape=dict(mesh.shape) if mesh is not None else None,
+                saved_mesh_shape=restored.saved_mesh_shape,
+                resharded=restored.resharded,
+            )
             logger.info("resumed from epoch %d (best_f1=%s)", meta.epoch, meta.best_f1)
+            resume_cursor, meta.cursor = meta.cursor, None
+            if resume_cursor is not None and sharded_feed:
+                # the cursor records ONE host RNG state (process 0's), but
+                # each feed group draws its own stream — honoring it would
+                # silently desynchronize the hosts' epochs
+                logger.warning(
+                    "ignoring the checkpoint's data cursor under host-"
+                    "sharded feeding; resuming at the epoch boundary"
+                )
+                resume_cursor = None
+            if resume_cursor is not None:
+                cursor_step = int(resume_cursor.get("step", 0))
+                if use_device_epoch and cursor_step > 0:
+                    raise ValueError(
+                        "the checkpoint carries a mid-epoch cursor (a host-"
+                        "pipeline save), which --device_epoch cannot replay; "
+                        "resume without --device_epoch, or restart from an "
+                        "epoch-boundary checkpoint"
+                    )
+                cursor_batch = int(
+                    resume_cursor.get("feed_batch", feed_batch)
+                )
+                if cursor_step > 0 and cursor_batch != feed_batch:
+                    raise ValueError(
+                        f"the mid-epoch cursor was saved at batch size "
+                        f"{cursor_batch} but this run feeds {feed_batch} "
+                        "rows per batch — the replay would skip the wrong "
+                        "examples; resume with the original batch size (the "
+                        "batching config changed since the checkpoint was "
+                        "saved), or restart without --resume"
+                    )
+                # the cursor's RNG state is the interrupted epoch's START
+                # state: everything that epoch streams (context subsample,
+                # batch order, bucket plan) is a pure function of it
+                np_rng.bit_generator.state = resume_cursor["np_rng_state"]
+                jax_rng = jnp.asarray(resume_cursor["jax_rng"], jnp.uint32)
+                if int(resume_cursor.get("step", 0)) > 0:
+                    logger.info(
+                        "mid-epoch resume: replaying epoch %d to batch %d",
+                        resume_cursor["epoch"], resume_cursor["step"],
+                    )
     elif out_dir is not None:
         # fresh run: clear any checkpoints from a previous run in the same
         # model_path (the reference likewise overwrites its model file,
@@ -729,6 +1004,21 @@ def train(
         else:
             profiler = StepProfiler(config.profile_steps)
 
+    if config.checkpoint_every_steps:
+        if sharded_feed:
+            raise ValueError(
+                "--checkpoint_every_steps does not compose with host-sharded "
+                "feeding: the mid-epoch cursor records one host RNG state, "
+                "but each feed group draws its own stream; use "
+                "--checkpoint_cycle (epoch-boundary saves) instead"
+            )
+        if use_device_epoch:
+            logger.warning(
+                "--checkpoint_every_steps is a host-pipeline feature; "
+                "device-epoch runs dispatch whole chunks and save at epoch "
+                "boundaries only"
+            )
+
     f1 = 0.0
     start_epoch = meta.epoch
     epoch = start_epoch
@@ -752,13 +1042,99 @@ def train(
     # are min(raw row count, bag) regardless of which contexts the per-epoch
     # subsample picked, so the O(N*L) scan need not repeat every epoch
     host_train_pad: tuple[int, int, int] | None = None
+    def _boundary_cursor(next_epoch: int) -> dict:
+        """Epoch-boundary cursor: step 0 plus the CURRENT RNG states — the
+        state the next epoch will start from — so even a boundary resume
+        continues the uninterrupted run's stream bitwise."""
+        return _data_cursor(
+            next_epoch, 0, feed_batch, _rng_state(np_rng), jax_rng
+        )
+
+    # installed HERE — immediately before the try whose finally restores
+    # it — so none of the setup/validation raises above can leave the
+    # handler (which only sets a flag nobody would poll) installed in a
+    # long-lived host process. A SIGTERM during setup takes the default
+    # disposition: terminate, leaving the previous checkpoint intact —
+    # the same state any setup crash leaves
+    previous_sigterm = install_sigterm_handler()
     try:
         for epoch in range(start_epoch, config.max_epoch):
+            faultinject.fault_point("epoch_start", epoch=epoch)
+            # epoch boundaries are deterministic collective points, so the
+            # check is process-coordinated (multi-process runs must not
+            # split into "saves and exits" vs "trains another epoch")
+            if coordinated_stop(guard):
+                # preempted between epochs (or in a mode without per-step
+                # hooks, e.g. device_epoch): checkpoint at the boundary
+                # and exit cleanly
+                if writer is not None and report_fn is None:
+                    meta.epoch = epoch
+                    # a resume cursor still pending here (SIGTERM landed
+                    # during restore/pipeline setup, before the first
+                    # resumed epoch consumed it) MUST be re-persisted:
+                    # `state` holds that cursor's mid-epoch arrays, and a
+                    # step-0 boundary cursor would make the next resume
+                    # replay the epoch's head on top of them
+                    meta.cursor = (
+                        resume_cursor
+                        if resume_cursor is not None
+                        else _boundary_cursor(epoch)
+                    )
+                    with tracer.span(
+                        "checkpoint_save", category="checkpoint",
+                        epoch=epoch, slot="last",
+                    ):
+                        writer.save(state, meta, "last", epoch=epoch)
+                raise PreemptionStop(guard.reason or "requested")
             if profile_dir is not None and epoch == start_epoch + 1:
                 jax.profiler.start_trace(profile_dir)
             epoch_start = time.perf_counter()
             if profiler is not None:
                 profiler.reset()
+
+            # mid-epoch resume bookkeeping: the host RNG state everything
+            # this epoch streams derives from (recorded in every mid-epoch
+            # cursor), plus the replayed cursor's offsets on the first
+            # resumed epoch
+            epoch_rng_state = _rng_state(np_rng)
+            skip = 0
+            loss_offset = 0.0
+            cursor_widths: dict | None = None
+            if resume_cursor is not None and epoch == start_epoch:
+                skip = int(resume_cursor.get("step", 0))
+                loss_offset = float(
+                    resume_cursor.get("partial_train_loss", 0.0)
+                )
+                cursor_widths = resume_cursor.get("bucket_positions") or None
+                resume_cursor = None
+
+            def _replay(batches, skip=skip, widths=cursor_widths):
+                """Replay the epoch stream to the cursor: the iterator is a
+                pure function of the epoch arrays + the RNG state restored
+                above, so discarding the first `skip` batches puts it
+                bitwise where the interrupted run stopped (host batch
+                builds only; no device work)."""
+                with tracer.span(
+                    "resume_replay", category="train", epoch=epoch, skip=skip,
+                ):
+                    return skip_batches(batches, skip, expect_widths=widths)
+
+            step_hook = None
+            if not use_device_epoch:
+                step_hook = _EpochCursorHook(
+                    writer=writer if report_fn is None else None,
+                    meta=meta,
+                    epoch=epoch,
+                    epoch_rng_state=epoch_rng_state,
+                    jax_rng=jax_rng,
+                    guard=guard,
+                    feed_batch=feed_batch,
+                    every_steps=config.checkpoint_every_steps,
+                    skip=skip,
+                    loss_offset=loss_offset,
+                    widths=cursor_widths,
+                    tracer=tracer,
+                )
 
             train_epoch = None  # host epoch arrays, built lazily in device mode
             test_epoch = None
@@ -834,9 +1210,12 @@ def train(
                     test_batches = pad_batch_stream(
                         test_batches, synced_steps(global_test), template
                     )
+                if skip:
+                    train_batches = _replay(train_batches)
                 state, train_loss = _train_pass(
                     config, state, train_step, train_batches, to_device,
                     profiler, tracer=tracer, epoch=epoch,
+                    step_hook=step_hook, loss_offset=loss_offset,
                 )
                 test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
                     config, data, state, eval_step, test_batches, to_device,
@@ -881,9 +1260,12 @@ def train(
                         synced_steps(global_train),
                         empty_batch(feed_batch, config.max_path_length),
                     )
+                if skip:
+                    train_batches = _replay(train_batches)
                 state, train_loss = _train_pass(
                     config, state, train_step, train_batches, to_device,
                     profiler, tracer=tracer, epoch=epoch,
+                    step_hook=step_hook, loss_offset=loss_offset,
                 )
 
                 test_epoch = build_epoch(
@@ -1069,19 +1451,15 @@ def train(
 
             if save_slot is not None:
                 meta.epoch = epoch + 1
+                meta.cursor = _boundary_cursor(epoch + 1)
+                # the writer runs the save (sync, or snapshot + background
+                # persist under --async_checkpoint) and emits the
+                # checkpoint_saved event with async provenance
                 with tracer.span(
                     "checkpoint_save", category="checkpoint",
                     epoch=epoch, slot=save_slot,
                 ):
-                    ckpt_path = save_checkpoint(
-                        out_dir, state, meta, slot=save_slot
-                    )
-                events.emit(
-                    "checkpoint_saved",
-                    epoch=epoch,
-                    slot=save_slot,
-                    path=ckpt_path,
-                )
+                    writer.save(state, meta, save_slot, epoch=epoch)
 
             if meta.bad_count > config.early_stop_patience:
                 logger.info(
@@ -1095,8 +1473,30 @@ def train(
                         config.batch_size, to_device,
                     )
                 break
+        # drain the in-flight async save before declaring the run done —
+        # a persist failure must fail the run, not vanish with the thread
+        if writer is not None:
+            writer.finish()
     except StopTraining:
-        pass
+        if writer is not None:
+            writer.finish()
+    except PreemptionStop as stop:
+        # the checkpoint (when there is an out_dir) is already on disk —
+        # drain it, report, and fall through to a NORMAL return: the
+        # graceful half of the SIGTERM contract is exit code 0
+        if writer is not None:
+            writer.finish()
+        events.emit("preempted", epoch=epoch, reason=str(stop))
+        # saves happen exactly when the hook/boundary had a writer (no
+        # out_dir, or an HPO trial, stops WITHOUT a checkpoint)
+        logger.warning(
+            "preemption (%s): %s; exiting cleanly after %d "
+            "completed epochs", stop,
+            "state saved"
+            if writer is not None and report_fn is None
+            else "NO checkpoint written (no --model_path / trial run)",
+            epochs_completed,
+        )
     except Exception as exc:
         try:
             events.emit(
@@ -1106,6 +1506,12 @@ def train(
             logger.warning("could not emit error event", exc_info=True)
         raise
     finally:
+        restore_sigterm_handler(previous_sigterm)
+        if writer is not None:
+            # exception-path drain: joins the persist thread and LOGS any
+            # stored failure (finish() above already raised on the normal
+            # paths; raising here would mask the unwinding exception)
+            writer.close()
         if restore_tracer:
             set_tracer(previous_tracer)
         events.unsubscribe(sinks_on_stream)

@@ -1,0 +1,139 @@
+"""Graceful-preemption coordination: the SIGTERM contract.
+
+TPU pods signal preemption with SIGTERM and grant a grace window before the
+SIGKILL. The default Python disposition tears the process down mid-step —
+losing every step since the last epoch-boundary save. This module turns
+SIGTERM into a cooperative flag:
+
+- :func:`install_sigterm_handler` (called by ``train()``) registers a
+  handler that sets a process-wide :class:`PreemptionGuard`; the previous
+  disposition is returned and restored by the loop's ``finally``.
+- The train loop polls :meth:`PreemptionGuard.requested` after every step:
+  it finishes the in-flight step, forces a cursor-bearing ``last``-slot
+  save (checkpoint.py), and returns cleanly — the CLI exits 0.
+- The prefetch producer thread (train/prefetch.py) polls the same guard and
+  drains cleanly — it stops building batches nobody will consume and ends
+  the stream instead of racing the consumer's shutdown.
+
+The guard is also the lever the fault-injection harness pulls: a
+``sigterm`` action (faultinject.py) delivers a real SIGTERM to the process,
+so tests exercise the identical code path production preemption takes.
+
+Signal handlers are a main-thread-only facility; when ``train()`` runs on
+another thread (HPO workers), installation degrades to a no-op and SIGTERM
+keeps its prior disposition — preemption safety then rests on periodic
+saves alone.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PreemptionGuard",
+    "PreemptionStop",
+    "coordinated_stop",
+    "install_sigterm_handler",
+    "preemption_guard",
+    "restore_sigterm_handler",
+]
+
+
+class PreemptionStop(Exception):
+    """Raised inside the train loop once the preemption save is on disk:
+    unwinds the epoch cleanly (prefetch producer joined, sinks closed) and
+    train() returns normally — the graceful half of the SIGTERM contract."""
+
+
+class PreemptionGuard:
+    """A sticky, thread-safe "preemption requested" flag."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.reason: str | None = None
+
+    def request(self, reason: str = "requested") -> None:
+        """Mark preemption requested (signal handler, or tests)."""
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        """Reset for a fresh run (train() entry)."""
+        self.reason = None
+        self._event.clear()
+
+
+_GUARD = PreemptionGuard()
+
+
+def preemption_guard() -> PreemptionGuard:
+    """The process-wide guard shared by the loop, the prefetch producer,
+    and the signal handler."""
+    return _GUARD
+
+
+def coordinated_stop(guard: PreemptionGuard) -> bool:
+    """Whether to act on the guard — process-collectively.
+
+    Single-process: the local flag. Multi-process: the flag flips at
+    *signal-delivery* time, which differs per process by whole steps, but
+    the save it triggers is a collective orbax write — uncoordinated
+    participants deadlock in the commit barrier. So processes agree on
+    process 0's view via one tiny ``broadcast_one_to_all`` (a pod preempts
+    every process, so process 0's flag is the group's). Call ONLY at
+    deterministic points every process reaches at the same step (a
+    periodic-save step, stream end, an epoch boundary) — the broadcast is
+    itself a collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return guard.requested()
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    return bool(
+        multihost_utils.broadcast_one_to_all(
+            np.asarray(1 if guard.requested() else 0, np.int32)
+        )
+    )
+
+
+def install_sigterm_handler():
+    """Route SIGTERM into the guard; returns the previous handler (pass it
+    to :func:`restore_sigterm_handler`), or None when installation is not
+    possible (non-main thread)."""
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 - signal signature
+        logger.warning(
+            "SIGTERM received: finishing the in-flight step, then saving "
+            "and exiting cleanly"
+        )
+        _GUARD.request("SIGTERM")
+
+    try:
+        return signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # signals are main-thread-only
+        logger.debug(
+            "not installing SIGTERM handler (train() is off the main "
+            "thread); preemption safety rests on periodic saves"
+        )
+        return None
+
+
+def restore_sigterm_handler(previous) -> None:
+    """Undo :func:`install_sigterm_handler` (no-op for a None previous)."""
+    if previous is None:
+        return
+    try:
+        signal.signal(signal.SIGTERM, previous)
+    except ValueError:
+        pass

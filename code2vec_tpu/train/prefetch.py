@@ -43,7 +43,9 @@ from typing import Callable, Iterable, Iterator
 
 import jax
 
+from code2vec_tpu import faultinject
 from code2vec_tpu.obs.trace import get_tracer
+from code2vec_tpu.train.preempt import preemption_guard
 
 __all__ = ["HostPrefetcher", "StepProfiler", "device_batches"]
 
@@ -183,12 +185,19 @@ class HostPrefetcher:
         to_device: Callable[[dict], dict],
         depth: int = 2,
         profiler: StepProfiler | None = None,
+        drain_on_preemption: bool = False,
     ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._batches = batches
         self._to_device = to_device
         self._profiler = profiler
+        # train streams only (see device_batches): an eval stream that
+        # drained on SIGTERM would silently compute metrics over a partial
+        # test set and record them as a completed epoch. Single-process
+        # only: a per-process early stream end desynchronizes the
+        # lockstep collectives of a multi-process epoch
+        self._drain = drain_on_preemption and jax.process_count() == 1
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exhausted = False
@@ -213,8 +222,17 @@ class HostPrefetcher:
         it = iter(self._batches)
         step = 0
         tracer = get_tracer()
+        guard = preemption_guard()
         try:
             while not self._stop.is_set():
+                if self._drain and guard.requested():
+                    # SIGTERM drain: stop building batches nobody will
+                    # consume and END the stream — the consumer side is
+                    # about to checkpoint and exit, and racing its
+                    # shutdown (a closed/abandoned queue) helps no one
+                    self._put(_End)
+                    return
+                faultinject.fault_point("prefetch_produce", step=step)
                 # span args are evaluated at entry: qsize() IS the queue
                 # depth at this enqueue attempt (how far ahead we run)
                 spanned = _span_step(step, self._profiler)
@@ -352,11 +370,21 @@ def device_batches(
     to_device: Callable[[dict], dict],
     prefetch: int = 0,
     profiler: StepProfiler | None = None,
+    drain_on_preemption: bool = False,
 ):
     """The epoch loops' single entry point: a context manager iterating
     ``(host_batch, device_batch)`` pairs — prefetched ``prefetch`` deep when
     > 0, synchronous otherwise. Both paths yield identical batches in
-    identical order under a fixed seed."""
+    identical order under a fixed seed.
+
+    ``drain_on_preemption``: let the producer thread end the stream early
+    once the SIGTERM guard is set — for TRAIN streams, whose consumer
+    re-checks the guard at stream end and never records a truncated pass;
+    eval streams must run to completion (partial metrics would silently
+    enter the history)."""
     if prefetch > 0:
-        return HostPrefetcher(batches, to_device, depth=prefetch, profiler=profiler)
+        return HostPrefetcher(
+            batches, to_device, depth=prefetch, profiler=profiler,
+            drain_on_preemption=drain_on_preemption,
+        )
     return _SyncBatches(batches, to_device, profiler=profiler)

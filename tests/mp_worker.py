@@ -183,6 +183,16 @@ def main() -> None:
         ),
         model_axis=int(os.environ.get("MP_MODEL_AXIS", "1")),
         print_sample_cycle=0,
+        # elastic-training drills (test_elastic.py): periodic saves so a
+        # fault-killed group leaves a restorable checkpoint behind (the
+        # fault plan itself arrives via C2V_FAULT_PLAN, which train()
+        # reads directly)
+        checkpoint_cycle=int(os.environ.get("MP_CHECKPOINT_CYCLE", "0")),
+        resume=os.environ.get("MP_RESUME", "").strip() == "1",
+        # pin table padding when a drill resumes under a different
+        # model_axis (the pad follows model_axis unless pinned, and
+        # restore validates it)
+        vocab_pad_multiple=int(os.environ.get("MP_VOCAB_PAD", "0")),
     )
     # shard the corpus by FEED GROUP (the processes sharing this one's
     # data-axis coords), not by process index — with a model axis spanning
