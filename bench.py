@@ -65,6 +65,8 @@ def _metric_id() -> tuple[str, str]:
         return "host_pipeline_steps_per_sec", "steps/sec"
     if "--bucket-ab" in sys.argv[1:]:
         return "bucketed_real_contexts_per_sec", "contexts/sec"
+    if "--kernel-ab" in sys.argv[1:]:
+        return "fused_kernel_real_contexts_per_sec", "contexts/sec"
     return "path_contexts_per_sec_per_chip", "contexts/sec"
 
 
@@ -231,6 +233,18 @@ def _recipe_knob(
     if name in os.environ:
         return int(os.environ[name])
     return cpu_default if fell_back or backend == "cpu" else device_default
+
+
+def _recipe_flag(
+    name: str, device_default: bool, cpu_default: bool,
+    fell_back: bool, backend: str,
+) -> bool:
+    """Bool sibling of ``_recipe_knob``: env override (1/true/yes/on), else
+    the backend-sized default. First-class recipe knobs, not ad-hoc env
+    reads — so every mode parses and defaults them identically."""
+    if name in os.environ:
+        return os.environ[name].strip().lower() in ("1", "true", "yes", "on")
+    return bool(cpu_default if fell_back or backend == "cpu" else device_default)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -977,6 +991,260 @@ def _bucket_ab() -> None:
     )
 
 
+def _kernel_provenance(model_config) -> dict:
+    """Kernel impl + schedule provenance for a detail block: the stamp must
+    say which lowering produced the number, and — for autotuned runs — how
+    much schedule search the process paid (the obs/ counters)."""
+    out = {
+        "use_pallas": model_config.use_pallas,
+        "impl": model_config.pallas_impl if model_config.use_pallas else "xla",
+        "block_b": model_config.pallas_block_b,
+        "dma_depth": model_config.pallas_dma_depth,
+        "chunk_l": model_config.pallas_chunk_l,
+        "table_dtype": model_config.table_dtype,
+    }
+    if model_config.use_pallas and model_config.pallas_impl == "auto":
+        from code2vec_tpu.ops.autotune import counters_snapshot, get_cache
+
+        out["autotune_cache"] = get_cache().path
+        out["autotune_counters"] = counters_snapshot()
+    return out
+
+
+def _kernel_ab() -> None:
+    """``--kernel-ab``: fused-vs-XLA kernel A/B at real-context accounting.
+
+    Measures the EVAL/SERVING forward (the int8 arms cannot train — the
+    step contract forbids quantized master weights) over identical batches
+    of a top11-shaped synth corpus for the arms
+    {xla, pool_only, fused} × {f32} plus {pool_only, fused} × {int8}, with
+    a generalized ABBA protocol: the arm order runs forward then reversed
+    per repeat (monotonic drift cancels), best-of per arm. The metric line
+    reports the fused-f32 arm's real-context throughput with
+    ``vs_baseline`` = fused/xla speedup; the detail block records every
+    arm's rate plus kernel impl + schedule provenance.
+
+    ``--autotune`` first runs the Autocomp-style schedule search
+    (ops/autotune.py) for this run's shapes and records the winners +
+    cache counters — a SECOND identical invocation loads every schedule
+    from the persisted cache with zero timing runs (the counters in the
+    detail block prove it). ``--dry`` makes that pass serialize-only.
+
+    On a non-TPU backend the kernels execute in Pallas interpret mode:
+    the record is still produced, flagged ``"interpret": true`` — an
+    honest statement that the numbers characterize the interpreter, not
+    the hardware.
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.pipeline import build_method_epoch, iter_batches
+    from code2vec_tpu.data.synth import (
+        SynthSpec,
+        corpus_data_from_raw,
+        generate_corpus_data,
+    )
+    from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+    from code2vec_tpu.obs.runtime import memory_snapshot
+    from code2vec_tpu.ops import autotune as at
+    from code2vec_tpu.ops.quant import quantize_table
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    interpret = jax.default_backend() != "tpu"
+    batch_size = knob("BENCH_BATCH", 1024, 16)
+    bag = knob("BENCH_BAG", 200, 24)
+    steps = knob("BENCH_AB_STEPS", 30, 4)  # batches per timed pass
+    embed_size = knob("BENCH_EMBED", 100, 8)
+    encode_size = knob("BENCH_ENCODE", 100, 16)
+    repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 3 if not interpret else 2)), 1)
+    block_b = knob("BENCH_PALLAS_BLOCK_B", 8, 8)
+    dma_depth = knob("BENCH_PALLAS_DMA_DEPTH", 2, 2)
+    chunk_l = knob("BENCH_PALLAS_CHUNK_L", 128, 128)
+
+    spec = SynthSpec(
+        n_methods=max(batch_size * steps, 256),
+        n_terminals=knob("BENCH_AB_TERMINALS", 360_631, 2_000),
+        n_paths=knob("BENCH_AB_PATHS", 342_845, 2_000),
+        n_labels=knob("BENCH_AB_LABELS", 8_000, 100),
+        mean_contexts=float(knob("BENCH_AB_MEAN_CTX", 120, 12)),
+        max_contexts=2 * bag,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+
+    def cfg(**kw) -> Code2VecConfig:
+        return Code2VecConfig(
+            terminal_count=spec.n_terminals + 2,
+            path_count=spec.n_paths + 1,
+            label_count=len(data.label_vocab),
+            terminal_embed_size=embed_size,
+            path_embed_size=embed_size,
+            encode_size=encode_size,
+            dropout_prob=0.0,  # eval forward
+            dtype=jnp.float32,
+            pallas_block_b=block_b,
+            pallas_dma_depth=dma_depth,
+            pallas_chunk_l=chunk_l,
+            **kw,
+        )
+
+    # one f32 param set shared by every arm (the tree is impl-invariant)
+    base_model = Code2Vec(cfg())
+    rng = np.random.default_rng(0)
+    epoch = build_method_epoch(data, np.arange(data.n_items), bag, rng)
+    batches = list(iter_batches(epoch, batch_size, rng=None, pad_final=True))[:steps]
+    first = batches[0]
+    params = base_model.init(
+        {"params": jax.random.PRNGKey(0)},
+        first["starts"], first["paths"], first["ends"],
+    )["params"]
+    real_slots = sum(
+        int((b["paths"][b["example_mask"].astype(bool)] != 0).sum())
+        for b in batches
+    )
+    device_batches = [
+        {k: jax.device_put(b[k]) for k in ("starts", "paths", "ends")}
+        for b in batches
+    ]
+
+    # optional Autocomp pass over THIS run's shapes: populates/consults the
+    # persisted schedule cache; the counters delta below is the proof of
+    # how much search this invocation actually paid
+    autotune_info = None
+    if "--autotune" in sys.argv[1:]:
+        cache = at.get_cache(os.environ.get("BENCH_AUTOTUNE_CACHE", "").strip() or None)
+        before = at.counters_snapshot()
+        keys = at.keys_for(
+            batch_size, [bag], embed_size, embed_size, encode_size,
+            ["f32", "int8"],
+        )
+        schedules = at.autotune(
+            keys, cache=cache, dry="--dry" in sys.argv[1:],
+            iters=knob("BENCH_AUTOTUNE_ITERS", 3, 1),
+        )
+        after = at.counters_snapshot()
+        autotune_info = {
+            "cache": cache.path,
+            "dry": "--dry" in sys.argv[1:],
+            "schedules": {k: s.to_dict() for k, s in schedules.items()},
+            "counters_delta": {k: after[k] - before[k] for k in after},
+        }
+
+    quant = {
+        dt: (
+            quantize_table(params["terminal_embedding"]["embedding"], dt),
+            quantize_table(params["path_embedding"]["embedding"], dt),
+        )
+        for dt in ("int8",)
+    }
+
+    arms: list[tuple[str, Code2VecConfig, tuple | None]] = [
+        ("xla_f32", cfg(), None),
+        ("pool_only_f32", cfg(use_pallas=True, pallas_impl="pool_only"), None),
+        ("fused_f32", cfg(use_pallas=True, pallas_impl="fused"), None),
+        (
+            "pool_only_int8",
+            cfg(use_pallas=True, pallas_impl="pool_only", table_dtype="int8"),
+            quant["int8"],
+        ),
+        (
+            "fused_int8",
+            cfg(use_pallas=True, pallas_impl="fused", table_dtype="int8"),
+            quant["int8"],
+        ),
+    ]
+    if autotune_info is not None:
+        arms.append(
+            ("auto_f32", cfg(use_pallas=True, pallas_impl="auto"), None)
+        )
+
+    def make_forward(model_config: Code2VecConfig, quant_tables):
+        model = Code2Vec(model_config)
+
+        def fwd(params, batch):
+            logits, cv, _ = model.apply(
+                {"params": params}, batch["starts"], batch["paths"],
+                batch["ends"], deterministic=True,
+                quant_tables=quant_tables,
+            )
+            return jnp.argmax(logits, axis=-1), cv
+
+        return jax.jit(fwd)
+
+    fns = {name: make_forward(mc, qt) for name, mc, qt in arms}
+    for name in fns:  # compile + warm, untimed
+        jax.block_until_ready(fns[name](params, device_batches[0]))
+
+    def one_pass(fn) -> float:
+        t0 = time.perf_counter()
+        for b in device_batches:
+            out = fn(params, b)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    best: dict[str, float] = {name: float("inf") for name, _, _ in arms}
+    order = [name for name, _, _ in arms]
+    for _ in range(repeats):
+        # generalized ABBA: forward order then reversed — monotonic drift
+        # (cache/frequency warm-up) cancels across the pair of sweeps
+        for name in order + order[::-1]:
+            best[name] = min(best[name], one_pass(fns[name]))
+
+    rates = {name: real_slots / best[name] for name in best}
+    speedup = best["xla_f32"] / best["fused_f32"]
+
+    detail = {
+        "backend": backend,
+        "mode": "kernel_ab",
+        "interpret": interpret,
+        "batch": batch_size,
+        "bag": bag,
+        "steps": len(device_batches),
+        "embed": embed_size,
+        "encode": encode_size,
+        "pad_efficiency": round(
+            real_slots / (len(device_batches) * batch_size * bag), 4
+        ),
+        "arms": {
+            name: {
+                "real_contexts_per_sec": round(rates[name], 1),
+                "ms_per_pass": round(best[name] * 1e3, 3),
+                "kernel": _kernel_provenance(mc),
+            }
+            for name, mc, _ in arms
+        },
+        "speedup_fused_vs_xla_f32": round(speedup, 4),
+        "autotune": autotune_info,
+        "memory": memory_snapshot(),
+    }
+    if interpret:
+        detail["note"] = (
+            "Pallas interpret mode (no TPU backend): rates characterize "
+            "the interpreter, not the hardware — an honest record, not a "
+            "hardware claim"
+        )
+    print(json.dumps({"detail": detail}), file=sys.stderr, flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": "fused_kernel_real_contexts_per_sec",
+                "value": round(rates["fused_f32"], 1),
+                "unit": "contexts/sec",
+                # in AB mode the baseline IS the same-spec XLA arm
+                "vs_baseline": round(speedup, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     jax, backend, fell_back = _init_backend()
     _bench_tracer(jax)
@@ -1017,6 +1285,18 @@ def main() -> None:
     # override for e.g. the wide-model config (BASELINE config 4: 512/512)
     embed_size = int(os.environ.get("BENCH_EMBED", 100))
     encode_size = int(os.environ.get("BENCH_ENCODE", 100))
+    # kernel knobs as first-class recipe knobs (shared parsing/defaults
+    # with every A/B mode); BENCH_PALLAS_IMPL picks the kernel variant
+    # (--kernel-ab measures them against each other; ops/autotune.py
+    # searches them per shape)
+    use_pallas = _recipe_flag("BENCH_USE_PALLAS", False, False, fell_back, backend)
+    pallas_block_b = _recipe_knob("BENCH_PALLAS_BLOCK_B", 8, 8, fell_back, backend)
+    pallas_impl = (
+        os.environ.get("BENCH_PALLAS_IMPL", "pool_only").strip().lower()
+        or "pool_only"
+    )
+    pallas_dma_depth = _recipe_knob("BENCH_PALLAS_DMA_DEPTH", 2, 2, fell_back, backend)
+    pallas_chunk_l = _recipe_knob("BENCH_PALLAS_CHUNK_L", 128, 128, fell_back, backend)
 
     # top11-scale synthetic corpus, shrunk in method count (the throughput
     # metric depends on vocab/model/batch shape, not corpus length); vocab
@@ -1056,9 +1336,11 @@ def main() -> None:
         attn_impl=os.environ.get("BENCH_ATTN_IMPL", "xla").strip().lower() or "xla",
         encoder_impl=os.environ.get("BENCH_ENCODER_IMPL", "concat").strip().lower()
         or "concat",
-        use_pallas=os.environ.get("BENCH_USE_PALLAS", "0").strip().lower()
-        in ("1", "true", "yes", "on"),
-        pallas_block_b=int(os.environ.get("BENCH_PALLAS_BLOCK_B", 8)),
+        use_pallas=use_pallas,
+        pallas_block_b=pallas_block_b,
+        pallas_impl=pallas_impl,
+        pallas_dma_depth=pallas_dma_depth,
+        pallas_chunk_l=pallas_chunk_l,
         # pad the tables so a model axis actually shards them instead of
         # silently replicating (parallel.shardings divisibility rule)
         vocab_pad_multiple=max(model_axis, 1),
@@ -1313,6 +1595,10 @@ def main() -> None:
                     "attn_impl": model_config.attn_impl,
                     "encoder_impl": model_config.encoder_impl,
                     "use_pallas": model_config.use_pallas,
+                    # kernel impl + schedule provenance: which kernel this
+                    # round actually measured, with the tuned-schedule
+                    # accounting when --pallas_impl auto consulted the cache
+                    "kernel": _kernel_provenance(model_config),
                     "sample_prefetch": sample_prefetch,
                     "attribution": attribution,
                     "memory": memory,
@@ -1344,6 +1630,8 @@ if __name__ == "__main__":
             _prefetch_ab()
         elif "--bucket-ab" in sys.argv[1:]:
             _bucket_ab()
+        elif "--kernel-ab" in sys.argv[1:]:
+            _kernel_ab()
         else:
             main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver

@@ -119,11 +119,33 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=("float32", "bfloat16"),
                         help="matmul/activation dtype; bfloat16 for TPU MXU")
     parser.add_argument("--use_pallas", action="store_true", default=False,
-                        help="fused attention-pooling Pallas kernel (composes "
-                             "with data/model mesh axes)")
+                        help="Pallas kernels on the aggregation hot path "
+                             "(composes with data/model mesh axes)")
     parser.add_argument("--pallas_block_b", type=int, default=8,
-                        help="batch-tile size of the fused kernel (tune via "
-                             "tools/run_tpu_ablation.py)")
+                        help="batch-tile size of the Pallas kernels")
+    parser.add_argument("--pallas_impl", type=str, default="pool_only",
+                        choices=("pool_only", "gather_split", "fused", "auto"),
+                        help="which kernel serves the forward: pool-only "
+                             "fusion, XLA-gather + fused encode/attend/pool, "
+                             "the fully-fused in-kernel-gather chain, or "
+                             "'auto' (consult the autotuned schedule cache "
+                             "per traced shape — ops/autotune.py)")
+    parser.add_argument("--pallas_dma_depth", type=int, default=2,
+                        help="fused-kernel gather double-buffer slots")
+    parser.add_argument("--pallas_chunk_l", type=int, default=128,
+                        help="fused-kernel bag-chunk lane tile")
+    parser.add_argument("--table_dtype", type=str, default="f32",
+                        choices=("f32", "bf16", "int8"),
+                        help="embedding-table storage for SERVING/EVAL "
+                             "forwards (int8 = per-row scale, dequant on "
+                             "load — ops/quant.py); training rejects "
+                             "anything but f32 (master weights)")
+    parser.add_argument("--autotune_cache", type=str, default="",
+                        help="kernel-schedule cache path for --pallas_impl "
+                             "auto (default $C2V_AUTOTUNE_CACHE or "
+                             "~/.cache/code2vec_tpu/autotune_schedules.json; "
+                             "populate it via python -m "
+                             "code2vec_tpu.ops.autotune)")
     parser.add_argument("--attn_impl", type=str, default="xla",
                         choices=("xla", "streaming"),
                         help="attention-pool lowering: jax.nn.softmax chain "
@@ -291,6 +313,11 @@ def config_from_args(args: argparse.Namespace):
         context_axis=args.context_axis,
         use_pallas=args.use_pallas,
         pallas_block_b=args.pallas_block_b,
+        pallas_impl=args.pallas_impl,
+        pallas_dma_depth=args.pallas_dma_depth,
+        pallas_chunk_l=args.pallas_chunk_l,
+        table_dtype=args.table_dtype,
+        autotune_cache=args.autotune_cache,
         attn_impl=args.attn_impl,
         encoder_impl=args.encoder_impl,
         sample_prefetch=args.sample_prefetch,

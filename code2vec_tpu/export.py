@@ -181,9 +181,25 @@ def export_from_checkpoint(
             "export needs the full corpus on this host; load it unsharded"
         )
 
+    # pin the kernel-schedule cache before the first trace, exactly like
+    # train() does — --pallas_impl auto on an export pass must consult the
+    # SAME --autotune_cache the operator tuned into, not the default path
+    if getattr(config, "autotune_cache", ""):
+        from code2vec_tpu.ops.autotune import get_cache
+
+        get_cache(config.autotune_cache)
+
     np_rng = np.random.default_rng(config.random_seed)
     train_idx, test_idx = split_items(data.n_items, np_rng)
     model_config = model_config_from(config, data)
+    if model_config.table_dtype != "f32":
+        # quantized export: the checkpoint's f32 master tables are restored
+        # as-is; the forward gathers through the quantized storage derived
+        # from them (ops/quant.py), so the written vectors ARE the vectors
+        # a quantized serving deployment would produce
+        logger.info(
+            "exporting with %s-quantized embedding tables", model_config.table_dtype
+        )
     class_weights = class_weights_from(config, data)
     state = create_train_state(
         config, model_config, jax.random.PRNGKey(config.random_seed),
@@ -215,13 +231,34 @@ def export_from_checkpoint(
         "restored checkpoint (epoch %d, best_f1=%s)", meta.epoch, meta.best_f1
     )
 
+    # quantize ONCE from the restored masters (mirrors predict.Predictor)
+    # — the per-batch eval forward then gathers int8/bf16 rows and never
+    # re-derives the quantized storage inside the traced call. The mesh
+    # path keeps in-graph derivation: the quantized tables would need
+    # their own shardings, and the post-hoc pod export is not the
+    # bandwidth-sensitive consumer.
+    quant_tables = None
+    if model_config.table_dtype != "f32" and mesh is None:
+        from code2vec_tpu.ops.quant import quantize_table
+
+        quant_tables = (
+            quantize_table(
+                state.params["terminal_embedding"]["embedding"],
+                model_config.table_dtype,
+            ),
+            quantize_table(
+                state.params["path_embedding"]["embedding"],
+                model_config.table_dtype,
+            ),
+        )
+
     if mesh is not None:
         eval_step = make_parallel_eval_step(
             model_config, class_weights, mesh, state
         )
         to_device = lambda b: shard_batch(mesh, b)  # noqa: E731
     else:
-        eval_step = make_eval_step(model_config, class_weights)
+        eval_step = make_eval_step(model_config, class_weights, quant_tables)
         to_device = lambda b: b  # noqa: E731
 
     train_epoch = build_epoch(

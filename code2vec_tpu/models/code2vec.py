@@ -46,8 +46,26 @@ class Code2VecConfig:
     angular_margin: float = 0.5
     inverse_temp: float = 30.0
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 for TPU throughput)
-    use_pallas: bool = False  # fused attention-pooling kernel (ops.pallas_attention)
-    pallas_block_b: int = 8  # batch-tile size of the fused kernel
+    use_pallas: bool = False  # Pallas kernels on the aggregation hot path
+    pallas_block_b: int = 8  # batch-tile size of the Pallas kernels
+    # which kernel serves the forward when use_pallas is set:
+    # "pool_only"    fuse score->softmax->pool only (ops.pallas_attention);
+    # "gather_split" XLA gathers rows, kernel fuses encode->attend->pool;
+    # "fused"        in-kernel DMA gather too — gathered rows and encoded
+    #                contexts never touch HBM (ops.fused_encode_pool);
+    # "auto"         consult the autotuned schedule cache per traced
+    #                (batch, width) shape (ops.autotune) — the tuner may
+    #                also pick plain "xla". Param tree is IDENTICAL across
+    #                impls, so checkpoints interchange freely.
+    pallas_impl: str = "pool_only"
+    pallas_dma_depth: int = 2  # fused-impl gather double-buffer slots
+    pallas_chunk_l: int = 128  # fused-impl bag-chunk lane tile
+    # embedding-table storage for the gathers: "f32" (master weights) |
+    # "bf16" | "int8" (per-row scale, dequant on load — ops.quant).
+    # Serving/eval only: the train loop rejects quantized tables, and the
+    # f32 master params remain in the tree (quantized storage is derived
+    # in-graph unless the caller passes pre-quantized ``quant_tables``).
+    table_dtype: str = "f32"
     # "xla" = jax.nn.softmax chain; "streaming" = the explicit exp/sum
     # decomposition (ops.attention.streaming_attention_pool) — same math,
     # different lowering; use_pallas overrides both
@@ -116,6 +134,43 @@ class _SplitEncoder(nn.Module):
         )
 
 
+class _DenseKernelParam(nn.Module):
+    """Bare ``input_dense/kernel`` param with ``nn.Dense``'s path, shape,
+    dtype, and default init — same RNG fold → identical values — so the
+    fused-kernel path (which consumes the raw kernel) shares checkpoints
+    with both unfused encoder lowerings (the ``_SplitEncoder`` trick)."""
+
+    features: int
+    in_features: int
+
+    @nn.compact
+    def __call__(self) -> jnp.ndarray:
+        return self.param(
+            "kernel",
+            nn.linear.default_kernel_init,
+            (self.in_features, self.features),
+            jnp.float32,
+        )
+
+
+class _LayerNormParams(nn.Module):
+    """Bare ``input_layer_norm/{scale,bias}`` params matching
+    ``nn.LayerNorm``'s names/inits, for the fused path (the kernel applies
+    the normalization itself)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        return scale, bias
+
+
 class Code2Vec(nn.Module):
     """Returns ``(logits, code_vector, attention)`` like the reference
     forward (model/model.py:88); the margin head uses ``labels`` to place
@@ -123,6 +178,51 @@ class Code2Vec(nn.Module):
     them (inference)."""
 
     config: Code2VecConfig
+
+    def _resolve_kernel(self, batch: int, width: int):
+        """(impl, schedule) for this trace — ``None`` impl means the plain
+        XLA path. ``pallas_impl="auto"`` consults the persisted autotune
+        schedule cache (ops.autotune) at trace time with the concrete
+        ``(batch, width)``: a cached winner is used as-is (it may be plain
+        "xla"), a miss falls back to the configured pool-only kernel with
+        zero search on the hot path."""
+        c = self.config
+        if not c.use_pallas:
+            return None, None
+        from code2vec_tpu.ops.autotune import KernelSchedule, lookup_schedule
+
+        configured = KernelSchedule(
+            impl=c.pallas_impl if c.pallas_impl != "auto" else "pool_only",
+            block_b=c.pallas_block_b,
+            dma_depth=c.pallas_dma_depth,
+            chunk_l=c.pallas_chunk_l,
+            source="config",
+        )
+        if c.pallas_impl == "auto":
+            sched = lookup_schedule(
+                batch, width, c.terminal_embed_size, c.path_embed_size,
+                c.encode_size, c.table_dtype, default=configured,
+            )
+            return sched.impl, sched
+        if c.pallas_impl not in ("pool_only", "gather_split", "fused"):
+            raise ValueError(
+                f"unknown pallas_impl {c.pallas_impl!r}: expected "
+                "'pool_only', 'gather_split', 'fused', or 'auto'"
+            )
+        return c.pallas_impl, configured
+
+    def _lookup(self, store, ids: jnp.ndarray) -> jnp.ndarray:
+        """Quant-aware row gather: the f32 master table goes through
+        ops.embed (selectable backward); quantized storage dequants on
+        load (ops.quant — serving/eval, no backward)."""
+        from code2vec_tpu.ops.quant import QuantTable, dequantize_rows
+
+        c = self.config
+        if isinstance(store, QuantTable):
+            return dequantize_rows(store, ids, c.dtype)
+        return embedding_lookup(
+            store, ids, compute_dtype=c.dtype, grad_mode=c.embed_grad
+        )
 
     @nn.compact
     def __call__(
@@ -133,14 +233,28 @@ class Code2Vec(nn.Module):
         labels: jnp.ndarray | None = None,  # int32 [B], margin head only
         deterministic: bool = True,
         embed_offsets: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        quant_tables: tuple | None = None,
     ):
         """``embed_offsets``: optional ``(off_se [B, 2L, E_t], off_p
         [B, L, E_p])`` zero tensors added to the gathered embeddings — the
         touched-rows optimizer differentiates w.r.t. these instead of the
         tables, so the dense ``[vocab, dim]`` table gradient is never
         materialized (train/table_opt.py). Zeros leave the forward math
-        bit-identical."""
+        bit-identical.
+
+        ``quant_tables``: optional pre-quantized ``(terminal, path)``
+        ``ops.quant.QuantTable`` pair used for the gathers when
+        ``config.table_dtype != "f32"`` — serving paths (predict.Predictor)
+        quantize ONCE at load instead of deriving quantized storage from
+        the f32 master params inside every traced forward."""
         c = self.config
+        from code2vec_tpu.ops.quant import TABLE_DTYPES, quantize_table
+
+        if c.table_dtype not in TABLE_DTYPES:
+            raise ValueError(
+                f"unknown table_dtype {c.table_dtype!r}: expected one of "
+                f"{TABLE_DTYPES}"
+            )
 
         # the param tree matches nn.Embed's ({name: {"embedding": table}}),
         # but the lookup goes through ops.embed so the backward formulation
@@ -154,20 +268,106 @@ class Code2Vec(nn.Module):
             c.padded(c.path_count), c.path_embed_size, name="path_embedding"
         )()
 
-        # shared table for start & end terminals (model/model.py:21,48-50);
-        # one fused [B, 2L] lookup so the backward reduces both in one pass
-        embed_se = embedding_lookup(
-            terminal_table,
-            jnp.concatenate([starts, ends], axis=1),
-            compute_dtype=c.dtype,
-            grad_mode=c.embed_grad,
+        # serving storage: quantized tables ride NEXT TO the f32 master
+        # params (which stay the training/source of truth) — pre-quantized
+        # when the caller did it once at load, derived in-graph otherwise
+        if c.table_dtype == "f32":
+            t_store, p_store = terminal_table, path_table
+        elif quant_tables is not None:
+            t_store, p_store = quant_tables
+        else:
+            t_store = quantize_table(terminal_table, c.table_dtype)
+            p_store = quantize_table(path_table, c.table_dtype)
+
+        b, l = starts.shape
+        impl, sched = self._resolve_kernel(b, l)
+        mask = (starts > 0).astype(jnp.float32)  # PAD = 0 (model/model.py:64)
+        # xavier-normal over the reference's [E, 1] shape -> std sqrt(2/(E+1))
+        # (model/model.py:31)
+        attention_param = self.param(
+            "attention",
+            normal(stddev=math.sqrt(2.0 / (c.encode_size + 1))),
+            (c.encode_size,),
+            jnp.float32,
+        )
+
+        if impl in ("fused", "gather_split"):
+            # the fully-fused path: raw encoder params (identical tree to
+            # the unfused modules — checkpoints interchange) feed the
+            # gather→encode→attend→pool kernel (ops.fused_encode_pool)
+            from code2vec_tpu.ops.fused_encode_pool import (
+                fused_encode_attend_pool,
+            )
+
+            in_features = 2 * c.terminal_embed_size + c.path_embed_size
+            dense_kernel = _DenseKernelParam(
+                c.encode_size, in_features, name="input_dense"
+            )()
+            ln_scale, ln_bias = _LayerNormParams(
+                c.encode_size, name="input_layer_norm"
+            )()
+            drop_mask = None
+            if 0.0 < c.dropout_prob < 1.0 and not deterministic:
+                # pre-scaled keep mask applied by the kernel after tanh —
+                # nn.Dropout semantics (same keep prob and scaling; the
+                # stream differs from nn.Dropout's module-scoped RNG fold)
+                keep = 1.0 - c.dropout_prob
+                drop_mask = (
+                    jax.random.bernoulli(
+                        self.make_rng("dropout"), keep,
+                        (b, l, c.encode_size),
+                    ).astype(jnp.float32)
+                    / keep
+                )
+            off_se = off_p = None
+            if embed_offsets is not None:
+                off_se, off_p = embed_offsets
+            code_vector_f32, attention = fused_encode_attend_pool(
+                t_store, p_store, starts, paths, ends, mask,
+                dense_kernel, ln_scale, ln_bias, attention_param,
+                drop_mask=drop_mask, off_se=off_se, off_p=off_p,
+                impl=impl, block_b=sched.block_b,
+                dma_depth=sched.dma_depth, chunk_l=sched.chunk_l,
+                compute_dtype=c.dtype,
+            )
+        else:
+            code_vector_f32, attention = self._unfused_forward(
+                t_store, p_store, starts, paths, ends, mask,
+                attention_param, deterministic, embed_offsets,
+                impl, sched,
+            )
+
+        if c.angular_margin_loss:
+            logits = self._angular_margin_head(code_vector_f32, labels)
+        else:
+            logits = nn.Dense(
+                c.padded(c.label_count),
+                use_bias=True,
+                dtype=jnp.float32,
+                param_dtype=jnp.float32,
+                bias_init=zeros,  # explicit zero bias (model/model.py:42)
+                name="output_dense",
+            )(code_vector_f32)
+            logits = logits[:, : c.label_count]  # drop sharding-pad columns
+
+        return logits, code_vector_f32, attention
+
+    def _unfused_forward(
+        self, t_store, p_store, starts, paths, ends, mask,
+        attention_param, deterministic, embed_offsets, impl, sched,
+    ):
+        """XLA gather + encode, with the pool stage dispatched across the
+        lowerings (pool-only Pallas kernel / streaming softmax / plain
+        XLA). ``impl`` is "pool_only", or None/"xla" for no kernel (the
+        autotuner may pick "xla" even under use_pallas)."""
+        c = self.config
+        embed_se = self._lookup(
+            t_store, jnp.concatenate([starts, ends], axis=1)
         )
         if embed_offsets is not None:
             embed_se = embed_se + embed_offsets[0]
         embed_starts, embed_ends = jnp.split(embed_se, 2, axis=1)
-        embed_paths = embedding_lookup(
-            path_table, paths, compute_dtype=c.dtype, grad_mode=c.embed_grad
-        )
+        embed_paths = self._lookup(p_store, paths)
         if embed_offsets is not None:
             embed_paths = embed_paths + embed_offsets[1]
         if c.encoder_impl == "split":
@@ -200,21 +400,12 @@ class Code2Vec(nn.Module):
                 contexts, deterministic=deterministic
             )
 
-        # xavier-normal over the reference's [E, 1] shape -> std sqrt(2/(E+1))
-        # (model/model.py:31)
-        attention_param = self.param(
-            "attention",
-            normal(stddev=math.sqrt(2.0 / (c.encode_size + 1))),
-            (c.encode_size,),
-            jnp.float32,
-        )
-        mask = (starts > 0).astype(jnp.float32)  # PAD = 0 (model/model.py:64)
-        if c.use_pallas:
+        if impl == "pool_only":
             from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
 
             code_vector, attention = pallas_attention_pool(
                 contexts, mask, attention_param.astype(c.dtype),
-                block_b=c.pallas_block_b,
+                block_b=sched.block_b,
             )
         elif c.attn_impl == "streaming":
             code_vector, attention = streaming_attention_pool(
@@ -229,22 +420,7 @@ class Code2Vec(nn.Module):
             raise ValueError(
                 f"unknown attn_impl {c.attn_impl!r}: expected 'xla' or 'streaming'"
             )
-        code_vector_f32 = code_vector.astype(jnp.float32)
-
-        if c.angular_margin_loss:
-            logits = self._angular_margin_head(code_vector_f32, labels)
-        else:
-            logits = nn.Dense(
-                c.padded(c.label_count),
-                use_bias=True,
-                dtype=jnp.float32,
-                param_dtype=jnp.float32,
-                bias_init=zeros,  # explicit zero bias (model/model.py:42)
-                name="output_dense",
-            )(code_vector_f32)
-            logits = logits[:, : c.label_count]  # drop sharding-pad columns
-
-        return logits, code_vector_f32, attention
+        return code_vector.astype(jnp.float32), attention
 
     def _angular_margin_head(
         self, code_vector: jnp.ndarray, labels: jnp.ndarray | None

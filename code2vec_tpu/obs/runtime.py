@@ -27,6 +27,7 @@ __all__ = [
     "Gauge",
     "RuntimeHealth",
     "RecompileDetector",
+    "global_health",
     "host_rss_bytes",
     "device_memory_stats",
     "memory_snapshot",
@@ -85,6 +86,23 @@ class RuntimeHealth:
                 "counters": {k: c.value for k, c in self._counters.items()},
                 "gauges": {k: g.value for k, g in self._gauges.items()},
             }
+
+
+_global_health: RuntimeHealth | None = None
+_global_health_lock = threading.Lock()
+
+
+def global_health() -> RuntimeHealth:
+    """Process-wide counter/gauge registry for subsystems that outlive any
+    one run (the kernel-schedule autotune cache counts its hits/misses/
+    timing runs here so callers can assert 'second run did zero search').
+    The train loop keeps its own per-run :class:`RuntimeHealth`; this one
+    is never reset."""
+    global _global_health
+    with _global_health_lock:
+        if _global_health is None:
+            _global_health = RuntimeHealth()
+        return _global_health
 
 
 def _lint_hints() -> dict[str, str]:

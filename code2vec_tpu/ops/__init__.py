@@ -1,7 +1,17 @@
-"""Compute ops: XLA reference implementations + Pallas TPU kernels."""
+"""Compute ops: XLA reference implementations + Pallas TPU kernels.
+
+Heavy modules stay import-on-demand (``fused_encode_pool`` pulls pallas;
+``autotune`` touches the device) — only the dependency-light XLA pool and
+the quantized-table containers are re-exported eagerly.
+"""
 
 from code2vec_tpu.ops.attention import (
     attention_pool,
     masked_attention_weights,
     streaming_attention_pool,
+)
+from code2vec_tpu.ops.quant import (
+    QuantTable,
+    dequantize_rows,
+    quantize_table,
 )

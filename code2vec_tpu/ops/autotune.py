@@ -1,0 +1,546 @@
+"""Autocomp-style kernel-schedule autotuning with a persisted cache.
+
+Per-shape schedule search — not a single hand-picked tiling — is where
+accelerator kernels win ("Autocomp: A Powerful and Portable Code Optimizer
+for Tensor Accelerators"; "LLM-Aided Compilation for Tensor Accelerators",
+PAPERS.md), and it pays doubly here because the bucket ladder (PR 4) gives
+a SMALL STATIC set of ``(bucket width, batch)`` shapes to tune for.
+
+For each ``(device kind, batch, bag width, embed dims, table dtype)`` key
+the tuner enumerates kernel variants — plain XLA, the pool-only Pallas
+kernel, and the gather-split / fully-fused kernels of
+``ops/fused_encode_pool.py`` across ``block_b`` batch tiling, lane chunk,
+and DMA pipeline depth — times each on the real device, and persists the
+winner to a JSON cache. The cache is CONSULTED AT TRACE TIME
+(``lookup_schedule``, called from ``models/code2vec.py`` when
+``pallas_impl="auto"``), so a second run with the same shape set performs
+zero timing runs: every schedule loads from disk.
+
+Accounting is observable: ``obs.runtime.global_health()`` counters
+``autotune_cache_hit`` / ``autotune_cache_miss`` / ``autotune_timing_run``
+/ ``autotune_schedule_stored`` let callers (tests, ``bench.py
+--kernel-ab``) assert exactly how much search a run paid.
+
+``--dry`` writes default schedules without timing — the serialization
+smoke CI runs on every PR::
+
+    python -m code2vec_tpu.ops.autotune --autotune --dry --cache /tmp/c.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+DEFAULT_CACHE_ENV = "C2V_AUTOTUNE_CACHE"
+_CACHE_VERSION = 1
+
+IMPLS = ("xla", "pool_only", "gather_split", "fused")
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSchedule:
+    """One tuned kernel configuration (the search space point)."""
+
+    impl: str = "pool_only"  # "xla" | "pool_only" | "gather_split" | "fused"
+    block_b: int = 8  # batch-tile rows per kernel program
+    dma_depth: int = 2  # gather double-buffer slots (fused impl only)
+    chunk_l: int = 128  # bag-chunk lane tile the gather pipelines over
+    source: str = "default"  # "default" | "dry" | "autotune" | "cache"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KernelSchedule":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeKey:
+    """What a schedule is keyed by: the device plus everything that changes
+    the kernel's tiling economics. Vocab size is deliberately absent — the
+    gather cost per row depends on row width, not table height."""
+
+    device_kind: str
+    batch: int
+    width: int  # bag width L (one per bucket-ladder rung)
+    terminal_embed: int
+    path_embed: int
+    encode: int
+    table_dtype: str  # "f32" | "bf16" | "int8"
+
+    def cache_key(self) -> str:
+        return (
+            f"{self.device_kind}|b={self.batch}|l={self.width}"
+            f"|et={self.terminal_embed}|ep={self.path_embed}"
+            f"|h={self.encode}|dt={self.table_dtype}"
+        )
+
+
+def device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def default_cache_path() -> str:
+    env = os.environ.get(DEFAULT_CACHE_ENV, "").strip()
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "code2vec_tpu",
+        "autotune_schedules.json",
+    )
+
+
+def _counters():
+    from code2vec_tpu.obs.runtime import global_health
+
+    h = global_health()
+    return {
+        "hit": h.counter("autotune_cache_hit"),
+        "miss": h.counter("autotune_cache_miss"),
+        "timing": h.counter("autotune_timing_run"),
+        "stored": h.counter("autotune_schedule_stored"),
+    }
+
+
+def counters_snapshot() -> dict[str, int]:
+    c = _counters()
+    return {
+        "autotune_cache_hit": c["hit"].value,
+        "autotune_cache_miss": c["miss"].value,
+        "autotune_timing_run": c["timing"].value,
+        "autotune_schedule_stored": c["stored"].value,
+    }
+
+
+class ScheduleCache:
+    """JSON-backed schedule store; loads tolerantly (a corrupt or
+    version-skewed file is an empty cache, never a crash) and saves
+    atomically (tmp + ``os.replace``)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.entries: dict[str, dict] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        if (
+            not isinstance(payload, dict)
+            or payload.get("version") != _CACHE_VERSION
+            or not isinstance(payload.get("entries"), dict)
+        ):
+            return
+        self.entries = payload["entries"]
+
+    def save(self) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"version": _CACHE_VERSION, "entries": self.entries}, f,
+                indent=1, sort_keys=True,
+            )
+        os.replace(tmp, self.path)
+
+    def get(self, key: ShapeKey) -> KernelSchedule | None:
+        entry = self.entries.get(key.cache_key())
+        if not isinstance(entry, dict) or "schedule" not in entry:
+            return None
+        try:
+            sched = KernelSchedule.from_dict(entry["schedule"])
+        except TypeError:
+            return None
+        return dataclasses.replace(sched, source="cache")
+
+    def put(
+        self, key: ShapeKey, schedule: KernelSchedule,
+        timings_ms: dict | None = None, interpret: bool | None = None,
+    ) -> None:
+        self.entries[key.cache_key()] = {
+            "schedule": schedule.to_dict(),
+            "timings_ms": timings_ms,
+            "interpret": interpret,
+            "created": time.time(),
+        }
+        _counters()["stored"].inc()
+
+
+_cache_singleton: ScheduleCache | None = None
+
+
+def get_cache(path: str | None = None) -> ScheduleCache:
+    """The process-wide cache. An explicit ``path`` pins (and reloads) the
+    singleton; ``path=None`` returns whatever is pinned — so a run that
+    pointed the cache somewhere (``--autotune_cache``) keeps it for every
+    later trace-time ``lookup_schedule`` in the process."""
+    global _cache_singleton
+    if path is None:
+        if _cache_singleton is None:
+            _cache_singleton = ScheduleCache(default_cache_path())
+        return _cache_singleton
+    if _cache_singleton is None or _cache_singleton.path != path:
+        _cache_singleton = ScheduleCache(path)
+    return _cache_singleton
+
+
+def reset_cache() -> None:
+    """Drop the memoized cache (tests; a fresh env var takes effect)."""
+    global _cache_singleton
+    _cache_singleton = None
+
+
+def lookup_schedule(
+    batch: int,
+    width: int,
+    terminal_embed: int,
+    path_embed: int,
+    encode: int,
+    table_dtype: str = "f32",
+    *,
+    default: KernelSchedule | None = None,
+    cache: ScheduleCache | None = None,
+) -> KernelSchedule:
+    """Trace-time schedule lookup (``pallas_impl="auto"``). A cache hit
+    returns the persisted winner; a miss falls back to ``default`` (the
+    pool-only kernel unless overridden) WITHOUT timing anything — search
+    happens only in :func:`autotune`, never on the training hot path."""
+    key = ShapeKey(
+        device_kind=device_kind(), batch=int(batch), width=int(width),
+        terminal_embed=int(terminal_embed), path_embed=int(path_embed),
+        encode=int(encode), table_dtype=table_dtype,
+    )
+    cache = cache or get_cache()
+    c = _counters()
+    found = cache.get(key)
+    if found is not None:
+        c["hit"].inc()
+        return found
+    c["miss"].inc()
+    return default or KernelSchedule(impl="pool_only", source="default")
+
+
+def enumerate_variants(batch: int, width: int, table_dtype: str) -> list[KernelSchedule]:
+    """The search space for one shape: plain XLA, pool-only, gather-split,
+    and fully-fused, across batch tiling / DMA pipeline depth / lane chunk.
+    Tile sizes larger than the (padded) batch are pruned — they would all
+    alias the same single-program grid."""
+    bp = max(batch, 1)
+    blocks = [b for b in (8, 16, 32) if b <= max(bp, 8)]
+    if not blocks:
+        blocks = [8]
+    lane_pad = -(-max(width, 1) // 128) * 128
+    chunks = sorted({c for c in (128, 256) if c <= lane_pad and lane_pad % c == 0})
+    variants = [KernelSchedule(impl="xla")]
+    for b in blocks:
+        variants.append(KernelSchedule(impl="pool_only", block_b=b))
+    for b in blocks:
+        variants.append(KernelSchedule(impl="gather_split", block_b=b))
+    for b in blocks[:2]:
+        for depth in (1, 2):
+            for cl in chunks:
+                variants.append(
+                    KernelSchedule(
+                        impl="fused", block_b=b, dma_depth=depth, chunk_l=cl
+                    )
+                )
+    return variants
+
+
+def _synth_inputs(key: ShapeKey, vocab: int, seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from code2vec_tpu.ops.quant import maybe_quantize
+
+    rng = np.random.default_rng(seed)
+    tt = jnp.asarray(rng.normal(size=(vocab, key.terminal_embed)).astype(np.float32))
+    pt = jnp.asarray(rng.normal(size=(vocab, key.path_embed)).astype(np.float32))
+    t_table = maybe_quantize(tt, key.table_dtype)
+    p_table = maybe_quantize(pt, key.table_dtype)
+    b, l, h = key.batch, key.width, key.encode
+    data = dict(
+        starts=jnp.asarray(rng.integers(1, vocab, (b, l)).astype(np.int32)),
+        paths=jnp.asarray(rng.integers(1, vocab, (b, l)).astype(np.int32)),
+        ends=jnp.asarray(rng.integers(1, vocab, (b, l)).astype(np.int32)),
+        mask=jnp.asarray((rng.random((b, l)) > 0.4).astype(np.float32)),
+        dense_kernel=jnp.asarray(
+            rng.normal(
+                size=(2 * key.terminal_embed + key.path_embed, h)
+            ).astype(np.float32)
+            * 0.05
+        ),
+        ln_scale=jnp.ones(h, jnp.float32),
+        ln_bias=jnp.zeros(h, jnp.float32),
+        attn_param=jnp.asarray(rng.normal(size=h).astype(np.float32)),
+    )
+    return t_table, p_table, data
+
+
+def _build_forward(schedule: KernelSchedule, t_table, p_table, data):
+    """A jitted code-vector forward for one variant over fixed inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.ops.fused_encode_pool import (
+        fused_encode_attend_pool,
+        xla_reference_forward,
+    )
+
+    if schedule.impl == "xla":
+
+        def fn():
+            return xla_reference_forward(
+                t_table, p_table, data["starts"], data["paths"], data["ends"],
+                data["mask"], data["dense_kernel"], data["ln_scale"],
+                data["ln_bias"], data["attn_param"],
+            )[0]
+
+    elif schedule.impl == "pool_only":
+        from code2vec_tpu.ops.fused_encode_pool import xla_encode_contexts
+        from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+        from code2vec_tpu.ops.quant import QuantTable, dequantize_rows
+
+        def lookup(table, ids):
+            if isinstance(table, QuantTable):
+                return dequantize_rows(table, ids)
+            return table[ids]
+
+        def fn():
+            # the shared reference encode (ops/fused_encode_pool.py) — the
+            # tuner must time exactly what the model runs, not a re-derived
+            # copy that can drift
+            enc = xla_encode_contexts(
+                lookup(t_table, data["starts"]),
+                lookup(p_table, data["paths"]),
+                lookup(t_table, data["ends"]),
+                data["dense_kernel"], data["ln_scale"], data["ln_bias"],
+            )
+            return pallas_attention_pool(
+                enc, data["mask"], data["attn_param"],
+                block_b=schedule.block_b,
+            )[0]
+
+    elif schedule.impl in ("gather_split", "fused"):
+
+        def fn():
+            return fused_encode_attend_pool(
+                t_table, p_table, data["starts"], data["paths"], data["ends"],
+                data["mask"], data["dense_kernel"], data["ln_scale"],
+                data["ln_bias"], data["attn_param"],
+                impl=schedule.impl, block_b=schedule.block_b,
+                dma_depth=schedule.dma_depth, chunk_l=schedule.chunk_l,
+            )[0]
+
+    else:
+        raise ValueError(f"unknown impl {schedule.impl!r}")
+    return jax.jit(fn)
+
+
+def time_variant(
+    schedule: KernelSchedule, t_table, p_table, data,
+    iters: int = 3, repeats: int = 2,
+) -> float:
+    """Best-of wall time (seconds per forward) for one variant on the real
+    device; compile excluded via an untimed warmup call."""
+    import jax
+
+    fn = _build_forward(schedule, t_table, p_table, data)
+    jax.block_until_ready(fn())  # compile + warm, untimed
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        for _ in range(max(iters, 1)):
+            out = fn()
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / max(iters, 1))
+    return best
+
+
+def autotune(
+    keys: list[ShapeKey],
+    *,
+    cache: ScheduleCache | None = None,
+    dry: bool = False,
+    iters: int = 3,
+    repeats: int = 2,
+    vocab: int | None = None,
+    force: bool = False,
+) -> dict[str, KernelSchedule]:
+    """Search (or dry-stamp) a schedule for every key not already cached,
+    persist the cache once, and return the full key→schedule mapping.
+
+    ``dry=True`` writes the default schedule per missing key WITHOUT any
+    timing — it exists so schedule-cache serialization is exercised
+    cheaply (the CI smoke) and so a tuner can pre-create entries to edit
+    by hand. Timed entries record per-variant ms for provenance.
+    """
+    import jax
+
+    cache = cache or get_cache()
+    c = _counters()
+    interpret = jax.default_backend() != "tpu"
+    vocab = vocab or int(os.environ.get("C2V_AUTOTUNE_VOCAB", 20_000))
+    out: dict[str, KernelSchedule] = {}
+    dirty = False
+    for key in keys:
+        cached = None if force else cache.get(key)
+        if cached is not None:
+            c["hit"].inc()
+            out[key.cache_key()] = cached
+            continue
+        c["miss"].inc()
+        if dry:
+            sched = KernelSchedule(source="dry")
+            cache.put(key, sched, timings_ms=None, interpret=interpret)
+            out[key.cache_key()] = sched
+            dirty = True
+            continue
+        t_table, p_table, data = _synth_inputs(key, vocab)
+        timings: dict[str, float] = {}
+        best_sched, best_t = None, float("inf")
+        for variant in enumerate_variants(key.batch, key.width, key.table_dtype):
+            c["timing"].inc()
+            try:
+                t = time_variant(
+                    variant, t_table, p_table, data, iters=iters,
+                    repeats=repeats,
+                )
+            except Exception as exc:  # noqa: BLE001 - a variant that fails
+                # to lower on this backend is skipped, not fatal: the
+                # tuner's whole job is to pick among what actually runs
+                timings[_variant_label(variant)] = float("nan")
+                print(
+                    f"autotune: variant {_variant_label(variant)} failed on "
+                    f"{key.cache_key()}: {type(exc).__name__}: {exc}",
+                    file=sys.stderr,
+                )
+                continue
+            timings[_variant_label(variant)] = round(t * 1e3, 4)
+            if t < best_t:
+                best_sched, best_t = variant, t
+        if best_sched is None:
+            raise RuntimeError(
+                f"every kernel variant failed for {key.cache_key()}"
+            )
+        sched = dataclasses.replace(best_sched, source="autotune")
+        cache.put(key, sched, timings_ms=timings, interpret=interpret)
+        out[key.cache_key()] = sched
+        dirty = True
+    if dirty:
+        cache.save()
+    return out
+
+
+def _variant_label(s: KernelSchedule) -> str:
+    if s.impl == "xla":
+        return "xla"
+    if s.impl == "pool_only":
+        return f"pool_only/b{s.block_b}"
+    if s.impl == "gather_split":
+        return f"gather_split/b{s.block_b}"
+    return f"fused/b{s.block_b}/d{s.dma_depth}/c{s.chunk_l}"
+
+
+def keys_for(
+    batch: int,
+    widths: list[int],
+    terminal_embed: int,
+    path_embed: int,
+    encode: int,
+    table_dtypes: list[str],
+    kind: str | None = None,
+) -> list[ShapeKey]:
+    kind = kind or device_kind()
+    return [
+        ShapeKey(
+            device_kind=kind, batch=batch, width=w,
+            terminal_embed=terminal_embed, path_embed=path_embed,
+            encode=encode, table_dtype=dt,
+        )
+        for w in widths
+        for dt in table_dtypes
+    ]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="kernel-schedule autotuner (see module docstring)"
+    )
+    parser.add_argument("--autotune", action="store_true",
+                        help="accepted for CLI symmetry; this module IS the "
+                             "autotuner")
+    parser.add_argument("--dry", action="store_true",
+                        help="write default schedules without timing "
+                             "(serialization smoke)")
+    parser.add_argument("--cache", type=str, default=None,
+                        help=f"cache path (default ${DEFAULT_CACHE_ENV} or "
+                             "~/.cache/code2vec_tpu/autotune_schedules.json)")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--widths", type=str, default="16,32",
+                        help="comma list of bag widths (the bucket ladder)")
+    parser.add_argument("--terminal-embed", type=int, default=8)
+    parser.add_argument("--path-embed", type=int, default=8)
+    parser.add_argument("--encode", type=int, default=16)
+    parser.add_argument("--table-dtypes", type=str, default="f32",
+                        help="comma list from {f32,bf16,int8}")
+    parser.add_argument("--iters", type=int, default=3)
+    parser.add_argument("--vocab", type=int, default=None)
+    parser.add_argument("--force", action="store_true",
+                        help="re-tune even for cached shapes")
+    parser.add_argument("--expect-cached", action="store_true",
+                        help="exit 2 if any shape missed the cache (the "
+                             "round-trip assertion: a second identical run "
+                             "must do zero search)")
+    args = parser.parse_args(argv)
+
+    cache = ScheduleCache(args.cache or default_cache_path())
+    keys = keys_for(
+        args.batch,
+        [int(w) for w in args.widths.split(",") if w.strip()],
+        args.terminal_embed, args.path_embed, args.encode,
+        [d.strip() for d in args.table_dtypes.split(",") if d.strip()],
+    )
+    before = counters_snapshot()
+    schedules = autotune(
+        keys, cache=cache, dry=args.dry, iters=args.iters, vocab=args.vocab,
+        force=args.force,
+    )
+    after = counters_snapshot()
+    delta = {k: after[k] - before[k] for k in after}
+    print(
+        json.dumps(
+            {
+                "device_kind": device_kind(),
+                "cache": cache.path,
+                "dry": args.dry,
+                "schedules": {k: s.to_dict() for k, s in schedules.items()},
+                "counters": delta,
+            }
+        ),
+        flush=True,
+    )
+    if args.expect_cached and delta["autotune_cache_miss"] > 0:
+        print(
+            f"autotune: --expect-cached but {delta['autotune_cache_miss']} "
+            "shape(s) missed the cache",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

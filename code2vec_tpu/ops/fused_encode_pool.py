@@ -1,0 +1,778 @@
+"""Fully-fused gather→encode→attend→pool as a Pallas TPU kernel.
+
+The code2vec hot path is a bag aggregation over enormous embedding tables
+(vocabs reach 360k+ rows): two table gathers, concat, dense+layernorm+tanh
+encode, then attention pooling. Lowered separately by XLA, the ``[B, L, 3E]``
+gathered rows and the ``[B, L, E']`` encoded contexts round-trip HBM between
+fusion boundaries; ``ops/pallas_attention.py`` fuses only the final
+score→softmax→pool stage. This module fuses the WHOLE chain: per batch
+tile, the needed start/path/end embedding rows are DMA'd from the HBM
+tables into VMEM (double-buffered across bag chunks), then split-encode
+(three sliced matmuls on the shared ``input_dense`` kernel — algebraically
+the concat matmul, ``models/code2vec.py:_SplitEncoder``) → layernorm →
+tanh → attention score → masked softmax → weighted pool run entirely in
+VMEM. Only the ``[TB, E']`` code vector and ``[TB, L]`` weights go back to
+HBM — the gathered rows and encoded contexts never touch it.
+
+Two kernel variants (the autotuner's ``impl`` axis, ``ops/autotune.py``):
+
+- ``fused``        in-kernel row DMA gather (tables stay in HBM/ANY space;
+                   ``dma_depth`` buffers pipeline the gather of bag chunk
+                   c+1 under the encode of chunk c);
+- ``gather_split`` XLA performs the row gathers (its gather lowering is
+                   hard to beat when rows are cache-resident), the kernel
+                   fuses encode→attend→pool so the encoded contexts still
+                   never hit HBM.
+
+Quantized tables (``ops/quant.py``): int8 rows are DMA'd with their per-row
+scales and dequantized in-register on load; bf16 rows are widened on load.
+Serving/eval only — the backward exists only for f32 master tables.
+
+Autodiff follows ``ops/pallas_attention.py``'s pattern: the forward runs
+the kernel; the backward is closed-form XLA over the saved inputs — the
+whole chain is rematerialized by XLA autodiff of the reference formulation
+(flash-attention-style recompute), so gradients are exact to the unfused
+path and the fused forward's HBM savings are kept.
+
+Masking semantics are identical to ``pallas_attention_pool``: user-masked
+positions score the finite ``NINF`` sentinel, lane-padding columns score a
+hard ``-inf`` below it, so a fully-masked row degenerates to uniform over
+the REAL bag length exactly like the XLA path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from code2vec_tpu.analysis.contracts import shape_contract, spec
+from code2vec_tpu.ops.attention import NINF, attention_pool
+from code2vec_tpu.ops.quant import QuantTable
+
+_LANE = 128
+_LN_EPS = 1e-6  # flax nn.LayerNorm default
+
+FUSED_IMPLS = ("fused", "gather_split")
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedStatic:
+    """Hashable static configuration of one fused-op instantiation (the
+    jit/custom_vjp nondiff payload). ``table_dtype``/``has_*`` determine
+    the exact positional argument layout — see ``_ARG_NAMES``."""
+
+    impl: str  # "fused" | "gather_split"
+    block_b: int
+    dma_depth: int
+    chunk_l: int
+    table_dtype: str  # "f32" | "bf16" | "int8"
+    compute: str  # compute dtype name ("float32" | "bfloat16")
+    has_drop: bool
+    has_off: bool
+    interpret: bool
+
+
+# full primal layout of the custom_vjp op (entries may be None per static)
+_ARG_NAMES = (
+    "t_vals", "t_scale", "p_vals", "p_scale",
+    "starts", "paths", "ends", "mask",
+    "dense_kernel", "ln_scale", "ln_bias", "attn_param",
+    "drop_mask", "off_se", "off_p",
+)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _pad_dim(x: jnp.ndarray, axis: int, target: int) -> jnp.ndarray:
+    if x.shape[axis] == target:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+def _dequant(rows, scale_rows, table_dtype: str):
+    """Widen gathered rows to f32; int8 applies the per-row scale."""
+    if table_dtype == "int8":
+        return rows.astype(jnp.float32) * scale_rows
+    return rows.astype(jnp.float32)
+
+
+def _encode_f32(s, p, e, kern_ref, lns_ref, lnb_ref):
+    """Split-encode + layernorm + tanh on f32 row blocks.
+
+    ``s/p/e``: [TB, C, E*] f32 gathered rows; returns [TB, C, H] f32.
+    2D ``jnp.dot`` form so Mosaic lowers the contractions onto the MXU
+    (batched dot_general does not lower; see ops/pallas_attention.py).
+    """
+    tb, c, et = s.shape
+    ep = p.shape[-1]
+    h = kern_ref.shape[-1]
+    kern = kern_ref[:]
+    x = jnp.dot(
+        s.reshape(tb * c, et), kern[:et], preferred_element_type=jnp.float32
+    )
+    x = x + jnp.dot(
+        p.reshape(tb * c, ep), kern[et : et + ep],
+        preferred_element_type=jnp.float32,
+    )
+    x = x + jnp.dot(
+        e.reshape(tb * c, et), kern[et + ep :],
+        preferred_element_type=jnp.float32,
+    )
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    xn = (x - mu) * jax.lax.rsqrt(var + _LN_EPS)
+    xn = xn * lns_ref[0][None, :] + lnb_ref[0][None, :]
+    return jnp.tanh(xn).reshape(tb, c, h)
+
+
+def _pool_f32(enc, mask, attn_ref, real_l: int):
+    """Masked softmax + weighted pool over [TB, Lp, H] f32 encoded rows —
+    the same arithmetic as ``pallas_attention.py``'s kernel (finite NINF
+    for user-masked slots, hard -inf for lane padding)."""
+    scores = jnp.sum(enc * attn_ref[0][None, None, :], axis=2)  # [TB, Lp]
+    masked = scores * mask + (1.0 - mask) * NINF
+    tb, lp = masked.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (tb, lp), 1)
+    masked = jnp.where(col < real_l, masked, -jnp.inf)
+    masked = masked - jnp.max(masked, axis=-1, keepdims=True)
+    ex = jnp.exp(masked)
+    weights = ex / jnp.sum(ex, axis=-1, keepdims=True)
+    cv = jnp.sum(enc * weights[:, :, None], axis=1)  # [TB, H]
+    return cv, weights
+
+
+def _make_split_kernel(real_l: int, has_drop: bool):
+    """encode→attend→pool kernel over pre-gathered rows (gather_split)."""
+
+    def _kernel(*refs):
+        i = 0
+        s_ref, p_ref, e_ref, mask_ref = refs[i : i + 4]; i += 4
+        kern_ref, lns_ref, lnb_ref, attn_ref = refs[i : i + 4]; i += 4
+        drop_ref = None
+        if has_drop:
+            drop_ref = refs[i]; i += 1
+        cv_ref, w_ref = refs[i : i + 2]
+
+        enc = _encode_f32(
+            s_ref[:].astype(jnp.float32),
+            p_ref[:].astype(jnp.float32),
+            e_ref[:].astype(jnp.float32),
+            kern_ref, lns_ref, lnb_ref,
+        )
+        if drop_ref is not None:
+            enc = enc * drop_ref[:].astype(jnp.float32)
+        cv, weights = _pool_f32(
+            enc, mask_ref[:].astype(jnp.float32), attn_ref, real_l
+        )
+        cv_ref[:] = cv.astype(cv_ref.dtype)
+        w_ref[:] = weights
+
+    return _kernel
+
+
+def _make_fused_kernel(
+    real_l: int, lp: int, cl: int, depth: int, table_dtype: str,
+    has_drop: bool, block_b: int,
+):
+    """The full kernel: in-kernel DMA row gather (``depth``-buffered across
+    bag chunks of ``cl``), then the same encode→attend→pool as the split
+    kernel, accumulating encoded rows + scores in VMEM scratch."""
+
+    quant = table_dtype == "int8"
+    n_chunks = lp // cl
+
+    def _kernel(*refs):
+        i = 0
+        t_vals_ref = refs[i]; i += 1
+        t_scale_ref = None
+        if quant:
+            t_scale_ref = refs[i]; i += 1
+        p_vals_ref = refs[i]; i += 1
+        p_scale_ref = None
+        if quant:
+            p_scale_ref = refs[i]; i += 1
+        starts_ref, paths_ref, ends_ref, mask_ref = refs[i : i + 4]; i += 4
+        kern_ref, lns_ref, lnb_ref, attn_ref = refs[i : i + 4]; i += 4
+        drop_ref = None
+        if has_drop:
+            drop_ref = refs[i]; i += 1
+        cv_ref, w_ref = refs[i : i + 2]; i += 2
+        s_rows, p_rows, e_rows = refs[i : i + 3]; i += 3
+        s_scl = p_scl = e_scl = None
+        if quant:
+            s_scl, p_scl, e_scl = refs[i : i + 3]; i += 3
+        enc_buf, sems = refs[i : i + 2]
+
+        def _copies(slot, c):
+            """The chunk's row DMAs, as (src, dst) pairs rebuilt identically
+            at issue and wait time (the double-buffer pattern)."""
+            base = c * cl
+
+            def row(j, op):
+                bi = j // cl
+                li = j - bi * cl
+                sid = starts_ref[bi, base + li]
+                pid = paths_ref[bi, base + li]
+                eid = ends_ref[bi, base + li]
+                pairs = [
+                    (t_vals_ref.at[sid], s_rows.at[slot, bi, li]),
+                    (p_vals_ref.at[pid], p_rows.at[slot, bi, li]),
+                    (t_vals_ref.at[eid], e_rows.at[slot, bi, li]),
+                ]
+                if quant:
+                    pairs += [
+                        (t_scale_ref.at[sid], s_scl.at[slot, bi, li]),
+                        (p_scale_ref.at[pid], p_scl.at[slot, bi, li]),
+                        (t_scale_ref.at[eid], e_scl.at[slot, bi, li]),
+                    ]
+                for src, dst in pairs:
+                    op(pltpu.make_async_copy(src, dst, sems.at[slot]))
+
+            return row
+
+        # the loops carry a strong-typed dummy (the bodies act by side
+        # effect only); issue starts each copy, wait rebuilds the same
+        # descriptors and waits them — all on the slot's semaphore, so
+        # totals balance
+        zero = jnp.int32(0)
+
+        def issue_chunk(slot, c):
+            row = _copies(slot, c)
+            jax.lax.fori_loop(
+                0, block_b * cl,
+                lambda j, x: (row(j, lambda d: d.start()), x)[1], zero,
+            )
+
+        def wait_chunk(slot, c):
+            row = _copies(slot, c)
+            jax.lax.fori_loop(
+                0, block_b * cl,
+                lambda j, x: (row(j, lambda d: d.wait()), x)[1], zero,
+            )
+
+        def compute_chunk(slot, c):
+            base = c * cl
+            s = _dequant(
+                s_rows[slot], s_scl[slot] if quant else None, table_dtype
+            )
+            p = _dequant(
+                p_rows[slot], p_scl[slot] if quant else None, table_dtype
+            )
+            e = _dequant(
+                e_rows[slot], e_scl[slot] if quant else None, table_dtype
+            )
+            enc = _encode_f32(s, p, e, kern_ref, lns_ref, lnb_ref)
+            if drop_ref is not None:
+                enc = enc * drop_ref[:, pl.ds(base, cl), :].astype(jnp.float32)
+            enc_buf[:, pl.ds(base, cl), :] = enc
+
+        if depth <= 1:
+            # no pipeline: strictly issue → wait → compute per chunk
+            def serial_body(c, x):
+                issue_chunk(0, c)
+                wait_chunk(0, c)
+                compute_chunk(0, c)
+                return x
+
+            jax.lax.fori_loop(0, n_chunks, serial_body, zero)
+        else:
+            issue_chunk(0, 0)
+
+            def pipe_body(c, x):
+                slot = jax.lax.rem(c, depth)
+
+                @pl.when(c + 1 < n_chunks)
+                def _():
+                    issue_chunk(jax.lax.rem(c + 1, depth), c + 1)
+
+                wait_chunk(slot, c)
+                compute_chunk(slot, c)
+                return x
+
+            jax.lax.fori_loop(0, n_chunks, pipe_body, zero)
+
+        cv, weights = _pool_f32(
+            enc_buf[:], mask_ref[:].astype(jnp.float32), attn_ref, real_l
+        )
+        cv_ref[:] = cv.astype(cv_ref.dtype)
+        w_ref[:] = weights
+
+    return _kernel
+
+
+def _kernel_forward(static: FusedStatic, args: dict):
+    """Pad, tile, and run the selected Pallas kernel. ``args`` holds the
+    kernel-relevant arrays (tables/scales or pre-gathered rows, ids, mask,
+    encoder params, optional drop mask)."""
+    starts, paths, ends = args["starts"], args["paths"], args["ends"]
+    mask = args["mask"]
+    b, l = starts.shape
+    h = args["dense_kernel"].shape[-1]
+    block_b = static.block_b
+    bp = _round_up(max(b, 1), block_b)
+    lp = _round_up(max(l, 1), _LANE)
+    cl = static.chunk_l
+    if cl <= 0 or cl > lp or lp % cl:
+        cl = _LANE
+
+    mask_p = _pad_dim(_pad_dim(mask.astype(jnp.float32), 0, bp), 1, lp)
+    grid = (bp // block_b,)
+
+    def tile2(x):  # [B, L] → blocked (block_b, lp)
+        return pl.BlockSpec(
+            (block_b, x.shape[-1]), lambda i: (i, 0), memory_space=pltpu.VMEM
+        )
+
+    def vec_spec(x):  # params broadcast to every tile
+        return pl.BlockSpec(
+            x.shape, lambda i: (0,) * x.ndim, memory_space=pltpu.VMEM
+        )
+
+    kern = args["dense_kernel"].astype(jnp.float32)
+    lns = args["ln_scale"].reshape(1, h).astype(jnp.float32)
+    lnb = args["ln_bias"].reshape(1, h).astype(jnp.float32)
+    attn = args["attn_param"].reshape(1, h).astype(jnp.float32)
+    drop = args.get("drop_mask")
+    if drop is not None:
+        drop = _pad_dim(_pad_dim(drop.astype(jnp.float32), 0, bp), 1, lp)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((bp, h), jnp.float32),
+        jax.ShapeDtypeStruct((bp, lp), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((block_b, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+
+    if static.impl == "gather_split":
+        gs, gp, ge = args["g_start"], args["g_path"], args["g_end"]
+        gs = _pad_dim(_pad_dim(gs, 0, bp), 1, lp)
+        gp = _pad_dim(_pad_dim(gp, 0, bp), 1, lp)
+        ge = _pad_dim(_pad_dim(ge, 0, bp), 1, lp)
+        inputs = [gs, gp, ge, mask_p, kern, lns, lnb, attn]
+        in_specs = [
+            pl.BlockSpec(
+                (block_b, lp, gs.shape[-1]), lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_b, lp, gp.shape[-1]), lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_b, lp, ge.shape[-1]), lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            tile2(mask_p), vec_spec(kern), vec_spec(lns), vec_spec(lnb),
+            vec_spec(attn),
+        ]
+        if drop is not None:
+            inputs.append(drop)
+            in_specs.append(
+                pl.BlockSpec(
+                    (block_b, lp, h), lambda i: (i, 0, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            )
+        kernel = _make_split_kernel(l, drop is not None)
+        scratch_shapes: list = []
+    elif static.impl == "fused":
+        t_vals, p_vals = args["t_vals"], args["p_vals"]
+        quant = static.table_dtype == "int8"
+        ids = [
+            _pad_dim(_pad_dim(x.astype(jnp.int32), 0, bp), 1, lp)
+            for x in (starts, paths, ends)
+        ]
+        inputs = [t_vals]
+        in_specs: list = [pl.BlockSpec(memory_space=pltpu.ANY)]
+        if quant:
+            inputs.append(args["t_scale"])
+            in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        inputs.append(p_vals)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        if quant:
+            inputs.append(args["p_scale"])
+            in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        inputs += ids + [mask_p, kern, lns, lnb, attn]
+        in_specs += [tile2(x) for x in ids] + [
+            tile2(mask_p), vec_spec(kern), vec_spec(lns), vec_spec(lnb),
+            vec_spec(attn),
+        ]
+        if drop is not None:
+            inputs.append(drop)
+            in_specs.append(
+                pl.BlockSpec(
+                    (block_b, lp, h), lambda i: (i, 0, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            )
+        et, ep = t_vals.shape[-1], p_vals.shape[-1]
+        depth = max(int(static.dma_depth), 1)
+        store_dt = t_vals.dtype
+        scratch_shapes = [
+            pltpu.VMEM((depth, block_b, cl, et), store_dt),
+            pltpu.VMEM((depth, block_b, cl, ep), store_dt),
+            pltpu.VMEM((depth, block_b, cl, et), store_dt),
+        ]
+        if quant:
+            scratch_shapes += [
+                pltpu.VMEM((depth, block_b, cl, 1), jnp.float32),
+                pltpu.VMEM((depth, block_b, cl, 1), jnp.float32),
+                pltpu.VMEM((depth, block_b, cl, 1), jnp.float32),
+            ]
+        scratch_shapes += [
+            pltpu.VMEM((block_b, lp, h), jnp.float32),
+            pltpu.SemaphoreType.DMA((depth,)),
+        ]
+        kernel = _make_fused_kernel(
+            l, lp, cl, depth, static.table_dtype, drop is not None, block_b
+        )
+    else:
+        raise ValueError(
+            f"impl must be one of {FUSED_IMPLS}, got {static.impl!r}"
+        )
+
+    cv, weights = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
+        interpret=static.interpret,
+    )(*inputs)
+    return cv[:b], weights[:b, :l]
+
+
+_partitioned_cache: dict = {}
+
+
+def _get_partitioned_forward(static: FusedStatic, names: tuple[str, ...],
+                             ranks: tuple[int, ...]):
+    """The kernel forward wrapped in ``custom_partitioning`` so GSPMD
+    shards it batch-wise over a mesh (same rationale and rule as
+    ``pallas_attention.py``): batch-major args follow the operand's batch
+    sharding, tables/params are replicated per shard (a model-sharded
+    table is all-gathered — correct; the fused kernel needs whole rows)."""
+    key = (static, names, ranks)
+    if key not in _partitioned_cache:
+        from jax.experimental.custom_partitioning import custom_partitioning
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from code2vec_tpu.ops.pallas_attention import compat_def_partition
+
+        batch_major = {
+            "starts", "paths", "ends", "mask", "drop_mask",
+            "g_start", "g_path", "g_end",
+        }
+        is_batch = tuple(n in batch_major for n in names)
+        first_batch = is_batch.index(True)
+
+        def fwd(*arrays):
+            return _kernel_forward(static, dict(zip(names, arrays)))
+
+        def _bspec(arg_shapes):
+            sharding = arg_shapes[first_batch].sharding
+            spec = sharding.spec
+            return spec[0] if len(spec) else None
+
+        def infer_sharding(mesh, arg_shapes, result_shape):
+            b = _bspec(arg_shapes)
+            return (
+                NamedSharding(mesh, P(b, None)),
+                NamedSharding(mesh, P(b, None)),
+            )
+
+        def partition(mesh, arg_shapes, result_shape):
+            b = _bspec(arg_shapes)
+            arg_shardings = tuple(
+                NamedSharding(mesh, P(b, *(None,) * (r - 1)))
+                if bm else NamedSharding(mesh, P())
+                for bm, r in zip(is_batch, ranks)
+            )
+            out_shardings = (
+                NamedSharding(mesh, P(b, None)),
+                NamedSharding(mesh, P(b, None)),
+            )
+            return mesh, fwd, out_shardings, arg_shardings
+
+        p = custom_partitioning(fwd)
+        compat_def_partition(
+            p, partition=partition, infer_sharding_from_operands=infer_sharding
+        )
+        _partitioned_cache[key] = p
+    return _partitioned_cache[key]
+
+
+def _forward(static: FusedStatic, args: tuple):
+    """Assemble kernel args (XLA-side gather for gather_split) and invoke
+    the partitioned kernel forward."""
+    named = dict(zip(_ARG_NAMES, args))
+    cd = jnp.dtype(static.compute)
+    kargs = {
+        "starts": named["starts"], "paths": named["paths"],
+        "ends": named["ends"], "mask": named["mask"],
+        "dense_kernel": named["dense_kernel"], "ln_scale": named["ln_scale"],
+        "ln_bias": named["ln_bias"], "attn_param": named["attn_param"],
+    }
+    if static.has_drop:
+        kargs["drop_mask"] = named["drop_mask"]
+    if static.impl == "gather_split":
+        # XLA gathers (+ dequant); the kernel fuses the rest. Offsets (zero
+        # by the table_opt contract) are added here so the forward matches
+        # the reference formulation exactly even if that contract is bent.
+        kargs["g_start"] = _gather_rows(
+            named["t_vals"], named["t_scale"], named["starts"], static, cd
+        )
+        kargs["g_path"] = _gather_rows(
+            named["p_vals"], named["p_scale"], named["paths"], static, cd
+        )
+        kargs["g_end"] = _gather_rows(
+            named["t_vals"], named["t_scale"], named["ends"], static, cd
+        )
+        if static.has_off:
+            o_s, o_e = jnp.split(named["off_se"], 2, axis=1)
+            kargs["g_start"] = kargs["g_start"] + o_s
+            kargs["g_path"] = kargs["g_path"] + named["off_p"]
+            kargs["g_end"] = kargs["g_end"] + o_e
+    else:
+        kargs["t_vals"] = named["t_vals"]
+        kargs["p_vals"] = named["p_vals"]
+        if static.table_dtype == "int8":
+            kargs["t_scale"] = named["t_scale"]
+            kargs["p_scale"] = named["p_scale"]
+        # the fused kernel gathers in-kernel and cannot add the offsets;
+        # they are zeros by contract (train/table_opt.py) and enter only
+        # the backward (where the reference differentiates w.r.t. them)
+
+    names = tuple(kargs.keys())
+    arrays = tuple(kargs.values())
+    ranks = tuple(a.ndim for a in arrays)
+    p = _get_partitioned_forward(static, names, ranks)
+    return p(*arrays)
+
+
+def _gather_rows(vals, scale, ids, static: FusedStatic, cd):
+    if static.table_dtype == "f32":
+        return vals[ids].astype(cd)
+    rows = vals[ids]
+    if static.table_dtype == "int8":
+        rows = rows.astype(jnp.float32) * scale[ids]
+    return rows.astype(cd)
+
+
+def xla_encode_contexts(
+    gs, gp, ge, dense_kernel, ln_scale, ln_bias, compute_dtype=jnp.float32
+):
+    """Split-encode + layernorm + tanh over pre-gathered rows — THE
+    reference encode formulation. Single source of truth: the fused
+    backward differentiates it and the autotuner's pool-only arm times it,
+    so a change here changes every consumer in lockstep."""
+    cd = jnp.dtype(compute_dtype)
+    et, ep = gs.shape[-1], gp.shape[-1]
+    kern = dense_kernel.astype(cd)
+    x = gs @ kern[:et] + gp @ kern[et : et + ep] + ge @ kern[et + ep :]
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    xn = (x32 - mu) * jax.lax.rsqrt(var + _LN_EPS)
+    xn = xn * ln_scale.astype(jnp.float32) + ln_bias.astype(jnp.float32)
+    return jnp.tanh(xn.astype(cd))
+
+
+def _xla_reference(static: FusedStatic, args: tuple):
+    """The unfused XLA formulation of the exact same math — the backward
+    differentiates THIS (rematerialized: nothing but the primal inputs is
+    saved), so fused gradients are exact to the unfused path."""
+    named = dict(zip(_ARG_NAMES, args))
+    cd = jnp.dtype(static.compute)
+    gs = _gather_rows(named["t_vals"], named["t_scale"], named["starts"], static, cd)
+    gp = _gather_rows(named["p_vals"], named["p_scale"], named["paths"], static, cd)
+    ge = _gather_rows(named["t_vals"], named["t_scale"], named["ends"], static, cd)
+    if static.has_off:
+        o_s, o_e = jnp.split(named["off_se"], 2, axis=1)
+        gs = gs + o_s
+        gp = gp + named["off_p"]
+        ge = ge + o_e
+    enc = xla_encode_contexts(
+        gs, gp, ge, named["dense_kernel"], named["ln_scale"],
+        named["ln_bias"], cd,
+    )
+    if static.has_drop:
+        enc = enc * named["drop_mask"].astype(cd)
+    cv, weights = attention_pool(
+        enc, named["mask"], named["attn_param"].astype(cd)
+    )
+    return cv.astype(jnp.float32), weights
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _op(static: FusedStatic, args: tuple):
+    return _forward(static, args)
+
+
+def _op_fwd(static: FusedStatic, args: tuple):
+    return _forward(static, args), args
+
+
+def _op_bwd(static: FusedStatic, residuals: tuple, grads):
+    named = dict(zip(_ARG_NAMES, residuals))
+    diff_names = ["dense_kernel", "ln_scale", "ln_bias", "attn_param"]
+    if static.table_dtype == "f32":
+        diff_names += ["t_vals", "p_vals"]
+    if static.has_off:
+        diff_names += ["off_se", "off_p"]
+
+    def ref(diff: dict):
+        merged = dict(named, **diff)
+        return _xla_reference(
+            static, tuple(merged[n] for n in _ARG_NAMES)
+        )
+
+    _, vjp = jax.vjp(ref, {n: named[n] for n in diff_names})
+    (gd,) = vjp(grads)
+
+    def cot(name):
+        if name in gd:
+            return gd[name]
+        v = named[name]
+        # float non-diff data (mask, drop, quant scales) gets explicit
+        # zeros; integer ids / quantized values get None (no tangent space)
+        if v is not None and jnp.issubdtype(v.dtype, jnp.floating):
+            return jnp.zeros_like(v)
+        return None
+
+    return (tuple(cot(n) for n in _ARG_NAMES),)
+
+
+_op.defvjp(_op_fwd, _op_bwd)
+
+
+FUSED_CONTRACT = {
+    "starts": spec("B,L", "int"),
+    "paths": spec("B,L", "int"),
+    "ends": spec("B,L", "int"),
+    "mask": spec("B,L"),
+    "dense_kernel": spec("D,H", "float"),
+    "ln_scale": spec("H", "float"),
+    "ln_bias": spec("H", "float"),
+    "attn_param": spec("H", "float"),
+}
+
+
+@shape_contract(**{k: v for k, v in FUSED_CONTRACT.items()})
+def _check_contract(starts, paths, ends, mask, dense_kernel, ln_scale,
+                    ln_bias, attn_param):
+    return None
+
+
+def fused_encode_attend_pool(
+    t_table,  # f32 [Vt, Et] master table OR ops.quant.QuantTable
+    p_table,  # f32 [Vp, Ep] master table OR ops.quant.QuantTable
+    starts: jnp.ndarray,  # int32 [B, L]
+    paths: jnp.ndarray,  # int32 [B, L]
+    ends: jnp.ndarray,  # int32 [B, L]
+    mask: jnp.ndarray,  # [B, L] (1 = real, 0 = PAD)
+    dense_kernel: jnp.ndarray,  # f32 [2*Et+Ep, H] (input_dense/kernel)
+    ln_scale: jnp.ndarray,  # f32 [H]
+    ln_bias: jnp.ndarray,  # f32 [H]
+    attn_param: jnp.ndarray,  # f32 [H]
+    drop_mask: jnp.ndarray | None = None,  # pre-scaled keep mask [B, L, H]
+    off_se: jnp.ndarray | None = None,  # zero offsets [B, 2L, Et] (table_opt)
+    off_p: jnp.ndarray | None = None,  # zero offsets [B, L, Ep]
+    *,
+    impl: str = "fused",
+    block_b: int = 8,
+    dma_depth: int = 2,
+    chunk_l: int = _LANE,
+    compute_dtype=jnp.float32,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused forward for the whole code2vec aggregation chain.
+
+    Returns ``(code_vector [B, H] f32, attention [B, L] f32)`` matching
+    the unfused model path (``models/code2vec.py``) within float tolerance
+    and ``pallas_attention_pool``'s exact masking semantics.
+
+    ``off_se``/``off_p`` are the touched-rows optimizer's zero offset
+    tensors (``train/table_opt.py``): ZERO by that contract. The ``fused``
+    kernel does not read them in the forward (adding zeros is a no-op);
+    the backward differentiates w.r.t. them so the lazy optimizer's
+    per-slot gradients come out exactly as on the unfused path.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (tests and the CPU mesh run the same code path).
+    """
+    if impl not in FUSED_IMPLS:
+        raise ValueError(f"impl must be one of {FUSED_IMPLS}, got {impl!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    t_vals, t_scale, table_dtype = _split_table(t_table)
+    p_vals, p_scale, p_dtype = _split_table(p_table)
+    if table_dtype != p_dtype:
+        raise ValueError(
+            f"terminal/path tables must share a storage dtype, got "
+            f"{table_dtype!r} vs {p_dtype!r}"
+        )
+    if (off_se is None) != (off_p is None):
+        raise ValueError("off_se and off_p must be provided together")
+    _check_contract(starts, paths, ends, mask, dense_kernel, ln_scale,
+                    ln_bias, attn_param)
+    static = FusedStatic(
+        impl=impl,
+        block_b=max(int(block_b), 1),
+        dma_depth=max(int(dma_depth), 1),
+        chunk_l=int(chunk_l),
+        table_dtype=table_dtype,
+        compute=jnp.dtype(compute_dtype).name,
+        has_drop=drop_mask is not None,
+        has_off=off_se is not None,
+        interpret=bool(interpret),
+    )
+    args = (
+        t_vals, t_scale, p_vals, p_scale,
+        starts, paths, ends, mask.astype(jnp.float32),
+        dense_kernel, ln_scale, ln_bias, attn_param,
+        drop_mask, off_se, off_p,
+    )
+    return _op(static, args)
+
+
+def _split_table(table) -> tuple[jnp.ndarray, jnp.ndarray | None, str]:
+    if isinstance(table, QuantTable):
+        return table.values, table.scale, table.table_dtype
+    return table, None, "f32"
+
+
+def xla_reference_forward(
+    t_table, p_table, starts, paths, ends, mask, dense_kernel, ln_scale,
+    ln_bias, attn_param, drop_mask=None, off_se=None, off_p=None,
+    *, compute_dtype=jnp.float32,
+):
+    """Public unfused formulation of the same op (parity tests and the
+    autotuner's ``impl="xla"`` arm). Differentiable end to end."""
+    t_vals, t_scale, table_dtype = _split_table(t_table)
+    p_vals, p_scale, p_dtype = _split_table(p_table)
+    static = FusedStatic(
+        impl="xla", block_b=1, dma_depth=1, chunk_l=_LANE,
+        table_dtype=table_dtype, compute=jnp.dtype(compute_dtype).name,
+        has_drop=drop_mask is not None, has_off=off_se is not None,
+        interpret=True,
+    )
+    args = (
+        t_vals, t_scale, p_vals, p_scale,
+        starts, paths, ends, mask.astype(jnp.float32),
+        dense_kernel, ln_scale, ln_bias, attn_param,
+        drop_mask, off_se, off_p,
+    )
+    return _xla_reference(static, args)

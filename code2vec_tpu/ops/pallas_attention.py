@@ -106,6 +106,26 @@ def _forward(contexts, mask, attn_param, *, block_b: int, interpret: bool):
     return cv[:b], weights[:b, :bag]
 
 
+def compat_def_partition(p, *, partition, infer_sharding_from_operands,
+                         sharding_rule=None) -> None:
+    """``custom_partitioning.def_partition`` across jax versions.
+
+    ``sharding_rule`` (the Shardy einsum-like spec) only exists on newer
+    jax; 0.4.37's GSPMD partitioner needs only the infer/partition pair.
+    Probed by signature, not try/except — a TypeError raised *inside* a
+    user callback must not be misread as an unsupported kwarg."""
+    import inspect
+
+    kwargs = dict(
+        partition=partition,
+        infer_sharding_from_operands=infer_sharding_from_operands,
+    )
+    params = inspect.signature(type(p).def_partition).parameters
+    if sharding_rule is not None and "sharding_rule" in params:
+        kwargs["sharding_rule"] = sharding_rule
+    p.def_partition(**kwargs)
+
+
 _partitioned_forward_cache: dict = {}
 
 
@@ -151,7 +171,8 @@ def _get_partitioned_forward(block_b: int, interpret: bool):
             return mesh, fwd, out_shardings, arg_shardings
 
         p = custom_partitioning(fwd)
-        p.def_partition(
+        compat_def_partition(
+            p,
             partition=partition,
             infer_sharding_from_operands=infer_sharding,
             sharding_rule="b l e, b l, e -> b e, b l",

@@ -1,0 +1,121 @@
+"""Quantized embedding-table storage for serving/eval.
+
+The forward's dominant HBM traffic at serving time is the row gathers out
+of the two embedding tables (vocabs reach 360k+ rows, SURVEY.md §5.7);
+int8 storage cuts that traffic (and the table's HBM footprint) 4x, bf16
+2x. Production llama serving shards int8 tables the same way
+(SNIPPETS.md [3]). Quantization is a SERVING/EVAL feature: training keeps
+f32 master weights (the touched-rows optimizer already isolates table
+updates, train/table_opt.py), and the train loop + the step contract
+reject quantized tables outright — see ``train/loop.py`` and
+``train/step.py:STEP_STATE_CONTRACT``.
+
+Storage modes (``table_dtype``):
+
+- ``f32``  — no quantization (identity; the training layout);
+- ``bf16`` — values stored bfloat16, no scale;
+- ``int8`` — values stored int8 with one f32 scale per ROW
+  (``absmax/127`` symmetric), dequantized on load: ``row = q * scale``.
+  Per-row (not per-table) scales matter here because embedding rows are
+  independently distributed — a single table-wide scale would let one
+  hot row crush the resolution of every other.
+
+The gather-site dequant (:func:`dequantize_rows`) is the XLA formulation;
+the fused Pallas kernel (``ops/fused_encode_pool.py``) DMAs the int8 rows
++ their scales into VMEM and applies the same dequant in-register.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+TABLE_DTYPES = ("f32", "bf16", "int8")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantTable:
+    """A quantized ``[vocab, dim]`` embedding table.
+
+    ``values``: int8 or bf16 ``[V, E]``; ``scale``: f32 ``[V, 1]`` per-row
+    dequant scale for int8, ``None`` for bf16. A pytree, so it flows
+    through jit/vmap unchanged (``table_dtype`` rides along statically).
+    """
+
+    values: jnp.ndarray
+    scale: jnp.ndarray | None
+    table_dtype: str  # "bf16" | "int8" (static — part of the treedef)
+
+    def tree_flatten(self):
+        return (self.values, self.scale), self.table_dtype
+
+    @classmethod
+    def tree_unflatten(cls, table_dtype, children):
+        values, scale = children
+        return cls(values=values, scale=scale, table_dtype=table_dtype)
+
+    @property
+    def shape(self) -> tuple:
+        return self.values.shape
+
+    def nbytes(self) -> int:
+        n = self.values.size * self.values.dtype.itemsize
+        if self.scale is not None:
+            n += self.scale.size * self.scale.dtype.itemsize
+        return n
+
+
+def quantize_table(table: jnp.ndarray, table_dtype: str) -> QuantTable:
+    """f32 ``[V, E]`` master table -> quantized storage.
+
+    int8 is symmetric per-row absmax: ``scale = absmax/127``,
+    ``q = round(x/scale)`` (an all-zero row keeps scale 0 and dequantizes
+    to exact zeros — PAD row 0 stays bit-exact zero after round-trip when
+    the table's PAD row is zero).
+    """
+    if table_dtype == "bf16":
+        return QuantTable(
+            values=table.astype(jnp.bfloat16), scale=None, table_dtype="bf16"
+        )
+    if table_dtype == "int8":
+        absmax = jnp.max(jnp.abs(table.astype(jnp.float32)), axis=1, keepdims=True)
+        scale = absmax / 127.0
+        # guard the divide only — a zero row quantizes to zeros either way,
+        # and its STORED scale stays 0 so dequant returns exact zeros
+        q = jnp.round(table.astype(jnp.float32) / jnp.where(scale > 0, scale, 1.0))
+        values = jnp.clip(q, -127, 127).astype(jnp.int8)
+        return QuantTable(values=values, scale=scale, table_dtype="int8")
+    raise ValueError(
+        f"table_dtype must be one of {TABLE_DTYPES[1:]} to quantize, "
+        f"got {table_dtype!r}"
+    )
+
+
+def dequantize_rows(
+    qt: QuantTable, ids: jnp.ndarray, compute_dtype=jnp.float32
+) -> jnp.ndarray:
+    """Gather rows at ``ids`` and dequantize to ``compute_dtype`` —
+    the XLA serving lookup (the gather reads int8/bf16, the win)."""
+    rows = qt.values[ids]
+    if qt.scale is not None:
+        rows = rows.astype(jnp.float32) * qt.scale[ids]
+    return rows.astype(compute_dtype)
+
+
+def dequantize_table(qt: QuantTable, dtype=jnp.float32) -> jnp.ndarray:
+    """The full dequantized table (tests / error analysis)."""
+    vals = qt.values
+    if qt.scale is not None:
+        vals = vals.astype(jnp.float32) * qt.scale
+    return vals.astype(dtype)
+
+
+def maybe_quantize(table: jnp.ndarray, table_dtype: str):
+    """``table_dtype``-dispatch used by the model: "f32" passes the master
+    table through untouched; anything else returns a :class:`QuantTable`."""
+    if table_dtype == "f32":
+        return table
+    return quantize_table(table, table_dtype)

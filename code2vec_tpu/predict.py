@@ -55,6 +55,10 @@ def save_inference_meta(out_dir: str, config, model_config, data) -> None:
         "max_path_length": config.max_path_length,
         "infer_method_name": config.infer_method_name,
         "infer_variable_name": config.infer_variable_name,
+        # training is always f32 (train/loop.py rejects otherwise), so this
+        # records the DEFAULT serving storage; the Predictor can override
+        # per deployment (--table_dtype int8 for the bandwidth-lean tier)
+        "table_dtype": getattr(config, "table_dtype", "f32"),
     }
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, MODEL_META), "w", encoding="utf-8") as f:
@@ -124,7 +128,13 @@ class Predictor:
         model_path: str,
         terminal_idx_path: str,
         path_idx_path: str,
+        table_dtype: str | None = None,
     ) -> None:
+        """``table_dtype``: embedding-table storage for the serving forward
+        (``f32``/``bf16``/``int8`` — ops/quant.py). ``None`` follows the
+        checkpoint's ``model_meta.json`` (itself ``f32`` unless edited for
+        a deployment). Quantization happens ONCE here at load; the jitted
+        forward then gathers through the pre-quantized tables."""
         import jax
 
         from code2vec_tpu.checkpoint import restore_checkpoint
@@ -160,6 +170,7 @@ class Predictor:
             os.path.join(os.path.dirname(os.path.abspath(path_idx_path)),
                          "params.txt")
         )
+        self.table_dtype = table_dtype or meta.get("table_dtype", "f32")
         model_config = Code2VecConfig(
             terminal_count=meta["terminal_count"],
             path_count=meta["path_count"],
@@ -172,6 +183,7 @@ class Predictor:
             angular_margin=meta["angular_margin"],
             inverse_temp=meta["inverse_temp"],
             vocab_pad_multiple=meta.get("vocab_pad_multiple", 1) or 1,
+            table_dtype=self.table_dtype,
         )
         config = TrainConfig(
             batch_size=1, max_path_length=self.bag,
@@ -202,14 +214,35 @@ class Predictor:
             raise FileNotFoundError(f"no checkpoint found under {model_path}")
         self.state = restored[0]
 
+        # quantize the restored f32 master tables ONCE for the serving
+        # forward — the per-call path then gathers int8/bf16 rows + scales
+        # (and never reads the f32 master again)
+        self._quant_tables = None
+        if self.table_dtype != "f32":
+            from code2vec_tpu.ops.quant import quantize_table
+
+            params = self.state.params
+            self._quant_tables = (
+                quantize_table(
+                    params["terminal_embedding"]["embedding"], self.table_dtype
+                ),
+                quantize_table(
+                    params["path_embedding"]["embedding"], self.table_dtype
+                ),
+            )
+            logger.info("serving with %s-quantized tables", self.table_dtype)
+
         # the training eval step deliberately omits full logits (they would
         # be [B, labels] of device->host traffic per batch); inference
         # wants them, so jit a dedicated forward
+        quant_tables = self._quant_tables
+
         def forward(state, batch):
             logits, code_vector, attention = state.apply_fn(
                 {"params": state.params},
                 batch["starts"], batch["paths"], batch["ends"],
                 labels=None, deterministic=True,
+                quant_tables=quant_tables,
             )
             return logits, code_vector, attention
 
@@ -468,6 +501,12 @@ def main(argv: list[str] | None = None) -> None:
     )
     parser.add_argument("--top_k", type=int, default=5)
     parser.add_argument(
+        "--table_dtype", default=None, choices=("f32", "bf16", "int8"),
+        help="embedding-table storage for the serving forward (int8 = "
+        "per-row scale, dequant on load; 4x less gather bandwidth). "
+        "Default: the checkpoint's model_meta.json (f32 unless edited)",
+    )
+    parser.add_argument(
         "--show_attention", type=int, default=0, metavar="N",
         help="also print the N highest-attention path-contexts per method",
     )
@@ -518,7 +557,8 @@ def main(argv: list[str] | None = None) -> None:
         neighbor_index = (nn_labels, nn_rows, np.linalg.norm(nn_rows, axis=1))
 
     predictor = Predictor(
-        args.model_path, args.terminal_idx_path, args.path_idx_path
+        args.model_path, args.terminal_idx_path, args.path_idx_path,
+        table_dtype=args.table_dtype,
     )
     with open(args.source_file, encoding="utf-8") as f:
         source = f.read()

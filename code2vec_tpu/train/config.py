@@ -55,8 +55,24 @@ class TrainConfig:
     data_axis: int = 1  # mesh parallelism, see code2vec_tpu.parallel
     model_axis: int = 1
     context_axis: int = 1
-    use_pallas: bool = False  # fused attention-pooling kernel on TPU
+    use_pallas: bool = False  # Pallas kernels on the hot path (ops/)
     pallas_block_b: int = 8  # the kernel's batch-tile size
+    # which Pallas kernel serves the forward (ops/fused_encode_pool.py):
+    # "pool_only" = fuse only score->softmax->pool (the original kernel);
+    # "gather_split" = XLA gathers rows, kernel fuses encode->attend->pool;
+    # "fused" = in-kernel DMA gather too — the full chain in VMEM;
+    # "auto" = consult the autotuned schedule cache (ops/autotune.py) per
+    # traced (batch, width) shape — zero search at trace time
+    pallas_impl: str = "pool_only"
+    pallas_dma_depth: int = 2  # fused-impl gather double-buffer slots
+    pallas_chunk_l: int = 128  # fused-impl bag-chunk lane tile
+    # embedding-table storage dtype for SERVING/EVAL forwards: f32 (train
+    # master weights; the only dtype train() accepts) | bf16 | int8 (per-row
+    # scale, dequant on load — ops/quant.py). Export/predict accept it.
+    table_dtype: str = "f32"
+    # kernel-schedule cache path ("" = $C2V_AUTOTUNE_CACHE or
+    # ~/.cache/code2vec_tpu/autotune_schedules.json)
+    autotune_cache: str = ""
     attn_impl: str = "xla"  # attention-pool lowering: "xla" | "streaming"
     encoder_impl: str = "concat"  # context-encoder lowering: "concat" | "split"
     # device-epoch train chunks sample batch i+1 while stepping on batch i

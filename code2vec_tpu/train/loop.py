@@ -284,6 +284,10 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
         dtype=jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32,
         use_pallas=config.use_pallas,
         pallas_block_b=config.pallas_block_b,
+        pallas_impl=config.pallas_impl,
+        pallas_dma_depth=config.pallas_dma_depth,
+        pallas_chunk_l=config.pallas_chunk_l,
+        table_dtype=config.table_dtype,
         attn_impl=config.attn_impl,
         encoder_impl=config.encoder_impl,
         embed_grad=config.embed_grad,
@@ -317,14 +321,17 @@ def build_mesh(config: TrainConfig):
     from code2vec_tpu.parallel.mesh import make_mesh
 
     if config.use_pallas and config.context_axis > 1:
-        # batch/model sharding composes with the kernel (it carries a
-        # custom_partitioning rule that shards the batch dim), but a
+        # batch/model sharding composes with the kernels (they carry
+        # custom_partitioning rules that shard the batch dim), but a
         # ctx-sharded bag needs the streaming-softmax decomposition
-        # (parallel.context) which the fused kernel doesn't implement
+        # (parallel.context) which none of the Pallas kernels implement
         raise ValueError(
-            "use_pallas with context_axis > 1 is not supported: the "
-            "fused kernel pools the whole bag per device; use the XLA "
-            "path (default) for context parallelism"
+            "use_pallas with context_axis > 1 is not supported: every "
+            "Pallas kernel variant (--pallas_impl pool_only | gather_split "
+            "| fused | auto) pools the whole bag per device; drop "
+            "--use_pallas (and its --pallas_impl/--pallas_block_b/"
+            "--pallas_dma_depth knobs) to use the XLA path (default) for "
+            "context parallelism"
         )
     if config.batch_size % config.data_axis:
         raise ValueError(
@@ -504,6 +511,25 @@ def train(
             f"infer_variable={data.infer_variable}; pass matching flags to "
             "load_corpus"
         )
+
+    # quantized tables are a serving/eval storage mode: training updates
+    # f32 master weights only (the step contract enforces the same at
+    # trace time — train/step.py:STEP_STATE_CONTRACT). export_only /
+    # predict accept --table_dtype; the TRAIN loop never does.
+    if config.table_dtype != "f32":
+        raise ValueError(
+            f"table_dtype={config.table_dtype!r} is not trainable: "
+            "quantized (int8/bf16) tables serve eval/predict/export "
+            "forwards; training keeps f32 master weights (the touched-rows "
+            "optimizer isolates table updates). Drop --table_dtype for "
+            "training, or pass it to predict/--export_only"
+        )
+    # pin the schedule cache for this process before any step traces so a
+    # --pallas_impl auto run consults the configured file at trace time
+    if config.autotune_cache:
+        from code2vec_tpu.ops.autotune import get_cache
+
+        get_cache(config.autotune_cache)
 
     if events is None:
         events = EventLog()  # dispatch-only: sinks still ride the stream

@@ -34,7 +34,17 @@ from code2vec_tpu.train.config import TrainConfig
 # TRACE TIME with an attributable error instead of silently recompiling.
 # Symbols bind per trace: bucketed runs validate each ladder width's
 # [B, L_b] trace independently.
-STEP_STATE_CONTRACT = {"step": spec("", jnp.int32)}
+STEP_STATE_CONTRACT = {
+    "step": spec("", jnp.int32),
+    # training master weights are f32, full stop: quantized (int8/bf16)
+    # tables are a SERVING/EVAL storage mode (ops/quant.py) — an optimizer
+    # step over quantized storage would silently train on dequant noise,
+    # so the contract rejects it at trace time on every step path
+    "params": {
+        "terminal_embedding": {"embedding": spec(None, jnp.float32)},
+        "path_embedding": {"embedding": spec(None, jnp.float32)},
+    },
+}
 STEP_BATCH_CONTRACT = {
     "starts": spec("B,L", "int"),
     "paths": spec("B,L", "int"),
@@ -289,10 +299,16 @@ def build_train_step_fn(
 def build_eval_step_fn(
     model_config: Code2VecConfig,
     class_weights: jnp.ndarray,
+    quant_tables: tuple | None = None,
 ):
     """Raw eval step: batch-mean loss (the reference accumulates per-batch
     means, main.py:283-284), argmax predictions, and the max logit (what the
-    reference reports as the prediction 'prob', main.py:411)."""
+    reference reports as the prediction 'prob', main.py:411).
+
+    ``quant_tables``: pre-quantized ``(terminal, path)`` QuantTable pair for
+    ``table_dtype != "f32"`` configs — quantize ONCE at the call site
+    (export/serving) instead of re-deriving the quantized storage from the
+    f32 master inside every traced eval call."""
 
     needs_labels = model_config.angular_margin_loss
 
@@ -304,6 +320,7 @@ def build_eval_step_fn(
             batch["ends"],
             labels=batch["labels"] if needs_labels else None,
             deterministic=True,
+            quant_tables=quant_tables,
         )
         loss = weighted_nll(
             logits, batch["labels"], class_weights, batch["example_mask"]
@@ -335,8 +352,14 @@ def make_train_step(
     )
 
 
-def make_eval_step(model_config: Code2VecConfig, class_weights: jnp.ndarray):
+def make_eval_step(
+    model_config: Code2VecConfig,
+    class_weights: jnp.ndarray,
+    quant_tables: tuple | None = None,
+):
     """Single-device jitted eval step (contract-checked at trace time)."""
     return jax.jit(
-        contract_step(build_eval_step_fn(model_config, class_weights))
+        contract_step(
+            build_eval_step_fn(model_config, class_weights, quant_tables)
+        )
     )

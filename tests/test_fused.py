@@ -1,0 +1,705 @@
+"""Fused gather→encode→attend→pool kernel, quantized tables, autotuner.
+
+Everything runs in Pallas interpreter mode on CPU (the same code path the
+TPU compiles); parity is always against the unfused XLA formulation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.ops.fused_encode_pool import (
+    fused_encode_attend_pool,
+    xla_reference_forward,
+)
+from code2vec_tpu.ops.quant import (
+    QuantTable,
+    dequantize_table,
+    quantize_table,
+)
+
+# the ladder the parity matrix sweeps: small enough for the interpreter,
+# shaped like a real bucket ladder (several rungs below the top width)
+LADDER = (8, 24, 56)
+
+
+def op_inputs(B, L, Et=6, Ep=5, H=12, seed=0, all_masked_row=None):
+    rng = np.random.default_rng(seed)
+    Vt, Vp = 37, 29
+    tt = jnp.asarray(rng.normal(size=(Vt, Et)).astype(np.float32))
+    pt = jnp.asarray(rng.normal(size=(Vp, Ep)).astype(np.float32))
+    starts = rng.integers(1, Vt, (B, L)).astype(np.int32)
+    mask = (rng.random((B, L)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0
+    if all_masked_row is not None:
+        mask[all_masked_row, :] = 0.0
+    return dict(
+        t_table=tt,
+        p_table=pt,
+        starts=jnp.asarray(starts),
+        paths=jnp.asarray(rng.integers(1, Vp, (B, L)).astype(np.int32)),
+        ends=jnp.asarray(rng.integers(1, Vt, (B, L)).astype(np.int32)),
+        mask=jnp.asarray(mask),
+        dense_kernel=jnp.asarray(
+            rng.normal(size=(2 * Et + Ep, H)).astype(np.float32) * 0.1
+        ),
+        ln_scale=jnp.asarray(1.0 + 0.1 * rng.normal(size=H).astype(np.float32)),
+        ln_bias=jnp.asarray(0.1 * rng.normal(size=H).astype(np.float32)),
+        attn_param=jnp.asarray(rng.normal(size=H).astype(np.float32)),
+    )
+
+
+def call(inp, **kw):
+    return fused_encode_attend_pool(
+        inp["t_table"], inp["p_table"], inp["starts"], inp["paths"],
+        inp["ends"], inp["mask"], inp["dense_kernel"], inp["ln_scale"],
+        inp["ln_bias"], inp["attn_param"], **kw,
+    )
+
+
+def reference(inp, **kw):
+    return xla_reference_forward(
+        inp["t_table"], inp["p_table"], inp["starts"], inp["paths"],
+        inp["ends"], inp["mask"], inp["dense_kernel"], inp["ln_scale"],
+        inp["ln_bias"], inp["attn_param"], **kw,
+    )
+
+
+class TestOpParity:
+    """Acceptance matrix: every ladder width × {partial, full} batch ×
+    both kernel impls matches the unfused XLA path."""
+
+    @pytest.mark.parametrize("width", LADDER)
+    @pytest.mark.parametrize("batch", [3, 8])  # 3 = partial block_b tile
+    @pytest.mark.parametrize("impl", ["gather_split", "fused"])
+    def test_matches_xla(self, width, batch, impl):
+        inp = op_inputs(batch, width, seed=width * 100 + batch)
+        cv_ref, w_ref = reference(inp)
+        cv, w = call(inp, impl=impl, block_b=4, dma_depth=2)
+        np.testing.assert_allclose(
+            np.asarray(cv), np.asarray(cv_ref), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(w_ref), rtol=1e-5, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("impl", ["gather_split", "fused"])
+    def test_all_masked_row_degenerates_like_xla(self, impl):
+        # the fully-masked row must softmax uniformly over the REAL bag
+        # length (pallas_attention_pool's exact semantics), not the padded
+        inp = op_inputs(5, 21, seed=7, all_masked_row=2)
+        cv_ref, w_ref = reference(inp)
+        cv, w = call(inp, impl=impl, block_b=4)
+        np.testing.assert_allclose(
+            np.asarray(w[2]), np.asarray(w_ref[2]), rtol=1e-5
+        )
+        np.testing.assert_allclose(float(w[2].sum()), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(cv[2]), np.asarray(cv_ref[2]), rtol=1e-4, atol=1e-5
+        )
+
+    def test_dma_depth_and_chunk_variants_agree(self):
+        # schedule knobs change the pipeline, never the math
+        inp = op_inputs(6, 40, seed=3)
+        base = call(inp, impl="fused", block_b=4, dma_depth=2)
+        for depth, chunk in ((1, 128), (3, 128), (2, 64)):
+            cv, w = call(
+                inp, impl="fused", block_b=4, dma_depth=depth, chunk_l=chunk
+            )
+            np.testing.assert_allclose(
+                np.asarray(cv), np.asarray(base[0]), rtol=1e-6, atol=1e-6
+            )
+
+    def test_grads_exact_to_unfused(self):
+        inp = op_inputs(4, 17, seed=11)
+        names = ("t_table", "p_table", "dense_kernel", "ln_scale", "ln_bias",
+                 "attn_param")
+
+        def loss(fn):
+            def inner(*diff):
+                d = dict(inp, **dict(zip(names, diff)))
+                cv, w = fn(d)
+                return jnp.sum(cv**2) + jnp.sum(w * jnp.cos(w))
+
+            return inner
+
+        args = tuple(inp[n] for n in names)
+        g_ref = jax.grad(loss(reference), argnums=tuple(range(6)))(*args)
+        g_fused = jax.grad(
+            loss(lambda d: call(d, impl="fused", block_b=4)),
+            argnums=tuple(range(6)),
+        )(*args)
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            )
+
+    def test_offset_grads_match_reference(self):
+        # the lazy touched-rows optimizer differentiates w.r.t. zero offset
+        # tensors; the fused backward must hand back identical per-slot grads
+        inp = op_inputs(3, 9, seed=13)
+        off = (
+            jnp.zeros((3, 18, 6), jnp.float32),
+            jnp.zeros((3, 9, 5), jnp.float32),
+        )
+
+        g1 = jax.grad(
+            lambda o: jnp.sum(
+                call(inp, off_se=o[0], off_p=o[1], impl="fused", block_b=4)[0]
+                ** 2
+            )
+        )(off)
+        g2 = jax.grad(
+            lambda o: jnp.sum(reference(inp, off_se=o[0], off_p=o[1])[0] ** 2)
+        )(off)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            )
+
+
+class TestQuantTables:
+    def test_int8_round_trip_error_bounded(self):
+        rng = np.random.default_rng(0)
+        table = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32))
+        qt = quantize_table(table, "int8")
+        assert qt.values.dtype == jnp.int8
+        back = np.asarray(dequantize_table(qt))
+        # symmetric per-row absmax: max error is half a quant step per row
+        step = np.abs(np.asarray(table)).max(axis=1, keepdims=True) / 127.0
+        assert (np.abs(back - np.asarray(table)) <= step * 0.5 + 1e-7).all()
+
+    def test_zero_row_stays_exact_zero(self):
+        table = jnp.zeros((4, 8), jnp.float32).at[1].set(1.5)
+        qt = quantize_table(table, "int8")
+        assert np.asarray(dequantize_table(qt))[0].sum() == 0.0
+
+    def test_quant_table_is_pytree(self):
+        qt = quantize_table(jnp.ones((4, 8)), "int8")
+        mapped = jax.tree.map(lambda x: x, qt)
+        assert isinstance(mapped, QuantTable) and mapped.table_dtype == "int8"
+
+    @pytest.mark.parametrize("impl", ["gather_split", "fused"])
+    def test_kernel_dequant_matches_xla_dequant(self, impl):
+        inp = op_inputs(4, 20, seed=5)
+        qinp = dict(
+            inp,
+            t_table=quantize_table(inp["t_table"], "int8"),
+            p_table=quantize_table(inp["p_table"], "int8"),
+        )
+        cv_ref, w_ref = reference(qinp)
+        cv, w = call(qinp, impl=impl, block_b=4)
+        np.testing.assert_allclose(
+            np.asarray(cv), np.asarray(cv_ref), rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(w_ref), rtol=1e-4, atol=1e-5
+        )
+
+
+def model_fixture(B=6, L=14, dropout=0.0, **cfg_kw):
+    rng = np.random.default_rng(0)
+    base = dict(
+        terminal_count=50, path_count=40, label_count=9,
+        terminal_embed_size=8, path_embed_size=6, encode_size=16,
+        dropout_prob=dropout,
+    )
+    batch = dict(
+        starts=jnp.asarray(rng.integers(1, 50, (B, L)).astype(np.int32)),
+        paths=jnp.asarray(rng.integers(1, 40, (B, L)).astype(np.int32)),
+        ends=jnp.asarray(rng.integers(1, 50, (B, L)).astype(np.int32)),
+    )
+    batch["starts"] = batch["starts"].at[:, L // 2 :].set(0)
+    model = Code2Vec(Code2VecConfig(**base, **cfg_kw))
+    ref = Code2Vec(Code2VecConfig(**base))
+    params = ref.init(
+        {"params": jax.random.PRNGKey(0)},
+        batch["starts"], batch["paths"], batch["ends"],
+    )["params"]
+    return model, ref, params, batch
+
+
+class TestModelDispatch:
+    @pytest.mark.parametrize("impl", ["pool_only", "gather_split", "fused"])
+    def test_param_tree_identical_and_forward_matches(self, impl):
+        model, ref, params, batch = model_fixture(
+            use_pallas=True, pallas_impl=impl, pallas_block_b=4
+        )
+        own = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            batch["starts"], batch["paths"], batch["ends"],
+        )["params"]
+        assert jax.tree.structure(own) == jax.tree.structure(params)
+        for a, b in zip(jax.tree.leaves(own), jax.tree.leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        out = model.apply(
+            {"params": params}, batch["starts"], batch["paths"], batch["ends"]
+        )
+        out_ref = ref.apply(
+            {"params": params}, batch["starts"], batch["paths"], batch["ends"]
+        )
+        for a, b in zip(out, out_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    def test_unknown_pallas_impl_fails_loudly(self):
+        model, _, params, batch = model_fixture(
+            use_pallas=True, pallas_impl="typo"
+        )
+        with pytest.raises(ValueError, match="pallas_impl"):
+            model.apply(
+                {"params": params}, batch["starts"], batch["paths"],
+                batch["ends"],
+            )
+
+    @pytest.mark.parametrize("table_dtype", ["bf16", "int8"])
+    def test_quantized_forward_agreement_thresholds(self, table_dtype):
+        model, ref, params, batch = model_fixture(table_dtype=table_dtype)
+        logits, cv, _ = model.apply(
+            {"params": params}, batch["starts"], batch["paths"], batch["ends"]
+        )
+        logits_ref, cv_ref, _ = ref.apply(
+            {"params": params}, batch["starts"], batch["paths"], batch["ends"]
+        )
+        cv, cv_ref = np.asarray(cv), np.asarray(cv_ref)
+        cos = (cv * cv_ref).sum(-1) / (
+            np.linalg.norm(cv, axis=-1) * np.linalg.norm(cv_ref, axis=-1)
+        )
+        assert cos.min() > 0.99, f"cosine {cos.min()}"
+        agree = (
+            np.argmax(np.asarray(logits), -1)
+            == np.argmax(np.asarray(logits_ref), -1)
+        ).mean()
+        assert agree >= 0.9, f"top-1 agreement {agree}"
+
+    def test_fused_training_step_runs_dense_and_lazy(self):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state, make_train_step
+
+        rng = np.random.default_rng(1)
+        B, L = 6, 14
+        model, _, params, batch = model_fixture(
+            B, L, dropout=0.25, use_pallas=True, pallas_impl="fused",
+            pallas_block_b=4,
+        )
+        full = dict(
+            {k: np.asarray(v) for k, v in batch.items()},
+            labels=rng.integers(0, 9, B).astype(np.int32),
+            example_mask=np.ones(B, np.float32),
+            ids=np.arange(B, dtype=np.int64),
+        )
+        cw = jnp.ones(9, jnp.float32)
+        for table_update in ("dense", "lazy"):
+            tc = TrainConfig(
+                batch_size=B, max_path_length=L, table_update=table_update
+            )
+            st = create_train_state(
+                tc, model.config, jax.random.PRNGKey(0), full
+            )
+            step = make_train_step(model.config, cw, table_update)
+            st, l1 = step(st, full)
+            st, l2 = step(st, full)
+            assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+            assert float(l2) != float(l1)  # it actually learned something
+
+
+class TestFusedEndToEnd:
+    def test_training_with_fused_device_epoch(self, tmp_path):
+        """The fused kernel inside the scanned device-epoch chunk (donated
+        state, lax.scan) — the configuration the TPU benchmark exercises
+        with BENCH_USE_PALLAS=1 BENCH_PALLAS_IMPL=fused."""
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"]
+        )
+        cfg = TrainConfig(
+            max_epoch=1, batch_size=32, encode_size=16,
+            terminal_embed_size=8, path_embed_size=8, max_path_length=16,
+            print_sample_cycle=0, use_pallas=True, pallas_impl="fused",
+            pallas_block_b=8, device_epoch=True, device_chunk_batches=2,
+        )
+        res = train(cfg, data)
+        assert np.isfinite(res.history[-1]["train_loss"])
+
+
+class TestFusedOnMesh:
+    """The fused kernels composed with data/model mesh axes: the op's
+    custom_partitioning rule shards the batch dim instead of replicating
+    the Mosaic call behind an all-gather (same contract as
+    TestPallasOnMesh for the pool-only kernel)."""
+
+    @pytest.mark.parametrize("impl", ["gather_split", "fused"])
+    def test_matches_xla_path_on_mesh(self, impl):
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_batch, shard_state
+        from code2vec_tpu.parallel.step import make_parallel_train_step
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state
+
+        mesh = make_mesh(data=4, model=2, ctx=1)
+        rng = np.random.default_rng(0)
+        B, L = 16, 24
+        base = dict(
+            terminal_count=60, path_count=50, label_count=9,
+            terminal_embed_size=8, path_embed_size=8, encode_size=16,
+            dropout_prob=0.0,
+        )
+        batch = {
+            "ids": np.arange(B, dtype=np.int64),
+            "starts": rng.integers(1, 60, (B, L)).astype(np.int32),
+            "paths": rng.integers(1, 50, (B, L)).astype(np.int32),
+            "ends": rng.integers(1, 60, (B, L)).astype(np.int32),
+            "labels": rng.integers(0, 9, B).astype(np.int32),
+            "example_mask": np.ones(B, np.float32),
+        }
+        batch["starts"][:, L // 2 :] = 0
+
+        losses = {}
+        for use_fused in (False, True):
+            mc = Code2VecConfig(
+                **base,
+                use_pallas=use_fused,
+                pallas_impl=impl,
+                pallas_block_b=4,
+            )
+            tc = TrainConfig(batch_size=B, max_path_length=L)
+            state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+            state = shard_state(mesh, state)
+            cw = jnp.ones(mc.label_count, jnp.float32)
+            step = make_parallel_train_step(mc, cw, mesh, state)
+            device_batch = shard_batch(mesh, batch)
+            state, loss = step(state, device_batch)
+            state, loss2 = step(state, device_batch)
+            losses[use_fused] = (float(loss), float(loss2))
+        np.testing.assert_allclose(losses[False], losses[True], rtol=2e-5)
+
+
+class TestTrainingRejectsQuantized:
+    def test_train_rejects_table_dtype(self, tmp_path):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        paths = generate_corpus_files(tmp_path, SPECS["tiny"])
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"]
+        )
+        cfg = TrainConfig(table_dtype="int8", max_epoch=1)
+        with pytest.raises(ValueError, match="not trainable"):
+            train(cfg, data)
+
+    def test_step_contract_rejects_quantized_master_weights(self):
+        # the trace-time pincer: even a hand-built state with non-f32
+        # tables must fail at the step contract, not train on dequant noise
+        from code2vec_tpu.analysis.contracts import ContractError
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state, make_train_step
+
+        rng = np.random.default_rng(0)
+        B, L = 4, 8
+        model, _, params, batch = model_fixture(B, L)
+        full = dict(
+            {k: np.asarray(v) for k, v in batch.items()},
+            labels=rng.integers(0, 9, B).astype(np.int32),
+            example_mask=np.ones(B, np.float32),
+            ids=np.arange(B, dtype=np.int64),
+        )
+        tc = TrainConfig(batch_size=B, max_path_length=L)
+        st = create_train_state(tc, model.config, jax.random.PRNGKey(0), full)
+        bad_params = dict(st.params)
+        bad_params["terminal_embedding"] = {
+            "embedding": st.params["terminal_embedding"]["embedding"].astype(
+                jnp.bfloat16
+            )
+        }
+        st = st.replace(params=bad_params)
+        step = make_train_step(model.config, jnp.ones(9, jnp.float32))
+        with pytest.raises(ContractError, match="float32"):
+            step(st, full)
+
+    def test_ctx_axis_error_names_fused_kernel_flags(self):
+        # regression for the error path: the message must steer users of
+        # the NEW kernel flags too, not just --use_pallas
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import build_mesh
+
+        cfg = TrainConfig(use_pallas=True, context_axis=2, batch_size=32)
+        with pytest.raises(ValueError, match="pallas_impl") as exc:
+            build_mesh(cfg)
+        msg = str(exc.value)
+        assert "use_pallas with context_axis" in msg
+        for flag in ("pool_only", "gather_split", "fused", "pallas_dma_depth"):
+            assert flag in msg
+
+
+class TestAutotune:
+    def _keys(self, at, widths=(8, 16), dtypes=("f32",)):
+        return at.keys_for(4, list(widths), 6, 5, 12, list(dtypes))
+
+    def test_dry_round_trip_zero_search_on_second_run(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        cache = at.ScheduleCache(str(tmp_path / "sched.json"))
+        before = at.counters_snapshot()
+        at.autotune(self._keys(at), cache=cache, dry=True)
+        mid = at.counters_snapshot()
+        assert mid["autotune_cache_miss"] - before["autotune_cache_miss"] == 2
+        assert mid["autotune_schedule_stored"] - before["autotune_schedule_stored"] == 2
+
+        # a FRESH cache object re-reads the persisted file: zero timing
+        # runs, every schedule loads from disk
+        cache2 = at.ScheduleCache(str(tmp_path / "sched.json"))
+        out = at.autotune(self._keys(at), cache=cache2, dry=True)
+        after = at.counters_snapshot()
+        assert after["autotune_cache_hit"] - mid["autotune_cache_hit"] == 2
+        assert after["autotune_cache_miss"] == mid["autotune_cache_miss"]
+        assert after["autotune_timing_run"] == mid["autotune_timing_run"]
+        assert all(s.source == "cache" for s in out.values())
+
+    def test_timed_autotune_picks_a_winner_and_persists(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        cache = at.ScheduleCache(str(tmp_path / "sched.json"))
+        keys = at.keys_for(4, [8], 4, 4, 8, ["f32"])
+        before = at.counters_snapshot()
+        out = at.autotune(cache=cache, keys=keys, iters=1, repeats=1, vocab=64)
+        after = at.counters_snapshot()
+        assert after["autotune_timing_run"] > before["autotune_timing_run"]
+        (sched,) = out.values()
+        assert sched.impl in at.IMPLS and sched.source == "autotune"
+        entry = json.load(open(cache.path))["entries"]
+        (stored,) = entry.values()
+        assert stored["schedule"]["impl"] == sched.impl
+        assert stored["timings_ms"]  # provenance: per-variant timings kept
+
+    def test_lookup_schedule_miss_falls_back_without_search(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        cache = at.ScheduleCache(str(tmp_path / "empty.json"))
+        before = at.counters_snapshot()
+        sched = at.lookup_schedule(4, 99, 6, 5, 12, cache=cache)
+        after = at.counters_snapshot()
+        assert sched.impl == "pool_only" and sched.source == "default"
+        assert after["autotune_cache_miss"] == before["autotune_cache_miss"] + 1
+        assert after["autotune_timing_run"] == before["autotune_timing_run"]
+
+    def test_corrupt_cache_is_empty_not_fatal(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        p = tmp_path / "bad.json"
+        p.write_text("{corrupt")
+        cache = at.ScheduleCache(str(p))
+        assert cache.entries == {}
+
+    def test_cli_dry_smoke_and_expect_cached(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        argv = [
+            "--autotune", "--dry", "--cache", str(tmp_path / "c.json"),
+            "--batch", "4", "--widths", "8", "--terminal-embed", "4",
+            "--path-embed", "4", "--encode", "8",
+        ]
+        assert at.main(argv) == 0
+        # second identical run: everything cached — --expect-cached passes
+        assert at.main(argv + ["--expect-cached"]) == 0
+        # a new shape under --expect-cached must fail loudly
+        assert (
+            at.main(
+                [a if a != "8" else "16" for a in argv] + ["--expect-cached"]
+            )
+            == 2
+        )
+
+    def test_model_auto_impl_consults_cache_at_trace_time(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        model, ref, params, batch = model_fixture(
+            use_pallas=True, pallas_impl="auto", pallas_block_b=4
+        )
+        b, l = np.asarray(batch["starts"]).shape
+        cache = at.get_cache(str(tmp_path / "model.json"))
+        key = at.ShapeKey(
+            device_kind=at.device_kind(), batch=b, width=l,
+            terminal_embed=8, path_embed=6, encode=16, table_dtype="f32",
+        )
+        cache.put(key, at.KernelSchedule(impl="gather_split", block_b=4))
+        cache.save()
+        try:
+            before = at.counters_snapshot()
+            out = jax.jit(
+                lambda p, bt: model.apply(
+                    {"params": p}, bt["starts"], bt["paths"], bt["ends"]
+                )
+            )(params, batch)
+            after = at.counters_snapshot()
+            # the trace consulted the cache exactly once and used its winner
+            assert after["autotune_cache_hit"] == before["autotune_cache_hit"] + 1
+            out_ref = ref.apply(
+                {"params": params}, batch["starts"], batch["paths"],
+                batch["ends"],
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[1]), np.asarray(out_ref[1]), rtol=1e-4,
+                atol=1e-5,
+            )
+        finally:
+            at.reset_cache()
+
+
+class TestQuantizedServingRoundTrip:
+    @pytest.fixture(scope="class")
+    def trained_model_dir(self, tmp_path_factory):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        root = tmp_path_factory.mktemp("quant_rt")
+        paths = generate_corpus_files(root, SPECS["tiny"])
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"]
+        )
+        out_dir = str(root / "model")
+        cfg = TrainConfig(
+            max_epoch=2, batch_size=32, encode_size=16,
+            terminal_embed_size=8, path_embed_size=8, max_path_length=16,
+            print_sample_cycle=0,
+        )
+        train(cfg, data, out_dir=out_dir, vectors_path=str(root / "code.vec"))
+        return root, paths, out_dir
+
+    @pytest.mark.parametrize("table_dtype", ["bf16", "int8"])
+    def test_export_predict_round_trip(self, trained_model_dir, table_dtype):
+        # train → checkpoint+meta → quantized Predictor: the quantized
+        # serving forward must agree with the f32 one on real contexts
+        from code2vec_tpu.predict import Predictor
+
+        root, paths, out_dir = trained_model_dir
+        f32 = Predictor(
+            out_dir, str(paths["terminal_idx"]), str(paths["path_idx"])
+        )
+        q = Predictor(
+            out_dir, str(paths["terminal_idx"]), str(paths["path_idx"]),
+            table_dtype=table_dtype,
+        )
+        assert q.table_dtype == table_dtype
+        assert q._quant_tables is not None
+        rng = np.random.default_rng(0)
+        contexts = [
+            (int(s), int(p), int(e))
+            for s, p, e in zip(
+                rng.integers(2, 20, 12), rng.integers(1, 15, 12),
+                rng.integers(2, 20, 12),
+            )
+        ]
+        pf = f32._predict_contexts("m", list(contexts), 0, top_k=3, rng=None)
+        pq = q._predict_contexts("m", list(contexts), 0, top_k=3, rng=None)
+        # top-1 must agree; probabilities within quantization tolerance
+        assert pf.predictions[0].name == pq.predictions[0].name
+        assert abs(pf.predictions[0].prob - pq.predictions[0].prob) < 0.05
+        cos = float(
+            np.dot(pf.code_vector, pq.code_vector)
+            / (np.linalg.norm(pf.code_vector) * np.linalg.norm(pq.code_vector))
+        )
+        assert cos > 0.99
+
+    def test_export_only_accepts_quantized(self, trained_model_dir):
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.export import export_from_checkpoint
+        from code2vec_tpu.train.config import TrainConfig
+
+        root, paths, out_dir = trained_model_dir
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"]
+        )
+        cfg = TrainConfig(
+            max_epoch=2, batch_size=32, encode_size=16,
+            terminal_embed_size=8, path_embed_size=8, max_path_length=16,
+            table_dtype="int8",
+        )
+        vec = str(root / "code_int8.vec")
+        f1 = export_from_checkpoint(cfg, data, out_dir, vec)
+        assert os.path.exists(vec)
+        assert np.isfinite(f1)
+
+
+class TestBenchKernelAB:
+    def test_metric_id(self):
+        import importlib.util
+
+        bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        spec = importlib.util.spec_from_file_location("_bench_kab", bench_path)
+        bench = importlib.util.module_from_spec(spec)
+        old = sys.argv
+        try:
+            sys.argv = ["bench.py", "--kernel-ab"]
+            spec.loader.exec_module(bench)
+            assert bench._metric_id() == (
+                "fused_kernel_real_contexts_per_sec", "contexts/sec"
+            )
+        finally:
+            sys.argv = old
+
+    def test_end_to_end_cpu_interpret_record(self, tmp_path):
+        # --kernel-ab on CPU: an HONEST interpret-mode record, not a crash.
+        # Second invocation with the same shapes: zero autotune timing runs
+        # (every schedule from the persisted cache).
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            BENCH_SUPERVISED="1",
+            BENCH_BATCH="8",
+            BENCH_BAG="16",
+            BENCH_AB_STEPS="2",
+            BENCH_EMBED="4",
+            BENCH_ENCODE="8",
+            BENCH_AB_TERMINALS="200",
+            BENCH_AB_PATHS="150",
+            BENCH_AB_LABELS="20",
+            BENCH_AB_REPEATS="1",
+            BENCH_AUTOTUNE_CACHE=str(tmp_path / "sched.json"),
+        )
+        bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+        def run():
+            proc = subprocess.run(
+                [sys.executable, bench_path, "--kernel-ab", "--autotune", "--dry"],
+                env=env, capture_output=True, text=True, timeout=540,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            metric = json.loads(proc.stdout.strip().splitlines()[-1])
+            detail = None
+            for line in proc.stderr.splitlines():
+                line = line.strip()
+                if line.startswith("{") and '"detail"' in line:
+                    detail = json.loads(line)["detail"]
+            return metric, detail
+
+        metric, detail = run()
+        assert metric["metric"] == "fused_kernel_real_contexts_per_sec"
+        assert metric["value"] and metric["value"] > 0
+        assert detail["interpret"] is True and "note" in detail
+        for arm in ("xla_f32", "pool_only_f32", "fused_f32",
+                    "pool_only_int8", "fused_int8"):
+            assert detail["arms"][arm]["real_contexts_per_sec"] > 0
+        assert detail["autotune"]["counters_delta"]["autotune_schedule_stored"] == 2
+
+        metric2, detail2 = run()
+        delta = detail2["autotune"]["counters_delta"]
+        assert delta["autotune_timing_run"] == 0
+        assert delta["autotune_cache_miss"] == 0
+        assert delta["autotune_cache_hit"] == 2

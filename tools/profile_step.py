@@ -150,6 +150,55 @@ def fwd(params, batch):
 bench("forward", fwd, state.params, batch)
 
 
+# --- kernel variants (ops/fused_encode_pool.py) ---------------------------
+# Pallas forward rows: pool-only vs gather-split vs fully-fused (+ int8
+# fused), with the autotuned schedule consulted/recorded for provenance.
+# On non-TPU backends the kernels run in the Pallas INTERPRETER — numbers
+# characterize the interpreter, so the rows are opt-in there.
+_kern_env = os.environ.get("PROF_KERNEL_VARIANTS", "auto").strip().lower()
+if _kern_env in ("1", "true", "yes", "on") or (
+    _kern_env == "auto" and jax.default_backend() == "tpu"
+):
+    from code2vec_tpu.ops.autotune import counters_snapshot, lookup_schedule
+    from code2vec_tpu.ops.quant import quantize_table
+
+    sched = lookup_schedule(B, L, mc.terminal_embed_size, mc.path_embed_size,
+                            mc.encode_size, "f32")
+    print(json.dumps({"kernel_schedule": sched.to_dict(),
+                      "autotune_counters": counters_snapshot()}), flush=True)
+
+    def _variant_fwd(impl, table_dtype="f32", quant_tables=None):
+        mck = Code2VecConfig(
+            terminal_count=mc.terminal_count, path_count=mc.path_count,
+            label_count=mc.label_count,
+            terminal_embed_size=mc.terminal_embed_size,
+            path_embed_size=mc.path_embed_size, encode_size=mc.encode_size,
+            dropout_prob=0.25, dtype=DTYPE, embed_grad=EMBED_GRAD,
+            use_pallas=impl != "xla", pallas_impl=impl if impl != "xla" else "pool_only",
+            pallas_block_b=sched.block_b, pallas_dma_depth=sched.dma_depth,
+            pallas_chunk_l=sched.chunk_l, table_dtype=table_dtype,
+        )
+        mk = Code2Vec(mck)
+
+        @jax.jit
+        def f(params, batch):
+            logits, _, _ = mk.apply(
+                {"params": params}, batch["starts"], batch["paths"],
+                batch["ends"], deterministic=True, quant_tables=quant_tables)
+            return logits.astype(jnp.float32).sum()
+
+        return f
+
+    for impl in ("pool_only", "gather_split", "fused"):
+        bench(f"forward/{impl}", _variant_fwd(impl), state.params, batch)
+    _qt = (
+        quantize_table(state.params["terminal_embedding"]["embedding"], "int8"),
+        quantize_table(state.params["path_embedding"]["embedding"], "int8"),
+    )
+    bench("forward/fused_int8",
+          _variant_fwd("fused", "int8", _qt), state.params, batch)
+
+
 # --- fwd+bwd, with and without table grads -------------------------------
 def loss_fn(params, batch, key):
     logits, _, _ = model.apply(
