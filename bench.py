@@ -67,6 +67,8 @@ def _metric_id() -> tuple[str, str]:
         return "bucketed_real_contexts_per_sec", "contexts/sec"
     if "--kernel-ab" in sys.argv[1:]:
         return "fused_kernel_real_contexts_per_sec", "contexts/sec"
+    if "--serve" in sys.argv[1:]:
+        return "serve_requests_per_sec", "req/sec"
     return "path_contexts_per_sec_per_chip", "contexts/sec"
 
 
@@ -1245,6 +1247,221 @@ def _kernel_ab() -> None:
     )
 
 
+def _serve_bench() -> None:
+    """``--serve``: open-loop load test of the online serving stack.
+
+    Builds the real serving pieces — a :class:`ServingEngine` with its AOT
+    executable ladder over a skewed (lognormal) width distribution, and
+    the continuous micro-batcher in front of it — then drives them with an
+    OPEN-LOOP request generator: arrivals follow a seeded exponential
+    schedule at ``BENCH_SERVE_QPS`` regardless of completions (a closed
+    loop would hide queueing collapse — the generator does not slow down
+    because the server is struggling, exactly like real traffic).
+
+    Reported: p50/p99/mean end-to-end latency plus the per-phase split
+    (queue_wait / pad / device), measured QPS, REAL context throughput
+    (sum of each request's true context count — the padded slots an
+    executable processes are accounted separately as ``pad_efficiency``),
+    and the zero-post-warmup-recompile assertion: the obs
+    RecompileDetector tracks the engine's executable table across the
+    whole mixed-width stream and the metric line carries its verdict.
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+
+    from code2vec_tpu.data.pipeline import derive_bucket_ladder
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.obs.runtime import (
+        RecompileDetector,
+        RuntimeHealth,
+        memory_snapshot,
+    )
+    from code2vec_tpu.serve.batcher import MicroBatcher, ServeOverloaded
+    from code2vec_tpu.serve.engine import ServingEngine
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    bag = knob("BENCH_BAG", 200, 32)
+    embed_size = knob("BENCH_EMBED", 100, 16)
+    encode_size = knob("BENCH_ENCODE", 100, 24)
+    n_terminals = knob("BENCH_SERVE_TERMINALS", 360_631, 2_000)
+    n_paths = knob("BENCH_SERVE_PATHS", 342_845, 2_000)
+    n_labels = knob("BENCH_SERVE_LABELS", 8_000, 100)
+    n_requests = knob("BENCH_SERVE_REQUESTS", 2_000, 300)
+    target_qps = _env_float("BENCH_SERVE_QPS", 0.0) or (
+        150.0 if fell_back or backend == "cpu" else 500.0
+    )
+    deadline_ms = _env_float("BENCH_SERVE_DEADLINE_MS", 2.0)
+    batch_sizes = tuple(
+        int(t)
+        for t in os.environ.get("BENCH_SERVE_BATCH_SIZES", "1,8").split(",")
+        if t.strip()
+    )
+
+    config = TrainConfig(batch_size=max(batch_sizes), max_path_length=bag)
+    model_config = Code2VecConfig(
+        terminal_count=n_terminals + 2,
+        path_count=n_paths + 1,
+        label_count=n_labels,
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,
+        dropout_prob=0.0,
+    )
+    example = {
+        "starts": np.zeros((1, bag), np.int32),
+        "paths": np.zeros((1, bag), np.int32),
+        "ends": np.zeros((1, bag), np.int32),
+        "labels": np.zeros(1, np.int32),
+        "example_mask": np.ones(1, np.float32),
+    }
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+
+    # the request mix: heavy-tailed real context counts (data/synth.py
+    # models corpora as lognormal) — the mixed-width stream the recompile
+    # assertion runs across
+    rng = np.random.default_rng(0)
+    counts = np.clip(
+        np.rint(rng.lognormal(np.log(bag / 6.0), 0.6, n_requests)), 1, bag
+    ).astype(np.int64)
+    ladder = derive_bucket_ladder(counts, bag)
+
+    health = RuntimeHealth()
+    engine = ServingEngine(
+        state,
+        max_width=bag,
+        model_dims=(embed_size, embed_size, encode_size),
+        ladder=ladder,
+        batch_sizes=batch_sizes,
+        health=health,
+    )
+    t0 = time.perf_counter()
+    provenance = engine.prepare()
+    startup_compile_s = time.perf_counter() - t0
+    detector = RecompileDetector(health=health)
+    detector.track(
+        "serve_executables", engine, expected_compiles=engine._cache_size()
+    )
+
+    def request(i: int) -> np.ndarray:
+        n = int(counts[i])
+        return np.stack(
+            [
+                rng.integers(1, n_terminals, n),
+                rng.integers(1, n_paths, n),
+                rng.integers(1, n_terminals, n),
+            ],
+            axis=1,
+        ).astype(np.int32)
+
+    requests = [request(i) for i in range(n_requests)]
+    # seeded exponential inter-arrival gaps: a Poisson process at the
+    # target rate, fixed before the clock starts (open loop)
+    gaps = rng.exponential(1.0 / target_qps, n_requests)
+    arrivals = np.cumsum(gaps)
+
+    batcher = MicroBatcher(
+        engine, deadline_ms=deadline_ms, max_pending=4096, health=health
+    )
+    futures = []
+    rejected = 0
+    t_start = time.perf_counter()
+    for i, arr in enumerate(requests):
+        delay = arrivals[i] - (time.perf_counter() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append(batcher.submit(arr))
+        except ServeOverloaded:
+            rejected += 1
+    results = [f.result() for f in futures]
+    t_wall = time.perf_counter() - t_start
+    batcher.close()
+
+    completed = len(results)
+    real_contexts = sum(r.n_contexts for r in results)
+    # each group member carries an equal share of its executable's padded
+    # B x L slots, so this sums every device call's slots exactly once
+    padded_slots = sum(r.batch * r.width / r.coalesced for r in results)
+    new_compiles = detector.check()
+    lat = {
+        name: health.latency(key).summary()
+        for name, key in (
+            ("e2e", "serve.e2e_ms"),
+            ("queue_wait", "serve.queue_wait_ms"),
+            ("pad", "serve.pad_ms"),
+            ("device", "serve.device_ms"),
+        )
+    }
+    qps = completed / t_wall if t_wall > 0 else 0.0
+
+    detail = {
+        "backend": backend,
+        "mode": "serve",
+        "bag": bag,
+        "embed": embed_size,
+        "encode": encode_size,
+        "ladder": list(ladder),
+        "batch_sizes": list(batch_sizes),
+        "deadline_ms": deadline_ms,
+        "target_qps": target_qps,
+        "requests": n_requests,
+        "completed": completed,
+        "rejected": rejected,
+        "qps": round(qps, 2),
+        "latency_ms": lat,
+        "real_contexts_per_sec": round(real_contexts / t_wall, 1),
+        "pad_efficiency": round(real_contexts / padded_slots, 4)
+        if padded_slots
+        else None,
+        "coalesce_mean": round(
+            sum(r.coalesced for r in results) / completed, 3
+        )
+        if completed
+        else None,
+        "executables": engine._cache_size(),
+        "startup_compile_s": round(startup_compile_s, 3),
+        "schedule_provenance": provenance,
+        "post_warmup_recompiles": engine.post_warmup_compiles,
+        "detector_new_compiles": new_compiles,
+        "counters": health.snapshot()["counters"],
+        "memory": memory_snapshot(),
+    }
+    print(json.dumps({"detail": detail}), file=sys.stderr, flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": "serve_requests_per_sec",
+                "value": round(qps, 2),
+                "unit": "req/sec",
+                # first serving benchmark: no prior round to compare to;
+                # the acceptance gate is the latency block + the recompile
+                # verdict below, not a speedup ratio
+                "vs_baseline": 1.0,
+                "p50_ms": lat["e2e"]["p50_ms"] if lat["e2e"] else None,
+                "p99_ms": lat["e2e"]["p99_ms"] if lat["e2e"] else None,
+                "post_warmup_recompiles": engine.post_warmup_compiles,
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+    if engine.post_warmup_compiles or new_compiles:
+        raise RuntimeError(
+            f"serving hot path recompiled post-warmup "
+            f"({engine.post_warmup_compiles} engine / {new_compiles} "
+            "detector) — the AOT ladder failed to cover the stream"
+        )
+
+
 def main() -> None:
     jax, backend, fell_back = _init_backend()
     _bench_tracer(jax)
@@ -1632,6 +1849,8 @@ if __name__ == "__main__":
             _bucket_ab()
         elif "--kernel-ab" in sys.argv[1:]:
             _kernel_ab()
+        elif "--serve" in sys.argv[1:]:
+            _serve_bench()
         else:
             main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver

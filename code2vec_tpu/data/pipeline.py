@@ -406,6 +406,11 @@ def derive_bucket_ladder(
         if nxt == w:
             break
         w = nxt
+    if not widths:
+        # a bag narrower than min_width: the contract ("the top width is
+        # always max_contexts") still holds — one rung, never an empty
+        # ladder (which would crash every nearest_bucket_width consumer)
+        widths = [int(max_contexts)]
     widths = sorted(set(widths))
     counts = np.minimum(np.asarray(counts), max_contexts)
     if len(counts) and len(widths) > 1:
@@ -440,6 +445,20 @@ def parse_bucket_ladder(spec: str, max_contexts: int) -> tuple[int, ...] | None:
             f"long bags are not truncated; got top width {widths[-1]}"
         )
     return tuple(widths)
+
+
+def nearest_bucket_width(count: int, ladder: tuple[int, ...]) -> int:
+    """The smallest ladder width holding ``count`` real contexts (the top
+    width for anything longer). THE padding rule shared by every consumer
+    of a ladder — the bucketed trainer, ``predict.Predictor``'s single
+    forwards, and the serving micro-batcher — so all of them land on the
+    same static shapes and reuse the same compiled executables."""
+    if not ladder:
+        raise ValueError("bucket ladder must not be empty")
+    for width in ladder:
+        if count <= width:
+            return int(width)
+    return int(ladder[-1])
 
 
 def assign_buckets(counts: np.ndarray, ladder: tuple[int, ...]) -> np.ndarray:

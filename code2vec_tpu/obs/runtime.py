@@ -25,6 +25,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "Counter",
     "Gauge",
+    "LatencyHistogram",
     "RuntimeHealth",
     "RecompileDetector",
     "global_health",
@@ -64,13 +65,79 @@ class Gauge:
         return self._value
 
 
+class LatencyHistogram:
+    """Latency samples with percentile summaries (thread-safe).
+
+    The serving layer records one sample per request per phase
+    (queue_wait / pad / device / postprocess plus end-to-end), and
+    ``bench.py --serve`` reports the p50/p99 the ISSUE's acceptance
+    criteria name. Exact samples, not buckets: serving test runs are
+    10^3-10^5 requests, where a sorted copy per summary is cheap and
+    bucket-boundary error would dominate a p99 over so few samples.
+    ``max_samples`` bounds memory on long-lived servers: past the cap the
+    buffer becomes a sliding window over the most recent samples (the
+    regime a live server's percentiles should reflect anyway); ``count``
+    keeps the true total.
+    """
+
+    def __init__(self, max_samples: int = 200_000) -> None:
+        self._samples: list[float] = []
+        self._count = 0
+        self._max = int(max_samples)
+        self._lock = threading.Lock()
+
+    def record(self, value_ms: float) -> None:
+        with self._lock:
+            self._count += 1
+            if len(self._samples) < self._max:
+                self._samples.append(float(value_ms))
+            else:
+                # count is post-increment: sample #i lives at (i-1) % max,
+                # so the overwrite must use the same 0-based index or the
+                # oldest sample survives a full extra window
+                self._samples[(self._count - 1) % self._max] = float(value_ms)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def summary(self) -> dict | None:
+        # copy under the lock, sort OUTSIDE it: sorting 200k floats while
+        # holding the lock would stall the batcher thread's record() calls
+        # for the duration of every health poll
+        with self._lock:
+            samples = list(self._samples)
+            count = self._count
+        if not samples:
+            return None
+        ordered = sorted(samples)
+
+        def at(q: float) -> float:
+            rank = min(
+                len(ordered) - 1,
+                max(0, int(round(q / 100.0 * (len(ordered) - 1)))),
+            )
+            return round(ordered[rank], 3)
+
+        return {
+            "count": count,
+            "p50_ms": at(50),
+            "p90_ms": at(90),
+            "p99_ms": at(99),
+            "max_ms": round(ordered[-1], 3),
+            "mean_ms": round(sum(ordered) / len(ordered), 3),
+        }
+
+
 class RuntimeHealth:
-    """Named counters/gauges registry; one per run, snapshot on demand."""
+    """Named counters/gauges/latency-histograms registry; one per run,
+    snapshot on demand."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
+        self._latencies: dict[str, LatencyHistogram] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -80,12 +147,28 @@ class RuntimeHealth:
         with self._lock:
             return self._gauges.setdefault(name, Gauge())
 
+    def latency(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            return self._latencies.setdefault(name, LatencyHistogram())
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                "counters": {k: c.value for k, c in self._counters.items()},
-                "gauges": {k: g.value for k, g in self._gauges.items()},
-            }
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            latencies = dict(self._latencies)
+        return {
+            "counters": {k: c.value for k, c in counters.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
+            **(
+                {
+                    "latencies_ms": {
+                        k: h.summary() for k, h in latencies.items()
+                    }
+                }
+                if latencies
+                else {}
+            ),
+        }
 
 
 _global_health: RuntimeHealth | None = None

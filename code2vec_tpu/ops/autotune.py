@@ -232,6 +232,37 @@ def lookup_schedule(
     return default or KernelSchedule(impl="pool_only", source="default")
 
 
+def consult_schedules(
+    keys: list[ShapeKey], cache: ScheduleCache | None = None
+) -> list[dict]:
+    """The serving-startup consultation (``--expect-cached``-style warmup):
+    for every shape the server is about to compile an executable for, look
+    up the persisted schedule WITHOUT any timing and return one provenance
+    record per key — ``{"key", "schedule", "cached"}``. Hits/misses land on
+    the shared ``autotune_*`` counters, so a deployment can assert 'the
+    warm cache covered every serving shape' exactly like the CLI's
+    ``--expect-cached`` does; the records go into the serve run manifest."""
+    cache = cache or get_cache()
+    c = _counters()
+    out: list[dict] = []
+    for key in keys:
+        found = cache.get(key)
+        if found is not None:
+            c["hit"].inc()
+            schedule = found
+        else:
+            c["miss"].inc()
+            schedule = KernelSchedule(impl="pool_only", source="default")
+        out.append(
+            {
+                "key": key.cache_key(),
+                "schedule": schedule.to_dict(),
+                "cached": found is not None,
+            }
+        )
+    return out
+
+
 def enumerate_variants(batch: int, width: int, table_dtype: str) -> list[KernelSchedule]:
     """The search space for one shape: plain XLA, pool-only, gather-split,
     and fully-fused, across batch tiling / DMA pipeline depth / lane chunk.

@@ -134,6 +134,26 @@ def shard_state(mesh: Mesh, state):
     )
 
 
+def retrieval_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Placement for the serving top-k retrieval matmul (serve/retrieval.py).
+
+    The exported code-vector matrix is ``[n_methods, E]`` — the same
+    tall-skinny layout as the embedding tables, so it takes the same rule:
+    row-sharded over ``model`` (the corpus scales with method count the
+    way the tables scale with vocab). The query block ``[Q, E]`` and each
+    query's result are tiny and replicate. ``sims = rows @ q.T`` is then a
+    fully local matmul per shard ([rows/n, E] x [E, Q]); the top-k over
+    the sharded rows axis is the only cross-shard step and GSPMD inserts
+    the gather for it. Like ``_spec_for_param``, an indivisible row count
+    silently replicates — pad rows at load if the shard must happen."""
+    model_axis = AXIS_MODEL if mesh.shape[AXIS_MODEL] > 1 else None
+    return {
+        "rows": NamedSharding(mesh, P(model_axis, None)),
+        "query": NamedSharding(mesh, P()),
+        "out": NamedSharding(mesh, P()),
+    }
+
+
 # ---------------------------------------------------------------------------
 # PartitionSpec serialization — the mesh-reshape restore primitive
 #

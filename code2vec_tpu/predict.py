@@ -35,9 +35,17 @@ MODEL_META = "model_meta.json"
 LABEL_VOCAB = "label_vocab.txt"
 
 
-def save_inference_meta(out_dir: str, config, model_config, data) -> None:
+def save_inference_meta(
+    out_dir: str, config, model_config, data, bucket_ladder=None
+) -> None:
     """Persist what prediction needs beyond the checkpoint (called by the
-    train loop on process 0): model dims/flags and the label vocab."""
+    train loop on process 0): model dims/flags and the label vocab.
+
+    ``bucket_ladder``: the training run's resolved bag-width ladder (or a
+    corpus-derived one for fixed-L runs). Recording it lets the serving
+    layer (code2vec_tpu.serve) build its AOT executable ladder WITHOUT the
+    corpus on the serving host; absent (older checkpoints), the server
+    falls back to a width histogram of the live request stream."""
     meta = {
         "rng_impl": config.rng_impl,
         "adam_mu_dtype": config.adam_mu_dtype,
@@ -59,6 +67,9 @@ def save_inference_meta(out_dir: str, config, model_config, data) -> None:
         # records the DEFAULT serving storage; the Predictor can override
         # per deployment (--table_dtype int8 for the bandwidth-lean tier)
         "table_dtype": getattr(config, "table_dtype", "f32"),
+        "bucket_ladder": (
+            [int(w) for w in bucket_ladder] if bucket_ladder else None
+        ),
     }
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, MODEL_META), "w", encoding="utf-8") as f:
@@ -75,6 +86,21 @@ def save_inference_meta(out_dir: str, config, model_config, data) -> None:
 class Prediction:
     name: str
     prob: float
+
+
+def softmax_top_k(
+    logits: np.ndarray, n_labels: int, top_k: int
+) -> list[tuple[int, float]]:
+    """Top-k ``(label index, probability)`` from one logits row — float64
+    softmax over the REAL label rows (the head may be vocab-padded for
+    even model-axis sharding; the dummy rows are meaningless). THE one
+    implementation shared by offline prediction and the serving protocol,
+    so the two surfaces cannot drift numerically."""
+    logits = np.asarray(logits, np.float64)[:n_labels]
+    z = np.exp(logits - logits.max())
+    probs = z / z.sum()
+    order = np.argsort(-probs)[:top_k]
+    return [(int(i), float(probs[i])) for i in order]
 
 
 @dataclass
@@ -162,6 +188,26 @@ class Predictor:
         self.label_vocab = read_vocab(os.path.join(model_path, LABEL_VOCAB))
 
         self.bag = int(meta["max_path_length"])
+        # bag-width ladder for single-forward padding: each prediction is
+        # padded to the nearest ladder width (shared rule with the serving
+        # micro-batcher — data/pipeline.nearest_bucket_width), so the jitted
+        # forward compiles AT MOST len(ladder) variants and repeat
+        # predictions of differently-sized methods reuse them — instead of
+        # paying full-bag FLOPs/gathers for every 5-context method. Older
+        # checkpoints without a recorded ladder get the geometric default.
+        from code2vec_tpu.data.pipeline import derive_bucket_ladder
+
+        recorded = meta.get("bucket_ladder")
+        # ladder_recorded distinguishes "the checkpoint told us" from the
+        # geometric guess below: the serving engine (serve/engine.py) must
+        # NOT inherit a guess — an unrecorded ladder routes it to the
+        # request-stream histogram fallback instead
+        self.ladder_recorded = bool(recorded)
+        self.ladder: tuple[int, ...] = (
+            tuple(int(w) for w in recorded)
+            if recorded
+            else derive_bucket_ladder(np.zeros(0, np.int64), self.bag)
+        )
         # extraction hyperparameters: the corpus records them in params.txt
         # next to the vocab files (reference format, typo'd 'nomalize_' keys
         # included) — new sources must be extracted identically or their
@@ -434,23 +480,26 @@ class Predictor:
             r = rng if rng is not None else np.random.default_rng(0)
             keep = r.choice(len(contexts), self.bag, replace=False)
             contexts = [contexts[i] for i in sorted(keep)]
+        from code2vec_tpu.data.pipeline import nearest_bucket_width
+
         arr = np.asarray(contexts, np.int32).reshape(-1, 3)
         n = arr.shape[0]
-        starts = np.full((1, self.bag), PAD_INDEX, np.int32)
-        paths = np.full((1, self.bag), PAD_INDEX, np.int32)
-        ends = np.full((1, self.bag), PAD_INDEX, np.int32)
+        # pad to the nearest ladder width, not the full bag: PAD lanes carry
+        # exactly-zero attention weight, so the outputs are identical at any
+        # width >= n (the PR-4 bucketing invariant) while the forward pays
+        # for the small shape — and the jit cache stays at <= len(ladder)
+        width = nearest_bucket_width(max(n, 1), self.ladder)
+        starts = np.full((1, width), PAD_INDEX, np.int32)
+        paths = np.full((1, width), PAD_INDEX, np.int32)
+        ends = np.full((1, width), PAD_INDEX, np.int32)
         starts[0, :n], paths[0, :n], ends[0, :n] = arr[:, 0], arr[:, 1], arr[:, 2]
         batch = {"starts": starts, "paths": paths, "ends": ends}
         logits, code_vector, attn = self._forward(self.state, batch)
-        # the head may be vocab-padded for even model-axis sharding; the
-        # dummy rows are meaningless — slice to the real label count
-        logits = np.asarray(logits, np.float64)[0, : len(self.label_vocab)]
-        z = np.exp(logits - logits.max())
-        probs = z / z.sum()
-        order = np.argsort(-probs)[:top_k]
         preds = [
-            Prediction(self.label_vocab.itos[int(i)], float(probs[i]))
-            for i in order
+            Prediction(self.label_vocab.itos[i], prob)
+            for i, prob in softmax_top_k(
+                np.asarray(logits)[0], len(self.label_vocab), top_k
+            )
         ]
         attn = np.asarray(attn)[0]
         t_itos, p_itos = self.terminal_vocab.itos, self.path_vocab.itos

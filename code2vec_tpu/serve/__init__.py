@@ -1,0 +1,46 @@
+"""Compiled online serving: code2vec-as-a-service.
+
+The training side of this repo ends at a checkpoint plus an exported
+code-vector matrix; this package is the online path in front of them
+(ROADMAP item 1 — "the single biggest step toward the millions-of-users
+north star"). Three pieces, composable and individually testable:
+
+- :mod:`engine` — the **AOT executable ladder**: at server start the
+  predict forward is lowered and compiled once per (micro-batch size,
+  bucket width) from the ladder recorded at train time
+  (``model_meta.json``), consulting the PR-8 autotuned schedule cache and
+  quantized tables, so every request shape dispatches into a warm
+  ``jax.jit(...).lower().compile()`` executable and the hot path performs
+  ZERO tracing (asserted via the obs ``RecompileDetector``: the engine
+  exposes a ``_cache_size`` probe over its executable table).
+- :mod:`batcher` — the **continuous micro-batcher**: a bounded-queue
+  coalescer (the ``train/prefetch.py`` machinery family) that gathers
+  concurrent requests within a deadline, pads them to the nearest bucket
+  width (``data/pipeline.nearest_bucket_width`` — the same rule the
+  bucketed trainer and ``predict.Predictor`` use), runs ONE device call,
+  and scatters rows back to per-request futures. Under low load it
+  degrades to a deterministic single-request dispatch.
+- :mod:`retrieval` — **top-k nearest-method search** over the exported
+  ``code.vec`` matrix, the query→matrix matmul sharded across the mesh by
+  the ``parallel/shardings.retrieval_shardings`` rule (row-sharded like
+  the embedding tables).
+
+:mod:`protocol` wires them behind a transport-thin server (stdio-JSONL or
+stdlib HTTP — the request handling is a plain ``dict -> dict`` function,
+testable without sockets), and ``python -m code2vec_tpu.serve`` is the
+CLI. Every phase is measured: per-request queue_wait / pad / device /
+postprocess spans and ``serve_*`` counters via ``obs``, with
+``bench.py --serve`` as the open-loop p50/p99 + QPS load harness.
+"""
+
+from code2vec_tpu.serve.batcher import MicroBatcher, ServeOverloaded, ServerClosed
+from code2vec_tpu.serve.engine import ServingEngine
+from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+__all__ = [
+    "MicroBatcher",
+    "RetrievalIndex",
+    "ServeOverloaded",
+    "ServerClosed",
+    "ServingEngine",
+]

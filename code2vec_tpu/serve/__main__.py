@@ -1,0 +1,205 @@
+"""``python -m code2vec_tpu.serve`` — start the online server.
+
+Startup order matters and is the whole point: pin the backend, pin the
+autotune cache, load the checkpoint (quantizing tables once), AOT-compile
+the full executable ladder, write the run manifest WITH per-executable
+schedule provenance — and only then accept traffic, so the first request
+is as fast as the millionth.
+
+    python -m code2vec_tpu.serve --model_path out \\
+        --terminal_idx_path ds/terminal_idxs.txt \\
+        --path_idx_path ds/path_idxs.txt \\
+        --transport stdio        # or: --transport http --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="code2vec_tpu.serve",
+        description="code2vec-as-a-service: compiled online inference + "
+        "nearest-method retrieval",
+    )
+    parser.add_argument("--model_path", required=True,
+                        help="train output dir (checkpoint + model_meta.json)")
+    parser.add_argument("--terminal_idx_path", required=True)
+    parser.add_argument("--path_idx_path", required=True)
+    parser.add_argument("--transport", default="stdio",
+                        choices=("stdio", "http"),
+                        help="stdio = JSONL request/response over "
+                        "stdin/stdout; http = stdlib threading server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--table_dtype", default=None,
+                        choices=("f32", "bf16", "int8"),
+                        help="embedding-table storage for the serving "
+                        "forward (default: the checkpoint's meta)")
+    parser.add_argument("--batch_sizes", default="1,8",
+                        help="comma list of micro-batch sizes to compile "
+                        "executables for (the batcher pads request groups "
+                        "to the smallest fitting size)")
+    parser.add_argument("--deadline_ms", type=float, default=2.0,
+                        help="micro-batcher coalescing window: how long "
+                        "the first request of a group waits for company "
+                        "(0 = dispatch immediately, one request per call)")
+    parser.add_argument("--max_pending", type=int, default=256,
+                        help="queued-request bound; beyond it submissions "
+                        "are rejected as overloaded (shed, don't buffer)")
+    parser.add_argument("--warmup_requests", type=int, default=64,
+                        help="histogram-fallback sample size when the "
+                        "checkpoint's meta has no recorded bucket ladder")
+    parser.add_argument("--autotune_cache", default="",
+                        help="kernel-schedule cache consulted per compiled "
+                        "executable (ops/autotune.py; default "
+                        "$C2V_AUTOTUNE_CACHE or the user cache path)")
+    parser.add_argument("--code_vec_path", default=None,
+                        help="exported code.vec for the neighbors op "
+                        "(default: <model_path>/code.vec when present)")
+    parser.add_argument("--accelerator", action="store_true", default=False,
+                        help="serve from the default device backend; off = "
+                        "pin CPU (same contract as the predict CLI)")
+    parser.add_argument("--events_dir", default=None,
+                        help="JSONL event log (run manifest with the "
+                        "executable ladder + schedule provenance, then "
+                        "serve_executable/... events)")
+    parser.add_argument("--trace_dir", default=None,
+                        help="Chrome trace of the serve spans "
+                        "(queue_wait/pad/device/postprocess)")
+    return parser
+
+
+def build_server(args):
+    """Everything between arg parsing and the transport loop, importable
+    so tests can drive a fully-assembled server without a subprocess."""
+    from code2vec_tpu.obs.runtime import global_health
+    from code2vec_tpu.predict import Predictor
+    from code2vec_tpu.serve.batcher import MicroBatcher
+    from code2vec_tpu.serve.engine import ServingEngine
+    from code2vec_tpu.serve.protocol import CodeServer
+    from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+    # pin the schedule cache BEFORE the first trace, exactly like train()
+    # and export_from_checkpoint do
+    if args.autotune_cache:
+        from code2vec_tpu.ops.autotune import get_cache
+
+        get_cache(args.autotune_cache)
+
+    events = None
+    if args.events_dir:
+        from code2vec_tpu.obs.events import EventLog
+
+        events = EventLog(args.events_dir)
+
+    predictor = Predictor(
+        args.model_path, args.terminal_idx_path, args.path_idx_path,
+        table_dtype=args.table_dtype,
+    )
+    batch_sizes = tuple(
+        int(tok) for tok in str(args.batch_sizes).split(",") if tok.strip()
+    )
+    engine = ServingEngine.from_predictor(
+        predictor,
+        batch_sizes=batch_sizes,
+        autotune_cache=args.autotune_cache or None,
+        warmup_requests=args.warmup_requests,
+    )
+    provenance = engine.prepare()
+    logger.info(
+        "compiled %d executables over ladder %s x batch sizes %s",
+        len(provenance), list(engine.active_ladder), list(engine.batch_sizes),
+    )
+    if events is not None:
+        events.write_manifest(
+            serve={
+                "model_path": args.model_path,
+                "transport": args.transport,
+                "table_dtype": engine.table_dtype,
+                "ladder": list(engine.active_ladder),
+                "batch_sizes": list(engine.batch_sizes),
+                "deadline_ms": args.deadline_ms,
+                # per-executable schedule provenance: which tuned kernel
+                # schedule each compiled shape consulted, and whether the
+                # cache covered it (the --expect-cached-style warmup)
+                "executables": provenance,
+            }
+        )
+        # attach the log only AFTER the manifest so it stays the first
+        # line; later compiles (histogram-freeze, shape misses) still get
+        # their own serve_executable events
+        engine._events = events
+
+    retrieval = None
+    code_vec_path = args.code_vec_path
+    if code_vec_path is None:
+        default = os.path.join(args.model_path, "code.vec")
+        code_vec_path = default if os.path.exists(default) else None
+    if code_vec_path:
+        retrieval = RetrievalIndex.from_code_vec(code_vec_path)
+
+    batcher = MicroBatcher(
+        engine,
+        deadline_ms=args.deadline_ms,
+        max_pending=args.max_pending,
+    )
+    server = CodeServer(predictor, engine, batcher, retrieval=retrieval)
+    health = global_health()
+    health.gauge("serve_transport").set(args.transport)
+    return server, events
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s: %(message)s",
+        datefmt="%m/%d/%Y %I:%M:%S %p",
+    )
+    args = build_parser().parse_args(argv)
+
+    from code2vec_tpu.cli import pin_platform
+
+    pin_platform(not args.accelerator)
+
+    tracer = None
+    if args.trace_dir:
+        from code2vec_tpu.obs.trace import Tracer, set_tracer
+
+        tracer = Tracer()
+        set_tracer(tracer)
+
+    server, events = build_server(args)
+    try:
+        if args.transport == "stdio":
+            from code2vec_tpu.serve.protocol import serve_stdio
+
+            serve_stdio(server, sys.stdin, sys.stdout)
+        else:
+            from code2vec_tpu.serve.protocol import serve_http
+
+            serve_http(server, args.host, args.port)
+    finally:
+        if tracer is not None:
+            from code2vec_tpu.obs.trace import set_tracer
+
+            set_tracer(None)
+            try:
+                tracer.export_dir(args.trace_dir)
+            except Exception:
+                logger.warning("could not write chrome trace", exc_info=True)
+        if events is not None:
+            try:
+                events.close()
+            except Exception:
+                logger.warning("could not close event log", exc_info=True)
+
+
+if __name__ == "__main__":
+    main()

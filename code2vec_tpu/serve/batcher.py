@@ -1,0 +1,273 @@
+"""Continuous micro-batcher: coalesce concurrent requests into one device call.
+
+Same machinery family as ``train/prefetch.py`` — one background thread,
+a bounded FIFO queue, explicit shutdown semantics — but inverted: the
+prefetcher runs a *known* batch stream ahead of one consumer, while the
+batcher gathers *unknown* concurrent requests behind one device. The loop:
+
+1. block until a request arrives;
+2. coalesce more arrivals for at most ``deadline_ms`` (or until the
+   engine's top micro-batch size fills) — under low load the deadline
+   expires with a single request, which dispatches alone through the
+   batch-1 executable: the deterministic single-request fallback;
+3. pad the group to its nearest bucket width + micro-batch size
+   (``ServingEngine.pad_requests`` — the trainer's padding rule, so every
+   group hits a warm AOT executable);
+4. ONE device call; scatter rows back to per-request futures.
+
+Batched and one-at-a-time execution are bitwise-equal per request: every
+per-row op in the forward (gather, matmul-per-row, layernorm, masked
+softmax, pool) is independent of the other rows, and PAD lanes contribute
+exact zeros (the PR-4 bucketing invariant, pinned by tests/test_serve.py).
+
+Backpressure is explicit: the queue holds at most ``max_pending``
+requests and :meth:`submit` raises :class:`ServeOverloaded` instead of
+buffering unboundedly — the transport maps it to a retryable 429-class
+error. Shutdown drains: queued and in-flight requests complete before
+:meth:`close` returns; submissions after close raise
+:class:`ServerClosed`.
+
+Every phase is measured per request/group: ``queue_wait`` / ``pad`` /
+``device`` / ``postprocess`` spans on the tracer, the same buckets as
+latency histograms on the health registry (``serve.queue_wait_ms`` etc.),
+plus ``serve_requests`` / ``serve_batches`` / ``serve_coalesced``
+counters — ``bench.py --serve`` reads p50/p99 straight from these.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import numpy as np
+
+from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.trace import get_tracer
+
+__all__ = ["MicroBatcher", "ServeOverloaded", "ServerClosed", "ServeResult"]
+
+
+class ServeOverloaded(RuntimeError):
+    """The pending queue is full — shed load instead of buffering."""
+
+
+class ServerClosed(RuntimeError):
+    """submit() after close(): the server is shutting down."""
+
+
+@dataclass
+class ServeResult:
+    """One request's slice of a device call, host-side."""
+
+    logits: np.ndarray  # [label_count_padded] f32
+    code_vector: np.ndarray  # [encode_size] f32
+    attention: np.ndarray  # [n_contexts] f32 (PAD lanes stripped)
+    n_contexts: int
+    batch: int  # the executable's micro-batch size
+    width: int  # the executable's bucket width
+    coalesced: int  # how many requests shared the device call
+    queue_wait_ms: float
+    device_ms: float
+
+
+class _Pending:
+    __slots__ = ("contexts", "future", "enqueued")
+
+    def __init__(self, contexts: np.ndarray):
+        self.contexts = contexts
+        self.future: Future = Future()
+        self.enqueued = time.perf_counter()
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer in front of a :class:`ServingEngine`.
+
+    ``deadline_ms``: how long the first request of a group waits for
+    company — the latency/efficiency dial (0 = dispatch immediately,
+    strictly one request per device call). ``max_batch`` defaults to the
+    engine's top micro-batch size; ``max_pending`` bounds queued (not yet
+    dispatched) requests.
+    """
+
+    _POLL_S = 0.05  # close-check cadence while idle
+
+    def __init__(
+        self,
+        engine,
+        deadline_ms: float = 2.0,
+        max_batch: int | None = None,
+        max_pending: int = 256,
+        health: RuntimeHealth | None = None,
+    ) -> None:
+        if deadline_ms < 0:
+            raise ValueError(f"deadline_ms must be >= 0, got {deadline_ms}")
+        self._engine = engine
+        self._deadline_s = float(deadline_ms) / 1e3
+        # groups never exceed the top compiled micro-batch size — a larger
+        # cap would force the engine onto an uncompiled shape
+        top = max(engine.batch_sizes)
+        self._max_batch = min(int(max_batch or top), top)
+        if self._max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self._max_batch}")
+        self._health = health or global_health()
+        self._queue: queue.Queue = queue.Queue(maxsize=int(max_pending))
+        self._closed = threading.Event()
+        # serializes submit's closed-check+enqueue against close's
+        # flag-set: without it a submit could pass the check, lose the
+        # CPU, and enqueue after close() already swept the queue —
+        # leaving its future pending forever
+        self._submit_lock = threading.Lock()
+        self._requests = self._health.counter("serve_requests")
+        self._batches = self._health.counter("serve_batches")
+        self._coalesced = self._health.counter("serve_coalesced")
+        self._rejected = self._health.counter("serve_rejected")
+        self._thread = threading.Thread(
+            target=self._loop, name="c2v-micro-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # ---- caller side ----------------------------------------------------
+    def submit(self, contexts) -> Future:
+        """Enqueue one request (an ``[n, 3]`` array of mapped
+        (start, path, end) vocab ids); resolves to a :class:`ServeResult`.
+        Raises :class:`ServerClosed` after close, :class:`ServeOverloaded`
+        when ``max_pending`` requests are already waiting."""
+        pending = _Pending(np.asarray(contexts, np.int32).reshape(-1, 3))
+        max_width = getattr(self._engine, "max_width", None)
+        if max_width is not None and len(pending.contexts) > max_width:
+            # reject loudly instead of silently truncating the bag: the
+            # caller (the protocol layer, predict-style subsampling) owns
+            # the decision of WHICH contexts to drop
+            raise ValueError(
+                f"request has {len(pending.contexts)} contexts, more than "
+                f"the model's max bag width {max_width}; subsample before "
+                "submitting"
+            )
+        with self._submit_lock:
+            if self._closed.is_set():
+                raise ServerClosed("micro-batcher is closed")
+            try:
+                self._queue.put_nowait(pending)
+            except queue.Full:
+                self._rejected.inc()
+                raise ServeOverloaded(
+                    f"serving queue is full ({self._queue.maxsize} pending); "
+                    "retry with backoff"
+                ) from None
+        self._requests.inc()
+        return pending.future
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting requests, DRAIN everything already queued (every
+        accepted future resolves), and join the thread. Idempotent."""
+        with self._submit_lock:
+            self._closed.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():  # pragma: no cover - hung device call
+            raise TimeoutError("micro-batcher did not drain in time")
+        # anything enqueued before the flag flipped but after the drain
+        # loop's last empty poll — fail it loudly rather than leave its
+        # future pending forever (the submit lock guarantees nothing can
+        # enqueue after this sweep)
+        while True:
+            try:
+                leftover = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not leftover.future.done():
+                leftover.future.set_exception(
+                    ServerClosed("micro-batcher closed before dispatch")
+                )
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    # ---- batcher thread -------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=self._POLL_S)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            group = [first]
+            t_end = time.perf_counter() + self._deadline_s
+            while len(group) < self._max_batch:
+                if self._closed.is_set():
+                    # draining: take whatever is already queued, never wait
+                    try:
+                        group.append(self._queue.get_nowait())
+                        continue
+                    except queue.Empty:
+                        break
+                remaining = t_end - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    group.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                self._run_group(group)
+            except BaseException as exc:  # noqa: BLE001 - scattered to callers
+                for pending in group:
+                    if not pending.future.done():
+                        pending.future.set_exception(exc)
+
+    def _run_group(self, group: list[_Pending]) -> None:
+        tracer = get_tracer()
+        engine = self._engine
+        t_start = time.perf_counter()
+        for pending in group:
+            engine.observe_width(len(pending.contexts))
+        with tracer.span("serve_pad", category="serve", requests=len(group)):
+            t0 = time.perf_counter()
+            starts, paths, ends, batch, width = engine.pad_requests(
+                [p.contexts for p in group]
+            )
+            pad_ms = (time.perf_counter() - t0) * 1e3
+        with tracer.span(
+            "serve_device", category="serve",
+            batch=batch, width=width, requests=len(group),
+        ):
+            t0 = time.perf_counter()
+            logits, vectors, attention = engine.run(starts, paths, ends)
+            # the scatter below reads host values anyway; fencing here
+            # attributes the wait to the device phase, not postprocess
+            logits = np.asarray(logits)
+            vectors = np.asarray(vectors)
+            attention = np.asarray(attention)
+            device_ms = (time.perf_counter() - t0) * 1e3
+        with tracer.span("serve_postprocess", category="serve"):
+            for i, pending in enumerate(group):
+                n = int(pending.contexts.shape[0])
+                queue_wait_ms = (t_start - pending.enqueued) * 1e3
+                pending.future.set_result(
+                    ServeResult(
+                        logits=logits[i],
+                        code_vector=vectors[i],
+                        attention=attention[i, : min(n, width)],
+                        n_contexts=n,
+                        batch=batch,
+                        width=width,
+                        coalesced=len(group),
+                        queue_wait_ms=round(queue_wait_ms, 3),
+                        device_ms=round(device_ms, 3),
+                    )
+                )
+                self._health.latency("serve.queue_wait_ms").record(queue_wait_ms)
+                self._health.latency("serve.e2e_ms").record(
+                    (time.perf_counter() - pending.enqueued) * 1e3
+                )
+        self._health.latency("serve.pad_ms").record(pad_ms)
+        self._health.latency("serve.device_ms").record(device_ms)
+        self._batches.inc()
+        if len(group) > 1:
+            self._coalesced.inc(len(group))

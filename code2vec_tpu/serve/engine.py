@@ -1,0 +1,364 @@
+"""The AOT executable ladder: zero tracing on the serving hot path.
+
+A jitted forward re-traces (and re-compiles, seconds of XLA) the first
+time each shape arrives — precisely the latency spike an online service
+cannot take mid-traffic ("Compiler-First … Portable O(1) Autoregressive
+Caching for Inference", PAPERS.md: compile ahead of time, keep per-request
+state O(1)). The bucket ladder (PR 4) makes that affordable here: request
+shapes are a SMALL STATIC set — ``len(ladder) × len(micro-batch sizes)``
+— so the engine lowers and compiles every one of them at startup via
+``jax.jit(fn).lower(...).compile()`` and the hot path is a dict lookup
+into finished executables.
+
+Ladder sources, in order:
+
+1. the ladder recorded in ``model_meta.json`` at train time
+   (``predict.save_inference_meta``) — the serving host never needs the
+   corpus;
+2. absent that (older checkpoints), a width histogram of the live request
+   stream: until ``warmup_requests`` requests have been observed every
+   request runs at the top width, then the ladder is derived from the
+   observed counts (``data/pipeline.derive_bucket_ladder``) and its
+   executables compiled once.
+
+Schedule provenance: startup consults the PR-8 autotune cache for every
+(batch, width) shape (``ops/autotune.consult_schedules`` — the
+``--expect-cached``-style warmup) and keeps the per-executable records for
+the run manifest.
+
+Observability: ``serve_executable_compile`` / ``serve_forward`` counters
+on the shared registry, and a ``_cache_size`` probe (the executable-table
+size) so the obs :class:`RecompileDetector` can assert zero post-warmup
+compiles exactly as it does for the training step functions.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+from code2vec_tpu import PAD_INDEX
+from code2vec_tpu.data.pipeline import derive_bucket_ladder, nearest_bucket_width
+from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.trace import get_tracer
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BATCH_SIZES = (1, 8)
+
+
+class ServingEngine:
+    """Compiled forwards for every (micro-batch, bucket width) shape.
+
+    ``state``: a restored/initialized TrainState (its ``apply_fn`` is the
+    model). ``quant_tables``: optional pre-quantized ``(terminal, path)``
+    tables (quantize ONCE at load — ``ops/quant.py``). ``ladder``: bag
+    widths ending at ``max_width``; None = histogram fallback. The engine
+    serializes device work behind one lock: the micro-batcher is its only
+    steady-state caller, but startup warmup and ad-hoc single calls must
+    not interleave with it.
+    """
+
+    def __init__(
+        self,
+        state,
+        *,
+        max_width: int,
+        model_dims: tuple[int, int, int] | None = None,
+        ladder: tuple[int, ...] | None = None,
+        batch_sizes: tuple[int, ...] = DEFAULT_BATCH_SIZES,
+        quant_tables=None,
+        table_dtype: str = "f32",
+        autotune_cache: str | None = None,
+        warmup_requests: int = 64,
+        health: RuntimeHealth | None = None,
+        events=None,
+    ) -> None:
+        if not batch_sizes or any(b < 1 for b in batch_sizes):
+            raise ValueError(f"batch_sizes must be >= 1, got {batch_sizes!r}")
+        self._state = state
+        self.max_width = int(max_width)
+        self.batch_sizes = tuple(sorted({int(b) for b in batch_sizes}))
+        self.ladder: tuple[int, ...] | None = (
+            tuple(int(w) for w in ladder) if ladder else None
+        )
+        if self.ladder and self.ladder[-1] != self.max_width:
+            raise ValueError(
+                f"ladder must end at max_width ({self.max_width}), got "
+                f"{self.ladder}"
+            )
+        self._model_dims = model_dims
+        self._quant_tables = quant_tables
+        self.table_dtype = table_dtype
+        self._autotune_cache = autotune_cache or None
+        self.warmup_requests = int(warmup_requests)
+        self._health = health or global_health()
+        self._events = events
+        self._lock = threading.RLock()
+        self._compiled: dict[tuple[int, int], object] = {}
+        self._width_samples: list[int] = []
+        self._warmed = False  # True once the ladder's executables exist
+        self.provenance: list[dict] = []
+        self._jit = None
+
+        # per-engine tallies (the health counters are process-global and
+        # would alias across engines); mirrored into the registry below
+        self._n_post_warmup = 0
+        self._compile_counter = self._health.counter("serve_executable_compile")
+        self._forward_counter = self._health.counter("serve_forward")
+        self._post_warmup_counter = self._health.counter(
+            "serve_post_warmup_compile"
+        )
+
+    # ---- construction helpers ------------------------------------------
+    @classmethod
+    def from_predictor(cls, predictor, **kw) -> "ServingEngine":
+        """Build from a loaded :class:`predict.Predictor` (checkpoint +
+        meta): the meta's recorded ladder, quantized tables, and model dims
+        flow through automatically unless overridden."""
+        meta = predictor.meta
+        # only a ladder the checkpoint actually recorded flows through;
+        # the Predictor's geometric fallback guess is for its own offline
+        # single forwards — the server instead learns its ladder from the
+        # live request stream (the documented histogram fallback)
+        kw.setdefault(
+            "ladder", predictor.ladder if predictor.ladder_recorded else None
+        )
+        kw.setdefault("quant_tables", predictor._quant_tables)
+        kw.setdefault("table_dtype", predictor.table_dtype)
+        kw.setdefault(
+            "model_dims",
+            (
+                int(meta["terminal_embed_size"]),
+                int(meta["path_embed_size"]),
+                int(meta["encode_size"]),
+            ),
+        )
+        return cls(predictor.state, max_width=predictor.bag, **kw)
+
+    # ---- forward construction ------------------------------------------
+    def _forward_fn(self):
+        if self._jit is None:
+            import jax
+
+            quant_tables = self._quant_tables
+
+            def forward(state, starts, paths, ends):
+                logits, code_vector, attention = state.apply_fn(
+                    {"params": state.params},
+                    starts, paths, ends,
+                    labels=None, deterministic=True,
+                    quant_tables=quant_tables,
+                )
+                return logits, code_vector, attention
+
+            self._jit = jax.jit(forward)
+        return self._jit
+
+    # ---- the RecompileDetector probe -----------------------------------
+    def _cache_size(self) -> int:
+        """Executable-table size — grows by exactly one per compile, so the
+        obs RecompileDetector can track the engine like a jitted fn."""
+        return len(self._compiled)
+
+    @property
+    def post_warmup_compiles(self) -> int:
+        """Compiles after :meth:`prepare` finished (or after the fallback
+        ladder froze) — a correctly-warmed server holds this at zero."""
+        return self._n_post_warmup
+
+    # ---- ladder resolution ---------------------------------------------
+    @property
+    def active_ladder(self) -> tuple[int, ...]:
+        """The ladder requests pad to RIGHT NOW: the resolved ladder, or
+        just the top width while the histogram fallback is still
+        observing."""
+        return self.ladder if self.ladder else (self.max_width,)
+
+    def observe_width(self, count: int) -> None:
+        """Histogram fallback: record one request's real context count;
+        once ``warmup_requests`` are seen, derive and compile the ladder."""
+        if self.ladder is not None:
+            return
+        with self._lock:
+            if self.ladder is not None:  # froze while we waited on the lock
+                return
+            self._width_samples.append(min(int(count), self.max_width))
+            if len(self._width_samples) < self.warmup_requests:
+                return
+            counts = np.asarray(self._width_samples, np.int64)
+            ladder = derive_bucket_ladder(counts, self.max_width)
+            logger.info(
+                "request-stream histogram froze the serving ladder at %s "
+                "(%d samples)", list(ladder), len(counts),
+            )
+            self.ladder = ladder
+            self._warmed = False
+            self.prepare()
+
+    # ---- startup: consult + compile ------------------------------------
+    def _consult(self, shapes: list[tuple[int, int]]) -> dict[tuple[int, int], dict]:
+        """Autotune-cache consultation for every executable shape; misses
+        are recorded, never searched (search belongs to the offline
+        autotune pass)."""
+        if self._model_dims is None:
+            return {}
+        from code2vec_tpu.ops.autotune import (
+            ShapeKey,
+            consult_schedules,
+            device_kind,
+            get_cache,
+        )
+
+        cache = get_cache(self._autotune_cache)
+        te, pe, enc = self._model_dims
+        kind = device_kind()
+        keys = [
+            ShapeKey(
+                device_kind=kind, batch=b, width=w, terminal_embed=te,
+                path_embed=pe, encode=enc, table_dtype=self.table_dtype,
+            )
+            for b, w in shapes
+        ]
+        records = consult_schedules(keys, cache=cache)
+        return dict(zip(shapes, records))
+
+    def prepare(self) -> list[dict]:
+        """Lower + compile the full executable ladder (idempotent): every
+        (micro-batch size, bucket width) pair. Returns one provenance
+        record per executable — shape, schedule, cache hit — which the
+        server writes into the run manifest."""
+        with self._lock:
+            shapes = [
+                (b, w) for w in self.active_ladder for b in self.batch_sizes
+            ]
+            schedules = self._consult(shapes)
+            for b, w in shapes:
+                if (b, w) in self._compiled:
+                    continue
+                record = {
+                    "batch": b,
+                    "width": w,
+                    "table_dtype": self.table_dtype,
+                    "compile_ms": self._compile(b, w),
+                    "schedule": schedules.get((b, w), {}).get("schedule"),
+                    "schedule_cached": schedules.get((b, w), {}).get("cached"),
+                }
+                self.provenance.append(record)
+                if self._events is not None:
+                    self._events.emit("serve_executable", **record)
+            self._warmed = True
+            self._health.gauge("serve_executables").set(len(self._compiled))
+            return list(self.provenance)
+
+    def _compile(self, b: int, w: int) -> float:
+        """AOT-compile one (batch, width) executable; returns compile ms."""
+        import time
+
+        import jax
+
+        fn = self._forward_fn()
+        struct = jax.ShapeDtypeStruct((b, w), np.int32)
+        t0 = time.perf_counter()
+        with get_tracer().span(
+            "serve_compile", category="serve", batch=b, width=w
+        ):
+            self._compiled[(b, w)] = fn.lower(
+                self._state, struct, struct, struct
+            ).compile()
+        self._compile_counter.inc()
+        if self._warmed:
+            self._n_post_warmup += 1
+            self._post_warmup_counter.inc()
+            logger.warning(
+                "post-warmup executable compile for shape (%d, %d): a "
+                "request shape missed the AOT ladder — the ladder or batch "
+                "sizes do not cover the traffic", b, w,
+            )
+        return round((time.perf_counter() - t0) * 1e3, 3)
+
+    # ---- hot path -------------------------------------------------------
+    def width_for(self, count: int) -> int:
+        """Nearest bucket width for one request's real context count."""
+        return nearest_bucket_width(
+            min(max(int(count), 1), self.max_width), self.active_ladder
+        )
+
+    def batch_size_for(self, n_requests: int) -> int:
+        """Smallest micro-batch size holding ``n_requests`` (callers split
+        anything larger than the top size)."""
+        for b in self.batch_sizes:
+            if n_requests <= b:
+                return b
+        return self.batch_sizes[-1]
+
+    def run(self, starts: np.ndarray, paths: np.ndarray, ends: np.ndarray):
+        """One device call at an exact ``[B, L]`` shape. A shape outside
+        the compiled table compiles on the spot — counted as a post-warmup
+        compile (the thing a warmed server must never do)."""
+        key = (int(starts.shape[0]), int(starts.shape[1]))
+        with self._lock:
+            compiled = self._compiled.get(key)
+            if compiled is None:
+                # a shape miss gets the same provenance/event treatment as
+                # startup compiles — the event log must show every compile
+                # an audit of post_warmup_compiles would ask about
+                was_warmed = self._warmed
+                record = {
+                    "batch": key[0],
+                    "width": key[1],
+                    "table_dtype": self.table_dtype,
+                    "compile_ms": self._compile(*key),
+                    "schedule": None,
+                    "schedule_cached": None,
+                    "post_warmup": was_warmed,
+                }
+                self.provenance.append(record)
+                if self._events is not None:
+                    self._events.emit("serve_executable", **record)
+                self._health.gauge("serve_executables").set(len(self._compiled))
+                compiled = self._compiled[key]
+            self._forward_counter.inc()
+            logits, code_vector, attention = compiled(
+                self._state, starts, paths, ends
+            )
+        return logits, code_vector, attention
+
+    def pad_requests(
+        self, contexts: list[np.ndarray]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, int]:
+        """Pack per-request ``[n_i, 3]`` id arrays into one padded batch.
+
+        Returns ``(starts, paths, ends, batch, width)`` where width is the
+        nearest bucket width for the LONGEST member and batch the smallest
+        micro-batch size holding them all; spare rows are all-PAD. The
+        shared padding rule means a coalesced batch and a one-at-a-time
+        replay land on the same executables (and, per the PR-4 invariant,
+        the same row values: PAD lanes carry exactly-zero attention)."""
+        n = len(contexts)
+        if n > self.batch_sizes[-1]:
+            raise ValueError(
+                f"{n} requests exceed the top micro-batch size "
+                f"{self.batch_sizes[-1]}; the batcher must split the group"
+            )
+        longest = max(len(c) for c in contexts)
+        if longest > self.max_width:
+            raise ValueError(
+                f"a request has {longest} contexts, more than the model's "
+                f"max bag width {self.max_width}; subsample before packing "
+                "(the batcher rejects these at submit)"
+            )
+        width = self.width_for(longest)
+        batch = self.batch_size_for(n)
+        starts = np.full((batch, width), PAD_INDEX, np.int32)
+        paths = np.full((batch, width), PAD_INDEX, np.int32)
+        ends = np.full((batch, width), PAD_INDEX, np.int32)
+        for i, arr in enumerate(contexts):
+            arr = np.asarray(arr, np.int32).reshape(-1, 3)
+            m = arr.shape[0]
+            starts[i, :m] = arr[:, 0]
+            paths[i, :m] = arr[:, 1]
+            ends[i, :m] = arr[:, 2]
+        return starts, paths, ends, batch, width

@@ -1,0 +1,410 @@
+"""Transport-thin request handling: dict in, dict out.
+
+The protocol layer owns everything between a parsed request object and a
+response object — extraction, vocab mapping, batcher submission, softmax/
+top-k postprocessing — and NOTHING about bytes on a wire. Both transports
+are adapters over the same :class:`CodeServer`:
+
+- **stdio-JSONL** (:func:`serve_stdio`): one JSON object per line in, one
+  per line out, responses in request order. The reader thread submits
+  requests as fast as they arrive while the writer resolves them in FIFO
+  order — pipelined clients therefore get real micro-batch coalescing
+  over a pipe, no sockets involved (what the tests and the CI smoke
+  drive).
+- **HTTP** (:func:`serve_http`): stdlib ``ThreadingHTTPServer``; each
+  concurrent POST maps to one handler thread blocking on its future, so
+  concurrency again becomes coalescing.
+
+Request schema (one ``op`` per object; unknown fields ignored)::
+
+    {"op": "predict",   "source": str, "language": "java"|"python",
+     "method_name": "*", "top_k": 5, "include_vector": false}
+    {"op": "embed",     ... same selectors ...}
+    {"op": "neighbors", "vector": [...] | source selectors, "top_k": 5}
+    {"op": "health"}
+    {"op": "shutdown"}
+
+Responses echo an optional ``"id"`` field (client-side correlation) and
+carry ``"error"`` instead of results on failure; :class:`~code2vec_tpu
+.serve.batcher.ServeOverloaded` maps to ``"error_kind": "overloaded"``
+(retryable).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from typing import Callable
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CodeServer", "serve_stdio", "serve_http", "make_http_server"]
+
+
+def _topk_predictions(logits: np.ndarray, label_vocab, top_k: int) -> list[dict]:
+    """Top-k names+probs for one logits row — the SAME numerics as offline
+    prediction, by construction: both call ``predict.softmax_top_k``."""
+    from code2vec_tpu.predict import softmax_top_k
+
+    return [
+        {"name": label_vocab.itos[i], "prob": prob}
+        for i, prob in softmax_top_k(logits, len(label_vocab), top_k)
+    ]
+
+
+class CodeServer:
+    """The serving facade: extraction + mapping on the caller thread,
+    device work through the micro-batcher, postprocess on resolve.
+
+    ``predictor`` supplies vocab mapping and extraction (it already knows
+    the corpus's extraction params and the ``@question`` framing);
+    ``engine``/``batcher`` run the compiled forwards; ``retrieval`` is
+    optional (the ``neighbors`` op errors cleanly without it).
+    """
+
+    def __init__(
+        self, predictor, engine, batcher, retrieval=None, health=None,
+    ) -> None:
+        from code2vec_tpu.obs.runtime import global_health
+
+        self.predictor = predictor
+        self.engine = engine
+        self.batcher = batcher
+        self.retrieval = retrieval
+        self.health = health or global_health()
+        self._shutdown = threading.Event()
+
+    # ---- lifecycle ------------------------------------------------------
+    @property
+    def shutdown_requested(self) -> bool:
+        return self._shutdown.is_set()
+
+    def close(self) -> None:
+        """Drain in-flight requests and stop the batcher."""
+        self.batcher.close()
+
+    # ---- request handling ----------------------------------------------
+    def handle(self, request: dict) -> dict:
+        """Synchronous convenience: submit + wait (the HTTP path).
+        Resolve-time failures (a future carrying the device call's
+        exception) become error payloads here too — handle_async's try
+        only covers submission."""
+        resolver = self.handle_async(request)
+        try:
+            return resolver()
+        except Exception as exc:  # noqa: BLE001 - protocol boundary
+            return self._error_payload(exc)
+
+    def handle_async(self, request: dict) -> Callable[[], dict]:
+        """Submit any device work NOW; return a resolver that blocks for
+        the results and builds the response. The stdio loop calls
+        resolvers in FIFO order on its writer thread while the reader
+        keeps submitting — which is exactly what lets the micro-batcher
+        coalesce a pipelined request stream."""
+        req_id = request.get("id")
+
+        def finish(payload: dict) -> dict:
+            if req_id is not None:
+                payload = {"id": req_id, **payload}
+            return payload
+
+        try:
+            op = request.get("op")
+            if op == "health":
+                # resolve-time snapshot: in a pipelined stream the health
+                # line reports the state AFTER the requests ahead of it,
+                # not the instant it was read off the wire
+                return lambda: finish(self._health_payload())
+            if op == "shutdown":
+                self._shutdown.set()
+                return lambda: finish({"ok": True, "shutting_down": True})
+            if op in ("predict", "embed"):
+                resolver = self._submit_methods(request, op)
+                return lambda: finish(resolver())
+            if op == "neighbors":
+                resolver = self._submit_neighbors(request)
+                return lambda: finish(resolver())
+            return lambda: finish(
+                {"error": f"unknown op {op!r}", "error_kind": "bad_request"}
+            )
+        except Exception as exc:  # noqa: BLE001 - protocol boundary
+            payload = self._error_payload(exc)
+            return lambda: finish(payload)
+
+    @staticmethod
+    def _error_payload(exc: BaseException) -> dict:
+        from code2vec_tpu.serve.batcher import ServeOverloaded, ServerClosed
+
+        if isinstance(exc, ServeOverloaded):
+            kind = "overloaded"
+        elif isinstance(exc, ServerClosed):
+            kind = "closed"
+        elif isinstance(exc, (ValueError, KeyError, TypeError)):
+            kind = "bad_request"
+        else:
+            kind = "internal"
+            logger.exception("request failed")
+        return {"error": f"{type(exc).__name__}: {exc}", "error_kind": kind}
+
+    # ---- ops ------------------------------------------------------------
+    def _health_payload(self) -> dict:
+        engine = self.engine
+        return {
+            "ok": True,
+            "ladder": list(engine.active_ladder),
+            "batch_sizes": list(engine.batch_sizes),
+            "executables": engine._cache_size(),
+            "post_warmup_compiles": engine.post_warmup_compiles,
+            "table_dtype": engine.table_dtype,
+            **self.health.snapshot(),
+        }
+
+    def _submit_methods(self, request: dict, op: str) -> Callable[[], dict]:
+        source = request.get("source")
+        if not isinstance(source, str) or not source.strip():
+            raise ValueError(f"{op!r} needs a non-empty 'source' string")
+        if op == "predict" and not self.predictor.meta.get(
+            "infer_method_name", True
+        ):
+            # same guard as Predictor.predict_source: a variable-task-only
+            # head would serve confident nonsense as method names
+            raise ValueError(
+                "this checkpoint was trained for the variable-name task "
+                "only; 'predict' is unavailable (embed/neighbors still work)"
+            )
+        language = request.get("language", "java")
+        method_name = request.get("method_name", "*")
+        top_k = int(request.get("top_k", 5))
+        include_vector = bool(request.get("include_vector", op == "embed"))
+
+        # extraction + vocab mapping on THIS thread (CPU-bound, no device):
+        # the batcher only ever sees mapped id arrays
+        submitted = []  # (label, n_oov, future | None, n_contexts)
+        for label, contexts, _ in self.predictor._extract(
+            source, method_name, language
+        ):
+            mapped, n_oov = self.predictor._map_contexts(contexts)
+            if len(mapped) > self.engine.max_width:
+                # same seeded subsample rule as the offline Predictor
+                rng = np.random.default_rng(0)
+                keep = rng.choice(
+                    len(mapped), self.engine.max_width, replace=False
+                )
+                mapped = [mapped[i] for i in sorted(keep)]
+            if not mapped:
+                submitted.append((label, n_oov, None, 0))
+                continue
+            arr = np.asarray(mapped, np.int32).reshape(-1, 3)
+            submitted.append((label, n_oov, self.batcher.submit(arr), len(mapped)))
+
+        label_vocab = self.predictor.label_vocab
+
+        def resolve() -> dict:
+            methods = []
+            for label, n_oov, future, n_contexts in submitted:
+                entry: dict = {
+                    "method_name": label,
+                    "n_contexts": n_contexts,
+                    "n_oov": n_oov,
+                }
+                if future is None:
+                    entry["error"] = (
+                        "every context is OOV against the training vocab"
+                    )
+                    methods.append(entry)
+                    continue
+                result = future.result()
+                if op == "predict":
+                    entry["predictions"] = _topk_predictions(
+                        result.logits, label_vocab, top_k
+                    )
+                if include_vector:
+                    entry["code_vector"] = [
+                        float(v) for v in result.code_vector
+                    ]
+                entry["timing"] = {
+                    "queue_wait_ms": result.queue_wait_ms,
+                    "device_ms": result.device_ms,
+                    "coalesced": result.coalesced,
+                    "batch": result.batch,
+                    "width": result.width,
+                }
+                methods.append(entry)
+            return {"ok": True, "methods": methods}
+
+        return resolve
+
+    def _submit_neighbors(self, request: dict) -> Callable[[], dict]:
+        if self.retrieval is None:
+            raise ValueError(
+                "no retrieval index loaded — start the server with "
+                "--code_vec_path (an exported code.vec)"
+            )
+        top_k = int(request.get("top_k", 5))
+        vector = request.get("vector")
+        if vector is not None:
+            vec = np.asarray(vector, np.float32)
+            if vec.shape != (self.retrieval.dim,):
+                raise ValueError(
+                    f"'vector' must have dim {self.retrieval.dim}, got "
+                    f"{vec.shape}"
+                )
+            neighbors = self.retrieval.top_k(vec, top_k)
+            payload = {
+                "ok": True,
+                "neighbors": [
+                    {"name": n, "similarity": s} for n, s in neighbors
+                ],
+            }
+            return lambda: payload
+
+        # source-form: embed through the micro-batcher, then retrieve.
+        # include_vector=True here is internal plumbing — remember whether
+        # the CLIENT also asked for the vector so their flag survives
+        want_vector = bool(request.get("include_vector", False))
+        embed_resolver = self._submit_methods(
+            {**request, "include_vector": True}, "embed"
+        )
+        retrieval = self.retrieval
+
+        def resolve() -> dict:
+            embedded = embed_resolver()
+            for entry in embedded["methods"]:
+                cv = entry.get("code_vector")
+                if cv is not None:
+                    entry["neighbors"] = [
+                        {"name": n, "similarity": s}
+                        for n, s in retrieval.top_k(
+                            np.asarray(cv, np.float32), top_k
+                        )
+                    ]
+                if not want_vector:
+                    entry.pop("code_vector", None)
+            return embedded
+
+        return resolve
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+
+def serve_stdio(server: CodeServer, in_stream, out_stream) -> None:
+    """JSONL over any line-iterable/writable stream pair (stdin/stdout in
+    production, in-memory pipes in tests). Responses keep request order;
+    submission outpaces resolution, so pipelined clients coalesce."""
+    pending: "queue.Queue" = queue.Queue()
+    _EOF = object()
+
+    def reader() -> None:
+        try:
+            for line in in_stream:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    request = json.loads(line)
+                    if not isinstance(request, dict):
+                        raise ValueError("request must be a JSON object")
+                except ValueError as exc:
+                    payload = {
+                        "error": f"bad request line: {exc}",
+                        "error_kind": "bad_request",
+                    }
+                    pending.put(lambda payload=payload: payload)
+                    continue
+                pending.put(server.handle_async(request))
+                if server.shutdown_requested:
+                    break
+        finally:
+            pending.put(_EOF)
+
+    thread = threading.Thread(target=reader, name="c2v-serve-stdin", daemon=True)
+    thread.start()
+    try:
+        while True:
+            resolver = pending.get()
+            if resolver is _EOF:
+                break
+            try:
+                response = resolver()
+            except Exception as exc:  # noqa: BLE001 - keep serving
+                response = CodeServer._error_payload(exc)
+            out_stream.write(json.dumps(response) + "\n")
+            out_stream.flush()
+    finally:
+        server.close()
+        thread.join(timeout=5.0)
+
+
+def make_http_server(server: CodeServer, host: str, port: int):
+    """Build (but don't run) the stdlib threading HTTP server: POST /
+    (or /v1/<op>) with a JSON body; GET /healthz for the health payload.
+    Split from :func:`serve_http` so tests can bind port 0 and read the
+    chosen port before starting ``serve_forever`` on a thread."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path.rstrip("/") in ("", "/healthz".rstrip("/")):
+                self._respond(200, server.handle({"op": "health"}))
+            else:
+                self._respond(404, {"error": "unknown path"})
+
+        def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                request = json.loads(self.rfile.read(length) or b"{}")
+                op = self.path.strip("/").split("/")[-1]
+                if op and "op" not in request and op != "v1":
+                    request["op"] = op
+            except (ValueError, TypeError) as exc:
+                self._respond(
+                    400,
+                    {"error": f"bad body: {exc}", "error_kind": "bad_request"},
+                )
+                return
+            response = server.handle(request)
+            kind = response.get("error_kind")
+            code = {
+                None: 200,
+                "bad_request": 400,
+                "overloaded": 429,
+                "closed": 503,
+                "internal": 500,
+            }.get(kind, 200)
+            self._respond(code, response)
+            if server.shutdown_requested:
+                threading.Thread(
+                    target=httpd.shutdown, daemon=True
+                ).start()
+
+        def log_message(self, fmt, *args):  # quiet: obs carries the metrics
+            logger.debug("http: " + fmt, *args)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    return httpd
+
+
+def serve_http(server: CodeServer, host: str, port: int) -> None:
+    """Run the HTTP transport until shutdown; drains the batcher on exit."""
+    httpd = make_http_server(server, host, port)
+    try:
+        logger.info("serving HTTP on %s:%d", *httpd.server_address[:2])
+        httpd.serve_forever(poll_interval=0.1)
+    finally:
+        server.close()
+        httpd.server_close()

@@ -1,0 +1,161 @@
+"""Top-k nearest-method retrieval over the exported code-vector matrix.
+
+``predict.nearest_from_rows`` is the offline NumPy lookup: one matvec per
+query on the host. The serving endpoint instead keeps the matrix resident
+on the device(s) — L2-normalized once at load, so cosine similarity is a
+plain matmul — and answers each query with one compiled
+``sims = q @ rows.T`` + ``lax.top_k`` call. On a mesh the matrix rows are
+sharded over the ``model`` axis by ``parallel/shardings
+.retrieval_shardings`` (the same tall-skinny rule as the embedding
+tables): the matmul is fully shard-local and the top-k over the sharded
+row axis is the only collective, inserted by GSPMD. Rows are padded to a
+multiple of the axis size so the shard actually happens; pad rows carry a
+``-inf`` similarity bias so they can never surface.
+
+Parity contract (tests/test_serve.py): identical ranking to a NumPy
+normalize→matmul→argsort reference on both the single-device and meshed
+paths.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RetrievalIndex"]
+
+
+class RetrievalIndex:
+    """Device-resident cosine top-k over ``[n_methods, E]`` vectors."""
+
+    def __init__(self, labels: list[str], rows: np.ndarray, mesh=None) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if rows.ndim != 2 or len(labels) != rows.shape[0]:
+            raise ValueError(
+                f"rows must be [len(labels), E]; got {rows.shape} for "
+                f"{len(labels)} labels"
+            )
+        self.labels = list(labels)
+        self.n = len(labels)
+        self.dim = int(rows.shape[1])
+        self._mesh = mesh
+
+        norms = np.linalg.norm(rows.astype(np.float32), axis=1, keepdims=True)
+        unit = rows.astype(np.float32) / np.maximum(norms, 1e-12)
+
+        # pad the row count so the model axis shards it evenly (the
+        # _spec_for_param divisibility rule would otherwise silently
+        # replicate); pad rows get -inf similarity, never surfacing
+        pad_to = 1
+        if mesh is not None:
+            from code2vec_tpu.parallel.mesh import AXIS_MODEL
+
+            pad_to = max(int(mesh.shape[AXIS_MODEL]), 1)
+        n_padded = -(-self.n // pad_to) * pad_to
+        if n_padded != self.n:
+            unit = np.concatenate(
+                [unit, np.zeros((n_padded - self.n, self.dim), np.float32)]
+            )
+        bias = np.zeros(n_padded, np.float32)
+        bias[self.n :] = -np.inf
+
+        if mesh is not None:
+            from code2vec_tpu.parallel.shardings import retrieval_shardings
+
+            sh = retrieval_shardings(mesh)
+            self._rows = jax.device_put(unit, sh["rows"])
+            # the bias aligns with the rows' sharded dim
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._bias = jax.device_put(
+                bias, NamedSharding(mesh, PartitionSpec(sh["rows"].spec[0]))
+            )
+            self._query_sharding = sh["query"]
+        else:
+            self._rows = jnp.asarray(unit)
+            self._bias = jnp.asarray(bias)
+            self._query_sharding = None
+        self._fns: dict[int, object] = {}  # k -> jitted query fn
+
+    @classmethod
+    def from_code_vec(cls, path: str, mesh=None) -> "RetrievalIndex":
+        """Load an exported ``code.vec`` (word2vec text format)."""
+        from code2vec_tpu.formats.vectors_io import read_code_vectors
+
+        labels, rows = read_code_vectors(path)
+        logger.info(
+            "retrieval index: %d vectors of dim %d from %s",
+            len(labels), rows.shape[1] if rows.ndim == 2 else -1, path,
+        )
+        return cls(labels, rows, mesh=mesh)
+
+    # ---- query ----------------------------------------------------------
+    def _bucketed_k(self, k: int) -> int:
+        """Round ``k`` up to a power of two (capped at n): the jitted
+        query fn is compiled per BUCKET, not per client-supplied k, so a
+        client sweeping top_k 1..1000 costs at most log2(n) compiles over
+        the index's whole lifetime instead of one compile per distinct k
+        on the request path — results are sliced back to the exact k."""
+        bucket = 1
+        while bucket < k:
+            bucket *= 2
+        return min(bucket, self.n)
+
+    def _cache_size(self) -> int:
+        """Compiled query-fn count — lets the obs RecompileDetector track
+        the index like the engine's executable table."""
+        return len(self._fns)
+
+    def _fn(self, k: int):
+        fn = self._fns.get(k)
+        if fn is None:
+            import jax
+
+            rows, bias = self._rows, self._bias
+
+            def query(q):  # q: [Q, E] unit-normalized
+                sims = q @ rows.T + bias[None, :]
+                return jax.lax.top_k(sims, k)
+
+            if self._mesh is not None:
+                fn = jax.jit(
+                    query,
+                    in_shardings=self._query_sharding,
+                    out_shardings=self._query_sharding,
+                )
+            else:
+                fn = jax.jit(query)
+            # jit caches per (k bucket, Q): serving queries are Q=1 per
+            # request, so compiles are bounded by log2(n) buckets
+            self._fns[k] = fn
+        return fn
+
+    def top_k_batch(
+        self, vectors: np.ndarray, k: int = 5
+    ) -> list[list[tuple[str, float]]]:
+        """Cosine top-k per query row of ``vectors [Q, E]``."""
+        k = min(int(k), self.n)
+        if k < 1:
+            return [[] for _ in range(len(vectors))]
+        q = np.asarray(vectors, np.float32).reshape(-1, self.dim)
+        qn = np.linalg.norm(q, axis=1, keepdims=True)
+        q = q / np.maximum(qn, 1e-12)
+        values, indices = self._fn(self._bucketed_k(k))(q)
+        values = np.asarray(values)[:, :k]
+        indices = np.asarray(indices)[:, :k]
+        return [
+            [
+                (self.labels[int(i)], float(v))
+                for i, v in zip(indices[row], values[row])
+            ]
+            for row in range(q.shape[0])
+        ]
+
+    def top_k(self, vector: np.ndarray, k: int = 5) -> list[tuple[str, float]]:
+        """Single-query convenience wrapper."""
+        return self.top_k_batch(np.asarray(vector)[None, :], k)[0]

@@ -620,7 +620,16 @@ def train(
         # needs beyond the checkpoint: model dims/flags + the label vocab
         from code2vec_tpu.predict import save_inference_meta
 
-        save_inference_meta(out_dir, config, model_config, data)
+        # fixed-L runs still record a corpus-derived ladder: the serving
+        # layer keys its AOT executables by these widths and should not
+        # need the corpus (or a live-request histogram) to learn them
+        save_inference_meta(
+            out_dir, config, model_config, data,
+            bucket_ladder=bucket_ladder
+            or derive_bucket_ladder(
+                np.diff(data.row_splits), config.max_path_length
+            ),
+        )
 
     state = initial_state
     if state is None:

@@ -1,0 +1,825 @@
+"""Online serving (code2vec_tpu.serve): AOT executable ladder, continuous
+micro-batcher, sharded top-k retrieval, protocol + CLI.
+
+The load-bearing contracts pinned here:
+
+- batched micro-batcher results are BITWISE equal to one-at-a-time
+  dispatch (row-independent forward + exact-zero PAD lanes — the PR-4
+  bucketing invariant carried into serving);
+- a warmed server performs ZERO post-warmup compiles across a
+  mixed-width request stream (the obs RecompileDetector tracks the
+  engine's executable table like a jit cache);
+- deadline coalescing, backpressure shedding, and graceful shutdown
+  draining behave as documented;
+- device top-k retrieval (single-device AND mesh-sharded) ranks
+  identically to a NumPy normalize->matmul->argsort reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from code2vec_tpu.obs.runtime import (
+    LatencyHistogram,
+    RecompileDetector,
+    RuntimeHealth,
+)
+from code2vec_tpu.serve.batcher import (
+    MicroBatcher,
+    ServeOverloaded,
+    ServerClosed,
+)
+from code2vec_tpu.serve.engine import ServingEngine
+from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+pytestmark = pytest.mark.serve
+
+BAG = 16
+LADDER = (4, 8, 16)
+BATCH_SIZES = (1, 4)
+N_TERMINALS, N_PATHS, N_LABELS = 50, 40, 6
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    cfg = TrainConfig(batch_size=4, max_path_length=BAG)
+    mc = Code2VecConfig(
+        terminal_count=N_TERMINALS, path_count=N_PATHS, label_count=N_LABELS,
+        terminal_embed_size=8, path_embed_size=8, encode_size=12,
+        dropout_prob=0.0,
+    )
+    example = {
+        "starts": np.zeros((1, BAG), np.int32),
+        "paths": np.zeros((1, BAG), np.int32),
+        "ends": np.zeros((1, BAG), np.int32),
+        "labels": np.zeros(1, np.int32),
+        "example_mask": np.ones(1, np.float32),
+    }
+    return create_train_state(cfg, mc, jax.random.PRNGKey(0), example)
+
+
+def make_engine(tiny_state, **kw):
+    kw.setdefault("max_width", BAG)
+    kw.setdefault("model_dims", (8, 8, 12))
+    kw.setdefault("ladder", LADDER)
+    kw.setdefault("batch_sizes", BATCH_SIZES)
+    kw.setdefault("health", RuntimeHealth())
+    return ServingEngine(tiny_state, **kw)
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_state):
+    eng = make_engine(tiny_state)
+    eng.prepare()
+    return eng
+
+
+def requests_of(widths, seed=0):
+    """One [n, 3] mapped-context array per width, deterministic."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for n in widths:
+        out.append(
+            np.stack(
+                [
+                    rng.integers(1, N_TERMINALS, n),
+                    rng.integers(1, N_PATHS, n),
+                    rng.integers(1, N_TERMINALS, n),
+                ],
+                axis=1,
+            ).astype(np.int32)
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine: AOT ladder
+# ---------------------------------------------------------------------------
+
+
+def test_prepare_compiles_full_ladder(engine):
+    assert engine._cache_size() == len(LADDER) * len(BATCH_SIZES)
+    assert len(engine.provenance) == len(LADDER) * len(BATCH_SIZES)
+    for record in engine.provenance:
+        assert record["batch"] in BATCH_SIZES
+        assert record["width"] in LADDER
+        assert record["compile_ms"] > 0
+        # schedule provenance consulted per executable (cache miss here —
+        # no autotune pass ran — but the record must say so explicitly)
+        assert record["schedule"]["impl"]
+        assert record["schedule_cached"] is False
+    assert engine.post_warmup_compiles == 0
+
+
+def test_width_and_batch_size_selection(engine):
+    assert [engine.width_for(n) for n in (1, 4, 5, 8, 9, 16, 99)] == [
+        4, 4, 8, 8, 16, 16, 16,
+    ]
+    assert [engine.batch_size_for(k) for k in (1, 2, 4, 7)] == [1, 4, 4, 4]
+
+
+def test_prepare_is_idempotent(engine):
+    before = engine._cache_size()
+    engine.prepare()
+    assert engine._cache_size() == before
+    assert engine.post_warmup_compiles == 0
+
+
+def test_off_ladder_shape_is_a_post_warmup_compile(tiny_state):
+    eng = make_engine(tiny_state, ladder=(BAG,), batch_sizes=(1,))
+    eng.prepare()
+    det = RecompileDetector()
+    det.track("serve_executables", eng, expected_compiles=eng._cache_size())
+    # (2, 16) was never compiled: batch 2 is outside the (1,) size set
+    ids = np.ones((2, BAG), np.int32)
+    eng.run(ids, ids, ids)
+    assert eng.post_warmup_compiles == 1
+    assert det.check() == 1
+
+
+def test_ladder_must_end_at_max_width(tiny_state):
+    with pytest.raises(ValueError, match="end at max_width"):
+        make_engine(tiny_state, ladder=(4, 8))
+
+
+def test_narrow_bag_ladder_is_never_empty():
+    """A bag below derive_bucket_ladder's min_width must still yield a
+    one-rung ladder (the documented 'top width is always max_contexts'
+    contract) — an empty ladder crashed every padding consumer."""
+    from code2vec_tpu.data.pipeline import (
+        derive_bucket_ladder,
+        nearest_bucket_width,
+    )
+
+    assert derive_bucket_ladder(np.asarray([1, 2, 3]), 4) == (4,)
+    assert derive_bucket_ladder(np.zeros(0, np.int64), 7) == (7,)
+    assert nearest_bucket_width(3, (4,)) == 4
+    with pytest.raises(ValueError, match="empty"):
+        nearest_bucket_width(1, ())
+
+
+def test_overlong_request_rejected_at_submit(engine):
+    with MicroBatcher(engine, deadline_ms=0.0, health=RuntimeHealth()) as b:
+        with pytest.raises(ValueError, match="subsample before submitting"):
+            b.submit(requests_of([BAG + 4])[0])
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher: determinism, coalescing, backpressure, shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_batched_bitwise_equals_one_at_a_time(engine):
+    widths = [3, 7, 12, 5, 1, 16, 9, 2]
+    reqs = requests_of(widths)
+    # batched: generous deadline so concurrent submissions coalesce
+    with MicroBatcher(engine, deadline_ms=250.0, health=RuntimeHealth()) as b:
+        futures = [b.submit(r) for r in reqs]
+        batched = [f.result(timeout=60) for f in futures]
+    assert any(r.coalesced > 1 for r in batched)
+    # one-at-a-time: zero deadline, sequential submission
+    with MicroBatcher(engine, deadline_ms=0.0, health=RuntimeHealth()) as b:
+        single = [b.submit(r).result(timeout=60) for r in reqs]
+    for r in single:
+        assert r.coalesced == 1
+    for got, ref, n in zip(batched, single, widths):
+        # bitwise: every per-row op in the forward is row-independent and
+        # PAD lanes contribute exact zeros, so neither the micro-batch
+        # size nor the bucket width changes a request's values
+        assert np.array_equal(got.logits, ref.logits)
+        assert np.array_equal(got.code_vector, ref.code_vector)
+        assert np.array_equal(got.attention, ref.attention)
+        assert got.n_contexts == ref.n_contexts == n
+
+
+def test_zero_post_warmup_recompiles_mixed_stream(tiny_state):
+    health = RuntimeHealth()
+    eng = make_engine(tiny_state, health=health)
+    eng.prepare()
+    det = RecompileDetector()
+    det.track("serve_executables", eng, expected_compiles=eng._cache_size())
+    rng = np.random.default_rng(7)
+    widths = rng.integers(1, BAG + 1, 100).tolist()
+    with MicroBatcher(eng, deadline_ms=1.0, health=health) as b:
+        futures = [b.submit(r) for r in requests_of(widths, seed=7)]
+        for f in futures:
+            f.result(timeout=120)
+    assert det.check() == 0
+    assert eng.post_warmup_compiles == 0
+    snap = health.snapshot()
+    assert snap["counters"]["serve_requests"] == 100
+    assert snap["latencies_ms"]["serve.e2e_ms"]["count"] == 100
+
+
+def test_deadline_coalesces_and_single_request_falls_back(engine):
+    health = RuntimeHealth()
+    with MicroBatcher(engine, deadline_ms=500.0, health=health) as b:
+        futures = [b.submit(r) for r in requests_of([3, 5, 7])]
+        results = [f.result(timeout=60) for f in futures]
+    # all three arrived well inside the window: one device call
+    assert {r.coalesced for r in results} == {3}
+    assert {r.batch for r in results} == {4}
+    with MicroBatcher(engine, deadline_ms=0.0, health=health) as b:
+        r = b.submit(requests_of([5])[0]).result(timeout=60)
+    # low-load fallback: a lone request dispatches alone at batch size 1
+    assert r.coalesced == 1 and r.batch == 1
+
+
+class _GatedEngine:
+    """Engine stub whose device call blocks until released — makes queue
+    states deterministic for backpressure/shutdown tests."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.gate = threading.Event()
+        self.batch_sizes = inner.batch_sizes
+
+    def observe_width(self, n):
+        self._inner.observe_width(n)
+
+    def pad_requests(self, contexts):
+        return self._inner.pad_requests(contexts)
+
+    def run(self, starts, paths, ends):
+        assert self.gate.wait(timeout=60), "gate never released"
+        return self._inner.run(starts, paths, ends)
+
+
+def test_backpressure_rejects_when_pending_full(engine):
+    gated = _GatedEngine(engine)
+    b = MicroBatcher(gated, deadline_ms=0.0, max_pending=2,
+                     health=RuntimeHealth())
+    try:
+        first = b.submit(requests_of([3])[0])  # dequeued, blocks on gate
+        time.sleep(0.2)  # let the batcher pull it off the queue
+        queued = [b.submit(r) for r in requests_of([4, 5])]  # fills pending
+        with pytest.raises(ServeOverloaded, match="queue is full"):
+            b.submit(requests_of([6])[0])
+        gated.gate.set()
+        for f in [first, *queued]:
+            assert f.result(timeout=60).n_contexts > 0
+    finally:
+        gated.gate.set()
+        b.close()
+
+
+def test_graceful_shutdown_drains_in_flight(engine):
+    gated = _GatedEngine(engine)
+    b = MicroBatcher(gated, deadline_ms=0.0, max_pending=16,
+                     health=RuntimeHealth())
+    futures = [b.submit(r) for r in requests_of([3, 9, 14, 2, 6])]
+    closer = threading.Thread(target=b.close)
+    closer.start()
+    time.sleep(0.2)
+    gated.gate.set()  # release the device while close() is draining
+    closer.join(timeout=60)
+    assert not closer.is_alive()
+    for f in futures:  # every accepted request resolved before close returned
+        assert f.done()
+        assert f.result().n_contexts > 0
+    with pytest.raises(ServerClosed):
+        b.submit(requests_of([3])[0])
+
+
+def test_engine_errors_propagate_to_futures(engine):
+    class _Exploding(_GatedEngine):
+        def run(self, *a):
+            raise RuntimeError("device on fire")
+
+    b = MicroBatcher(_Exploding(engine), deadline_ms=0.0,
+                     health=RuntimeHealth())
+    try:
+        f = b.submit(requests_of([3])[0])
+        with pytest.raises(RuntimeError, match="device on fire"):
+            f.result(timeout=60)
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# histogram fallback: no recorded ladder
+# ---------------------------------------------------------------------------
+
+
+def test_request_histogram_freezes_fallback_ladder(tiny_state):
+    health = RuntimeHealth()
+    eng = make_engine(
+        tiny_state, ladder=None, warmup_requests=8, health=health
+    )
+    eng.prepare()
+    assert eng.active_ladder == (BAG,)  # top width only until frozen
+    pre_freeze = eng._cache_size()
+    widths = [2, 3, 2, 4, 3, 2, 16, 3, 2, 4]
+    with MicroBatcher(eng, deadline_ms=0.0, health=health) as b:
+        for f in [b.submit(r) for r in requests_of(widths)]:
+            f.result(timeout=60)
+    assert eng.ladder is not None
+    assert eng.ladder[-1] == BAG
+    assert len(eng.ladder) > 1  # the skewed stream earned a narrow rung
+    assert eng._cache_size() > pre_freeze
+    # the freeze itself is warmup, not churn
+    assert eng.post_warmup_compiles == 0
+
+
+# ---------------------------------------------------------------------------
+# retrieval: parity vs NumPy argsort
+# ---------------------------------------------------------------------------
+
+
+def _np_reference(labels, rows, query, k):
+    unit = rows.astype(np.float32) / np.maximum(
+        np.linalg.norm(rows.astype(np.float32), axis=1, keepdims=True), 1e-12
+    )
+    q = query.astype(np.float32)
+    q = q / max(np.linalg.norm(q), 1e-12)
+    sims = unit @ q
+    order = np.argsort(-sims)[:k]
+    return [(labels[int(i)], float(sims[i])) for i in order]
+
+
+def test_topk_matches_numpy_reference():
+    rng = np.random.default_rng(11)
+    labels = [f"method_{i}" for i in range(57)]
+    rows = rng.normal(size=(57, 12)).astype(np.float32)
+    index = RetrievalIndex(labels, rows)
+    for seed in range(5):
+        q = np.random.default_rng(seed).normal(size=12).astype(np.float32)
+        got = index.top_k(q, 7)
+        ref = _np_reference(labels, rows, q, 7)
+        assert [n for n, _ in got] == [n for n, _ in ref]
+        assert np.allclose([s for _, s in got], [s for _, s in ref], atol=1e-5)
+
+
+def test_topk_sharded_matches_numpy_reference():
+    from code2vec_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 4:
+        pytest.skip("needs >= 4 devices (conftest forces 8 on CPU)")
+    mesh = make_mesh(data=1, model=4, ctx=1, devices=jax.devices()[:4])
+    rng = np.random.default_rng(13)
+    labels = [f"m{i}" for i in range(50)]  # 50 % 4 != 0: exercises padding
+    rows = rng.normal(size=(50, 8)).astype(np.float32)
+    index = RetrievalIndex(labels, rows, mesh=mesh)
+    q = rng.normal(size=8).astype(np.float32)
+    got = index.top_k(q, 5)
+    ref = _np_reference(labels, rows, q, 5)
+    assert [n for n, _ in got] == [n for n, _ in ref]
+    assert np.allclose([s for _, s in got], [s for _, s in ref], atol=1e-5)
+    # pad rows must never surface, even when k spans the whole index
+    everything = index.top_k(q, 50)
+    assert len(everything) == 50
+    assert {n for n, _ in everything} == set(labels)
+
+
+def test_topk_batch_and_k_clamp():
+    labels = ["a", "b", "c"]
+    rows = np.eye(3, dtype=np.float32)
+    index = RetrievalIndex(labels, rows)
+    results = index.top_k_batch(np.eye(3, dtype=np.float32), k=10)
+    assert [r[0][0] for r in results] == ["a", "b", "c"]
+    assert all(len(r) == 3 for r in results)  # k clamped to n
+
+
+def test_topk_compiles_bounded_by_k_buckets():
+    """A client sweeping top_k must not compile one query fn per distinct
+    k on the request path — k rounds up to a power-of-two bucket and the
+    results slice back, so compiles are bounded by log2(n)."""
+    rng = np.random.default_rng(5)
+    labels = [f"m{i}" for i in range(57)]
+    rows = rng.normal(size=(57, 8)).astype(np.float32)
+    index = RetrievalIndex(labels, rows)
+    q = rng.normal(size=8).astype(np.float32)
+    for k in range(1, 20):
+        got = index.top_k(q, k)
+        assert len(got) == k
+        assert [n for n, _ in got] == [
+            n for n, _ in _np_reference(labels, rows, q, k)
+        ]
+    # k 1..19 spans buckets {1, 2, 4, 8, 16, 32}: six compiles, not 19
+    assert index._cache_size() <= 6
+
+
+# ---------------------------------------------------------------------------
+# obs: latency histogram
+# ---------------------------------------------------------------------------
+
+
+def test_latency_histogram_percentiles():
+    hist = LatencyHistogram()
+    for v in range(1, 101):  # 1..100 ms
+        hist.record(float(v))
+    s = hist.summary()
+    assert s["count"] == 100
+    assert s["p50_ms"] == pytest.approx(50, abs=1)
+    assert s["p99_ms"] == pytest.approx(99, abs=1)
+    assert s["max_ms"] == 100
+    assert LatencyHistogram().summary() is None
+
+
+def test_latency_histogram_bounded():
+    hist = LatencyHistogram(max_samples=10)
+    for v in range(100):
+        hist.record(float(v))
+    assert hist.count == 100
+    assert len(hist._samples) == 10
+
+
+def test_latency_histogram_window_evicts_oldest():
+    """Past the cap the buffer is a sliding window: a cold-start outlier
+    must leave after exactly max_samples further records, not 2x."""
+    hist = LatencyHistogram(max_samples=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0):
+        hist.record(v)
+    assert sorted(hist._samples) == [5.0, 6.0, 7.0, 8.0]
+
+
+# ---------------------------------------------------------------------------
+# predictor: ladder-aware padding (the repeat-prediction executable reuse)
+# ---------------------------------------------------------------------------
+
+PY = """
+def add(a, b):
+    total = a + b
+    return total
+
+
+def mul(a, b):
+    product = a * b
+    return product
+
+
+def is_even(n):
+    even = n % 2 == 0
+    return even
+"""
+
+
+@pytest.fixture(scope="module")
+def trained_py(tmp_path_factory):
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.export import export_from_checkpoint
+    from code2vec_tpu.pyextract import extract_python_dataset
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.loop import train
+
+    root = tmp_path_factory.mktemp("serve_py")
+    src, ds, out = root / "src", root / "ds", root / "out"
+    for d in (src, ds, out):
+        d.mkdir()
+    (src / "util.py").write_text(PY)
+    extract_python_dataset(str(ds), str(src), [("util.py", "*")])
+    data = load_corpus(
+        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+    )
+    cfg = TrainConfig(
+        max_epoch=20, batch_size=2, encode_size=32, terminal_embed_size=16,
+        path_embed_size=16, max_path_length=64, lr=0.01, print_sample_cycle=0,
+    )
+    train(cfg, data, out_dir=str(out))
+    # exported vectors power the neighbors/search endpoint
+    export_from_checkpoint(cfg, data, str(out), str(out / "code.vec"))
+    return ds, out
+
+
+def test_meta_records_bucket_ladder(trained_py):
+    _, out = trained_py
+    meta = json.loads((out / "model_meta.json").read_text())
+    ladder = meta["bucket_ladder"]
+    assert ladder and ladder[-1] == 64
+    assert ladder == sorted(set(ladder))
+
+
+def test_unrecorded_ladder_routes_server_to_histogram_fallback(trained_py):
+    """An old checkpoint (no bucket_ladder in meta) must put the SERVER on
+    the request-stream histogram fallback — the Predictor's geometric
+    guess is for its own offline forwards only."""
+    from code2vec_tpu.predict import Predictor
+
+    ds, out = trained_py
+    meta_path = out / "model_meta.json"
+    original = meta_path.read_text()
+    meta = json.loads(original)
+    meta.pop("bucket_ladder")
+    try:
+        meta_path.write_text(json.dumps(meta))
+        p = Predictor(str(out), str(ds / "terminal_idxs.txt"),
+                      str(ds / "path_idxs.txt"))
+        assert not p.ladder_recorded
+        assert p.ladder  # the offline guess still exists and is non-empty
+        eng = ServingEngine.from_predictor(p, health=RuntimeHealth())
+        assert eng.ladder is None  # histogram fallback armed
+        assert eng.active_ladder == (p.bag,)
+    finally:
+        meta_path.write_text(original)
+
+
+def test_predictor_pads_to_ladder_not_full_bag(trained_py):
+    from code2vec_tpu.predict import Predictor
+
+    ds, out = trained_py
+    p = Predictor(str(out), str(ds / "terminal_idxs.txt"),
+                  str(ds / "path_idxs.txt"))
+    assert p.ladder[-1] == p.bag
+    results = p.predict_source(PY, "*", language="python", top_k=2)
+    assert len(results) == 3
+    # tiny methods pad to a narrow rung, not the 64-wide bag
+    from code2vec_tpu.data.pipeline import nearest_bucket_width
+
+    widths = {nearest_bucket_width(m.n_contexts, p.ladder) for m in results}
+    assert max(widths) < p.bag
+    # repeat predictions across differently-sized methods reuse at most
+    # len(ladder) compiled variants of the jitted forward
+    assert p._forward._cache_size() <= len(p.ladder)
+
+
+# ---------------------------------------------------------------------------
+# protocol: dict -> dict handling + stdio transport (no sockets)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def served(trained_py):
+    from code2vec_tpu.serve.__main__ import build_parser, build_server
+
+    ds, out = trained_py
+    args = build_parser().parse_args([
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--deadline_ms", "2",
+    ])
+    server, events = build_server(args)
+    yield server
+    server.close()
+    if events is not None:
+        events.close()
+
+
+def test_server_predict_and_health(served):
+    resp = served.handle({
+        "op": "predict", "source": PY, "language": "python", "top_k": 3,
+    })
+    assert resp["ok"]
+    assert len(resp["methods"]) == 3
+    for m in resp["methods"]:
+        assert m["n_contexts"] > 0
+        assert len(m["predictions"]) == 3
+        probs = [p["prob"] for p in m["predictions"]]
+        assert probs == sorted(probs, reverse=True)
+        assert m["timing"]["width"] in served.engine.active_ladder
+    health = served.handle({"op": "health"})
+    assert health["ok"]
+    assert health["post_warmup_compiles"] == 0
+    assert health["executables"] == len(served.engine.active_ladder) * len(
+        served.engine.batch_sizes
+    )
+
+
+def test_server_neighbors_from_source(served):
+    resp = served.handle({
+        "op": "neighbors", "source": PY, "language": "python",
+        "method_name": "add", "top_k": 3,
+    })
+    assert resp["ok"]
+    (m,) = resp["methods"]
+    assert len(m["neighbors"]) == 3
+    sims = [n["similarity"] for n in m["neighbors"]]
+    assert sims == sorted(sims, reverse=True)
+    # 'add' was exported from the same checkpoint: it finds itself
+    assert m["neighbors"][0]["similarity"] > 0.9
+
+
+def test_server_neighbors_parity_with_numpy(served):
+    q = np.random.default_rng(3).normal(
+        size=served.retrieval.dim
+    ).astype(np.float32)
+    got = served.handle({"op": "neighbors", "vector": q.tolist(), "top_k": 4})
+    # reference straight off the index's own (already-normalized) rows
+    ref = _np_reference(
+        served.retrieval.labels,
+        np.asarray(served.retrieval._rows)[: served.retrieval.n],
+        q,
+        4,
+    )
+    assert [n["name"] for n in got["neighbors"]] == [n for n, _ in ref]
+
+
+def test_server_bad_requests(served):
+    assert served.handle({"op": "nope"})["error_kind"] == "bad_request"
+    assert served.handle({"op": "predict"})["error_kind"] == "bad_request"
+    resp = served.handle({"op": "neighbors", "vector": [1.0]})
+    assert resp["error_kind"] == "bad_request"
+
+
+def test_variable_only_checkpoint_rejects_predict_op(served):
+    """Same guard as Predictor.predict_source: a variable-task-only head
+    must not serve method-name predictions (embed still works — the code
+    vector does not depend on the label head's task)."""
+    served.predictor.meta = {
+        **served.predictor.meta, "infer_method_name": False,
+    }
+    resp = served.handle({"op": "predict", "source": PY, "language": "python"})
+    assert resp["error_kind"] == "bad_request"
+    assert "variable-name task" in resp["error"]
+    assert served.handle(
+        {"op": "embed", "source": PY, "language": "python"}
+    )["ok"]
+
+
+def test_handle_maps_resolve_time_errors(served):
+    """A device-call failure surfaces on the future at resolve time — the
+    sync handle() (the HTTP path) must turn it into an error payload, not
+    let it escape and reset the connection."""
+    import concurrent.futures
+
+    class _BoomBatcher:
+        def submit(self, arr):
+            f = concurrent.futures.Future()
+            f.set_exception(RuntimeError("device on fire"))
+            return f
+
+    real = served.batcher
+    served.batcher = _BoomBatcher()
+    try:
+        resp = served.handle(
+            {"op": "predict", "source": PY, "language": "python"}
+        )
+    finally:
+        served.batcher = real
+    assert resp["error_kind"] == "internal"
+    assert "device on fire" in resp["error"]
+
+
+def test_stdio_roundtrip_pipelined(served):
+    from code2vec_tpu.serve.protocol import serve_stdio
+
+    requests = [
+        {"id": 1, "op": "predict", "source": PY, "language": "python",
+         "top_k": 2},
+        {"id": 2, "op": "embed", "source": PY, "language": "python",
+         "method_name": "mul"},
+        "this is not json",
+        {"id": 3, "op": "health"},
+        {"id": 4, "op": "shutdown"},
+    ]
+    in_lines = [
+        (r if isinstance(r, str) else json.dumps(r)) + "\n" for r in requests
+    ]
+
+    class _Out:
+        def __init__(self):
+            self.lines = []
+
+        def write(self, s):
+            self.lines.append(s)
+
+        def flush(self):
+            pass
+
+    out = _Out()
+    serve_stdio(served, iter(in_lines), out)
+    responses = [json.loads(line) for line in out.lines]
+    assert len(responses) == 5
+    assert responses[0]["id"] == 1 and responses[0]["ok"]
+    assert len(responses[0]["methods"]) == 3
+    assert responses[1]["id"] == 2
+    (mul,) = responses[1]["methods"]
+    assert len(mul["code_vector"]) == 32
+    assert responses[2]["error_kind"] == "bad_request"
+    assert responses[3]["id"] == 3 and responses[3]["post_warmup_compiles"] == 0
+    assert responses[4]["shutting_down"]
+
+
+def test_http_transport_roundtrip(served):
+    import urllib.request
+
+    from code2vec_tpu.serve.protocol import make_http_server
+
+    try:
+        httpd = make_http_server(served, "127.0.0.1", 0)
+    except OSError as exc:  # pragma: no cover - sandboxed CI
+        pytest.skip(f"cannot bind localhost: {exc}")
+    port = httpd.server_address[1]
+    thread = threading.Thread(
+        target=httpd.serve_forever, kwargs={"poll_interval": 0.05}, daemon=True
+    )
+    thread.start()
+    try:
+        body = json.dumps({
+            "op": "predict", "source": PY, "language": "python", "top_k": 1,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = json.loads(resp.read())
+        assert payload["ok"] and len(payload["methods"]) == 3
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] and health["post_warmup_compiles"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# bench --serve: the open-loop load harness
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serve_arm_reports_latency_and_zero_recompiles(tmp_path):
+    bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_SUPERVISED="1",
+        BENCH_SERVE_REQUESTS="60",
+        BENCH_SERVE_QPS="300",
+        BENCH_BAG="16",
+        BENCH_EMBED="8",
+        BENCH_ENCODE="12",
+        BENCH_SERVE_TERMINALS="200",
+        BENCH_SERVE_PATHS="150",
+        BENCH_SERVE_LABELS="20",
+    )
+    proc = subprocess.run(
+        [sys.executable, bench_path, "--serve"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(bench_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "serve_requests_per_sec"
+    assert metric["value"] > 0
+    assert metric["post_warmup_recompiles"] == 0
+    assert 0 < metric["p50_ms"] <= metric["p99_ms"]
+    detail_line = next(
+        l for l in proc.stderr.splitlines() if l.startswith('{"detail"')
+    )
+    detail = json.loads(detail_line)["detail"]
+    assert detail["mode"] == "serve"
+    assert detail["completed"] == 60
+    assert detail["detector_new_compiles"] == 0
+    assert detail["real_contexts_per_sec"] > 0
+    assert 0 < detail["pad_efficiency"] <= 1
+    assert detail["latency_ms"]["device"]["count"] > 0
+    assert len(detail["schedule_provenance"]) == detail["executables"]
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: the CI serve-smoke scenario
+# ---------------------------------------------------------------------------
+
+
+def test_cli_stdio_end_to_end(trained_py):
+    """Start the real server process, pipeline concurrent requests over
+    stdio, assert responses + zero post-warmup recompiles + clean exit."""
+    ds, out = trained_py
+    requests = [
+        {"id": i, "op": "predict", "source": PY, "language": "python",
+         "top_k": 2}
+        for i in range(4)
+    ]
+    requests.append({"id": 98, "op": "health"})
+    requests.append({"id": 99, "op": "shutdown"})
+    payload = "".join(json.dumps(r) + "\n" for r in requests)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "code2vec_tpu.serve",
+            "--model_path", str(out),
+            "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+            "--path_idx_path", str(ds / "path_idxs.txt"),
+            "--transport", "stdio",
+            "--deadline_ms", "5",
+        ],
+        input=payload, capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    responses = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert len(responses) == len(requests)
+    by_id = {r["id"]: r for r in responses}
+    for i in range(4):
+        assert by_id[i]["ok"], by_id[i]
+        assert len(by_id[i]["methods"]) == 3
+    assert by_id[98]["post_warmup_compiles"] == 0
+    assert by_id[98]["counters"]["serve_requests"] >= 12  # 4 reqs x 3 methods
+    assert by_id[99]["shutting_down"]
