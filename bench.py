@@ -36,6 +36,9 @@ reports both steps/sec plus the attribution split (see _prefetch_ab).
 against the fixed-L feed on an identical skewed synth corpus, same ABBA
 best-of protocol, reporting the wall-clock speedup at equal real-context
 throughput accounting (see _bucket_ab).
+``--ooc-ab`` A/Bs the in-RAM epoch feed against the out-of-core mmap-CSR
+feed (formats/corpus_io.py container + MmapCorpusSource) at equal
+real-context work, with host-RSS snapshots in both arms (see _ooc_ab).
 
 Metric honesty: the headline counts REAL path contexts (summed batch
 masks / staged row counts), not padded slots — bag lengths are heavy-
@@ -69,6 +72,8 @@ def _metric_id() -> tuple[str, str]:
         return "fused_kernel_real_contexts_per_sec", "contexts/sec"
     if "--serve" in sys.argv[1:]:
         return "serve_requests_per_sec", "req/sec"
+    if "--ooc-ab" in sys.argv[1:]:
+        return "mmap_csr_real_contexts_per_sec", "contexts/sec"
     return "path_contexts_per_sec_per_chip", "contexts/sec"
 
 
@@ -993,6 +998,221 @@ def _bucket_ab() -> None:
     )
 
 
+def _ooc_ab() -> None:
+    """``--ooc-ab``: in-RAM vs mmap-CSR feed A/B at equal real-context work.
+
+    The out-of-core acceptance instrument (ISSUE 10): one skewed synth
+    corpus is written as TEXT, converted to the binary CSR container
+    (tools/corpus_convert.py), and the same bucketed epoch is trained from
+    both backings — arm A feeds from the in-RAM ``EpochSource`` (the
+    materialized [N, L] path), arm B from ``MmapCorpusSource`` (per-bucket
+    batches gathered straight from the mmap views; no epoch tensor ever
+    exists). Both arms cover every example exactly once per pass over the
+    SAME ladder, so equal real-context work — the wall-clock ratio is the
+    out-of-core feed's cost (or win), not a workload difference. ABBA
+    best-of like the other AB arms. Detail carries both arms' real-context
+    rates, ``pad_efficiency``, the on-disk container size, and two memory
+    records from the obs sampler: ``memory_mmap_feed`` — the host-RSS
+    delta of a full mmap-fed pass measured BEFORE the in-RAM corpus is
+    even loaded (the bounded-memory claim, isolated: nothing
+    in-RAM-arm-sized is live in the process yet) — and per-arm
+    whole-process snapshots taken during the A/B (those necessarily
+    include the other arm's live corpus; context, not the claim).
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.pipeline import (
+        EpochSource,
+        MmapCorpusSource,
+        derive_bucket_ladder,
+        iter_batches,
+    )
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.data.synth import SynthSpec, generate_corpus_files
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.obs.runtime import memory_snapshot
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state, make_train_step
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    batch_size = knob("BENCH_BATCH", 1024, 128)
+    bag = knob("BENCH_BAG", 200, 48)
+    steps = knob("BENCH_AB_STEPS", 30, 10)  # full top-width batches per pass
+    embed_size = knob("BENCH_EMBED", 100, 8)
+    encode_size = knob("BENCH_ENCODE", 100, 16)
+    mean_ctx = knob("BENCH_AB_MEAN_CTX", 60, 16)
+    sigma = _env_float("BENCH_LENGTH_SIGMA", 1.0)
+
+    import tempfile
+
+    spec = SynthSpec(
+        n_methods=max(batch_size * steps, 2048),
+        n_terminals=knob("BENCH_AB_TERMINALS", 360_631, 20_000),
+        n_paths=knob("BENCH_AB_PATHS", 342_845, 20_000),
+        n_labels=knob("BENCH_AB_LABELS", 8_000, 800),
+        mean_contexts=float(mean_ctx),
+        length_sigma=sigma,
+        max_contexts=2 * bag,
+        seed=0,
+    )
+    tmp = tempfile.mkdtemp(prefix="c2v_ooc_ab_")
+    paths = generate_corpus_files(tmp, spec)
+    csr_path = os.path.join(tmp, "corpus.csr")
+    from tools.corpus_convert import text_to_csr
+
+    t0 = time.perf_counter()
+    text_to_csr(paths["corpus"], csr_path)
+    convert_seconds = time.perf_counter() - t0
+    corpus_bytes = os.path.getsize(csr_path)
+
+    # the MMAP side first — and alone: the isolated-feed memory record
+    # below must run while nothing in-RAM-arm-sized is live
+    data_mmap = load_corpus(csr_path, paths["path_idx"], paths["terminal_idx"])
+    assert data_mmap.mmap_backed
+    ladder = derive_bucket_ladder(np.diff(data_mmap.row_splits), bag)
+    counts = np.minimum(np.diff(data_mmap.row_splits), bag)
+    real_total = int(counts.sum())
+
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(data_mmap.label_vocab),
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,
+        dropout_prob=0.25,
+        dtype=jnp.float32,
+    )
+    config = TrainConfig(
+        batch_size=batch_size,
+        max_path_length=bag,
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
+    )
+    class_weights = jnp.ones(model_config.label_count, jnp.float32)
+    item_idx = np.arange(data_mmap.n_items)
+
+    mmap_source = MmapCorpusSource(
+        data_mmap, item_idx, batch_size, bag, ladder=ladder
+    )
+
+    example_stream = mmap_source.batches(np.random.default_rng(0))
+    example = next(example_stream)
+    example_stream.close()
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+    train_step = make_train_step(model_config, class_weights)
+
+    def one_pass(source) -> tuple[int, float]:
+        nonlocal state
+        n = 0
+        t0 = time.perf_counter()
+        # fresh seeded rng per pass -> identical batch plans every pass
+        for b in source.batches(np.random.default_rng(2)):
+            state, loss = train_step(state, jax.device_put(b))
+            float(loss)  # deliberate per-step sync: bounds step latency and keeps timings comparable across rounds  # jaxlint: disable=JX007
+            n += 1
+        return n, time.perf_counter() - t0
+
+    # warmup: compile every ladder width (not timed)
+    one_pass(mmap_source)
+    # THE memory claim, isolated: RSS delta of one full mmap-fed pass with
+    # compiles warm and the in-RAM corpus NOT YET LOADED — nothing
+    # corpus-sized exists in the process except the kernel's page cache
+    rss_before_feed = memory_snapshot().get("host_rss_bytes")
+    one_pass(mmap_source)
+    rss_after_feed = memory_snapshot().get("host_rss_bytes")
+    memory_mmap_feed = {
+        "rss_before_bytes": rss_before_feed,
+        "rss_after_bytes": rss_after_feed,
+        "rss_delta_bytes": (
+            rss_after_feed - rss_before_feed
+            if None not in (rss_before_feed, rss_after_feed)
+            else None
+        ),
+        "corpus_bytes_on_disk": corpus_bytes,
+    }
+
+    # only now bring up the in-RAM arm
+    data_ram = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+        cache=False, native=False,
+    )
+    ram_source = EpochSource(data_ram, item_idx, batch_size, bag, ladder=ladder)
+    one_pass(ram_source)
+
+    repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 3)), 1)
+    ram_times: list[float] = []
+    mmap_times: list[float] = []
+    ram_steps = mmap_steps = 0
+    memory_ram = memory_mmap = None
+    for _ in range(repeats):
+        ram_steps, t = one_pass(ram_source)
+        ram_times.append(t)
+        memory_ram = memory_snapshot()
+        mmap_steps, t = one_pass(mmap_source)
+        mmap_times.append(t)
+        mmap_steps, t = one_pass(mmap_source)
+        mmap_times.append(t)
+        memory_mmap = memory_snapshot()
+        ram_steps, t = one_pass(ram_source)
+        ram_times.append(t)
+    speedup = min(ram_times) / min(mmap_times)
+    mmap_rps = real_total / min(mmap_times)
+    real, slots = mmap_source.pad_stats()
+
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": backend,
+                    "mode": "ooc_ab",
+                    "batch": batch_size,
+                    "bag": bag,
+                    "ladder": list(ladder),
+                    "length_sigma": sigma,
+                    "n_methods": spec.n_methods,
+                    "corpus_bytes_on_disk": corpus_bytes,
+                    "convert_seconds": round(convert_seconds, 2),
+                    "in_ram_steps": ram_steps,
+                    "mmap_steps": mmap_steps,
+                    "pad_efficiency": round(real / slots, 4) if slots else None,
+                    "in_ram_real_contexts_per_sec": round(
+                        real_total / min(ram_times), 1
+                    ),
+                    "mmap_real_contexts_per_sec": round(mmap_rps, 1),
+                    "mmap_vs_in_ram": round(speedup, 4),
+                    "memory_mmap_feed": memory_mmap_feed,
+                    "memory_process_after_in_ram_arm": memory_ram,
+                    "memory_process_after_mmap_arm": memory_mmap,
+                }
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "mmap_csr_real_contexts_per_sec",
+                "value": round(mmap_rps, 1),
+                "unit": "contexts/sec",
+                # in AB mode the baseline IS the same-spec in-RAM arm
+                "vs_baseline": round(speedup, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+
+
 def _kernel_provenance(model_config) -> dict:
     """Kernel impl + schedule provenance for a detail block: the stamp must
     say which lowering produced the number, and — for autotuned runs — how
@@ -1851,6 +2071,8 @@ if __name__ == "__main__":
             _kernel_ab()
         elif "--serve" in sys.argv[1:]:
             _serve_bench()
+        elif "--ooc-ab" in sys.argv[1:]:
+            _ooc_ab()
         else:
             main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver

@@ -197,6 +197,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "--max_path_length (e.g. 25,50,100,200); empty = "
                         "derive a geometric ladder from the corpus length "
                         "histogram (see tools/corpus_stats.py)")
+    parser.add_argument("--corpus_format", type=str, default="auto",
+                        choices=("auto", "text", "csr"),
+                        help="corpus file format: text (L1 corpus.txt), "
+                        "csr (memory-mapped binary container from "
+                        "tools/corpus_convert.py — feeds training through "
+                        "mmap views in bounded host RSS), or auto-detect "
+                        "by magic (default)")
     parser.add_argument("--stream_chunk_items", type=int, default=0,
                         help="stream epochs in chunks of this many rows "
                         "instead of materializing [N, L] tensors (bounds "
@@ -505,6 +512,20 @@ def _run(args: argparse.Namespace, config, events, tracer) -> None:
         )
         shard = feed_groups(mesh)
         logger.info("loading corpus shard %d/%d", shard[0], shard[1])
+    if getattr(args, "corpus_format", "auto") != "auto":
+        # load_corpus dispatches by magic; the explicit flag exists to fail
+        # LOUDLY when the file is not what the operator believes it is
+        # (e.g. a text path after the corpus was converted, silently
+        # falling back to full-RAM parsing on a memory-budgeted host)
+        from code2vec_tpu.formats.corpus_io import is_csr_corpus
+
+        actual = "csr" if is_csr_corpus(args.corpus_path) else "text"
+        if actual != args.corpus_format:
+            raise SystemExit(
+                f"--corpus_format {args.corpus_format} but {args.corpus_path!r} "
+                f"is a {actual} corpus; convert with tools/corpus_convert.py "
+                "or fix the flag"
+            )
     data = load_corpus(
         args.corpus_path,
         args.path_idx_path,

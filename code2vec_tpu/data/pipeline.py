@@ -56,13 +56,20 @@ def split_items(
 
 
 def flat_context_indices(
-    row_splits: np.ndarray, item_idx: np.ndarray
+    row_splits: np.ndarray,
+    item_idx: np.ndarray,
+    row_base: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Vectorized CSR row gather: for the selected items, the flat indices
     of all their contexts plus each context's (segment, position-in-segment).
 
     Returns ``(flat, seg, within)``, each of length ``counts.sum()``. Shared
     by the host epoch builder and device staging (train/device_epoch.py).
+
+    ``row_base`` (sharded mmap corpora — data/reader.py:CorpusData.row_base)
+    overrides each item's base offset into the flat arrays when they are a
+    superset of the local rows; default is the contiguous ``row_splits``
+    layout.
     """
     counts = (row_splits[item_idx + 1] - row_splits[item_idx]).astype(np.int64)
     total = int(counts.sum())
@@ -72,7 +79,8 @@ def flat_context_indices(
     seg = np.repeat(np.arange(len(item_idx), dtype=np.int64), counts)
     seg_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
     within = np.arange(total, dtype=np.int64) - np.repeat(seg_starts, counts)
-    flat = np.repeat(row_splits[item_idx], counts) + within
+    base = row_splits[item_idx] if row_base is None else row_base[item_idx]
+    flat = np.repeat(base, counts) + within
     return flat, seg, within
 
 
@@ -81,6 +89,8 @@ def _segment_subsample(
     item_idx: np.ndarray,
     max_contexts: int,
     rng: np.random.Generator,
+    row_base: np.ndarray | None = None,
+    context_order: str = "shuffled",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Pick up to ``max_contexts`` random contexts per selected item.
 
@@ -91,8 +101,17 @@ def _segment_subsample(
     first L" (model/dataset_builder.py:134-135): draw one uniform per
     context, stably sort by (segment, uniform), keep the first L positions
     of each segment.
+
+    ``context_order="corpus"`` re-sorts the KEPT contexts back to corpus
+    order (the rng draws — and hence the kept SUBSET and the stream's
+    consumption of the generator — are identical to the default "shuffled"
+    mode; only the within-row placement changes). The attention pool is
+    order-invariant mathematically but not bitwise, so canonical order is
+    what makes per-example losses exactly comparable ACROSS feed paths that
+    build rows at different stream positions (the {fixed-L, bucketed,
+    streaming, mmap} parity matrix in tests/test_ooc.py).
     """
-    flat, seg, within = flat_context_indices(row_splits, item_idx)
+    flat, seg, within = flat_context_indices(row_splits, item_idx, row_base)
     total = len(flat)
     if total == 0:
         return flat, seg, within
@@ -102,7 +121,12 @@ def _segment_subsample(
     # so position-in-segment is the same ``within`` sequence
     keep = within < max_contexts
     kept_order = order[keep]
-    return flat[kept_order], seg[keep], within[keep]
+    kept_flat, kept_seg = flat[kept_order], seg[keep]
+    if context_order == "corpus":
+        kept_flat = kept_flat[np.lexsort((kept_flat, kept_seg))]
+    elif context_order != "shuffled":
+        raise ValueError(f"unknown context_order: {context_order!r}")
+    return kept_flat, kept_seg, within[keep]
 
 
 def build_method_epoch(
@@ -110,13 +134,14 @@ def build_method_epoch(
     item_idx: np.ndarray,
     max_contexts: int,
     rng: np.random.Generator,
+    context_order: str = "shuffled",
 ) -> EpochArrays:
     """Method-name task epoch: fresh context subsample per method, with the
     method's own ``@method_0`` token replaced by ``@question``
     (model/dataset_builder.py:122-150)."""
     n = len(item_idx)
     with get_tracer().span("build_method_epoch", category="data", items=n):
-        return _build_method_epoch(data, item_idx, max_contexts, rng)
+        return _build_method_epoch(data, item_idx, max_contexts, rng, context_order)
 
 
 def _build_method_epoch(
@@ -124,9 +149,13 @@ def _build_method_epoch(
     item_idx: np.ndarray,
     max_contexts: int,
     rng: np.random.Generator,
+    context_order: str = "shuffled",
 ) -> EpochArrays:
     n = len(item_idx)
-    flat, row, col = _segment_subsample(data.row_splits, item_idx, max_contexts, rng)
+    flat, row, col = _segment_subsample(
+        data.row_splits, item_idx, max_contexts, rng,
+        row_base=data.row_base, context_order=context_order,
+    )
 
     starts = np.full((n, max_contexts), PAD_INDEX, np.int32)
     paths = np.full((n, max_contexts), PAD_INDEX, np.int32)
@@ -166,7 +195,10 @@ def variable_items(data: CorpusData, item_idx: np.ndarray):
         alias_idx = np.asarray(
             [terminal_stoi[a] for a in alias_names], dtype=np.int32
         )
-        lo, hi = data.row_splits[i], data.row_splits[i + 1]
+        lo = int(
+            data.row_splits[i] if data.row_base is None else data.row_base[i]
+        )
+        hi = lo + int(data.row_splits[i + 1] - data.row_splits[i])
         s, p, e = data.starts[lo:hi], data.paths[lo:hi], data.ends[lo:hi]
         touches = np.isin(s, alias_idx) | np.isin(e, alias_idx)
         yield i, alias_names, alias_idx, s[touches], p[touches], e[touches]
@@ -178,6 +210,7 @@ def build_variable_epoch(
     max_contexts: int,
     rng: np.random.Generator,
     shuffle_variable_indexes: bool = False,
+    context_order: str = "shuffled",
 ) -> EpochArrays:
     """Variable-name task epoch (context2name-style extension).
 
@@ -196,7 +229,8 @@ def build_variable_epoch(
         "build_variable_epoch", category="data", items=len(item_idx)
     ):
         return _build_variable_epoch(
-            data, item_idx, max_contexts, rng, shuffle_variable_indexes
+            data, item_idx, max_contexts, rng, shuffle_variable_indexes,
+            context_order,
         )
 
 
@@ -206,6 +240,7 @@ def _build_variable_epoch(
     max_contexts: int,
     rng: np.random.Generator,
     shuffle_variable_indexes: bool = False,
+    context_order: str = "shuffled",
 ) -> EpochArrays:
     variable_indexes = data.variable_indexes
     perm_map = None
@@ -229,8 +264,12 @@ def _build_variable_epoch(
             rng.shuffle(shuffled)
             perm_map = _index_remap(variable_indexes, shuffled)
 
+        # the permutation is drawn in BOTH order modes so the rng stream's
+        # consumption (and every later draw) is identical; canonical mode
+        # just declines to apply it (see _segment_subsample)
         order = rng.permutation(len(s))
-        s, p, e = s[order], p[order], e[order]
+        if context_order == "shuffled":
+            s, p, e = s[order], p[order], e[order]
 
         for alias_name, var_idx in zip(alias_names, alias_idx):
             mine = (s == var_idx) | (e == var_idx)
@@ -290,17 +329,21 @@ def build_epoch(
     max_contexts: int,
     rng: np.random.Generator,
     shuffle_variable_indexes: bool = False,
+    context_order: str = "shuffled",
 ) -> EpochArrays:
     """Full epoch for whichever tasks the corpus was loaded with, method
     examples first then variable examples (matching the reference's
     concatenation order, model/dataset_builder.py:122-204)."""
     parts: list[EpochArrays] = []
     if data.infer_method:
-        parts.append(build_method_epoch(data, item_idx, max_contexts, rng))
+        parts.append(
+            build_method_epoch(data, item_idx, max_contexts, rng, context_order)
+        )
     if data.infer_variable:
         parts.append(
             build_variable_epoch(
-                data, item_idx, max_contexts, rng, shuffle_variable_indexes
+                data, item_idx, max_contexts, rng, shuffle_variable_indexes,
+                context_order,
             )
         )
     if len(parts) == 1:
@@ -379,22 +422,25 @@ def iter_batches(
 # ---------------------------------------------------------------------------
 
 
-def derive_bucket_ladder(
-    counts: np.ndarray,
+def derive_bucket_ladder_hist(
+    lengths: np.ndarray,
+    weights: np.ndarray,
     max_contexts: int,
     max_buckets: int = 4,
     min_fraction: float = 0.05,
     min_width: int = 8,
 ) -> tuple[int, ...]:
-    """A geometric ladder of bag widths capped at ``max_contexts``, pruned
-    by the corpus length histogram.
+    """:func:`derive_bucket_ladder` from a context-count HISTOGRAM —
+    ``weights[i]`` examples have ``lengths[i]`` real contexts.
 
-    Candidate widths halve down from ``max_contexts`` (e.g. 200 -> {25, 50,
-    100, 200}); a narrow width is kept only if at least ``min_fraction`` of
-    the examples would land in its bucket — sparse buckets just add a
-    compile without saving meaningful padding. The top width is always
-    ``max_contexts`` so long bags are never truncated relative to the
-    fixed-width path.
+    THE shared ladder-derivation entry point for every consumer that has a
+    histogram rather than per-example counts: the CSR container's
+    ``row_splits``-histogram footer (formats/corpus_io.py — the ladder
+    without a context scan), ``tools/corpus_stats.py``, and the serving
+    layer's live request-width warmup fallback (serve/engine.py).
+    Equivalent to expanding the histogram and calling
+    :func:`derive_bucket_ladder`, at O(distinct lengths) instead of
+    O(examples).
     """
     if max_buckets < 1:
         raise ValueError(f"max_buckets must be >= 1, got {max_buckets}")
@@ -412,18 +458,49 @@ def derive_bucket_ladder(
         # ladder (which would crash every nearest_bucket_width consumer)
         widths = [int(max_contexts)]
     widths = sorted(set(widths))
-    counts = np.minimum(np.asarray(counts), max_contexts)
-    if len(counts) and len(widths) > 1:
+    lengths = np.minimum(np.asarray(lengths), max_contexts)
+    weights = np.asarray(weights, np.int64)
+    total = int(weights.sum())
+    if total and len(widths) > 1:
         kept: list[int] = []
         prev = 0
         for width in widths[:-1]:
-            frac = ((counts > prev) & (counts <= width)).mean()
+            frac = (
+                weights[(lengths > prev) & (lengths <= width)].sum() / total
+            )
             if frac >= min_fraction:
                 kept.append(width)
                 prev = width
         kept.append(widths[-1])
         widths = kept
     return tuple(widths)
+
+
+def derive_bucket_ladder(
+    counts: np.ndarray,
+    max_contexts: int,
+    max_buckets: int = 4,
+    min_fraction: float = 0.05,
+    min_width: int = 8,
+) -> tuple[int, ...]:
+    """A geometric ladder of bag widths capped at ``max_contexts``, pruned
+    by the corpus length histogram.
+
+    Candidate widths halve down from ``max_contexts`` (e.g. 200 -> {25, 50,
+    100, 200}); a narrow width is kept only if at least ``min_fraction`` of
+    the examples would land in its bucket — sparse buckets just add a
+    compile without saving meaningful padding. The top width is always
+    ``max_contexts`` so long bags are never truncated relative to the
+    fixed-width path. Per-example-counts front end of
+    :func:`derive_bucket_ladder_hist`.
+    """
+    lengths, weights = np.unique(np.asarray(counts), return_counts=True)
+    return derive_bucket_ladder_hist(
+        lengths, weights, max_contexts,
+        max_buckets=max_buckets,
+        min_fraction=min_fraction,
+        min_width=min_width,
+    )
 
 
 def parse_bucket_ladder(spec: str, max_contexts: int) -> tuple[int, ...] | None:
@@ -530,21 +607,88 @@ def iter_bucketed_batches(
     if rng is not None:
         plans = [plans[i] for i in rng.permutation(len(plans))]
     for width, idx in plans:
-        valid = len(idx)
-        if valid < batch_size:
-            idx = np.concatenate(
-                [idx, np.full(batch_size - valid, idx[0], idx.dtype)]
-            )
-        mask = np.zeros(batch_size, np.float32)
-        mask[:valid] = 1.0
-        yield {
-            "ids": epoch.ids[idx],
-            "starts": epoch.starts[idx, :width],
-            "paths": epoch.paths[idx, :width],
-            "ends": epoch.ends[idx, :width],
-            "labels": epoch.labels[idx],
-            "example_mask": mask,
-        }
+        yield _bucket_batch(epoch, idx, width, batch_size)
+
+
+def _bucket_batch(
+    epoch: EpochArrays, idx: np.ndarray, width: int, batch_size: int
+) -> dict[str, np.ndarray]:
+    """Materialize one ``[B, width]`` batch from epoch rows ``idx`` — THE
+    bucketed batch layout (row-0-repeat padding + example mask), shared by
+    every bucketed iterator so the semantics exist in one place."""
+    valid = len(idx)
+    if valid < batch_size:
+        idx = np.concatenate(
+            [idx, np.full(batch_size - valid, idx[0], idx.dtype)]
+        )
+    mask = np.zeros(batch_size, np.float32)
+    mask[:valid] = 1.0
+    return {
+        "ids": epoch.ids[idx],
+        "starts": epoch.starts[idx, :width],
+        "paths": epoch.paths[idx, :width],
+        "ends": epoch.ends[idx, :width],
+        "labels": epoch.labels[idx],
+        "example_mask": mask,
+    }
+
+
+def bucket_batch_counts(
+    counts: np.ndarray, ladder: tuple[int, ...], batch_size: int
+) -> np.ndarray:
+    """Per-ladder-width batch counts (ceil division) for examples with
+    ``counts`` real contexts — the static epoch geometry behind the
+    host-sharded bucketed width SCHEDULE (train/loop.py): every feed group
+    derives its local counts, the global max per width is agreed once, and
+    short groups pad with masked batches so collective shapes stay in
+    lockstep."""
+    arr = np.asarray(ladder)
+    if not len(counts):
+        return np.zeros(len(arr), np.int64)
+    members = np.bincount(
+        assign_buckets(np.asarray(counts), ladder), minlength=len(arr)
+    )
+    return -(-members // batch_size)
+
+
+def iter_scheduled_bucketed_batches(
+    epoch: EpochArrays,
+    ladder: tuple[int, ...],
+    batch_size: int,
+    schedule: np.ndarray,
+    rng: np.random.Generator | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Bucketed batches following an externally-agreed width ``schedule``
+    (one width per step) instead of a locally-drawn interleave — the
+    host-sharded composition: every feed group walks the SAME schedule, so
+    all hosts dispatch identical collective shapes in lockstep even though
+    their local bucket membership differs. When this group's rows for a
+    width run out before the schedule does, the remaining steps of that
+    width emit fully-masked empty batches (the multi-host no-op step,
+    :func:`empty_batch`).
+
+    ``rng`` shuffles members within each bucket (None = sequential); the
+    schedule itself must already carry whatever interleave the caller
+    wants, drawn from an rng every host shares.
+    """
+    bucket_of = assign_buckets(epoch_context_counts(epoch), ladder)
+    queues: dict[int, np.ndarray] = {}
+    heads: dict[int, int] = {}
+    for b, width in enumerate(ladder):
+        members = np.flatnonzero(bucket_of == b)
+        if rng is not None:
+            members = members[rng.permutation(len(members))]
+        queues[int(width)] = members
+        heads[int(width)] = 0
+    for width in schedule:
+        width = int(width)
+        members, head = queues[width], heads[width]
+        idx = members[head : head + batch_size]
+        heads[width] = head + len(idx)
+        if len(idx) == 0:
+            yield empty_batch(batch_size, width)
+        else:
+            yield _bucket_batch(epoch, idx, width, batch_size)
 
 
 def iter_streaming_batches(
@@ -555,6 +699,7 @@ def iter_streaming_batches(
     chunk_items: int = 65536,
     pad_final: bool = True,
     shuffle: bool = True,
+    ladder: tuple[int, ...] | None = None,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Stream an epoch as static-shape batches without materializing [N, L].
 
@@ -571,7 +716,22 @@ def iter_streaming_batches(
     subsample is independent per item, so chunked construction draws the
     same distribution as a whole-epoch build). Variable-task expansion may
     return more examples than items; the carry buffer absorbs that.
+
+    ``ladder``: emit length-aware BUCKETED ``[B, L_b]`` batches instead of
+    fixed-shape ones — the streaming x bucketed composition. Rows are
+    assigned to buckets as each chunk is built; each bucket carries its
+    sub-batch remainder across chunk boundaries, and each chunk's ready
+    batches go out in a seeded interleave (``rng``). Same static-shape
+    contract as :func:`iter_bucketed_batches`: only ladder widths appear,
+    every batch has exactly ``batch_size`` rows, partial batches are
+    row-0-padded and masked.
     """
+    if ladder is not None:
+        yield from _iter_streaming_bucketed_batches(
+            epoch_builder, item_idx, ladder, batch_size, rng,
+            chunk_items=chunk_items, pad_final=pad_final, shuffle=shuffle,
+        )
+        return
     order = rng.permutation(len(item_idx)) if shuffle else np.arange(len(item_idx))
     carry: EpochArrays | None = None
 
@@ -601,6 +761,68 @@ def iter_streaming_batches(
         # ``yield from`` hands back emit()'s return value: the sub-batch
         # remainder carried into the next chunk (None once padded/emitted)
         carry = yield from emit(chunk, final)
+
+
+def _iter_streaming_bucketed_batches(
+    epoch_builder,
+    item_idx: np.ndarray,
+    ladder: tuple[int, ...],
+    batch_size: int,
+    rng: np.random.Generator,
+    chunk_items: int = 65536,
+    pad_final: bool = True,
+    shuffle: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """The bucketed body of :func:`iter_streaming_batches` (``ladder=``).
+
+    Per chunk: build, assign rows to ladder buckets, join each bucket's
+    rows onto its carry, emit the full batches (interleaved by ``rng``),
+    and keep each bucket's ``< batch_size`` remainder as the next carry —
+    so peak materialization stays chunk-bounded while every emitted shape
+    is a ladder width. The final chunk flushes all remainders as padded,
+    masked partial batches (``pad_final``).
+    """
+    order = (
+        rng.permutation(len(item_idx)) if shuffle else np.arange(len(item_idx))
+    )
+    carry: list[EpochArrays | None] = [None] * len(ladder)
+    for lo in range(0, len(order), chunk_items):
+        chunk_idx = item_idx[order[lo : lo + chunk_items]]
+        with get_tracer().span(
+            "stream_chunk", category="data", items=len(chunk_idx)
+        ):
+            chunk = epoch_builder(chunk_idx)
+        final = lo + chunk_items >= len(order)
+        bucket_of = assign_buckets(epoch_context_counts(chunk), ladder)
+        plans: list[tuple[int, EpochArrays]] = []
+        for b, width in enumerate(ladder):
+            part = _gather_epoch_rows(chunk, np.flatnonzero(bucket_of == b))
+            if carry[b] is not None and len(carry[b]):
+                part = _concat_epochs([carry[b], part])
+            n_full = len(part) // batch_size * batch_size
+            for s in range(0, n_full, batch_size):
+                plans.append((width, _slice_epoch(part, s, s + batch_size)))
+            rest = _slice_epoch(part, n_full, len(part))
+            if final and len(rest) and pad_final:
+                plans.append((width, rest))
+                rest = None
+            carry[b] = rest if rest is not None and len(rest) else None
+        if shuffle:
+            plans = [plans[i] for i in rng.permutation(len(plans))]
+        for width, part in plans:
+            yield _bucket_batch(
+                part, np.arange(len(part)), width, batch_size
+            )
+
+
+def _gather_epoch_rows(epoch: EpochArrays, idx: np.ndarray) -> EpochArrays:
+    return EpochArrays(
+        ids=epoch.ids[idx],
+        starts=epoch.starts[idx],
+        paths=epoch.paths[idx],
+        ends=epoch.ends[idx],
+        labels=epoch.labels[idx],
+    )
 
 
 def _slice_epoch(epoch: EpochArrays, lo: int, hi: int) -> EpochArrays:
@@ -700,6 +922,367 @@ def pad_batch_stream(
     while count < n_steps:
         count += 1
         yield template
+
+
+# ---------------------------------------------------------------------------
+# Batch sources: every host epoch variant behind ONE protocol
+#
+# The train loop used to pick among hand-wired epoch branches (fixed-L,
+# bucketed, streaming, host-sharded, prefetched), and the best ones were
+# mutually exclusive. A BatchSource owns one split's epoch construction and
+# exposes the same four things for every variant, so the loop — and the
+# prefetcher, the sharded feed padding, and mid-epoch resume — compose with
+# all of them:
+#
+# - ``ladder``: the static shape ladder the source emits (a single-width
+#   ladder is the fixed-L case) — the run's whole compile budget;
+# - ``batches(rng, shuffle)``: one epoch's stream, a PURE FUNCTION of the
+#   rng state at the call — which is exactly what makes ``skip_batches``
+#   mid-epoch resume replay work on every variant;
+# - ``scheduled_batches(rng, schedule)``: the same stream following an
+#   externally-agreed width schedule (host-sharded lockstep);
+# - ``pad_stats()``: (real context slots, padded slots) for the last built
+#   epoch — the ``pad_efficiency`` honesty metric, now reported by every
+#   variant including streaming.
+# ---------------------------------------------------------------------------
+
+
+class BatchSource:
+    """Protocol base for host epoch feeds (see module section comment).
+
+    ``last_epoch`` holds the most recently built :class:`EpochArrays` for
+    sources that materialize one (the in-RAM source) — exports and
+    print_sample reuse it instead of re-drawing; out-of-core sources leave
+    it None and callers fall back to an on-demand build.
+    """
+
+    ladder: tuple[int, ...] = ()
+    last_epoch: EpochArrays | None = None
+
+    def batches(
+        self, rng: np.random.Generator, shuffle: bool = True
+    ) -> Iterator[dict[str, np.ndarray]]:
+        raise NotImplementedError
+
+    def scheduled_batches(
+        self,
+        rng: np.random.Generator,
+        schedule: np.ndarray,
+        shuffle: bool = True,
+    ) -> Iterator[dict[str, np.ndarray]]:
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot follow an external width "
+            "schedule (host-sharded bucketed feeding); use the in-RAM or "
+            "mmap-CSR source (convert the corpus with "
+            "tools/corpus_convert.py and pass --corpus_format csr)"
+        )
+
+    def pad_stats(self) -> tuple[int, int] | None:
+        """(real, slots) of the last streamed epoch; None before any."""
+        return None
+
+    def _accounted(self, stream):
+        """Tally (real context slots, total padded slots) while a stream is
+        consumed — the streaming/mmap variants' ``pad_stats`` backing.
+        Masked rows (partial-batch padding, lockstep empties) count as
+        slots but never as real contexts, matching :func:`pad_stats`."""
+        real = slots = 0
+        try:
+            for batch in stream:
+                valid = batch["example_mask"].astype(bool)
+                real += int((batch["paths"][valid] != PAD_INDEX).sum())
+                slots += int(batch["paths"].size)
+                yield batch
+        finally:
+            self._last_pad = (real, slots)
+
+
+class EpochSource(BatchSource):
+    """The in-RAM variant: one materialized :class:`EpochArrays` per epoch,
+    batched fixed-L or bucketed. The build happens at the stream's first
+    pull (not at :meth:`batches` time) so the host RNG draw order is
+    identical to the historical loop — resumes of old checkpoints replay
+    bitwise."""
+
+    def __init__(
+        self,
+        data: CorpusData,
+        item_idx: np.ndarray,
+        batch_size: int,
+        max_contexts: int,
+        ladder: tuple[int, ...] | None = None,
+        shuffle_variable_indexes: bool = False,
+        context_order: str = "shuffled",
+    ):
+        self.data = data
+        self.item_idx = np.asarray(item_idx)
+        self.batch_size = int(batch_size)
+        self.max_contexts = int(max_contexts)
+        self.ladder = tuple(ladder) if ladder else (int(max_contexts),)
+        self._bucketed = ladder is not None
+        self._svi = shuffle_variable_indexes
+        self._context_order = context_order
+        self.last_epoch: EpochArrays | None = None
+        # (n_rows, real, slots): per-row counts are min(raw count, bag)
+        # regardless of which contexts the per-epoch subsample picked, so
+        # the O(N*L) scan need not repeat every epoch
+        self._pad_cache: tuple[int, int, int] | None = None
+        # set by a scheduled (host-sharded lockstep) stream: its masked
+        # empty batches are dispatched work the exact epoch geometry does
+        # not see, so pad accounting must come from the stream tally —
+        # keeping pad_efficiency's meaning identical across backings
+        # (MmapCorpusSource always tallies)
+        self._last_pad: tuple[int, int] | None = None
+
+    def _build(self, rng: np.random.Generator) -> EpochArrays:
+        epoch = build_epoch(
+            self.data, self.item_idx, self.max_contexts, rng, self._svi,
+            self._context_order,
+        )
+        self.last_epoch = epoch
+        return epoch
+
+    def batches(self, rng, shuffle: bool = True):
+        self._last_pad = None  # exact geometry applies to a plain epoch
+
+        def gen():
+            epoch = self._build(rng)
+            if self._bucketed:
+                yield from iter_bucketed_batches(
+                    epoch, self.ladder, self.batch_size,
+                    rng=rng if shuffle else None, pad_final=True,
+                )
+            else:
+                yield from iter_batches(
+                    epoch, self.batch_size,
+                    rng=rng if shuffle else None, pad_final=True,
+                )
+
+        return gen()
+
+    def scheduled_batches(self, rng, schedule, shuffle: bool = True):
+        def gen():
+            epoch = self._build(rng)
+            yield from iter_scheduled_bucketed_batches(
+                epoch, self.ladder, self.batch_size, schedule,
+                rng=rng if shuffle else None,
+            )
+
+        return self._accounted(gen())
+
+    def pad_stats(self) -> tuple[int, int] | None:
+        if self._last_pad is not None:
+            # a scheduled stream ran: report the DISPATCHED slots (incl.
+            # lockstep empties), same accounting the mmap source uses
+            return self._last_pad
+        if self.last_epoch is None:
+            return None
+        n_rows = len(self.last_epoch.ids)
+        if self._pad_cache is None or self._pad_cache[0] != n_rows:
+            real, slots = pad_stats(
+                epoch_context_counts(self.last_epoch),
+                self.ladder,
+                self.batch_size,
+            )
+            self._pad_cache = (n_rows, real, slots)
+        _, real, slots = self._pad_cache
+        return real, slots
+
+
+class StreamingSource(BatchSource):
+    """The bounded-RSS variant: chunked epoch builds
+    (:func:`iter_streaming_batches`), fixed-L or — new — bucketed via the
+    per-bucket carry. Works over any CorpusData backing, including the
+    mmap-CSR container (chunk gathers page only the touched rows), and is
+    the out-of-core path for the VARIABLE task, whose per-item expansion
+    defeats the gather source's static batch plans."""
+
+    def __init__(
+        self,
+        data: CorpusData,
+        item_idx: np.ndarray,
+        batch_size: int,
+        max_contexts: int,
+        chunk_items: int,
+        ladder: tuple[int, ...] | None = None,
+        shuffle_variable_indexes: bool = False,
+        context_order: str = "shuffled",
+    ):
+        self.data = data
+        self.item_idx = np.asarray(item_idx)
+        self.batch_size = int(batch_size)
+        self.max_contexts = int(max_contexts)
+        self.chunk_items = int(chunk_items)
+        self.ladder = tuple(ladder) if ladder else (int(max_contexts),)
+        self._bucket_ladder = tuple(ladder) if ladder else None
+        self._svi = shuffle_variable_indexes
+        self._context_order = context_order
+        self._last_pad: tuple[int, int] | None = None
+
+    def batches(self, rng, shuffle: bool = True):
+        def chunk_builder(idx):
+            return build_epoch(
+                self.data, idx, self.max_contexts, rng, self._svi,
+                self._context_order,
+            )
+
+        return self._accounted(
+            iter_streaming_batches(
+                chunk_builder, self.item_idx, self.batch_size, rng,
+                chunk_items=self.chunk_items, shuffle=shuffle,
+                ladder=self._bucket_ladder,
+            )
+        )
+
+    def pad_stats(self) -> tuple[int, int] | None:
+        return self._last_pad
+
+
+class MmapCorpusSource(BatchSource):
+    """The never-materialize variant: batches gathered straight from the
+    (mmap-backed) CSR arrays, per bucket — no ``[N, L]`` epoch tensor
+    exists at ANY point, so host RSS stays bounded by one batch regardless
+    of corpus size (the out-of-core acceptance bar; see the rlimit test in
+    tests/test_ooc.py).
+
+    The epoch geometry (bucket membership) is corpus-static for the method
+    task — ``min(row count, top width)`` per item — so the batch plan comes
+    from ``row_splits`` alone; each planned ``[B, L_b]`` batch then runs
+    the standard per-method context subsample over just its ``B`` items
+    (:func:`build_method_epoch` at the bucket's width). Method task only:
+    the variable expansion is data-dependent per item — route those through
+    :class:`StreamingSource`, which composes with mmap backing too.
+    """
+
+    def __init__(
+        self,
+        data: CorpusData,
+        item_idx: np.ndarray,
+        batch_size: int,
+        max_contexts: int,
+        ladder: tuple[int, ...] | None = None,
+        context_order: str = "shuffled",
+    ):
+        if data.infer_variable:
+            raise ValueError(
+                "MmapCorpusSource supports the method task only (the "
+                "variable expansion is data-dependent per item); use "
+                "stream_chunk_items for variable-task out-of-core feeding"
+            )
+        self.data = data
+        self.item_idx = np.asarray(item_idx)
+        self.batch_size = int(batch_size)
+        self.max_contexts = int(max_contexts)
+        self.ladder = tuple(ladder) if ladder else (int(max_contexts),)
+        self._context_order = context_order
+        counts = (
+            data.row_splits[self.item_idx + 1]
+            - data.row_splits[self.item_idx]
+        )
+        self._counts = np.minimum(counts, self.ladder[-1])
+        self._last_pad: tuple[int, int] | None = None
+
+    def _plan(
+        self, rng: np.random.Generator | None
+    ) -> list[tuple[int, np.ndarray]]:
+        """The epoch's (width, items) batch plan — same shuffle/interleave
+        draws as :func:`iter_bucketed_batches` (a single-width ladder
+        degenerates to the fixed-L plan)."""
+        bucket_of = assign_buckets(self._counts, self.ladder)
+        plans: list[tuple[int, np.ndarray]] = []
+        for b, width in enumerate(self.ladder):
+            members = self.item_idx[bucket_of == b]
+            if rng is not None:
+                members = members[rng.permutation(len(members))]
+            for lo in range(0, len(members), self.batch_size):
+                plans.append((width, members[lo : lo + self.batch_size]))
+        if rng is not None:
+            plans = [plans[i] for i in rng.permutation(len(plans))]
+        return plans
+
+    def _gather(
+        self, items: np.ndarray, width: int, rng: np.random.Generator
+    ) -> dict[str, np.ndarray]:
+        sub = build_method_epoch(
+            self.data, items, width, rng, self._context_order
+        )
+        return _bucket_batch(sub, np.arange(len(items)), width, self.batch_size)
+
+    def batches(self, rng, shuffle: bool = True):
+        def gen():
+            for width, items in self._plan(rng if shuffle else None):
+                yield self._gather(items, width, rng)
+
+        return self._accounted(gen())
+
+    def scheduled_batches(self, rng, schedule, shuffle: bool = True):
+        """Follow an external width schedule (host-sharded lockstep): the
+        gather plan is random-access, so ANY schedule order costs no
+        buffering — the composition text streaming cannot offer."""
+
+        def gen():
+            bucket_of = assign_buckets(self._counts, self.ladder)
+            queues: dict[int, np.ndarray] = {}
+            heads: dict[int, int] = {}
+            for b, width in enumerate(self.ladder):
+                members = self.item_idx[bucket_of == b]
+                if shuffle:
+                    members = members[rng.permutation(len(members))]
+                queues[int(width)] = members
+                heads[int(width)] = 0
+            for width in schedule:
+                width = int(width)
+                members, head = queues[width], heads[width]
+                items = members[head : head + self.batch_size]
+                heads[width] = head + len(items)
+                if len(items) == 0:
+                    yield empty_batch(self.batch_size, width)
+                else:
+                    yield self._gather(items, width, rng)
+
+        return self._accounted(gen())
+
+    def pad_stats(self) -> tuple[int, int] | None:
+        return self._last_pad
+
+
+def make_batch_source(
+    data: CorpusData,
+    item_idx: np.ndarray,
+    batch_size: int,
+    max_contexts: int,
+    ladder: tuple[int, ...] | None = None,
+    stream_chunk_items: int = 0,
+    shuffle_variable_indexes: bool = False,
+    context_order: str = "shuffled",
+) -> BatchSource:
+    """Pick the feed variant for one split — THE policy point:
+
+    - ``stream_chunk_items > 0``: chunked streaming (any backing, any task);
+    - mmap-backed corpus (CSR container), method task: the never-materialize
+      per-bucket gather source;
+    - otherwise: the in-RAM epoch source.
+
+    ``ladder=None`` means fixed-L; every source treats it as the
+    single-width ladder, so bucketing composes with all of them.
+    """
+    if stream_chunk_items:
+        return StreamingSource(
+            data, item_idx, batch_size, max_contexts, stream_chunk_items,
+            ladder=ladder,
+            shuffle_variable_indexes=shuffle_variable_indexes,
+            context_order=context_order,
+        )
+    if data.mmap_backed and not data.infer_variable:
+        return MmapCorpusSource(
+            data, item_idx, batch_size, max_contexts, ladder=ladder,
+            context_order=context_order,
+        )
+    return EpochSource(
+        data, item_idx, batch_size, max_contexts, ladder=ladder,
+        shuffle_variable_indexes=shuffle_variable_indexes,
+        context_order=context_order,
+    )
 
 
 def oov_rate(

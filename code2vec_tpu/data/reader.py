@@ -62,6 +62,20 @@ class CorpusData:
     # (reference: model/dataset_reader.py:54-56)
     variable_indexes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
 
+    # out-of-core backing (formats/corpus_io.py CSR container): True when
+    # starts/paths/ends are mmap VIEWS of the on-disk container — gathers
+    # touch only the rows they index and the OS pages the file lazily, so
+    # holding this CorpusData costs ~zero host RSS at any corpus size. The
+    # batch-source factory (data/pipeline.py) picks the never-materialize
+    # feed for such corpora.
+    mmap_backed: bool = False
+    # per-item base offsets into the FLAT context arrays when they differ
+    # from ``row_splits[:-1]`` — a sharded mmap corpus keeps the full
+    # on-disk arrays (no gather copy) with LOCAL row_splits, so local item
+    # i's contexts live at ``row_base[i] : row_base[i] + count_i`` of the
+    # global arrays. None = contiguous (row_splits themselves).
+    row_base: np.ndarray | None = None
+
     # host-shard bookkeeping (multi-host pods, SURVEY §7.4): when loaded
     # with load_corpus(..., shard=(index, count)), this CorpusData holds
     # only records assigned round-robin to this host (record i is local iff
@@ -209,6 +223,149 @@ def _write_cache(corpus_path, fingerprint, data: "CorpusData") -> None:
         logger.warning("could not write corpus cache (continuing): %s", e)
 
 
+def _build_label_state(headers, var_lists, infer_method, infer_variable):
+    """Per-record label/alias processing — ONE implementation for every
+    loader (python parser, native parser, CSR container), so label-vocab
+    insertion order (and hence label indices) cannot drift between them
+    (reference: model/dataset_reader.py:94-125). ALWAYS over every record,
+    even when sharded: the vocab must be global."""
+    label_vocab = Vocab()
+    labels: list[int] = []
+    normalized_labels: list[str] = []
+    sources: list[str | None] = []
+    aliases: list[dict[str, str]] = []
+    for (label, source), var_pairs in zip(headers, var_lists):
+        sources.append(source)
+        normalized_lower, _ = normalize_and_subtokenize(label)
+        normalized_labels.append(normalized_lower)
+        labels.append(label_vocab.add_label(label) if infer_method else -1)
+        alias_map: dict[str, str] = {}
+        for original, alias in var_pairs:
+            normalized_var, _ = normalize_and_subtokenize(original)
+            alias_map[alias] = normalized_var.lower()
+            if infer_variable and alias.startswith("@var_"):
+                label_vocab.add_label(original)
+        aliases.append(alias_map)
+    return label_vocab, labels, normalized_labels, sources, aliases
+
+
+def _variable_indexes_of(terminal_vocab: Vocab) -> np.ndarray:
+    return np.asarray(
+        sorted(
+            idx
+            for name, idx in terminal_vocab.stoi.items()
+            if name.startswith("@var_")
+        ),
+        dtype=np.int32,
+    )
+
+
+def load_corpus_csr(
+    corpus_path: str | os.PathLike,
+    path_idx_path: str | os.PathLike,
+    terminal_idx_path: str | os.PathLike,
+    infer_method: bool = True,
+    infer_variable: bool = False,
+    shard: tuple[int, int] | None = None,
+) -> CorpusData:
+    """Load a CSR container (formats/corpus_io.py) as an mmap-backed
+    CorpusData — the out-of-core corpus path.
+
+    The context arrays stay mmap VIEWS of the on-disk sections (the
+    container stores terminal ids already ``@question``-shifted, so the
+    views feed training zero-copy); only O(n_items) bookkeeping and the
+    label/alias string pass materialize. ``shard=(index, count)`` keeps the
+    FULL on-disk arrays (no gather copy — they cost no RSS) and maps this
+    host's round-robin items onto them via LOCAL ``row_splits`` plus
+    ``row_base`` global flat offsets, so host-sharded pod feeding composes
+    with mmap at zero per-host context RSS.
+    """
+    from code2vec_tpu.formats.corpus_io import FLAG_ID, open_corpus_csr
+
+    corpus = open_corpus_csr(corpus_path)
+    path_vocab = read_vocab(path_idx_path)
+    logger.info("path vocab size: %d", len(path_vocab))
+    terminal_vocab = read_vocab(terminal_idx_path, extra_tokens=[QUESTION_TOKEN_NAME])
+    logger.info("terminal vocab size: %d", len(terminal_vocab))
+
+    if corpus.terminal_shift == QUESTION_TOKEN_INDEX:
+        starts, paths, ends = corpus.starts, corpus.paths, corpus.ends
+        mmap_backed = True
+    else:
+        # container written without the standard shift: materialize once
+        # (loses the zero-RSS property; re-convert with the default shift)
+        logger.warning(
+            "CSR container stores terminal_shift=%d (expected %d); "
+            "materializing shifted copies — re-run tools/corpus_convert.py "
+            "for zero-copy mmap feeding",
+            corpus.terminal_shift, QUESTION_TOKEN_INDEX,
+        )
+        delta = np.int32(QUESTION_TOKEN_INDEX - corpus.terminal_shift)
+        starts = corpus.starts + delta
+        ends = corpus.ends + delta
+        paths = np.array(corpus.paths)
+        mmap_backed = False
+
+    n = corpus.n_items
+    # the label/alias pass mirrors the text loaders record-for-record (the
+    # blobs are small next to the context sections)
+    headers = [(corpus.label(i) or "", corpus.source(i)) for i in range(n)]
+    var_lists = [corpus.aliases(i) for i in range(n)]
+    label_vocab, labels, normalized_labels, sources, aliases = (
+        _build_label_state(headers, var_lists, infer_method, infer_variable)
+    )
+
+    ids_arr = corpus.ids.astype(np.int64)
+    missing_id = (corpus.flags & FLAG_ID) == 0  # records without a #id line
+    if missing_id.any():
+        ids_arr = ids_arr.copy()
+        ids_arr[missing_id] = np.nonzero(missing_id)[0]
+
+    global_splits = corpus.row_splits
+    row_base = None
+    if shard is not None:
+        index, count = shard
+        local = np.arange(index, n, count)
+        local_counts = np.diff(global_splits)[local]
+        row_splits = np.zeros(len(local) + 1, np.int64)
+        np.cumsum(local_counts, out=row_splits[1:])
+        row_base = global_splits[local].astype(np.int64)
+        ids_arr = ids_arr[local]
+        labels = labels[index::count]
+        normalized_labels = normalized_labels[index::count]
+        sources = sources[index::count]
+        aliases = aliases[index::count]
+    else:
+        row_splits = global_splits.astype(np.int64)
+
+    data = CorpusData(
+        starts=starts,
+        paths=paths,
+        ends=ends,
+        row_splits=row_splits,
+        ids=ids_arr,
+        labels=np.asarray(labels, dtype=np.int32),
+        normalized_labels=normalized_labels,
+        sources=sources,
+        aliases=aliases,
+        terminal_vocab=terminal_vocab,
+        path_vocab=path_vocab,
+        label_vocab=label_vocab,
+        infer_method=infer_method,
+        infer_variable=infer_variable,
+        variable_indexes=_variable_indexes_of(terminal_vocab),
+        shard=shard,
+        global_n_items=n,
+        mmap_backed=mmap_backed,
+        row_base=row_base,
+    )
+    logger.info("label vocab size: %d", len(label_vocab))
+    logger.info(
+        "corpus (csr mmap): %d items, %d contexts", data.n_items, data.n_contexts
+    )
+    return data
+
+
 def load_corpus(
     corpus_path: str | os.PathLike,
     path_idx_path: str | os.PathLike,
@@ -243,7 +400,22 @@ def load_corpus(
     startup from minutes to seconds at top11 scale (605k methods). Cache
     write failures degrade to a warning. The reference re-parses the full
     corpus in Python on every run (model/dataset_reader.py:72-128).
+
+    A CSR container (formats/corpus_io.py, ``tools/corpus_convert.py``) is
+    detected by magic and routed to :func:`load_corpus_csr` — mmap-backed
+    arrays, no parse, no sidecar cache needed.
     """
+    from code2vec_tpu.formats.corpus_io import is_csr_corpus
+
+    if is_csr_corpus(corpus_path):
+        return load_corpus_csr(
+            corpus_path,
+            path_idx_path,
+            terminal_idx_path,
+            infer_method=infer_method,
+            infer_variable=infer_variable,
+            shard=shard,
+        )
     fingerprint = None
     if cache:
         fingerprint = _cache_fingerprint(
@@ -286,12 +458,7 @@ def load_corpus(
         )
         return data
 
-    variable_indexes = np.asarray(
-        sorted(
-            idx for name, idx in terminal_vocab.stoi.items() if name.startswith("@var_")
-        ),
-        dtype=np.int32,
-    )
+    variable_indexes = _variable_indexes_of(terminal_vocab)
     logger.info("variable index size: %d", len(variable_indexes))
 
     native_arrays = None
@@ -365,27 +532,9 @@ def load_corpus(
             ids_arr = ids_arr[shard[0] :: shard[1]]
         parser_tag = "python parse"
 
-    # per-record label/alias processing — ONE implementation for both
-    # parsers, so label-vocab insertion order (and hence label indices)
-    # cannot drift between them (reference: model/dataset_reader.py:94-125).
-    # ALWAYS over every record, even when sharded: the vocab must be global.
-    label_vocab = Vocab()
-    labels: list[int] = []
-    normalized_labels: list[str] = []
-    sources: list[str | None] = []
-    aliases: list[dict[str, str]] = []
-    for (label, source), var_pairs in zip(headers, var_lists):
-        sources.append(source)
-        normalized_lower, _ = normalize_and_subtokenize(label)
-        normalized_labels.append(normalized_lower)
-        labels.append(label_vocab.add_label(label) if infer_method else -1)
-        alias_map: dict[str, str] = {}
-        for original, alias in var_pairs:
-            normalized_var, _ = normalize_and_subtokenize(original)
-            alias_map[alias] = normalized_var.lower()
-            if infer_variable and alias.startswith("@var_"):
-                label_vocab.add_label(original)
-        aliases.append(alias_map)
+    label_vocab, labels, normalized_labels, sources, aliases = (
+        _build_label_state(headers, var_lists, infer_method, infer_variable)
+    )
 
     global_n_items = len(headers)
     if shard is not None:

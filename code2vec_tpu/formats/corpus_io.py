@@ -22,9 +22,12 @@ the file. The ``@question`` +1 shift is applied by the dataset reader
 
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass, field
 from typing import IO, Iterator
+
+import numpy as np
 
 
 @dataclass
@@ -110,3 +113,366 @@ def write_corpus(path: str | os.PathLike, records: list[CorpusRecord]) -> None:
     with open(path, "w", encoding="utf-8") as f:
         for record in records:
             write_corpus_record(f, record)
+
+
+# ---------------------------------------------------------------------------
+# Binary memory-mapped CSR corpus container (the out-of-core corpus format)
+#
+# The text format above re-parses the whole corpus on every run and the
+# parsed CSR arrays must fit host RAM. This container stores the SAME record
+# stream as flat on-disk arrays so a corpus larger than host RAM feeds
+# training through mmap views (data/pipeline.py:MmapCorpusSource): batches
+# gather only the rows they touch and the kernel pages the file lazily.
+#
+# Layout (all little-endian, sections 16-byte aligned)::
+#
+#     [0:8)   magic  b"C2VCSR1\n"
+#     [8:16)  uint64 header length H
+#     [16:16+H) JSON header {version, n_items, n_contexts, terminal_shift,
+#                            sections: {name: [offset, dtype, n_elems]}}
+#     ...sections...
+#     footer: hist_lengths/hist_counts — the ``row_splits`` histogram, so
+#     ``derive_bucket_ladder`` and tools/corpus_stats.py read the bucket
+#     ladder WITHOUT scanning the context arrays.
+#
+# Sections: ``row_splits`` (int64 [n+1]), ``starts``/``paths``/``ends``
+# (int32 [total]), ``ids`` (int64 [n]), ``flags`` (uint8 [n]: bit0 source
+# present, bit1 doc present, bit2 label present, bit3 id present), four
+# (offsets, blob) string-table pairs (labels/sources/docs/vars), and the
+# histogram footer.
+#
+# ``terminal_shift``: start/end terminal ids are stored pre-shifted by this
+# amount (the ``@question`` +1 the dataset reader would otherwise apply per
+# run) so mmap feeding is zero-copy; the CSR->text converter subtracts it
+# back — shifting is a bijection on int32, so text -> CSR -> text is
+# byte-faithful for canonically-written corpora (``write_corpus``).
+# ---------------------------------------------------------------------------
+
+CSR_MAGIC = b"C2VCSR1\n"
+_CSR_ALIGN = 16
+
+
+def _aligned(offset: int) -> int:
+    return (offset + _CSR_ALIGN - 1) // _CSR_ALIGN * _CSR_ALIGN
+
+
+# public: readers outside this module (data/reader.py) test these bits
+FLAG_SOURCE, FLAG_DOC, FLAG_LABEL, FLAG_ID = 1, 2, 4, 8
+
+
+class _StringTable:
+    """Append-only UTF-8 string section: (offsets int64 [n+1], blob)."""
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+        self._offsets: list[int] = [0]
+
+    def add(self, text: str) -> None:
+        raw = text.encode("utf-8")
+        self._parts.append(raw)
+        self._offsets.append(self._offsets[-1] + len(raw))
+
+    def arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        blob = b"".join(self._parts)
+        return (
+            np.asarray(self._offsets, np.int64),
+            np.frombuffer(blob, np.uint8).copy()
+            if blob
+            else np.zeros(0, np.uint8),
+        )
+
+
+class CsrCorpusWriter:
+    """Streaming text-record -> CSR-container writer.
+
+    Context rows append to spill files as records arrive, so peak writer RSS
+    is O(n_items + strings) — independent of the context count, which is the
+    term that outgrows RAM. ``close()`` assembles the final container.
+    """
+
+    def __init__(self, path: str | os.PathLike, terminal_shift: int = 0):
+        self.path = os.fspath(path)
+        self.terminal_shift = int(terminal_shift)
+        self._tmp = [self.path + f".tmp{os.getpid()}.{k}" for k in "spe"]
+        self._spill = [open(p, "wb") for p in self._tmp]
+        self._counts: list[int] = []
+        self._ids: list[int] = []
+        self._flags: list[int] = []
+        self._labels = _StringTable()
+        self._sources = _StringTable()
+        self._docs = _StringTable()
+        self._vars = _StringTable()
+        self._closed = False
+
+    def add(self, record: CorpusRecord) -> None:
+        contexts = np.asarray(record.path_contexts, np.int32).reshape(-1, 3)
+        if self.terminal_shift:
+            contexts = contexts + np.asarray(
+                [self.terminal_shift, 0, self.terminal_shift], np.int32
+            )
+        for col, f in enumerate(self._spill):
+            f.write(np.ascontiguousarray(contexts[:, col]).tobytes())
+        self._counts.append(len(contexts))
+        flags = 0
+        if record.source is not None:
+            flags |= FLAG_SOURCE
+        if record.doc is not None:
+            flags |= FLAG_DOC
+        if record.label is not None:
+            flags |= FLAG_LABEL
+        if record.id is not None:
+            flags |= FLAG_ID
+        self._flags.append(flags)
+        self._ids.append(record.id if record.id is not None else -1)
+        self._labels.add(record.label or "")
+        self._sources.add(record.source or "")
+        self._docs.add(record.doc or "")
+        self._vars.add(
+            "".join(f"{orig}\t{alias}\n" for orig, alias in record.aliases)
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for f in self._spill:
+            f.close()
+        try:
+            self._assemble()
+        finally:
+            for p in self._tmp:
+                if os.path.exists(p):
+                    os.remove(p)
+
+    def _assemble(self) -> None:
+        row_splits = np.zeros(len(self._counts) + 1, np.int64)
+        np.cumsum(self._counts, out=row_splits[1:])
+        total = int(row_splits[-1])
+        lengths, weights = np.unique(
+            np.asarray(self._counts, np.int64), return_counts=True
+        )
+        sections: dict[str, tuple[np.ndarray | str, str, int]] = {}
+
+        def section(name, arr_or_tmp, dtype, n):
+            sections[name] = (arr_or_tmp, dtype, int(n))
+
+        section("row_splits", row_splits, "int64", len(row_splits))
+        for name, tmp in zip(("starts", "paths", "ends"), self._tmp):
+            section(name, tmp, "int32", total)
+        section("ids", np.asarray(self._ids, np.int64), "int64", len(self._ids))
+        section(
+            "flags", np.asarray(self._flags, np.uint8), "uint8", len(self._flags)
+        )
+        for prefix, table in (
+            ("label", self._labels),
+            ("source", self._sources),
+            ("doc", self._docs),
+            ("var", self._vars),
+        ):
+            offsets, blob = table.arrays()
+            section(f"{prefix}_offsets", offsets, "int64", len(offsets))
+            section(f"{prefix}_blob", blob, "uint8", len(blob))
+        # the histogram footer: ladder derivation without a context scan
+        section("hist_lengths", lengths.astype(np.int64), "int64", len(lengths))
+        section("hist_counts", weights.astype(np.int64), "int64", len(weights))
+
+        # lay out offsets; the header length feeds back into the first
+        # offset, so fix-point over the (stable) JSON serialization
+        def render(table: dict) -> bytes:
+            return json.dumps(
+                {
+                    "version": 1,
+                    "n_items": len(self._counts),
+                    "n_contexts": total,
+                    "terminal_shift": self.terminal_shift,
+                    "sections": table,
+                },
+                sort_keys=True,
+            ).encode("utf-8")
+
+        itemsize = {"int64": 8, "int32": 4, "uint8": 1}
+        header_len = len(render({n: [0, d, c] for n, (_, d, c) in sections.items()}))
+        for _ in range(4):  # offsets widen digits; re-layout until stable
+            offset = _aligned(16 + header_len)
+            table = {}
+            for name, (_, dtype, n) in sections.items():
+                table[name] = [offset, dtype, n]
+                offset = _aligned(offset + n * itemsize[dtype])
+            header = render(table)
+            if len(header) == header_len:
+                break
+            header_len = len(header)
+        else:
+            raise RuntimeError("csr header layout did not converge")
+
+        tmp_out = self.path + f".tmp{os.getpid()}.out"
+        with open(tmp_out, "wb") as out:
+            out.write(CSR_MAGIC)
+            out.write(np.uint64(header_len).tobytes())
+            out.write(header)
+            for name, (src, dtype, n) in sections.items():
+                off = table[name][0]
+                out.write(b"\0" * (off - out.tell()))
+                if isinstance(src, str):  # context spill file: chunked copy
+                    with open(src, "rb") as f:
+                        while True:
+                            chunk = f.read(1 << 22)
+                            if not chunk:
+                                break
+                            out.write(chunk)
+                else:
+                    out.write(np.ascontiguousarray(src).tobytes())
+        os.replace(tmp_out, self.path)
+
+    def __enter__(self) -> "CsrCorpusWriter":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+
+def write_corpus_csr(
+    path: str | os.PathLike,
+    records,
+    terminal_shift: int = 0,
+) -> None:
+    """Write an iterable of :class:`CorpusRecord` as a CSR container."""
+    with CsrCorpusWriter(path, terminal_shift=terminal_shift) as writer:
+        for record in records:
+            writer.add(record)
+
+
+def is_csr_corpus(path: str | os.PathLike) -> bool:
+    """Whether ``path`` is a CSR container (magic sniff)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(CSR_MAGIC)) == CSR_MAGIC
+    except OSError:
+        return False
+
+
+@dataclass
+class CsrCorpus:
+    """An open CSR container: mmap-backed array views + string tables.
+
+    ``starts``/``paths``/``ends`` are read-only views into one shared
+    ``np.memmap`` — fancy indexing gathers only the touched rows and the OS
+    pages the file on demand, so holding a CsrCorpus costs ~zero host RSS
+    regardless of corpus size. ``row_splits``/``ids``/``flags`` are small
+    in-RAM copies (O(n_items)).
+    """
+
+    path: str
+    n_items: int
+    n_contexts: int
+    terminal_shift: int
+    row_splits: np.ndarray  # int64 [n+1], in RAM
+    starts: np.ndarray  # int32 [total], mmap view
+    paths: np.ndarray  # int32 [total], mmap view
+    ends: np.ndarray  # int32 [total], mmap view
+    ids: np.ndarray  # int64 [n], in RAM
+    flags: np.ndarray  # uint8 [n], in RAM
+    hist_lengths: np.ndarray  # int64 [k], in RAM
+    hist_counts: np.ndarray  # int64 [k], in RAM
+    _mm: np.memmap = field(repr=False)
+    _strings: dict = field(repr=False)
+
+    def _string(self, prefix: str, i: int) -> str:
+        offsets, blob = self._strings[prefix]
+        return bytes(blob[offsets[i] : offsets[i + 1]]).decode("utf-8")
+
+    def label(self, i: int) -> str | None:
+        return (
+            self._string("label", i)
+            if self.flags[i] & FLAG_LABEL
+            else None
+        )
+
+    def source(self, i: int) -> str | None:
+        return (
+            self._string("source", i)
+            if self.flags[i] & FLAG_SOURCE
+            else None
+        )
+
+    def doc(self, i: int) -> str | None:
+        return self._string("doc", i) if self.flags[i] & FLAG_DOC else None
+
+    def aliases(self, i: int) -> list[tuple[str, str]]:
+        out = []
+        for line in self._string("var", i).splitlines():
+            orig, alias = line.split("\t", 1)
+            out.append((orig, alias))
+        return out
+
+    def record(self, i: int) -> CorpusRecord:
+        """Decode record ``i`` back to the text layer's representation
+        (terminal shift removed)."""
+        lo, hi = int(self.row_splits[i]), int(self.row_splits[i + 1])
+        shift = self.terminal_shift
+        return CorpusRecord(
+            id=int(self.ids[i]) if self.flags[i] & FLAG_ID else None,
+            label=self.label(i),
+            source=self.source(i),
+            doc=self.doc(i),
+            path_contexts=[
+                (int(s) - shift, int(p), int(e) - shift)
+                for s, p, e in zip(
+                    self.starts[lo:hi], self.paths[lo:hi], self.ends[lo:hi]
+                )
+            ],
+            aliases=self.aliases(i),
+        )
+
+    def iter_records(self) -> Iterator[CorpusRecord]:
+        for i in range(self.n_items):
+            yield self.record(i)
+
+
+def open_corpus_csr(path: str | os.PathLike) -> CsrCorpus:
+    """Open a CSR container with mmap-backed context arrays."""
+    path = os.fspath(path)
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    if bytes(mm[: len(CSR_MAGIC)]) != CSR_MAGIC:
+        raise ValueError(f"{path!r} is not a CSR corpus container")
+    header_len = int(mm[8:16].view(np.uint64)[0])
+    header = json.loads(bytes(mm[16 : 16 + header_len]).decode("utf-8"))
+    if header.get("version") != 1:
+        raise ValueError(
+            f"unsupported CSR container version {header.get('version')!r}"
+        )
+    itemsize = {"int64": 8, "int32": 4, "uint8": 1}
+
+    def view(name: str) -> np.ndarray:
+        offset, dtype, n = header["sections"][name]
+        return mm[offset : offset + n * itemsize[dtype]].view(dtype)
+
+    strings = {
+        prefix: (np.array(view(f"{prefix}_offsets")), view(f"{prefix}_blob"))
+        for prefix in ("label", "source", "doc", "var")
+    }
+    return CsrCorpus(
+        path=path,
+        n_items=int(header["n_items"]),
+        n_contexts=int(header["n_contexts"]),
+        terminal_shift=int(header["terminal_shift"]),
+        row_splits=np.array(view("row_splits")),
+        starts=view("starts"),
+        paths=view("paths"),
+        ends=view("ends"),
+        ids=np.array(view("ids")),
+        flags=np.array(view("flags")),
+        hist_lengths=np.array(view("hist_lengths")),
+        hist_counts=np.array(view("hist_counts")),
+        _mm=mm,
+        _strings=strings,
+    )
+
+
+def read_csr_histogram(
+    path: str | os.PathLike,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(lengths, counts) context-count histogram from the container footer —
+    no context scan; the O(1) input to ``derive_bucket_ladder_hist``."""
+    corpus = open_corpus_csr(path)
+    return corpus.hist_lengths, corpus.hist_counts

@@ -18,8 +18,9 @@ Ladder sources, in order:
 2. absent that (older checkpoints), a width histogram of the live request
    stream: until ``warmup_requests`` requests have been observed every
    request runs at the top width, then the ladder is derived from the
-   observed counts (``data/pipeline.derive_bucket_ladder``) and its
-   executables compiled once.
+   observed width histogram (``data/pipeline.derive_bucket_ladder_hist``
+   — the same histogram->ladder rule the CSR corpus container's footer
+   and tools/corpus_stats.py use) and its executables compiled once.
 
 Schedule provenance: startup consults the PR-8 autotune cache for every
 (batch, width) shape (``ops/autotune.consult_schedules`` — the
@@ -40,7 +41,10 @@ import threading
 import numpy as np
 
 from code2vec_tpu import PAD_INDEX
-from code2vec_tpu.data.pipeline import derive_bucket_ladder, nearest_bucket_width
+from code2vec_tpu.data.pipeline import (
+    derive_bucket_ladder_hist,
+    nearest_bucket_width,
+)
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
 from code2vec_tpu.obs.trace import get_tracer
 
@@ -98,7 +102,7 @@ class ServingEngine:
         self._events = events
         self._lock = threading.RLock()
         self._compiled: dict[tuple[int, int], object] = {}
-        self._width_samples: list[int] = []
+        self._width_histogram: dict[int, int] = {}
         self._warmed = False  # True once the ladder's executables exist
         self.provenance: list[dict] = []
         self._jit = None
@@ -179,20 +183,40 @@ class ServingEngine:
 
     def observe_width(self, count: int) -> None:
         """Histogram fallback: record one request's real context count;
-        once ``warmup_requests`` are seen, derive and compile the ladder."""
+        once ``warmup_requests`` are seen, derive and compile the ladder.
+
+        The stream is accumulated AS a width histogram and the ladder comes
+        from ``derive_bucket_ladder_hist`` — the same histogram->ladder
+        entry point the CSR corpus container's footer and
+        tools/corpus_stats.py use (one derivation rule everywhere, and the
+        engine's memory stays O(distinct widths) however long warmup runs).
+        """
         if self.ladder is not None:
             return
         with self._lock:
             if self.ladder is not None:  # froze while we waited on the lock
                 return
-            self._width_samples.append(min(int(count), self.max_width))
-            if len(self._width_samples) < self.warmup_requests:
+            width = min(int(count), self.max_width)
+            self._width_histogram[width] = (
+                self._width_histogram.get(width, 0) + 1
+            )
+            n_seen = sum(self._width_histogram.values())
+            if n_seen < self.warmup_requests:
                 return
-            counts = np.asarray(self._width_samples, np.int64)
-            ladder = derive_bucket_ladder(counts, self.max_width)
+            ladder = derive_bucket_ladder_hist(
+                np.asarray(sorted(self._width_histogram), np.int64),
+                np.asarray(
+                    [
+                        self._width_histogram[w]
+                        for w in sorted(self._width_histogram)
+                    ],
+                    np.int64,
+                ),
+                self.max_width,
+            )
             logger.info(
                 "request-stream histogram froze the serving ladder at %s "
-                "(%d samples)", list(ladder), len(counts),
+                "(%d samples)", list(ladder), n_seen,
             )
             self.ladder = ladder
             self._warmed = False

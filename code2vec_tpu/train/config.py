@@ -108,8 +108,10 @@ class TrainConfig:
     # len(ladder) step variants (the recompile detector is budgeted
     # accordingly). Per-example forward math is unchanged (PAD carries zero
     # attention weight), so the per-example loss multiset is invariant.
-    # Host pipeline and device_epoch; not composable with host-sharded
-    # feeding, streaming epochs, or shard_staged_corpus.
+    # Composes with every feed variant (PR 10): streaming epochs emit
+    # ladder widths with per-bucket carry, host-sharded feeding follows a
+    # globally-agreed width schedule, shard_staged_corpus shards each
+    # bucket over the data axis, and mmap-CSR corpora gather per bucket.
     bucketed: bool = False
     # comma list of bag widths ending at max_path_length (e.g. "25,50,100,200");
     # empty = derive a geometric ladder from the corpus length histogram

@@ -134,7 +134,9 @@ def stage_method_corpus(
     # the per-row shuffle is applied to the INDICES before the gather (one
     # [total, 3] pass instead of gather-then-permute — at java-large scale
     # that second copy is ~27 GB of transient)
-    flat, _, _ = flat_context_indices(data.row_splits, item_idx)
+    flat, _, _ = flat_context_indices(
+        data.row_splits, item_idx, row_base=data.row_base
+    )
     perm = _per_row_shuffle(total, new_splits, rng)
     flat = flat[perm]
     del perm
@@ -938,19 +940,16 @@ class BucketedStagedCorpus:
         ) if self.buckets else np.zeros(0, np.int32)
 
 
-def bucket_staged(
-    staged: StagedCorpus,
-    ladder: tuple[int, ...],
-    device: Any | None = None,
-) -> BucketedStagedCorpus:
+def _bucket_host_partition(
+    staged: StagedCorpus, ladder: tuple[int, ...]
+) -> list[tuple[int, StagedCorpus]]:
     """Partition a HOST-staged corpus's rows by context count into ladder
-    buckets and place each bucket on ``device``. Rows with more contexts
-    than the top width land in the top bucket (the rotation-window sampler
-    subsamples them, same as the fixed-width path). Empty buckets are
-    dropped — they would only cost a compile — except the top one, which
-    is always staged (possibly with zero rows) so an empty split behaves
-    like the fixed-width path: placement introspection (``.contexts``)
-    works and the runners fall through their empty chunk plans."""
+    buckets (host numpy sub-stagings; the caller places/shards each).
+    Rows with more contexts than the top width land in the top bucket (the
+    rotation-window sampler subsamples them, same as the fixed-width
+    path). Empty buckets are dropped — they would only cost a compile —
+    except the top one, which is always kept (possibly with zero rows) so
+    an empty split behaves like the fixed-width path."""
     from code2vec_tpu.data.pipeline import assign_buckets
 
     rs = np.asarray(staged.row_splits).astype(np.int64)
@@ -981,8 +980,72 @@ def bucket_staged(
             ),
             remap_flags=None if flags is None else flags[members],
         )
-        out.append((width, place_staged(sub, device=device)))
-    return BucketedStagedCorpus(buckets=out)
+        out.append((width, sub))
+    return out
+
+
+def bucket_staged(
+    staged: StagedCorpus,
+    ladder: tuple[int, ...],
+    device: Any | None = None,
+) -> BucketedStagedCorpus:
+    """Ladder-partition a host staging and place each bucket on ``device``
+    (see :func:`_bucket_host_partition` for the membership rules);
+    placement introspection (``.contexts``) works and the runners fall
+    through their empty chunk plans."""
+    return BucketedStagedCorpus(
+        buckets=[
+            (width, place_staged(sub, device=device))
+            for width, sub in _bucket_host_partition(staged, ladder)
+        ]
+    )
+
+
+@dataclass
+class BucketedShardedStagedCorpus:
+    """Bucketed x data-axis-sharded staging: each ladder bucket's rows are
+    partitioned over the mesh's ``data`` axis (per-device HBM ~1/D of a
+    replicated bucketed staging), and each bucket scans at its own
+    ``[B, L_b]`` shape — the composition the bucketed-vs-shard_staged
+    mutual-exclusion guard used to forbid."""
+
+    buckets: list[tuple[int, ShardedStagedCorpus]]
+
+    @property
+    def n_items(self) -> int:
+        return sum(s.n_items for _, s in self.buckets)
+
+    @property
+    def n_contexts(self) -> int:
+        return sum(s.n_contexts for _, s in self.buckets)
+
+    @property
+    def contexts(self):
+        """First bucket's context array (device/placement introspection)."""
+        return self.buckets[0][1].contexts
+
+    def flat_labels(self) -> np.ndarray:
+        """Valid labels in bucket-major, shard-concatenation order — the
+        ``expected`` array matching
+        :meth:`BucketedShardedEpochRunner.run_eval_epoch`'s preds."""
+        return (
+            np.concatenate([s.flat_labels() for _, s in self.buckets])
+            if self.buckets
+            else np.zeros(0, np.int32)
+        )
+
+
+def bucket_shard_staged(
+    staged: StagedCorpus, ladder: tuple[int, ...], mesh
+) -> BucketedShardedStagedCorpus:
+    """Ladder-partition a host staging, then shard EACH bucket over the
+    mesh's ``data`` axis (:func:`shard_staged`)."""
+    return BucketedShardedStagedCorpus(
+        buckets=[
+            (width, shard_staged(sub, mesh))
+            for width, sub in _bucket_host_partition(staged, ladder)
+        ]
+    )
 
 
 class BucketedEpochRunner:
@@ -1373,3 +1436,86 @@ class ShardedEpochRunner:
             n_batches += nb
             lo += nb
         return state, float(np.sum(jax.device_get(chunk_losses))), n_batches
+
+
+class BucketedShardedEpochRunner:
+    """Bucketed counterpart of :class:`ShardedEpochRunner` (and the sharded
+    counterpart of :class:`BucketedEpochRunner`): one data-axis-sharded
+    scanned sub-epoch per ladder width per epoch. Drop-in for the loop's
+    ``(runner, staged)`` protocol with a
+    :class:`BucketedShardedStagedCorpus`; the train-pass bucket order is
+    drawn from the epoch rng, eval runs buckets in ladder order so preds
+    align with :meth:`BucketedShardedStagedCorpus.flat_labels`.
+    """
+
+    def __init__(
+        self,
+        model_config: Code2VecConfig,
+        class_weights: jnp.ndarray,
+        batch_size: int,
+        ladder: tuple[int, ...],
+        chunk_batches: int = 16,
+        mesh=None,
+        shuffle_variable_ids: bool = False,
+        sample_prefetch: bool = False,
+        table_update: str = "dense",
+    ):
+        self.ladder = tuple(ladder)
+        self._runners = {
+            width: ShardedEpochRunner(
+                model_config,
+                class_weights,
+                batch_size,
+                width,
+                chunk_batches,
+                mesh=mesh,
+                shuffle_variable_ids=shuffle_variable_ids,
+                sample_prefetch=sample_prefetch,
+                table_update=table_update,
+            )
+            for width in self.ladder
+        }
+
+    def run_train_epoch(
+        self,
+        state,
+        corpus: BucketedShardedStagedCorpus,
+        rng: np.random.Generator,
+        key: jax.Array,
+    ) -> tuple[Any, float, int]:
+        total_loss = 0.0
+        n_batches = 0
+        for i in rng.permutation(len(corpus.buckets)):
+            width, staged = corpus.buckets[int(i)]
+            key, sub_key = jax.random.split(key)
+            state, loss, nb = self._runners[width].run_train_epoch(
+                state, staged, rng, sub_key
+            )
+            total_loss += loss
+            n_batches += nb
+        return state, total_loss, n_batches
+
+    def run_eval_epoch(
+        self,
+        state,
+        corpus: BucketedShardedStagedCorpus,
+        key: jax.Array,
+    ) -> tuple[float, np.ndarray, np.ndarray]:
+        total_loss = 0.0
+        preds: list[np.ndarray] = []
+        max_logits: list[np.ndarray] = []
+        for width, staged in corpus.buckets:
+            key, sub_key = jax.random.split(key)
+            loss, p, m = self._runners[width].run_eval_epoch(
+                state, staged, sub_key
+            )
+            total_loss += loss
+            preds.append(p)
+            max_logits.append(m)
+        return (
+            total_loss,
+            np.concatenate(preds) if preds else np.zeros(0, np.int64),
+            np.concatenate(max_logits)
+            if max_logits
+            else np.zeros(0, np.float32),
+        )

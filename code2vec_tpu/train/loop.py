@@ -47,13 +47,12 @@ from code2vec_tpu.checkpoint import (
     restore_checkpoint,
 )
 from code2vec_tpu.data.pipeline import (
+    bucket_batch_counts,
     build_epoch,
     derive_bucket_ladder,
     empty_batch,
-    epoch_context_counts,
     iter_batches,
-    iter_bucketed_batches,
-    iter_streaming_batches,
+    make_batch_source,
     oov_rate,
     pad_batch_stream,
     pad_stats,
@@ -573,12 +572,6 @@ def train(
             "would be silently ignored; add --bucketed or drop the ladder"
         )
     if config.bucketed:
-        if config.stream_chunk_items:
-            raise ValueError(
-                "--bucketed does not compose with --stream_chunk_items: "
-                "streaming epochs emit fixed-shape chunked batches; drop "
-                "one of the two flags"
-            )
         bucket_ladder = parse_bucket_ladder(
             config.bucket_ladder, config.max_path_length
         )
@@ -712,12 +705,19 @@ def train(
     #   identically.
     n_hosts = jax.process_count()
     sharded_feed = data.shard is not None and n_hosts > 1
-    if bucket_ladder is not None and sharded_feed:
-        # every host must dispatch identical collective shapes in lockstep;
-        # a per-host bucket interleave would have to be globally coordinated
+    if bucket_ladder is not None and sharded_feed and config.stream_chunk_items:
+        # the global width schedule below needs random access to each
+        # bucket's rows; a text stream builds chunks in item order and
+        # would have to buffer unboundedly to follow it. The mmap-CSR
+        # source IS random access — the out-of-core format makes the
+        # 3-way composition work.
         raise ValueError(
-            "--bucketed does not compose with host-sharded feeding; load "
-            "the corpus unsharded or drop --bucketed"
+            "--bucketed + host-sharded feeding + --stream_chunk_items: a "
+            "chunked text stream cannot follow the global bucket-width "
+            "schedule; convert the corpus with tools/corpus_convert.py and "
+            "feed it as --corpus_format csr (mmap batches are random-"
+            "access, so the combination needs no streaming), or drop one "
+            "flag"
         )
     feed_batch = config.batch_size
     feed_group = 0
@@ -777,6 +777,46 @@ def train(
         )
         return max(-(-int(shares.max()) // feed_batch), 1)
 
+    # bucketed x host-sharded: collective shapes must agree per step across
+    # hosts, so the epoch's WIDTH SCHEDULE is agreed globally once — each
+    # group's per-width batch counts are corpus-static for the method task
+    # (the only task sharded feeding supports), the per-width max across
+    # groups is allgathered at startup, and short groups pad with masked
+    # empty batches of the scheduled width (pipeline:
+    # iter_scheduled_bucketed_batches / MmapCorpusSource.scheduled_batches)
+    train_width_counts = test_width_counts = None
+    if sharded_feed and bucket_ladder is not None:
+        from jax.experimental import multihost_utils
+
+        def _global_width_counts(local_idx: np.ndarray) -> np.ndarray:
+            local_counts = (
+                data.row_splits[np.asarray(local_idx) + 1]
+                - data.row_splits[np.asarray(local_idx)]
+            )
+            mine = bucket_batch_counts(
+                np.minimum(local_counts, bucket_ladder[-1]),
+                bucket_ladder, feed_batch,
+            )
+            every = np.asarray(
+                multihost_utils.process_allgather(np.asarray(mine, np.int64))
+            )
+            return every.reshape(jax.process_count(), -1).max(axis=0)
+
+        train_width_counts = _global_width_counts(train_idx)
+        test_width_counts = _global_width_counts(test_idx)
+
+    def width_schedule(width_counts: np.ndarray, epoch: int, shuffled: bool):
+        """The epoch's global bucket-width sequence — identical on every
+        host: per-width multiplicities from the allgathered maxima,
+        interleaved by a generator seeded from (run seed, epoch) alone (the
+        per-host ``np_rng`` streams diverge under sharded feeding, so the
+        schedule cannot ride on them)."""
+        widths = np.repeat(np.asarray(bucket_ladder), width_counts)
+        if shuffled:
+            srng = np.random.default_rng([config.random_seed, 0x5EED, epoch])
+            widths = widths[srng.permutation(len(widths))]
+        return widths
+
     # device-resident epochs: corpus staged to HBM once, whole chunks of
     # batches per dispatch (train/device_epoch.py). Composes with the mesh:
     # the corpus is replicated over the devices and each scanned batch is
@@ -803,8 +843,10 @@ def train(
             use_device_epoch = True
             from code2vec_tpu.train.device_epoch import (
                 BucketedEpochRunner,
+                BucketedShardedEpochRunner,
                 EpochRunner,
                 ShardedEpochRunner,
+                bucket_shard_staged,
                 bucket_staged,
                 concat_staged,
                 place_staged,
@@ -813,12 +855,7 @@ def train(
                 stage_variable_corpus,
             )
 
-            if config.bucketed and config.shard_staged_corpus:
-                raise ValueError(
-                    "--bucketed does not compose with --shard_staged_corpus "
-                    "yet; drop one of the two flags"
-                )
-            if config.bucketed:
+            if config.bucketed and not config.shard_staged_corpus:
                 # one scanned sub-epoch per ladder width per epoch; each
                 # bucket samples/steps at its own [B, L_b] shape
                 device_runner = BucketedEpochRunner(
@@ -877,31 +914,49 @@ def train(
             if config.shard_staged_corpus:
                 # train AND test corpora partitioned over `data` (per-
                 # device HBM ~1/data_axis); eval preds come back in
-                # shard-concatenation order, aligned with flat_labels()
+                # shard-concatenation order, aligned with flat_labels().
+                # --bucketed composes: each ladder bucket shards over the
+                # data axis and scans at its own [B, L_b] shape
                 if mesh is None:
                     raise ValueError(
                         "--shard_staged_corpus needs mesh axes "
                         "(--data_axis > 1)"
                     )
-                sharded_train_runner = (
-                    ShardedEpochRunner(
-                        model_config,
-                        class_weights,
-                        config.batch_size,
-                        config.max_path_length,
-                        config.device_chunk_batches,
-                        mesh=mesh,
-                        shuffle_variable_ids=config.shuffle_variable_indexes,
-                        sample_prefetch=config.sample_prefetch,
-                        table_update=config.table_update,
-                    ),
-                    shard_staged(stage_host(train_idx), mesh),
+                runner_args = (
+                    model_config,
+                    class_weights,
+                    config.batch_size,
+                    bucket_ladder
+                    if config.bucketed
+                    else config.max_path_length,
+                    config.device_chunk_batches,
                 )
+                runner_kw = dict(
+                    mesh=mesh,
+                    shuffle_variable_ids=config.shuffle_variable_indexes,
+                    sample_prefetch=config.sample_prefetch,
+                    table_update=config.table_update,
+                )
+                if config.bucketed:
+                    sharded_train_runner = (
+                        BucketedShardedEpochRunner(*runner_args, **runner_kw),
+                        bucket_shard_staged(
+                            stage_host(train_idx), bucket_ladder, mesh
+                        ),
+                    )
+                    staged_test = bucket_shard_staged(
+                        stage_host(test_idx), bucket_ladder, mesh
+                    )
+                else:
+                    sharded_train_runner = (
+                        ShardedEpochRunner(*runner_args, **runner_kw),
+                        shard_staged(stage_host(train_idx), mesh),
+                    )
+                    # the test split shards too (it's 20% of the corpus —
+                    # at the scales this flag targets, replicating it
+                    # would undo much of the HBM win)
+                    staged_test = shard_staged(stage_host(test_idx), mesh)
                 staged_train = None
-                # the test split shards too (it's 20% of the corpus — at
-                # the scales this flag targets, replicating it would undo
-                # much of the HBM win)
-                staged_test = shard_staged(stage_host(test_idx), mesh)
                 # static for the run: fetch the shard-order labels once,
                 # not once per epoch
                 sharded_test_expected = staged_test.flat_labels()
@@ -1073,10 +1128,29 @@ def train(
     # install). The CLI pre-installs, making this a no-op there.
     restore_tracer = tracer is not get_tracer()
     previous_tracer = set_tracer(tracer) if restore_tracer else None
-    # host-path pad accounting cache, (n_rows, real, slots): per-row counts
-    # are min(raw row count, bag) regardless of which contexts the per-epoch
-    # subsample picked, so the O(N*L) scan need not repeat every epoch
-    host_train_pad: tuple[int, int, int] | None = None
+    # host epoch feeding goes through ONE BatchSource per split
+    # (data/pipeline.py): the factory picks in-RAM, streaming, or
+    # mmap-gather per the corpus backing and flags, and the epoch loop
+    # below no longer cares which variant it got — bucketing, prefetch,
+    # sharded lockstep padding, and mid-epoch resume compose with all of
+    # them through the same four protocol points
+    train_source = test_source = None
+    if not use_device_epoch:
+        source_kw = dict(
+            ladder=bucket_ladder,
+            stream_chunk_items=config.stream_chunk_items,
+            shuffle_variable_indexes=config.shuffle_variable_indexes,
+        )
+        train_source = make_batch_source(
+            data, train_idx, feed_batch, config.max_path_length, **source_kw
+        )
+        test_source = make_batch_source(
+            data, test_idx, feed_batch, config.max_path_length, **source_kw
+        )
+        logger.info(
+            "host feed: %s (ladder %s)",
+            type(train_source).__name__, list(train_source.ladder),
+        )
     def _boundary_cursor(next_epoch: int) -> dict:
         """Epoch-boundary cursor: step 0 plus the CURRENT RNG states — the
         state the next epoch will start from — so even a boundary resume
@@ -1217,83 +1291,35 @@ def train(
                 accuracy, precision, recall, f1 = evaluate(
                     config.eval_method, expected, preds, data.label_vocab
                 )
-            elif config.stream_chunk_items:
-                # streaming epochs: java-large-scale corpora (BASELINE
-                # config 3, 16M methods) cannot materialize [N, L] epoch
-                # tensors (~38 GB at bag 200); build chunk_items rows at a
-                # time. Exports still materialize on demand (host_epoch) —
-                # disable per-epoch export for bounded-RSS runs.
-                def chunk_builder(idx):
-                    return build_epoch(
-                        data, idx, config.max_path_length, np_rng,
-                        config.shuffle_variable_indexes,
-                    )
-
-                train_batches = iter_streaming_batches(
-                    chunk_builder, train_idx, feed_batch, np_rng,
-                    chunk_items=config.stream_chunk_items,
-                )
-                test_batches = iter_streaming_batches(
-                    chunk_builder, test_idx, feed_batch, np_rng,
-                    chunk_items=config.stream_chunk_items, shuffle=False,
-                )
-                if sharded_feed:
-                    template = empty_batch(feed_batch, config.max_path_length)
-                    train_batches = pad_batch_stream(
-                        train_batches, synced_steps(global_train), template
-                    )
-                    test_batches = pad_batch_stream(
-                        test_batches, synced_steps(global_test), template
-                    )
-                if skip:
-                    train_batches = _replay(train_batches)
-                state, train_loss = _train_pass(
-                    config, state, train_step, train_batches, to_device,
-                    profiler, tracer=tracer, epoch=epoch,
-                    step_hook=step_hook, loss_offset=loss_offset,
-                )
-                test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
-                    config, data, state, eval_step, test_batches, to_device,
-                    gather_processes=sharded_feed,
-                    feed_group=(feed_group, n_feed_groups),
-                    tracer=tracer, epoch=epoch,
-                )
             else:
-                train_epoch = build_epoch(
-                    data,
-                    train_idx,
-                    config.max_path_length,
-                    np_rng,
-                    config.shuffle_variable_indexes,
-                )
-                if bucket_ladder is not None:
-                    # [B, L_b] batches per bucket, seeded interleave; the
-                    # per-example rows are identical to the fixed-L path
-                    # (bucket width >= real count), so the loss semantics
-                    # are unchanged — only the padding is gone
-                    train_batches = iter_bucketed_batches(
-                        train_epoch, bucket_ladder, feed_batch,
-                        rng=np_rng, pad_final=True,
+                # the unified host path: whatever variant the factory
+                # picked (in-RAM fixed-L/bucketed, streaming, mmap-gather),
+                # the stream is a pure function of np_rng's state here —
+                # which is what makes _replay (mid-epoch resume) and the
+                # prefetcher compose with all of them. Sources build
+                # lazily at first pull, so the host RNG draw order is
+                # bitwise the historical one.
+                def sharded_wrap(batches, global_idx):
+                    """Host-sharded lockstep (fixed-L): pad the short
+                    groups with masked template batches. The bucketed
+                    variant pads inside scheduled_batches instead."""
+                    if not sharded_feed:
+                        return batches
+                    return pad_batch_stream(
+                        batches,
+                        synced_steps(global_idx),
+                        empty_batch(feed_batch, config.max_path_length),
+                    )
+
+                if sharded_feed and bucket_ladder is not None:
+                    train_batches = train_source.scheduled_batches(
+                        np_rng,
+                        width_schedule(train_width_counts, epoch, True),
                     )
                 else:
-                    train_batches = iter_batches(
-                        train_epoch, feed_batch, rng=np_rng, pad_final=True
-                    )
-                n_rows = len(train_epoch.ids)
-                if host_train_pad is None or host_train_pad[0] != n_rows:
-                    real, slots = pad_stats(
-                        epoch_context_counts(train_epoch),
-                        bucket_ladder or (config.max_path_length,),
-                        feed_batch,
-                    )
-                    host_train_pad = (n_rows, real, slots)
-                _, real, slots = host_train_pad
-                pad_efficiency = real / slots if slots else 1.0
-                if sharded_feed:
-                    train_batches = pad_batch_stream(
-                        train_batches,
-                        synced_steps(global_train),
-                        empty_batch(feed_batch, config.max_path_length),
+                    train_batches = sharded_wrap(
+                        train_source.batches(np_rng, shuffle=True),
+                        global_train,
                     )
                 if skip:
                     train_batches = _replay(train_batches)
@@ -1302,31 +1328,26 @@ def train(
                     profiler, tracer=tracer, epoch=epoch,
                     step_hook=step_hook, loss_offset=loss_offset,
                 )
+                # pad accounting comes from the source — exact corpus
+                # geometry for the in-RAM/mmap variants, stream-tallied
+                # for chunked streaming (which used to silently drop the
+                # honesty metric)
+                source_pad = train_source.pad_stats()
+                if source_pad is not None:
+                    real, slots = source_pad
+                    pad_efficiency = real / slots if slots else 1.0
 
-                test_epoch = build_epoch(
-                    data,
-                    test_idx,
-                    config.max_path_length,
-                    np_rng,
-                    config.shuffle_variable_indexes,
-                )
-                if bucket_ladder is not None:
-                    # rng=None: buckets run sequentially in ladder order —
-                    # eval metrics are order-invariant, so they match the
-                    # fixed-L pass bitwise (tests/test_bucketing.py)
-                    test_batches = iter_bucketed_batches(
-                        test_epoch, bucket_ladder, feed_batch,
-                        rng=None, pad_final=True,
+                if sharded_feed and bucket_ladder is not None:
+                    # eval schedule in deterministic ladder order
+                    test_batches = test_source.scheduled_batches(
+                        np_rng,
+                        width_schedule(test_width_counts, epoch, False),
+                        shuffle=False,
                     )
                 else:
-                    test_batches = iter_batches(
-                        test_epoch, feed_batch, rng=None, pad_final=True
-                    )
-                if sharded_feed:
-                    test_batches = pad_batch_stream(
-                        test_batches,
-                        synced_steps(global_test),
-                        empty_batch(feed_batch, config.max_path_length),
+                    test_batches = sharded_wrap(
+                        test_source.batches(np_rng, shuffle=False),
+                        global_test,
                     )
                 test_loss, accuracy, precision, recall, f1 = _evaluate_batches(
                     config, data, state, eval_step, test_batches, to_device,
@@ -1334,6 +1355,11 @@ def train(
                     feed_group=(feed_group, n_feed_groups),
                     tracer=tracer, epoch=epoch,
                 )
+                # in-RAM sources expose the built epoch for the export /
+                # print_sample reuse below; out-of-core sources leave these
+                # None and host_epoch() builds on demand
+                train_epoch = train_source.last_epoch
+                test_epoch = test_source.last_epoch
 
             metrics = {
                 "train_loss": train_loss,

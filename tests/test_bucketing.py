@@ -300,11 +300,18 @@ class TestTrainBucketed:
         res = train(cfg, data)
         assert res.epochs_run == 1
 
-    def test_streaming_combo_rejected(self, tiny):
+    def test_streaming_combo_composes(self, tiny):
+        """PR 10: the bucketed-vs-streaming mutual exclusion is gone — a
+        streaming epoch emits ladder-width batches (per-bucket carry across
+        chunks) and still reports the pad_efficiency honesty metric."""
         _, data = tiny
-        cfg = TrainConfig(**TINY_CFG).with_updates(stream_chunk_items=64)
-        with pytest.raises(ValueError, match="stream_chunk_items"):
-            train(cfg, data)
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            max_epoch=1, stream_chunk_items=64
+        )
+        res = train(cfg, data)
+        assert res.epochs_run == 1
+        assert all(np.isfinite(h["train_loss"]) for h in res.history)
+        assert all(0.0 < h["pad_efficiency"] <= 1.0 for h in res.history)
 
     def test_bad_ladder_rejected(self, tiny):
         _, data = tiny

@@ -1,0 +1,768 @@
+"""Out-of-core corpora (ISSUE 10): the binary CSR container, the unified
+BatchSource protocol, and the composition matrix.
+
+The load-bearing guarantees:
+
+- text -> CSR -> text conversion is byte-faithful, and the container's
+  histogram footer equals a full scan;
+- the CSR mmap loader produces the SAME CorpusData semantics as the text
+  parser (arrays, label-vocab insertion order, aliases, shards);
+- every feed variant — {fixed-L, bucketed, streaming, mmap-gather} x
+  {sync, prefetched} — yields the SAME per-example loss multiset and
+  bitwise-equal eval metrics as the in-RAM fixed-L reference (under
+  canonical context order, bag >= every real count);
+- the previously-forbidden compositions (bucketed x streaming, bucketed x
+  shard_staged, mmap x everything) train end to end with zero post-warmup
+  recompiles, report pad_efficiency, resume bitwise from mid-epoch
+  cursors, and keep host RSS bounded below the corpus size.
+"""
+
+import json
+import os
+import resource
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu import PAD_INDEX, faultinject
+from code2vec_tpu.data.pipeline import (
+    EpochSource,
+    MmapCorpusSource,
+    StreamingSource,
+    assign_buckets,
+    bucket_batch_counts,
+    derive_bucket_ladder,
+    derive_bucket_ladder_hist,
+    iter_scheduled_bucketed_batches,
+    make_batch_source,
+    variable_items,
+)
+from code2vec_tpu.data.reader import load_corpus, load_corpus_csr
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.formats.corpus_io import (
+    CorpusRecord,
+    is_csr_corpus,
+    iter_corpus_records,
+    open_corpus_csr,
+    read_csr_histogram,
+    write_corpus_csr,
+)
+from code2vec_tpu.metrics import evaluate
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.loop import model_config_from, train
+from code2vec_tpu.train.prefetch import device_batches
+from code2vec_tpu.train.step import create_train_state
+from tools.corpus_convert import csr_to_text, text_to_csr
+
+pytestmark = pytest.mark.ooc
+
+BAG = 32
+
+TINY_CFG = dict(
+    max_epoch=2,
+    batch_size=32,
+    encode_size=64,
+    terminal_embed_size=32,
+    path_embed_size=32,
+    max_path_length=BAG,
+    print_sample_cycle=0,
+)
+
+METRIC_KEYS = ("train_loss", "test_loss", "accuracy", "precision", "recall", "f1")
+
+
+@pytest.fixture(scope="module")
+def corpora(tmp_path_factory):
+    """(text paths, csr path, text-loaded data, mmap-loaded data)."""
+    out = tmp_path_factory.mktemp("ooc")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    csr = str(out / "corpus.csr")
+    text_to_csr(paths["corpus"], csr)
+    data_text = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+        cache=False, native=False,
+    )
+    data_mmap = load_corpus(csr, paths["path_idx"], paths["terminal_idx"])
+    return paths, csr, data_text, data_mmap
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faultinject.install_plan(None)
+    yield
+    faultinject.install_plan(None)
+
+
+def assert_bitwise_history(r1, r2):
+    assert len(r1.history) == len(r2.history)
+    for h1, h2 in zip(r1.history, r2.history):
+        for key in METRIC_KEYS:
+            assert h1[key] == h2[key], (h1["epoch"], key, h1[key], h2[key])
+
+
+# ---------------------------------------------------------------------------
+# the container format
+# ---------------------------------------------------------------------------
+
+
+class TestContainer:
+    def test_round_trip_byte_identical(self, corpora, tmp_path):
+        paths, csr, _, _ = corpora
+        back = str(tmp_path / "roundtrip.txt")
+        csr_to_text(csr, back)
+        with open(paths["corpus"], "rb") as a, open(back, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_record_round_trip_edge_cases(self, tmp_path):
+        """Records exercising every optional field: missing source/doc/id/
+        label, empty context and var sections, unicode, tab-bearing
+        aliases."""
+        records = [
+            CorpusRecord(id=7, label="getFoo", source="A.java",
+                         path_contexts=[(1, 2, 3), (4, 5, 6)],
+                         aliases=[("counter", "@var_0")]),
+            CorpusRecord(id=None, label=None, source=None, doc="döc ünicode",
+                         path_contexts=[], aliases=[]),
+            CorpusRecord(id=2**40, label="naïve_name", source=None,
+                         path_contexts=[(0, 0, 0)],
+                         aliases=[("x", "@var_0"), ("y", "@var_1")]),
+        ]
+        path = str(tmp_path / "edge.csr")
+        write_corpus_csr(path, records, terminal_shift=1)
+        got = list(open_corpus_csr(path).iter_records())
+        assert len(got) == len(records)
+        for a, b in zip(records, got):
+            assert (a.id, a.label, a.source, a.doc) == (
+                b.id, b.label, b.source, b.doc
+            )
+            assert a.path_contexts == b.path_contexts
+            assert a.aliases == b.aliases
+
+    def test_histogram_footer_matches_scan(self, corpora):
+        _, csr, data_text, _ = corpora
+        lengths, weights = read_csr_histogram(csr)
+        ul, uc = np.unique(np.diff(data_text.row_splits), return_counts=True)
+        assert (lengths == ul).all() and (weights == uc).all()
+        # the footer feeds the SAME ladder derivation a scan would
+        assert derive_bucket_ladder_hist(lengths, weights, BAG) == (
+            derive_bucket_ladder(np.diff(data_text.row_splits), BAG)
+        )
+
+    def test_magic_detection(self, corpora, tmp_path):
+        paths, csr, _, _ = corpora
+        assert is_csr_corpus(csr)
+        assert not is_csr_corpus(paths["corpus"])
+        assert not is_csr_corpus(str(tmp_path / "missing.csr"))
+        with pytest.raises(ValueError, match="not a CSR"):
+            open_corpus_csr(paths["corpus"])
+
+    def test_mmap_views_are_lazy(self, corpora):
+        _, csr, _, _ = corpora
+        corpus = open_corpus_csr(csr)
+        assert isinstance(corpus.starts, np.memmap)
+        # gathers come back as plain in-RAM arrays
+        got = corpus.starts[np.asarray([0, 5, 3])]
+        assert not isinstance(got, np.memmap)
+
+
+# ---------------------------------------------------------------------------
+# the mmap loader
+# ---------------------------------------------------------------------------
+
+
+class TestCsrLoader:
+    def test_matches_text_loader(self, corpora):
+        _, _, t, m = corpora
+        assert m.mmap_backed and m.row_base is None
+        assert (np.asarray(m.starts) == t.starts).all()
+        assert (np.asarray(m.paths) == t.paths).all()
+        assert (np.asarray(m.ends) == t.ends).all()
+        assert (m.row_splits == t.row_splits).all()
+        assert (m.ids == t.ids).all()
+        assert (m.labels == t.labels).all()
+        assert m.label_vocab.stoi == t.label_vocab.stoi
+        assert m.normalized_labels == t.normalized_labels
+        assert m.sources == t.sources
+        assert m.aliases == t.aliases
+        assert (m.variable_indexes == t.variable_indexes).all()
+
+    def test_sharded_loader_row_base(self, corpora):
+        """A sharded mmap load keeps the FULL on-disk arrays and maps local
+        items through row_base — epoch builds must equal the text shard
+        loader's (which gathers local copies)."""
+        paths, csr, _, _ = corpora
+        for index in (0, 1):
+            t = load_corpus(
+                paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+                cache=False, native=False, shard=(index, 2),
+            )
+            m = load_corpus_csr(
+                csr, paths["path_idx"], paths["terminal_idx"],
+                shard=(index, 2),
+            )
+            assert m.row_base is not None
+            assert (m.row_splits == t.row_splits).all()
+            assert (m.ids == t.ids).all()
+            from code2vec_tpu.data.pipeline import build_method_epoch
+
+            idx = np.arange(m.n_items)
+            et = build_method_epoch(t, idx, BAG, np.random.default_rng(9))
+            em = build_method_epoch(m, idx, BAG, np.random.default_rng(9))
+            assert (et.starts == em.starts).all()
+            assert (et.paths == em.paths).all()
+            assert (et.ends == em.ends).all()
+            assert (et.labels == em.labels).all()
+
+    def test_variable_items_through_row_base(self, corpora):
+        paths, csr, _, _ = corpora
+        t = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            cache=False, native=False, shard=(1, 2),
+        )
+        m = load_corpus_csr(
+            csr, paths["path_idx"], paths["terminal_idx"], shard=(1, 2)
+        )
+        idx = np.arange(m.n_items)
+        got_t = [
+            (i, tuple(a), s.tolist(), p.tolist(), e.tolist())
+            for i, a, _, s, p, e in variable_items(t, idx)
+        ]
+        got_m = [
+            (i, tuple(a), s.tolist(), p.tolist(), e.tolist())
+            for i, a, _, s, p, e in variable_items(m, idx)
+        ]
+        assert got_t == got_m
+
+
+# ---------------------------------------------------------------------------
+# the parity matrix: {fixed-L, bucketed, streaming, mmap} x {sync, prefetch}
+# ---------------------------------------------------------------------------
+
+
+class TestParityMatrix:
+    """Every feed variant must compute the SAME per-example forward —
+    identical loss multiset, bitwise-equal eval metrics — as the in-RAM
+    fixed-L reference. Canonical context order makes rows comparable
+    across variants that build them at different stream positions; the
+    tiny corpus's counts all fit BAG, so the subsample is the full bag."""
+
+    def _per_example_losses(self, source, state, prefetch):
+        @jax.jit
+        def nll_of(state, batch):
+            logits, _, _ = state.apply_fn(
+                {"params": state.params},
+                batch["starts"], batch["paths"], batch["ends"],
+                deterministic=True,
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(
+                logp, batch["labels"][:, None], axis=-1
+            )[:, 0], jnp.argmax(logits, axis=-1)
+
+        losses, expected, preds = {}, [], []
+        with device_batches(
+            source.batches(np.random.default_rng(11)),
+            jax.device_put,
+            prefetch,
+        ) as stream:
+            for host_batch, device_batch in stream:
+                nll, pred = nll_of(state, device_batch)
+                valid = host_batch["example_mask"].astype(bool)
+                nll = np.asarray(nll)
+                for i in np.flatnonzero(valid):
+                    losses[int(host_batch["ids"][i])] = float(nll[i])
+                expected.append(host_batch["labels"][valid])
+                preds.append(np.asarray(pred)[valid])
+        return losses, np.concatenate(expected), np.concatenate(preds)
+
+    def test_matrix_vs_in_ram_fixed_reference(self, corpora):
+        _, _, data_text, data_mmap = corpora
+        counts = np.diff(data_text.row_splits)
+        # a bag holding every real count: the subsample keeps the FULL bag
+        # for every method, so rows are comparable across variants that
+        # draw at different stream positions
+        bag = int(2 ** np.ceil(np.log2(counts.max())))
+        assert counts.max() <= bag
+        ladder = derive_bucket_ladder(counts, bag)
+        assert len(ladder) > 1
+
+        cfg = TrainConfig(**TINY_CFG).with_updates(max_path_length=bag)
+        model_config = model_config_from(cfg, data_text)
+        idx = np.arange(data_text.n_items)
+        src_kw = dict(context_order="corpus")
+        reference_source = EpochSource(
+            data_text, idx, 32, bag, ladder=None, **src_kw
+        )
+        batch0 = next(reference_source.batches(np.random.default_rng(0)))
+        state = create_train_state(
+            cfg, model_config, jax.random.PRNGKey(0), batch0
+        )
+        reference = self._per_example_losses(reference_source, state, 0)
+
+        arms = {
+            "bucketed": EpochSource(
+                data_text, idx, 32, bag, ladder=ladder, **src_kw
+            ),
+            "streaming": StreamingSource(
+                data_text, idx, 32, bag, chunk_items=48, ladder=ladder,
+                **src_kw,
+            ),
+            "streaming_fixed": StreamingSource(
+                data_text, idx, 32, bag, chunk_items=48, **src_kw
+            ),
+            "mmap": MmapCorpusSource(
+                data_mmap, idx, 32, bag, ladder=ladder, **src_kw
+            ),
+            "mmap_fixed": MmapCorpusSource(
+                data_mmap, idx, 32, bag, **src_kw
+            ),
+        }
+        m_ref = evaluate(
+            "subtoken", reference[1], reference[2], data_text.label_vocab
+        )
+        for name, source in arms.items():
+            for prefetch in (0, 2):
+                got = self._per_example_losses(source, state, prefetch)
+                label = f"{name}/prefetch={prefetch}"
+                assert got[0].keys() == reference[0].keys(), label
+                for k in reference[0]:
+                    assert got[0][k] == reference[0][k], (label, k)
+                m_got = evaluate(
+                    "subtoken", got[1], got[2], data_text.label_vocab
+                )
+                assert m_got == m_ref, label
+
+
+# ---------------------------------------------------------------------------
+# end-to-end composition through train()
+# ---------------------------------------------------------------------------
+
+
+class TestComposition:
+    def test_mmap_bucketed_streaming_prefetched_one_invocation(self, corpora):
+        """The acceptance bar: bucketed + streaming + prefetched + mmap-CSR
+        in ONE train() — trains, reports pad_efficiency, and compiles
+        exactly the ladder (zero recompile events)."""
+        _, _, _, data_mmap = corpora
+        seen = []
+        from code2vec_tpu.obs.events import EventLog
+
+        events = EventLog()
+        events.subscribe(lambda e: seen.append(e))
+        res = train(
+            TrainConfig(**TINY_CFG).with_updates(
+                bucketed=True, stream_chunk_items=64, prefetch_batches=2
+            ),
+            data_mmap,
+            events=events,
+        )
+        assert res.epochs_run == 2
+        assert all(np.isfinite(h["train_loss"]) for h in res.history)
+        assert res.best_f1 > 0.0
+        assert all(0.0 < h["pad_efficiency"] <= 1.0 for h in res.history)
+        assert not [e for e in seen if e["event"] == "recompile"]
+
+    def test_mmap_gather_source_trains(self, corpora):
+        """Without streaming, a mmap corpus feeds through the per-bucket
+        gather source — no [N, L] epoch tensor exists at any point."""
+        _, _, _, data_mmap = corpora
+        seen = []
+        from code2vec_tpu.obs.events import EventLog
+
+        events = EventLog()
+        events.subscribe(lambda e: seen.append(e))
+        res = train(
+            TrainConfig(**TINY_CFG).with_updates(
+                bucketed=True, prefetch_batches=2
+            ),
+            data_mmap,
+            events=events,
+        )
+        assert res.epochs_run == 2
+        assert all(np.isfinite(h["train_loss"]) for h in res.history)
+        assert all(0.0 < h["pad_efficiency"] <= 1.0 for h in res.history)
+        assert not [e for e in seen if e["event"] == "recompile"]
+
+    def test_text_vs_csr_bitwise(self, corpora):
+        """Same flags, same seed, different backing: the streaming source
+        is backing-agnostic, so a csr-fed run reproduces the text-fed
+        run's history BITWISE (the ooc-smoke parity bar)."""
+        _, _, data_text, data_mmap = corpora
+        cfg = TrainConfig(**TINY_CFG).with_updates(
+            bucketed=True, stream_chunk_items=64, prefetch_batches=2
+        )
+        assert_bitwise_history(train(cfg, data_text), train(cfg, data_mmap))
+
+    def test_streaming_reports_pad_efficiency(self, corpora):
+        """Satellite: --stream_chunk_items used to silently drop the
+        honesty metric; now every epoch reports it — as the metric AND the
+        health gauge — and it equals the exact corpus geometry."""
+        _, _, data_text, _ = corpora
+        from code2vec_tpu.data.pipeline import pad_stats
+        from code2vec_tpu.obs.events import EventLog
+
+        seen = []
+        events = EventLog()
+        events.subscribe(lambda e: seen.append(e))
+        res = train(
+            TrainConfig(**TINY_CFG).with_updates(
+                max_epoch=1, stream_chunk_items=64
+            ),
+            data_text,
+            events=events,
+        )
+        assert all("pad_efficiency" in h for h in res.history)
+        train_idx_size = len(res.history)  # history exists
+        epochs = [e for e in seen if e["event"] == "epoch"]
+        assert epochs and all(
+            e["health"]["gauges"]["pad_efficiency"] > 0 for e in epochs
+        )
+        # exact geometry: the train split is 80% of items; recompute from
+        # the corpus like the in-RAM accounting would
+        from code2vec_tpu.data.pipeline import split_items
+
+        rng = np.random.default_rng(TINY_CFG.get("random_seed", 123))
+        train_idx, _ = split_items(data_text.n_items, rng)
+        counts = np.minimum(np.diff(data_text.row_splits)[train_idx], BAG)
+        real, slots = pad_stats(counts, (BAG,), 32)
+        assert res.history[0]["pad_efficiency"] == pytest.approx(
+            real / slots
+        )
+        assert train_idx_size == 1
+
+    def test_bucketed_shard_staged_device_epoch(self, corpora):
+        """Guard 3 deleted: --bucketed composes with --shard_staged_corpus
+        — each ladder bucket shards over the data axis and scans at its
+        own width."""
+        _, _, data_text, _ = corpora
+        res = train(
+            TrainConfig(**TINY_CFG).with_updates(
+                bucketed=True,
+                device_epoch=True,
+                shard_staged_corpus=True,
+                data_axis=2,
+            ),
+            data_text,
+        )
+        assert res.epochs_run == 2
+        assert all(np.isfinite(h["train_loss"]) for h in res.history)
+        assert res.best_f1 > 0.0
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch resume on the previously-unreachable combinations
+# ---------------------------------------------------------------------------
+
+
+class TestResume:
+    def _kill_and_resume(self, data, out_dir, kill_cfg, resume_cfg):
+        with pytest.raises(faultinject.FaultInjected):
+            train(kill_cfg, data, out_dir=out_dir, sinks=())
+        return train(resume_cfg, data, out_dir=out_dir, sinks=())
+
+    def test_kill_resume_bitwise_streaming_bucketed(self, corpora, tmp_path):
+        """Satellite: mid-epoch kill -> resume, bitwise, on a STREAMING
+        BUCKETED run — a combination the old mutual-exclusion guard made
+        unreachable. The stream is a pure function of the epoch-start RNG
+        state, so skip_batches replays it exactly, per-bucket carry and
+        all."""
+        _, _, data, _ = corpora
+        base = dict(
+            TINY_CFG, max_epoch=3, checkpoint_cycle=1,
+            bucketed=True, bucket_ladder=f"8,16,{BAG}",
+            stream_chunk_items=64,
+        )
+        r_full = train(
+            TrainConfig(**base), data, out_dir=str(tmp_path / "full"),
+            sinks=(),
+        )
+        r_resumed = self._kill_and_resume(
+            data, str(tmp_path / "killed"),
+            TrainConfig(**base, checkpoint_every_steps=2,
+                        fault_plan="train_step@9:raise"),
+            TrainConfig(**base, resume=True),
+        )
+        assert_bitwise_history(r_full, r_resumed)
+
+    def test_kill_resume_bitwise_mmap_bucketed(self, corpora, tmp_path):
+        """Same bar through the mmap gather source: its batch plan and
+        per-batch subsample draws are a pure function of the epoch-start
+        RNG too."""
+        _, _, _, data_mmap = corpora
+        base = dict(
+            TINY_CFG, max_epoch=3, checkpoint_cycle=1,
+            bucketed=True, bucket_ladder=f"8,16,{BAG}",
+        )
+        r_full = train(
+            TrainConfig(**base), data_mmap, out_dir=str(tmp_path / "full"),
+            sinks=(),
+        )
+        r_resumed = self._kill_and_resume(
+            data_mmap, str(tmp_path / "killed"),
+            TrainConfig(**base, checkpoint_every_steps=2,
+                        fault_plan="train_step@9:raise"),
+            TrainConfig(**base, resume=True),
+        )
+        assert_bitwise_history(r_full, r_resumed)
+
+
+# ---------------------------------------------------------------------------
+# the host-sharded lockstep schedule (single-process unit coverage)
+# ---------------------------------------------------------------------------
+
+
+class TestScheduledBatches:
+    def test_schedule_followed_with_masked_empties(self, corpora):
+        _, _, data, _ = corpora
+        from code2vec_tpu.data.pipeline import build_epoch
+
+        epoch = build_epoch(
+            data, np.arange(data.n_items), BAG, np.random.default_rng(0)
+        )
+        ladder = derive_bucket_ladder(np.diff(data.row_splits), BAG)
+        counts = bucket_batch_counts(
+            np.minimum(np.diff(data.row_splits), BAG), ladder, 32
+        )
+        # a schedule with 2 EXTRA steps per width: the local queues run
+        # dry and the tail must come out as fully-masked empties
+        schedule = np.repeat(np.asarray(ladder), counts + 2)
+        rng = np.random.default_rng(3)
+        schedule = schedule[rng.permutation(len(schedule))]
+        got_widths, n_valid = [], 0
+        for batch in iter_scheduled_bucketed_batches(
+            epoch, ladder, 32, schedule, rng=np.random.default_rng(4)
+        ):
+            got_widths.append(batch["paths"].shape[1])
+            n_valid += int(batch["example_mask"].sum())
+        assert got_widths == [int(w) for w in schedule]
+        assert n_valid == len(epoch)  # every example exactly once
+
+    def test_mmap_scheduled_matches(self, corpora):
+        _, _, _, data_mmap = corpora
+        ladder = derive_bucket_ladder(np.diff(data_mmap.row_splits), BAG)
+        idx = np.arange(data_mmap.n_items)
+        source = MmapCorpusSource(data_mmap, idx, 32, BAG, ladder=ladder)
+        counts = bucket_batch_counts(
+            np.minimum(np.diff(data_mmap.row_splits), BAG), ladder, 32
+        )
+        schedule = np.repeat(np.asarray(ladder), counts + 1)
+        seen, got_widths = [], []
+        for batch in source.scheduled_batches(
+            np.random.default_rng(5), schedule
+        ):
+            got_widths.append(batch["paths"].shape[1])
+            valid = batch["example_mask"].astype(bool)
+            seen.extend(batch["ids"][valid].tolist())
+        assert got_widths == [int(w) for w in schedule]
+        assert sorted(seen) == sorted(data_mmap.ids.tolist())
+
+
+# ---------------------------------------------------------------------------
+# bounded host RSS: feed a corpus bigger than the address-space headroom
+# ---------------------------------------------------------------------------
+
+
+BOUNDED_RSS_SCRIPT = textwrap.dedent("""
+    import os, resource, sys
+    import numpy as np
+
+    # ALL imports before the budget is measured: module loading grows the
+    # address space and would eat the margin
+    from code2vec_tpu.data.reader import load_corpus_csr
+    from code2vec_tpu.data.pipeline import MmapCorpusSource, derive_bucket_ladder_hist
+    from code2vec_tpu.formats.corpus_io import read_csr_histogram
+
+    csr_path, path_idx, terminal_idx = sys.argv[1:4]
+
+    def vm_size():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmSize:"):
+                    return int(line.split()[1]) * 1024
+        raise RuntimeError("no VmSize")
+
+    corpus_bytes = os.path.getsize(csr_path)
+    # budget: the current address space + ONE corpus-sized mapping (the
+    # mmap itself) + a margin far smaller than a second copy. In-RAM
+    # materialization needs corpus-size ADDITIONAL allocations and must
+    # die; mmap feeding must fit.
+    margin = 48 << 20
+    budget = vm_size() + corpus_bytes + margin
+    resource.setrlimit(resource.RLIMIT_AS, (budget, budget))
+
+    data = load_corpus_csr(csr_path, path_idx, terminal_idx)
+    assert data.mmap_backed
+    # ladder from the loaded row_splits: read_csr_histogram would map the
+    # container a SECOND time — free address space normally, but this
+    # budget counts every mapping
+    lengths, weights = np.unique(np.diff(data.row_splits), return_counts=True)
+    ladder = derive_bucket_ladder_hist(lengths, weights, 200)
+    source = MmapCorpusSource(
+        data, np.arange(data.n_items), 64, 200, ladder=ladder
+    )
+    n = 0
+    for batch in source.batches(np.random.default_rng(0)):
+        n += 1
+        if n >= 40:
+            break
+    assert n == 40, n
+
+    # negative control: materializing the context arrays (what an in-RAM
+    # load would do) must blow the same budget
+    try:
+        hoard = [np.array(data.starts), np.array(data.paths), np.array(data.ends)]
+        print("CONTROL-SURVIVED", len(hoard))
+        sys.exit(3)
+    except MemoryError:
+        pass
+    print("BOUNDED-OK", n)
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="rlimit/VmSize probe")
+def test_mmap_feed_bounded_by_rlimit(tmp_path, corpora):
+    """THE out-of-core guarantee, enforced with an address-space budget:
+    a corpus whose in-RAM copy cannot fit the rlimit feeds fine through
+    the mmap gather source (jax-free subprocess: the data layer imports
+    no backend, so the budget measures the feed, not XLA)."""
+    paths, _, _, _ = corpora
+    rng = np.random.default_rng(0)
+    big = str(tmp_path / "big.csr")
+    n_methods, ctx_per = 6000, 900  # ~65 MB of context sections
+    records = (
+        CorpusRecord(
+            id=i,
+            label=f"m{i}",
+            path_contexts=rng.integers(
+                1, 1000, size=(ctx_per, 3), dtype=np.int64
+            ).tolist(),
+            aliases=[],
+        )
+        for i in range(n_methods)
+    )
+    write_corpus_csr(big, records, terminal_shift=1)
+    assert os.path.getsize(big) > 60 << 20
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", BOUNDED_RSS_SCRIPT, big,
+         paths["path_idx"], paths["terminal_idx"]],
+        capture_output=True, text=True, timeout=300,
+        cwd=repo_root,
+        # minimal env: inherited vars (threadpool sizing, preloads,
+        # allocator tuning) change the interpreter's address-space
+        # baseline between the vm_size() probe and the mmap
+        env={
+            "PATH": os.environ.get("PATH", ""),
+            "HOME": os.environ.get("HOME", "/tmp"),
+            "PYTHONPATH": repo_root,
+            "OMP_NUM_THREADS": "1",
+            "OPENBLAS_NUM_THREADS": "1",
+        },
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "BOUNDED-OK" in proc.stdout
+
+
+def test_rss_stays_below_corpus_size_during_mmap_epoch(tmp_path, corpora):
+    """The obs-memory-sampler form of the acceptance criterion: streaming
+    an epoch of batches from a mmap corpus grows host RSS by (much) less
+    than the corpus size, where an in-RAM load of the same container
+    grows it by at least the context sections."""
+    paths, _, _, _ = corpora
+    from code2vec_tpu.obs.runtime import host_rss_bytes
+
+    rng = np.random.default_rng(1)
+    big = str(tmp_path / "sampler.csr")
+    n_methods, ctx_per = 4000, 900
+    write_corpus_csr(
+        big,
+        (
+            CorpusRecord(
+                id=i, label=f"m{i}",
+                path_contexts=rng.integers(
+                    1, 1000, size=(ctx_per, 3), dtype=np.int64
+                ).tolist(),
+                aliases=[],
+            )
+            for i in range(n_methods)
+        ),
+        terminal_shift=1,
+    )
+    corpus_bytes = os.path.getsize(big)
+    data = load_corpus_csr(big, paths["path_idx"], paths["terminal_idx"])
+    source = MmapCorpusSource(
+        data, np.arange(data.n_items), 64, 200, ladder=(50, 200)
+    )
+    # warm one pass so allocator pools exist, then measure a full epoch
+    for i, _ in enumerate(source.batches(np.random.default_rng(2))):
+        if i > 4:
+            break
+    rss_before = host_rss_bytes()
+    for _ in source.batches(np.random.default_rng(3)):
+        pass
+    grown = host_rss_bytes() - rss_before
+    # mmap page cache can keep touched pages resident; the bound that
+    # matters is "well below the corpus" (an in-RAM load adds >= the
+    # ~41 MB context sections immediately)
+    assert grown < corpus_bytes // 2, (grown, corpus_bytes)
+
+
+# ---------------------------------------------------------------------------
+# CLI: --corpus_format + the ooc-smoke path
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_corpus_format_mismatch_fails_loudly(self, corpora, tmp_path):
+        paths, csr, _, _ = corpora
+        from code2vec_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="corpus_format"):
+            main([
+                "--corpus_path", paths["corpus"],
+                "--path_idx_path", paths["path_idx"],
+                "--terminal_idx_path", paths["terminal_idx"],
+                "--corpus_format", "csr",
+                "--model_path", str(tmp_path / "out"),
+                "--max_epoch", "1",
+            ])
+
+    def test_cli_trains_from_csr(self, corpora, tmp_path):
+        """The ooc-smoke: CLI end to end from a converted container,
+        bucketed + prefetched, zero recompile events in the log."""
+        paths, csr, _, _ = corpora
+        from code2vec_tpu.cli import main
+
+        events_dir = tmp_path / "events"
+        main([
+            "--corpus_path", csr,
+            "--path_idx_path", paths["path_idx"],
+            "--terminal_idx_path", paths["terminal_idx"],
+            "--corpus_format", "csr",
+            "--bucketed",
+            "--prefetch_batches", "2",
+            "--batch_size", "32",
+            "--max_path_length", str(BAG),
+            "--encode_size", "64",
+            "--terminal_embed_size", "32",
+            "--path_embed_size", "32",
+            "--max_epoch", "1",
+            "--print_sample_cycle", "0",
+            "--model_path", str(tmp_path / "out"),
+            "--vectors_path", str(tmp_path / "out" / "code.vec"),
+            "--events_dir", str(events_dir),
+        ])
+        log_files = list(events_dir.glob("*.jsonl"))
+        assert log_files
+        events = [
+            json.loads(line)
+            for line in log_files[0].read_text().splitlines()
+        ]
+        assert any(e.get("event") == "epoch" for e in events)
+        assert not [e for e in events if e.get("event") == "recompile"]

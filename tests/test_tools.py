@@ -75,10 +75,33 @@ def test_corpus_stats_end_to_end(tmp_path):
     # the suggested flags appear verbatim for copy-paste
     assert "--bucket_ladder" in out.stdout
 
+    # the CSR container path: convert, then stats MUST come from the
+    # histogram footer (no context scan) and match the text-scan numbers
+    csr = str(tmp_path / "corpus.csr")
+    conv = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "corpus_convert.py"),
+         paths["corpus"], csr],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.join(TOOLS, ".."),
+    )
+    assert conv.returncode == 0, conv.stderr[-1000:]
+    out_csr = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "corpus_stats.py"),
+         csr, "--max_contexts", "32", "--batch_size", "32"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.join(TOOLS, ".."),
+    )
+    assert out_csr.returncode == 0, out_csr.stderr[-1000:]
+    assert "footer" in out_csr.stdout
+    stats_csr = json.loads(out_csr.stdout.strip().splitlines()[-1])
+    assert stats_csr == stats
+
 
 @pytest.mark.parametrize(
     "script", ["run_tpu_ablation.py", "bench_ctx.py", "rehearse_java_large.py",
-               "parity_vs_reference.py", "corpus_stats.py"]
+               "parity_vs_reference.py", "corpus_stats.py", "corpus_convert.py"]
 )
 def test_tool_argparse_help(script):
     """--help exercises import + argparse without touching a backend.

@@ -1,0 +1,100 @@
+"""Convert a text corpus to the memory-mapped CSR container (and back).
+
+The CSR container (formats/corpus_io.py) is the out-of-core corpus format:
+context arrays live as flat on-disk sections that training gathers through
+mmap views (``--corpus_format csr``), so corpora larger than host RAM feed
+bucketed/prefetched/multi-host runs in bounded RSS. The conversion streams —
+peak converter RSS is O(n_items + strings), never O(contexts).
+
+Terminal start/end ids are stored pre-shifted by ``@question``'s +1 (the
+shift the dataset reader applies per run on the text path) so mmap feeding
+is zero-copy; the reverse conversion subtracts it, making
+
+    python tools/corpus_convert.py corpus.txt corpus.csr
+    python tools/corpus_convert.py --to-text corpus.csr roundtrip.txt
+
+byte-faithful for canonically-written corpora (``formats.corpus_io
+.write_corpus`` output — which includes the synth generator and the
+extractor): ``roundtrip.txt`` is byte-identical to ``corpus.txt``.
+
+The container footer carries the context-count histogram; inspect it with
+``tools/corpus_stats.py corpus.csr`` (no context scan).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: the package
+
+from code2vec_tpu import QUESTION_TOKEN_INDEX  # noqa: E402
+from code2vec_tpu.formats.corpus_io import (  # noqa: E402
+    CsrCorpusWriter,
+    is_csr_corpus,
+    iter_corpus_records,
+    open_corpus_csr,
+    write_corpus_record,
+)
+
+
+def text_to_csr(src: str, dst: str, shift: int = QUESTION_TOKEN_INDEX) -> int:
+    """Stream ``src`` (text corpus) into ``dst`` (CSR container); returns
+    the record count."""
+    n = 0
+    with CsrCorpusWriter(dst, terminal_shift=shift) as writer:
+        for record in iter_corpus_records(src):
+            writer.add(record)
+            n += 1
+    return n
+
+
+def csr_to_text(src: str, dst: str) -> int:
+    """Stream ``src`` (CSR container) back to the canonical text form."""
+    corpus = open_corpus_csr(src)
+    with open(dst, "w", encoding="utf-8") as f:
+        for record in corpus.iter_records():
+            write_corpus_record(f, record)
+    return corpus.n_items
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="text corpus <-> memory-mapped CSR container"
+    )
+    parser.add_argument("src", help="input corpus (text, or CSR with --to-text)")
+    parser.add_argument("dst", help="output path")
+    parser.add_argument(
+        "--to-text",
+        action="store_true",
+        default=False,
+        help="convert a CSR container back to canonical text "
+        "(default: text -> CSR)",
+    )
+    args = parser.parse_args(argv)
+
+    t0 = time.perf_counter()
+    if args.to_text:
+        if not is_csr_corpus(args.src):
+            raise SystemExit(f"{args.src!r} is not a CSR container")
+        n = csr_to_text(args.src, args.dst)
+        direction = "csr -> text"
+    else:
+        if is_csr_corpus(args.src):
+            raise SystemExit(
+                f"{args.src!r} is already a CSR container; did you mean "
+                "--to-text?"
+            )
+        n = text_to_csr(args.src, args.dst)
+        direction = "text -> csr"
+    print(
+        f"{direction}: {n} records, {os.path.getsize(args.dst)} bytes "
+        f"in {time.perf_counter() - t0:.1f}s -> {args.dst}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,10 +8,14 @@ PAD, and pin an explicit ``--bucket_ladder`` before a long run.
 
 Reads only the corpus text (a lightweight line scan — no vocab files, no
 jax, no package import cost beyond the ladder helper), so it works on any
-L1-format corpus including ones whose index files live elsewhere.
+L1-format corpus including ones whose index files live elsewhere. A CSR
+container (tools/corpus_convert.py) is even cheaper: the length histogram
+comes straight from the container's footer — NO scan of the context
+sections at any corpus size.
 
 Usage:
     python tools/corpus_stats.py dataset/corpus.txt --max_contexts 200
+    python tools/corpus_stats.py dataset/corpus.csr --max_contexts 200
 
 Prints a per-bucket occupancy table, length percentiles, the pad-efficiency
 a fixed-L feed would get vs the suggested ladder, and one final JSON line
@@ -77,7 +81,18 @@ def main(argv: list[str] | None = None) -> None:
                         help="batch size for the pad-efficiency estimate")
     args = parser.parse_args(argv)
 
-    counts = context_counts(args.corpus_path)
+    from code2vec_tpu.formats.corpus_io import is_csr_corpus
+
+    if is_csr_corpus(args.corpus_path):
+        # the container footer IS the histogram — O(header) read, zero
+        # context-section scan at any corpus size
+        from code2vec_tpu.formats.corpus_io import read_csr_histogram
+
+        lengths, weights = read_csr_histogram(args.corpus_path)
+        counts = np.repeat(lengths, weights)
+        print(f"(histogram from CSR container footer: {args.corpus_path})")
+    else:
+        counts = context_counts(args.corpus_path)
     if not len(counts):
         print(json.dumps({"error": "no records found", "n_methods": 0}))
         return
