@@ -39,6 +39,11 @@ throughput accounting (see _bucket_ab).
 ``--ooc-ab`` A/Bs the in-RAM epoch feed against the out-of-core mmap-CSR
 feed (formats/corpus_io.py container + MmapCorpusSource) at equal
 real-context work, with host-RSS snapshots in both arms (see _ooc_ab).
+``--ann-ab`` A/Bs IVF-PQ ANN retrieval (code2vec_tpu/ann/) against the
+exact RetrievalIndex on one synthetic clustered index: recall@{1,10,100}
+-vs-QPS across an ``n_probe`` sweep, probed-row-fraction accounting, and
+the serve arm's zero-post-warmup-recompile verdict on the query path
+(see _ann_ab).
 
 Metric honesty: the headline counts REAL path contexts (summed batch
 masks / staged row counts), not padded slots — bag lengths are heavy-
@@ -74,6 +79,8 @@ def _metric_id() -> tuple[str, str]:
         return "serve_requests_per_sec", "req/sec"
     if "--ooc-ab" in sys.argv[1:]:
         return "mmap_csr_real_contexts_per_sec", "contexts/sec"
+    if "--ann-ab" in sys.argv[1:]:
+        return "ann_queries_per_sec", "queries/sec"
     return "path_contexts_per_sec_per_chip", "contexts/sec"
 
 
@@ -1213,6 +1220,221 @@ def _ooc_ab() -> None:
     )
 
 
+def _ann_ab() -> None:
+    """``--ann-ab``: ANN (IVF-PQ) vs exact retrieval on one synthetic
+    clustered index — the ISSUE-11 acceptance instrument.
+
+    One clustered vector corpus (Gaussian blobs, seeded) is indexed both
+    ways: arm A is the exact ``RetrievalIndex`` (O(N*E) matmul per query),
+    arm B the ``AnnRetrievalIndex`` (coarse probe -> LUT-scored PQ codes ->
+    exact re-rank) built by ``code2vec_tpu/ann``. Every arm answers the
+    SAME queries one at a time (Q=1 — the serving shape), so per-query
+    wall-clock is directly comparable; the pinned comparison arm uses ABBA
+    best-of like the other AB modes. The ``n_probe`` sweep reports
+    recall@{1,10,100} against exact ground truth, QPS, and the REAL
+    probed-row fraction (``cell_counts`` of the probed cells / N — pad
+    slots cost padded-slab work but don't count as corpus coverage). The
+    headline arm is the smallest swept ``n_probe`` reaching recall@10 >=
+    0.95. The serve bench's recompile verdict applies to the query path:
+    after warmup, any growth of either backend's compiled-fn table fails
+    the run.
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+
+    from code2vec_tpu.ann.index import build_index, normalize_rows
+    from code2vec_tpu.obs.runtime import RecompileDetector, RuntimeHealth
+    from code2vec_tpu.serve.retrieval import AnnRetrievalIndex, RetrievalIndex
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    n = knob("BENCH_ANN_N", 1_000_000, 120_000)
+    dim = knob("BENCH_ANN_DIM", 128, 32)
+    n_list = knob("BENCH_ANN_NLIST", 2048, 512)
+    m = knob("BENCH_ANN_M", 16, 8)
+    true_clusters = knob("BENCH_ANN_CLUSTERS", 8192, 1024)
+    n_queries = knob("BENCH_ANN_QUERIES", 64, 64)
+    shortlist = knob("BENCH_ANN_SHORTLIST", 256, 200)
+    km_iters = knob("BENCH_ANN_KM_ITERS", 20, 10)
+    pq_iters = knob("BENCH_ANN_PQ_ITERS", 15, 8)
+    probes = [
+        int(tok)
+        for tok in os.environ.get("BENCH_ANN_PROBES", "1,2,4,8,16").split(",")
+        if tok.strip()
+    ]
+
+    # clustered synth corpus: queries are perturbed corpus points, so the
+    # true neighbors concentrate the way real code-search queries do
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(true_clusters, dim)).astype(np.float32)
+    member = rng.integers(0, true_clusters, n)
+    rows = (
+        centers[member] + 0.12 * rng.normal(size=(n, dim))
+    ).astype(np.float32)
+    labels = [f"m{i}" for i in range(n)]
+    q_src = rng.integers(0, n, n_queries)
+    queries = (
+        rows[q_src] + 0.05 * rng.normal(size=(n_queries, dim))
+    ).astype(np.float32)
+
+    unit = normalize_rows(rows)
+    qn = normalize_rows(queries)
+    # exact ground truth (numpy, f64-free: same f32 matmul as the arms)
+    truth = np.argsort(-(qn @ unit.T), axis=1)[:, :100]
+    truth_sets = {
+        k: [set(truth[i, :k].tolist()) for i in range(n_queries)]
+        for k in (1, 10, 100)
+    }
+
+    t0 = time.perf_counter()
+    index, _ = build_index(
+        rows, n_list=n_list, m=m, seed=0, kmeans_iters=km_iters,
+        pq_iters=pq_iters,
+    )
+    build_seconds = time.perf_counter() - t0
+
+    exact = RetrievalIndex(labels, rows)
+
+    def one_pass(idx) -> float:
+        """Answer every query ONE AT A TIME (the serving shape); returns
+        seconds for the whole set."""
+        t0 = time.perf_counter()
+        for i in range(n_queries):
+            idx.top_k(queries[i], 100)
+        return time.perf_counter() - t0
+
+    def recall_of(idx) -> dict[str, float]:
+        out = {}
+        answers = [
+            # labels are "m<row>" by construction: decode, don't search
+            [int(name[1:]) for name, _ in idx.top_k(queries[i], 100)]
+            for i in range(n_queries)
+        ]
+        for k in (1, 10, 100):
+            hits = sum(
+                len(set(ans[:k]) & truth_sets[k][i]) / k
+                for i, ans in enumerate(answers)
+            )
+            out[f"recall@{k}"] = round(hits / n_queries, 4)
+        return out
+
+    sweep: list[dict] = []
+    ann_arms: dict[int, AnnRetrievalIndex] = {}
+    for n_probe in probes:
+        ann = AnnRetrievalIndex(
+            labels, unit, index, n_probe=n_probe, shortlist=shortlist
+        )
+        ann_arms[n_probe] = ann
+        one_pass(ann)  # warmup: compile the Q=1 bucket
+        t = min(one_pass(ann) for _ in range(2))
+        rec = recall_of(ann)
+        sweep.append(
+            {
+                "n_probe": n_probe,
+                **rec,
+                "qps": round(n_queries / t, 1),
+                "per_query_ms": round(1e3 * t / n_queries, 3),
+                "probed_row_fraction": round(
+                    ann.probed_fraction(queries), 4
+                ),
+            }
+        )
+
+    pinned = next(
+        (arm for arm in sweep if arm["recall@10"] >= 0.95), sweep[-1]
+    )
+    pinned_probe = pinned["n_probe"]
+    ann = ann_arms[pinned_probe]
+
+    # the recompile verdict on the query path: every executable both arms
+    # will ever need exists after warmup; any growth during the timed
+    # window is a silent per-request compile — fail the run
+    one_pass(exact)  # exact warmup
+    detector = RecompileDetector(health=RuntimeHealth())
+    detector.track("exact_query_fns", exact)
+    detector.track("ann_query_fns", ann)
+    detector.check()
+
+    repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 3)), 1)
+    exact_times: list[float] = []
+    ann_times: list[float] = []
+    for _ in range(repeats):  # ABBA best-of
+        exact_times.append(one_pass(exact))
+        ann_times.append(one_pass(ann))
+        ann_times.append(one_pass(ann))
+        exact_times.append(one_pass(exact))
+    post_warmup = detector.check()
+    speedup = min(exact_times) / min(ann_times)
+    qps = n_queries / min(ann_times)
+    verdict_ok = (
+        post_warmup == 0 and pinned["recall@10"] >= 0.95 and speedup > 1.0
+    )
+
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": backend,
+                    "mode": "ann_ab",
+                    "n": n,
+                    "dim": dim,
+                    "n_list": index.meta["n_list"],
+                    "m": index.meta["m"],
+                    "capacity": index.meta["capacity"],
+                    "shortlist": shortlist,
+                    "n_queries": n_queries,
+                    "build_seconds": round(build_seconds, 2),
+                    "index_code_bytes": int(
+                        index.codes.nbytes + index.scales.nbytes
+                    ),
+                    "exact_matrix_bytes": int(unit.nbytes),
+                    "sweep": sweep,
+                    "pinned_n_probe": pinned_probe,
+                    "pinned_recall": {
+                        k: pinned[k]
+                        for k in ("recall@1", "recall@10", "recall@100")
+                    },
+                    "ann_schedule": ann.searcher.schedule.to_dict(),
+                    "exact_per_query_ms": round(
+                        1e3 * min(exact_times) / n_queries, 3
+                    ),
+                    "ann_per_query_ms": round(
+                        1e3 * min(ann_times) / n_queries, 3
+                    ),
+                    "ann_vs_exact": round(speedup, 4),
+                    "post_warmup_recompiles": post_warmup,
+                    "verdict_ok": verdict_ok,
+                }
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ann_queries_per_sec",
+                "value": round(qps, 1),
+                # in AB mode the baseline IS the same-index exact arm
+                "vs_baseline": round(speedup, 4),
+                "unit": "queries/sec",
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+    if not verdict_ok:
+        raise SystemExit(
+            f"ann-ab verdict failed: recall@10={pinned['recall@10']} "
+            f"speedup={round(speedup, 3)} "
+            f"post_warmup_recompiles={post_warmup}"
+        )
+
+
 def _kernel_provenance(model_config) -> dict:
     """Kernel impl + schedule provenance for a detail block: the stamp must
     say which lowering produced the number, and — for autotuned runs — how
@@ -2073,6 +2295,8 @@ if __name__ == "__main__":
             _serve_bench()
         elif "--ooc-ab" in sys.argv[1:]:
             _ooc_ab()
+        elif "--ann-ab" in sys.argv[1:]:
+            _ann_ab()
         else:
             main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver

@@ -1,0 +1,22 @@
+"""Approximate-nearest-neighbor retrieval: IVF-PQ trained and served in JAX.
+
+Layout:
+
+- ``kmeans.py``     mini-batch Lloyd's k-means (k-means++ seeding, seeded-
+                    deterministic, assignment step mesh-sharded over ``data``)
+- ``pq.py``         product quantization of coarse residuals (per-row absmax
+                    scale shared with ``ops/quant.py``)
+- ``lut_kernel.py`` the fused Pallas LUT-gather-accumulate scoring kernel +
+                    its XLA ``take``-based reference (pinned parity)
+- ``index.py``      the :class:`IvfPqIndex` pytree, build/save/load through
+                    the ``formats/ann_io.py`` container, and the compiled
+                    :class:`AnnSearcher` query path
+"""
+
+from code2vec_tpu.ann.index import (  # noqa: F401
+    AnnSearcher,
+    IvfPqIndex,
+    build_index,
+    load_index,
+    save_index,
+)

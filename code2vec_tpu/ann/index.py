@@ -1,0 +1,482 @@
+"""The IVF-PQ index: build, (de)serialize, and the compiled query path.
+
+Build (``build_index``): L2-normalize the exported vectors, k-means the
+unit rows into ``n_list`` cells (the coarse quantizer), PQ-encode each
+row's residual (``pq.py``), then lay the corpus out **cell-major**: every
+cell's rows packed into a fixed ``capacity`` slab (max cell size rounded
+to a lane multiple) so the search path is static-shaped — codes
+``[n_list, C, M]`` uint8, per-row scales ``[n_list, C]`` f32, original row
+ids ``[n_list, C]`` int32 (``-1`` on pad slots). Per query the search
+scores cells against the centroids, probes the top ``n_probe``, builds the
+``[M, 256]`` LUT once, scores the probed slabs with the fused kernel
+(``lut_kernel.py``), and returns a ``shortlist`` of candidate row ids for
+exact f32 re-ranking — O(n_probe * C * M + shortlist * E) per query
+instead of the exact path's O(N * E).
+
+The index is a registered pytree (arrays as children, geometry as static
+aux data), and serializes through the ``formats/ann_io.py`` container
+together with the unit rows (the exact-rerank matrix, mmap-loaded) and the
+method labels.
+
+Query-path compile discipline (the PR-9 contract): ``AnnSearcher`` holds
+one jitted function per power-of-two query-batch bucket — ``n_probe`` and
+``shortlist`` are static per searcher, the client's ``k`` only enters the
+host-side re-rank — and exposes the ``_cache_size`` probe so the obs
+``RecompileDetector`` tracks it like the serving engine's executable
+table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "IvfPqIndex",
+    "build_index",
+    "save_index",
+    "load_index",
+    "AnnSearcher",
+    "normalize_rows",
+    "pow2_bucket",
+]
+
+_LANE = 128
+
+
+def normalize_rows(rows: np.ndarray) -> np.ndarray:
+    """L2-normalize ``[N, E]`` rows (the exact index's rule: cosine
+    becomes a plain dot product)."""
+    rows = np.ascontiguousarray(rows, np.float32)
+    norms = np.linalg.norm(rows, axis=1, keepdims=True)
+    return rows / np.maximum(norms, 1e-12)
+
+
+@dataclasses.dataclass
+class IvfPqIndex:
+    """The trained index. Arrays are pytree children; ``meta`` (geometry +
+    provenance) is static aux data, so the whole index flows through
+    jit/device_put unchanged."""
+
+    centroids: np.ndarray  # f32 [n_list, E]
+    codebooks: np.ndarray  # f32 [M, 256, dsub]
+    codes: np.ndarray  # uint8 [n_list, C, M]
+    scales: np.ndarray  # f32 [n_list, C] (0 on pad slots)
+    ids: np.ndarray  # int32 [n_list, C] (-1 on pad slots)
+    cell_counts: np.ndarray  # int32 [n_list] real rows per cell
+    meta: dict
+
+    def tree_flatten(self):
+        import json
+
+        children = (
+            self.centroids, self.codebooks, self.codes, self.scales,
+            self.ids, self.cell_counts,
+        )
+        # aux data must be hashable; meta (which may nest dicts, e.g. the
+        # container's serving defaults) rides as its canonical JSON string
+        return children, json.dumps(self.meta, sort_keys=True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        import json
+
+        return cls(*children, meta=json.loads(aux))
+
+
+def _register_pytree() -> None:
+    import jax
+
+    try:
+        jax.tree_util.register_pytree_node(
+            IvfPqIndex,
+            lambda idx: idx.tree_flatten(),
+            IvfPqIndex.tree_unflatten,
+        )
+    except ValueError:  # pragma: no cover - double import guard
+        pass
+
+
+_register_pytree()
+
+
+def build_index(
+    rows: np.ndarray,
+    *,
+    n_list: int,
+    m: int,
+    seed: int = 0,
+    kmeans_iters: int = 25,
+    pq_iters: int = 15,
+    batch_size: int | None = None,
+    capacity: int | None = None,
+    mesh=None,
+) -> tuple[IvfPqIndex, np.ndarray]:
+    """Train an index over ``rows [N, E]``; returns ``(index, unit_rows)``
+    (the L2-normalized matrix the exact re-rank scores against).
+
+    Seeded-deterministic end to end: k-means and PQ training consume one
+    ``seed`` lineage and fold on the host (``kmeans.py``), and rows keep
+    their original relative order inside each cell (stable sort)."""
+    from code2vec_tpu.ann import pq
+    from code2vec_tpu.ann.kmeans import assign_cells, kmeans_fit
+
+    unit = normalize_rows(rows)
+    n, dim = unit.shape
+    n_list = max(min(int(n_list), n), 1)
+    if dim % m:
+        raise ValueError(f"m={m} must divide dim={dim}")
+
+    centroids = kmeans_fit(
+        unit, n_list, seed=seed, iters=kmeans_iters, batch_size=batch_size,
+        mesh=mesh,
+    )
+    assign = assign_cells(unit, centroids, mesh=mesh)
+    residuals = unit - centroids[assign]
+    codebooks, row_scales = pq.train_codebooks(
+        residuals, m, seed=seed + 1, iters=pq_iters, batch_size=batch_size,
+        mesh=mesh,
+    )
+    row_codes = pq.encode(residuals, codebooks, row_scales, mesh=mesh)
+
+    counts = np.bincount(assign, minlength=n_list).astype(np.int32)
+    cap = int(capacity) if capacity else int(counts.max())
+    cap = max(-(-cap // _LANE) * _LANE, _LANE)
+    if counts.max() > cap:
+        raise ValueError(
+            f"capacity {cap} < largest cell ({int(counts.max())} rows); "
+            "raise capacity or n_list"
+        )
+
+    codes = np.zeros((n_list, cap, m), np.uint8)
+    scales = np.zeros((n_list, cap), np.float32)
+    ids = np.full((n_list, cap), -1, np.int32)
+    order = np.argsort(assign, kind="stable")
+    sorted_cells = assign[order]
+    starts = np.searchsorted(sorted_cells, np.arange(n_list))
+    for cell in range(n_list):
+        lo = int(starts[cell])
+        cnt = int(counts[cell])
+        sel = order[lo : lo + cnt]
+        codes[cell, :cnt] = row_codes[sel]
+        scales[cell, :cnt] = row_scales[sel]
+        ids[cell, :cnt] = sel.astype(np.int32)
+
+    meta = {
+        "version": 1,
+        "n": int(n),
+        "dim": int(dim),
+        "n_list": int(n_list),
+        "m": int(m),
+        "dsub": int(dim // m),
+        "capacity": int(cap),
+        "seed": int(seed),
+    }
+    index = IvfPqIndex(
+        centroids=centroids, codebooks=codebooks, codes=codes,
+        scales=scales, ids=ids, cell_counts=counts, meta=meta,
+    )
+    return index, unit
+
+
+# ---------------------------------------------------------------------------
+# container save/load (formats/ann_io.py conventions)
+# ---------------------------------------------------------------------------
+
+
+def save_index(
+    path: str,
+    index: IvfPqIndex,
+    unit_rows: np.ndarray,
+    labels: list[str],
+    defaults: dict | None = None,
+) -> None:
+    """Serialize index + re-rank rows + labels into one container.
+    ``defaults`` (e.g. ``{"n_probe": 8, "shortlist": 128}``) ride in the
+    header meta so a server can start without per-deploy tuning flags."""
+    from code2vec_tpu.formats.ann_io import write_ann_container
+
+    n = index.meta["n"]
+    if len(labels) != n or unit_rows.shape[0] != n:
+        raise ValueError(
+            f"labels ({len(labels)}) and rows ({unit_rows.shape[0]}) must "
+            f"match the index size ({n})"
+        )
+    blob = bytearray()
+    offsets = np.zeros(n + 1, np.int64)
+    for i, label in enumerate(labels):
+        blob.extend(label.encode("utf-8"))
+        offsets[i + 1] = len(blob)
+    arrays = {
+        "centroids": index.centroids,
+        "codebooks": index.codebooks,
+        "codes": index.codes,
+        "scales": index.scales,
+        "ids": index.ids,
+        "cell_counts": index.cell_counts,
+        "label_offsets": offsets,
+        "label_blob": np.frombuffer(bytes(blob), np.uint8)
+        if blob
+        else np.zeros(0, np.uint8),
+        "rows": np.ascontiguousarray(unit_rows, np.float32),
+    }
+    meta = dict(index.meta)
+    meta["defaults"] = dict(defaults or {})
+    write_ann_container(path, arrays, meta)
+
+
+def load_index(path: str) -> tuple[IvfPqIndex, np.ndarray, list[str]]:
+    """Open a container: ``(index, unit_rows, labels)``. The big sections
+    (``rows``, ``codes``) stay mmap views until touched; labels decode to
+    an in-RAM list (the serving responses need the strings anyway)."""
+    from code2vec_tpu.formats.ann_io import read_ann_container
+
+    arrays, meta = read_ann_container(path)
+    offsets = arrays["label_offsets"]
+    blob = bytes(arrays["label_blob"])
+    labels = [
+        blob[int(offsets[i]) : int(offsets[i + 1])].decode("utf-8")
+        for i in range(len(offsets) - 1)
+    ]
+    index = IvfPqIndex(
+        centroids=arrays["centroids"],
+        codebooks=arrays["codebooks"],
+        codes=arrays["codes"],
+        scales=arrays["scales"],
+        ids=arrays["ids"],
+        cell_counts=np.asarray(arrays["cell_counts"], np.int32),
+        meta={k: v for k, v in meta.items() if k != "defaults"},
+    )
+    index.meta["defaults"] = dict(meta.get("defaults", {}))
+    return index, arrays["rows"], labels
+
+
+# ---------------------------------------------------------------------------
+# the compiled query path
+# ---------------------------------------------------------------------------
+
+
+def pow2_bucket(n: int, cap: int | None = None) -> int:
+    """Round up to a power of two, optionally capped — THE executable-
+    table keying rule, shared by the ANN searcher and both serving
+    retrieval backends (``serve/retrieval.py``): one definition, so the
+    bounded-table contract every ``_cache_size`` probe asserts cannot
+    drift between backends."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return min(bucket, cap) if cap is not None else bucket
+
+
+class AnnSearcher:
+    """Device-resident IVF-PQ search with a bounded executable table.
+
+    ``n_probe``/``shortlist`` are static (one searcher per configuration —
+    the serving deployment model); query batches bucket to powers of two,
+    so the jit cache is bounded by log2(max Q) entries regardless of
+    client batching. On a mesh the cell-major arrays shard over ``model``
+    per ``parallel/shardings.ann_shardings`` (``n_list`` padded with
+    ``-inf`` coarse bias so pad cells are never probed) and the scoring
+    runs the XLA formulation — the Pallas kernel carries no partitioning
+    rule, so it engages on the single-device/per-shard path only.
+    """
+
+    def __init__(
+        self,
+        index: IvfPqIndex,
+        *,
+        n_probe: int = 8,
+        shortlist: int = 128,
+        mesh=None,
+        schedule=None,
+        cache=None,
+        interpret: bool | None = None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from code2vec_tpu.ops.autotune import lookup_lut_schedule
+
+        meta = index.meta
+        self.meta = meta
+        self._mesh = mesh
+        self.capacity = int(meta["capacity"])
+        self.dim = int(meta["dim"])
+        self.m = int(meta["m"])
+        n_list = int(meta["n_list"])
+        counts = np.asarray(index.cell_counts, np.int64)
+        non_empty = int((counts > 0).sum())
+        self.n_probe = max(min(int(n_probe), non_empty), 1)
+        self.shortlist = max(
+            min(int(shortlist), self.n_probe * self.capacity), 1
+        )
+        self.schedule = schedule or lookup_lut_schedule(
+            self.m, n_list, self.capacity, self.shortlist, cache=cache
+        )
+        self._interpret = interpret
+        self._counts = counts
+
+        # pad n_list so the model axis shards the cell dim evenly; pad
+        # cells (and empty real cells) get -inf coarse bias: never probed
+        pad_to = 1
+        if mesh is not None:
+            from code2vec_tpu.parallel.mesh import AXIS_MODEL
+
+            pad_to = max(int(mesh.shape[AXIS_MODEL]), 1)
+        nl_pad = -(-n_list // pad_to) * pad_to
+        self.n_list = n_list
+
+        def pad_cells(x):
+            if x.shape[0] == nl_pad:
+                return x
+            pad = np.zeros((nl_pad - x.shape[0],) + x.shape[1:], x.dtype)
+            return np.concatenate([x, pad])
+
+        centroids = pad_cells(np.ascontiguousarray(index.centroids, np.float32))
+        codes = pad_cells(np.ascontiguousarray(index.codes))
+        scales = pad_cells(np.ascontiguousarray(index.scales, np.float32))
+        ids = np.concatenate(
+            [
+                np.ascontiguousarray(index.ids, np.int32),
+                np.full(
+                    (nl_pad - n_list, self.capacity), -1, np.int32
+                ),
+            ]
+        ) if nl_pad != n_list else np.ascontiguousarray(index.ids, np.int32)
+        bias = np.where(ids < 0, -np.inf, 0.0).astype(np.float32)
+        cell_bias = np.zeros(nl_pad, np.float32)
+        cell_bias[np.concatenate([counts, np.zeros(nl_pad - n_list)]) == 0] = (
+            -np.inf
+        )
+
+        if mesh is not None:
+            from code2vec_tpu.parallel.shardings import ann_shardings
+
+            sh = ann_shardings(mesh)
+            put = jax.device_put
+            self._centroids = put(centroids, sh["centroids"])
+            self._codebooks = put(
+                np.ascontiguousarray(index.codebooks, np.float32),
+                sh["codebooks"],
+            )
+            self._codes = put(codes, sh["codes"])
+            self._scales = put(scales, sh["scales"])
+            self._bias = put(bias, sh["bias"])
+            self._ids = put(ids, sh["ids"])
+            self._cell_bias = put(cell_bias, sh["cell_bias"])
+            self._query_sharding = sh["query"]
+        else:
+            self._centroids = jnp.asarray(centroids)
+            self._codebooks = jnp.asarray(
+                np.ascontiguousarray(index.codebooks, np.float32)
+            )
+            self._codes = jnp.asarray(codes)
+            self._scales = jnp.asarray(scales)
+            self._bias = jnp.asarray(bias)
+            self._ids = jnp.asarray(ids)
+            self._cell_bias = jnp.asarray(cell_bias)
+            self._query_sharding = None
+        self._fns: dict[int, object] = {}  # q bucket -> jitted search fn
+
+    # ---- accounting -----------------------------------------------------
+    def _cache_size(self) -> int:
+        """Compiled search-fn count (obs RecompileDetector probe)."""
+        return len(self._fns)
+
+    def probed_fraction(self, queries: np.ndarray) -> float:
+        """Mean fraction of REAL index rows inside the probed cells — the
+        honest probed-work accounting ``bench.py --ann-ab`` reports (pad
+        slots are scored but cost only the padded slab, not the corpus).
+        Applies the same ``-inf`` empty-cell bias as the compiled query
+        path, so the counted cell set IS the probed cell set."""
+        q = normalize_rows(np.asarray(queries, np.float32).reshape(-1, self.dim))
+        sims = q @ np.asarray(self._centroids[: self.n_list]).T
+        sims[:, self._counts == 0] = -np.inf  # never probed (cell_bias)
+        order = np.argsort(-sims, axis=1)[:, : self.n_probe]
+        probed = self._counts[order].sum(axis=1)
+        return float(probed.mean() / max(self._counts.sum(), 1))
+
+    def describe(self) -> dict:
+        return {
+            "n_list": int(self.n_list),
+            "n_probe": int(self.n_probe),
+            "shortlist": int(self.shortlist),
+            "m": int(self.m),
+            "capacity": int(self.capacity),
+            "schedule": self.schedule.to_dict(),
+            "impl_effective": self._impl_effective(),
+            "search_executables": self._cache_size(),
+        }
+
+    def _impl_effective(self) -> str:
+        return "xla" if self._mesh is not None else self.schedule.impl
+
+    # ---- query ----------------------------------------------------------
+    def _fn(self, qb: int):
+        fn = self._fns.get(qb)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            from code2vec_tpu.ann.lut_kernel import lut_score_cells
+
+            centroids, codebooks = self._centroids, self._codebooks
+            codes, scales, bias = self._codes, self._scales, self._bias
+            ids, cell_bias = self._ids, self._cell_bias
+            n_probe, shortlist = self.n_probe, self.shortlist
+            cap, m, dsub = self.capacity, self.m, self.dim // self.m
+            impl = self._impl_effective()
+            sched = self.schedule
+            interpret = self._interpret
+
+            def ann_query(q):  # [qb, E] unit queries
+                cell_scores = q @ centroids.T + cell_bias[None, :]
+                coarse, probed = jax.lax.top_k(cell_scores, n_probe)
+                qm = q.reshape(qb, m, dsub)
+                lut = jnp.einsum("qmd,mjd->qmj", qm, codebooks)
+                adc = lut_score_cells(
+                    lut, probed.astype(jnp.int32), codes, scales, bias,
+                    impl=impl, chunk_c=sched.chunk_c,
+                    dma_depth=sched.dma_depth, interpret=interpret,
+                )
+                scores = adc + coarse[:, :, None]  # + q . centroid term
+                flat = scores.reshape(qb, n_probe * cap)
+                top, flat_idx = jax.lax.top_k(flat, shortlist)
+                p_idx = flat_idx // cap
+                c_idx = flat_idx - p_idx * cap
+                cells = jnp.take_along_axis(probed, p_idx, axis=1)
+                return top, ids[cells, c_idx]
+
+            if self._mesh is not None:
+                fn = jax.jit(
+                    ann_query,
+                    in_shardings=self._query_sharding,
+                    out_shardings=(
+                        self._query_sharding, self._query_sharding,
+                    ),
+                )
+            else:
+                fn = jax.jit(ann_query)
+            self._fns[qb] = fn
+        return fn
+
+    def search(
+        self, queries: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """ANN shortlist for ``queries [Q, E]`` (normalized internally):
+        ``(adc_scores [Q, S] f32, row_ids [Q, S] int32, -1 = pad slot)``.
+        Scores are the approximate (ADC) values — callers re-rank the ids
+        against the exact rows."""
+        q = normalize_rows(
+            np.asarray(queries, np.float32).reshape(-1, self.dim)
+        )
+        n = q.shape[0]
+        qb = pow2_bucket(max(n, 1))
+        if n < qb:
+            q = np.concatenate([q, np.zeros((qb - n, self.dim), np.float32)])
+        top, rows = self._fn(qb)(q)
+        return np.asarray(top)[:n], np.asarray(rows)[:n]

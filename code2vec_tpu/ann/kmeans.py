@@ -1,0 +1,188 @@
+"""Mini-batch Lloyd's k-means: the coarse quantizer (and PQ codebook) core.
+
+Split of labor, chosen for determinism:
+
+- the O(batch * k * E) **assignment** step — the only term that grows with
+  corpus and cluster count — runs as one jitted matmul+argmin on the
+  device(s), optionally sharded over the mesh ``data`` axis (rows are
+  embarrassingly parallel; the reduction over E stays within a shard, so
+  assignments are bitwise identical on any topology);
+- the O(batch * E) **centroid update** folds on the host in float64 in
+  fixed row order (the Sculley running-average form: each cluster's
+  centroid is the exact mean of every sample ever assigned to it).
+
+Because every floating-point *accumulation* happens on the host in a fixed
+order, the same seed produces BITWISE-identical centroids on one device and
+on an 8-device mesh — the parity contract tests/test_ann.py pins. Seeding
+is standard k-means++ (D² sampling) from one ``np.random.default_rng``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["kmeans_pp_init", "kmeans_fit", "assign_cells"]
+
+
+def _l2_sq_to(x: np.ndarray, c: np.ndarray) -> np.ndarray:
+    """||x_i - c||^2 per row, float64 (host; k-means++ D² weights)."""
+    d = x.astype(np.float64) - c.astype(np.float64)[None, :]
+    return np.einsum("ne,ne->n", d, d)
+
+
+def kmeans_pp_init(
+    x: np.ndarray, k: int, rng: np.random.Generator
+) -> np.ndarray:
+    """k-means++ seeding: first center uniform, each next sampled with
+    probability proportional to the squared distance to the nearest center
+    chosen so far. Incremental min-distance update keeps it O(k * N * E).
+    With fewer distinct points than ``k`` the D² mass hits zero and the
+    remaining centers draw uniformly (duplicates are acceptable — the
+    assignment argmin resolves ties to the first index)."""
+    n = x.shape[0]
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    centers = np.empty((k, x.shape[1]), np.float64)
+    first = int(rng.integers(n))
+    centers[0] = x[first]
+    d2 = _l2_sq_to(x, centers[0])
+    for i in range(1, k):
+        total = float(d2.sum())
+        if total > 0.0:
+            idx = int(rng.choice(n, p=d2 / total))
+        else:
+            idx = int(rng.integers(n))
+        centers[i] = x[idx]
+        np.minimum(d2, _l2_sq_to(x, centers[i]), out=d2)
+    return centers.astype(np.float32)
+
+
+class _Assigner:
+    """One jitted nearest-centroid assignment, compiled per (B, k, E) —
+    the host loop pads the final short batch to the fixed B, so a full fit
+    costs exactly one compile. On a mesh the batch rows shard over the
+    ``data`` axis; centroids replicate (they are tiny at any scale)."""
+
+    def __init__(self, batch: int, mesh=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.batch = int(batch)
+        self._mesh = mesh
+
+        def nearest(xb, cents):  # [B, E], [K, E] -> int32 [B]
+            cross = xb @ cents.T
+            c2 = jnp.sum(cents * cents, axis=1)
+            return jnp.argmin(c2[None, :] - 2.0 * cross, axis=1).astype(
+                jnp.int32
+            )
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from code2vec_tpu.parallel.mesh import AXIS_DATA
+
+            data_axis = AXIS_DATA if mesh.shape[AXIS_DATA] > 1 else None
+            self._fn = jax.jit(
+                nearest,
+                in_shardings=(
+                    NamedSharding(mesh, P(data_axis, None)),
+                    NamedSharding(mesh, P()),
+                ),
+                out_shardings=NamedSharding(mesh, P(data_axis)),
+            )
+        else:
+            self._fn = jax.jit(nearest)
+
+    def __call__(self, xb: np.ndarray, cents: np.ndarray) -> np.ndarray:
+        n = xb.shape[0]
+        if n < self.batch:  # pad the tail batch to the compiled shape
+            xb = np.concatenate(
+                [xb, np.zeros((self.batch - n, xb.shape[1]), xb.dtype)]
+            )
+        out = np.asarray(self._fn(xb, cents))
+        return out[:n]
+
+
+def _draw_size(n: int, batch_size: int | None) -> int:
+    """Rows SAMPLED per mini-batch — a pure function of (n, batch_size),
+    never of the mesh, so the rng consumes identically on any topology
+    (the bitwise-parity contract)."""
+    batch = int(batch_size) if batch_size else min(n, 16384)
+    return max(min(batch, n), 1)
+
+
+def _compiled_batch(draw: int, mesh=None) -> int:
+    """The assigner's COMPILED batch shape: the draw size rounded up so
+    the data axis shards it evenly. Padding to this shape happens inside
+    the assigner (zero rows, sliced off before any fold), so mesh
+    divisibility changes the compiled shape only — never the samples."""
+    if mesh is not None:
+        from code2vec_tpu.parallel.mesh import AXIS_DATA
+
+        axis = max(int(mesh.shape[AXIS_DATA]), 1)
+        return -(-draw // axis) * axis
+    return draw
+
+
+def kmeans_fit(
+    x: np.ndarray,
+    k: int,
+    *,
+    seed: int = 0,
+    iters: int = 25,
+    batch_size: int | None = None,
+    mesh=None,
+) -> np.ndarray:
+    """Fit ``k`` centroids over ``x [N, E]``; returns f32 ``[k, E]``.
+
+    Mini-batch Lloyd's: per iteration a seeded sample is assigned on the
+    device and folded into the running per-cluster means on the host
+    (float64, fixed order — the determinism contract). Clusters that never
+    receive a sample keep their k-means++ seed point."""
+    x = np.ascontiguousarray(x, np.float32)
+    n = x.shape[0]
+    rng = np.random.default_rng(seed)
+    centers = kmeans_pp_init(x, k, rng).astype(np.float64)
+    counts = np.zeros(k, np.int64)
+    draw = _draw_size(n, batch_size)
+    assigner = _Assigner(_compiled_batch(draw, mesh), mesh=mesh)
+    for _ in range(max(int(iters), 0)):
+        idx = (
+            rng.choice(n, size=draw, replace=False)
+            if draw < n
+            else np.arange(n)
+        )
+        xb = x[idx]
+        a = assigner(xb, centers.astype(np.float32))
+        sums = np.zeros_like(centers)
+        np.add.at(sums, a, xb.astype(np.float64))
+        bc = np.bincount(a, minlength=k).astype(np.int64)
+        touched = bc > 0
+        total = counts[touched] + bc[touched]
+        centers[touched] = (
+            centers[touched] * counts[touched, None] + sums[touched]
+        ) / total[:, None]
+        counts[touched] = total
+    return centers.astype(np.float32)
+
+
+def assign_cells(
+    x: np.ndarray,
+    centroids: np.ndarray,
+    *,
+    batch_size: int | None = None,
+    mesh=None,
+) -> np.ndarray:
+    """Full nearest-centroid assignment pass: int32 ``[N]``. Same jitted
+    step as the fit (one compile; tail batch padded)."""
+    x = np.ascontiguousarray(x, np.float32)
+    n = x.shape[0]
+    draw = _draw_size(n, batch_size or 65536)
+    assigner = _Assigner(_compiled_batch(draw, mesh), mesh=mesh)
+    cents = np.ascontiguousarray(centroids, np.float32)
+    out = np.empty(n, np.int32)
+    for lo in range(0, n, draw):
+        hi = min(lo + draw, n)
+        out[lo:hi] = assigner(x[lo:hi], cents)
+    return out

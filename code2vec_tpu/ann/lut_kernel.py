@@ -1,0 +1,215 @@
+"""Fused LUT-gather-accumulate scoring for IVF-PQ search.
+
+Given the per-query LUT ``[M, 256]`` of subspace inner products and the
+index's cell-major storage (codes ``[n_list, C, M]`` uint8, per-row scales
+and pad bias ``[n_list, C]`` f32), score every row of every probed cell::
+
+    out[q, p, c] = scales[cell, c] * sum_m LUT[q, m, codes[cell, c, m]]
+                   + bias[cell, c]          where cell = probed[q, p]
+
+Two implementations with pinned parity (tests/test_ann.py):
+
+- ``xla``    — ``jnp.take`` over a flattened per-query LUT. XLA's gather
+  lowering is the right tool on CPU (and the reference semantics).
+- ``pallas`` — one kernel program per (query, probed cell): the cell's
+  codes/scales/bias are DMA'd from HBM into VMEM in ``chunk_c``-row chunks
+  (``dma_depth``-buffered — chunk c+1's copy overlaps chunk c's compute,
+  the PR-8 double-buffer pattern), the LUT stays VMEM-resident, and the
+  gather is formulated as a one-hot contraction per subspace: TPU has no
+  fast vector gather, but ``[chunk_c, 256] x [256]`` compare-and-reduce is
+  pure VPU work. ``interpret=True`` runs the same kernel on CPU.
+
+Pad rows (beyond a cell's real count) carry scale 0 and bias ``-inf``, so
+they score ``-inf`` and can never surface in the shortlist.
+
+The (``chunk_c`` x ``dma_depth`` x impl) space is the LUT kernel's variant
+axis in ``ops/autotune.py`` (``LutSchedule``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from code2vec_tpu.analysis.contracts import shape_contract, spec
+
+LUT_IMPLS = ("xla", "pallas")
+_LANE = 128
+
+
+def xla_lut_score_cells(lut, probed, codes, scales, bias):
+    """The ``take``-based reference: gather probed cells' codes, index the
+    flattened per-query LUT, reduce over subspaces."""
+    q, m, entries = lut.shape
+    gathered = codes[probed].astype(jnp.int32)  # [Q, P, C, M]
+    offsets = gathered + jnp.arange(m, dtype=jnp.int32) * entries
+    flat = lut.reshape(q, m * entries)
+    vals = jax.vmap(lambda table, idx: table[idx])(flat, offsets)
+    sums = jnp.sum(vals, axis=-1)  # [Q, P, C]
+    return scales[probed] * sums + bias[probed]
+
+
+def _make_kernel(m: int, entries: int, cap: int, cc: int, depth: int):
+    n_chunks = cap // cc
+
+    def _kernel(
+        probed_ref, lut_ref, codes_ref, scales_ref, bias_ref, out_ref,
+        code_buf, scale_buf, bias_buf, sems,
+    ):
+        cell = probed_ref[0, 0]
+
+        def _copies(slot, c):
+            """The chunk's three DMAs as (src, dst) pairs, rebuilt
+            identically at issue and wait time (the double-buffer
+            pattern, ops/fused_encode_pool.py)."""
+            base = c * cc
+            pairs = (
+                (codes_ref.at[cell, pl.ds(base, cc)], code_buf.at[slot]),
+                (scales_ref.at[cell, pl.ds(base, cc)], scale_buf.at[slot]),
+                (bias_ref.at[cell, pl.ds(base, cc)], bias_buf.at[slot]),
+            )
+
+            def run(op):
+                for src, dst in pairs:
+                    op(pltpu.make_async_copy(src, dst, sems.at[slot]))
+
+            return run
+
+        def issue_chunk(slot, c):
+            _copies(slot, c)(lambda d: d.start())
+
+        def wait_chunk(slot, c):
+            _copies(slot, c)(lambda d: d.wait())
+
+        def compute_chunk(slot, c):
+            codes_c = code_buf[slot].astype(jnp.int32)  # [cc, M]
+            col = jax.lax.broadcasted_iota(jnp.int32, (cc, entries), 1)
+            acc = jnp.zeros((cc,), jnp.float32)
+            # static loop over subspaces; the gather is a one-hot
+            # compare-and-reduce (VPU form — no vector gather on TPU)
+            for sub in range(m):
+                onehot = (codes_c[:, sub][:, None] == col).astype(jnp.float32)
+                acc = acc + jnp.sum(
+                    onehot * lut_ref[0, sub][None, :], axis=1
+                )
+            out_ref[0, 0, pl.ds(c * cc, cc)] = (
+                acc * scale_buf[slot] + bias_buf[slot]
+            )
+
+        zero = jnp.int32(0)
+        if depth <= 1:
+
+            def serial_body(c, x):
+                issue_chunk(0, c)
+                wait_chunk(0, c)
+                compute_chunk(0, c)
+                return x
+
+            jax.lax.fori_loop(0, n_chunks, serial_body, zero)
+        else:
+            issue_chunk(0, 0)
+
+            def pipe_body(c, x):
+                slot = jax.lax.rem(c, depth)
+
+                @pl.when(c + 1 < n_chunks)
+                def _():
+                    issue_chunk(jax.lax.rem(c + 1, depth), c + 1)
+
+                wait_chunk(slot, c)
+                compute_chunk(slot, c)
+                return x
+
+            jax.lax.fori_loop(0, n_chunks, pipe_body, zero)
+
+    return _kernel
+
+
+def pallas_lut_score_cells(
+    lut, probed, codes, scales, bias, *, chunk_c: int = _LANE,
+    dma_depth: int = 2, interpret: bool = True,
+):
+    q, m, entries = lut.shape
+    p = probed.shape[1]
+    n_list, cap, _ = codes.shape
+    cc = int(chunk_c)
+    if cc <= 0 or cc > cap or cap % cc:
+        cc = _LANE if cap % _LANE == 0 else cap
+    depth = max(int(dma_depth), 1)
+
+    grid = (q, p)
+    out = pl.pallas_call(
+        _make_kernel(m, entries, cap, cc, depth),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, m, entries), lambda i, j: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, cap), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((q, p, cap), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((depth, cc, m), codes.dtype),
+            pltpu.VMEM((depth, cc), jnp.float32),
+            pltpu.VMEM((depth, cc), jnp.float32),
+            pltpu.SemaphoreType.DMA((depth,)),
+        ],
+        interpret=interpret,
+    )(probed, lut, codes, scales, bias)
+    return out
+
+
+LUT_CONTRACT = {
+    "lut": spec("Q,M,J", "float"),
+    "probed": spec("Q,P", "int"),
+    "codes": spec("N,C,M", "int"),
+    "scales": spec("N,C", "float"),
+    "bias": spec("N,C", "float"),
+}
+
+
+@shape_contract(**LUT_CONTRACT)
+def _check_contract(lut, probed, codes, scales, bias):
+    return None
+
+
+def lut_score_cells(
+    lut: jnp.ndarray,  # [Q, M, 256] f32 per-query subspace LUT
+    probed: jnp.ndarray,  # [Q, P] int32 probed cell ids
+    codes: jnp.ndarray,  # [n_list, C, M] uint8 cell-major PQ codes
+    scales: jnp.ndarray,  # [n_list, C] f32 per-row scale (0 on pad rows)
+    bias: jnp.ndarray,  # [n_list, C] f32 (0 real, -inf pad)
+    *,
+    impl: str = "xla",
+    chunk_c: int = _LANE,
+    dma_depth: int = 2,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Score every row of every probed cell; returns f32 ``[Q, P, C]``.
+
+    Not jitted here: the searcher's query fn (and the autotuner's timing
+    harness) jit the enclosing computation, and the impl knobs are plain
+    Python — compile-time by construction.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (the repo-wide Pallas convention)."""
+    if impl not in LUT_IMPLS:
+        raise ValueError(f"impl must be one of {LUT_IMPLS}, got {impl!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_contract(lut, probed, codes, scales, bias)
+    if impl == "xla":
+        return xla_lut_score_cells(lut, probed, codes, scales, bias)
+    return pallas_lut_score_cells(
+        lut, probed, codes, scales, bias, chunk_c=int(chunk_c),
+        dma_depth=int(dma_depth), interpret=bool(interpret),
+    )

@@ -1,0 +1,119 @@
+"""Product quantization of the coarse residuals.
+
+Each residual row (vector minus its cell centroid) is split into ``M``
+subspaces of ``dsub = E / M`` dims; each subspace gets a 256-entry codebook
+trained by the same k-means core as the coarse quantizer, and a row stores
+one uint8 codebook id per subspace — ``E * 4`` bytes of f32 become ``M``
+bytes of codes.
+
+Rows are normalized by their **per-row absmax** before encoding
+(``ops/quant.py:row_absmax`` — the same scale primitive the int8 tables
+use), and the scale is stored per row: codebooks learn residual *shape* on
+a unit-magnitude cloud while the scale carries magnitude, so one 256-entry
+codebook is not spent modelling the residual-norm distribution. An
+all-zero residual keeps scale 0 and reconstructs to exact zeros, mirroring
+the int8 table contract.
+
+Asymmetric scoring (``index.py``): for a unit query ``q``,
+``q . x_n  ~=  q . c_cell  +  s_n * sum_m  <q_m, cb[m, code_{n,m}]>`` —
+the per-query ``[M, 256]`` table of ``<q_m, cb[m, j]>`` is the LUT the
+scoring kernel gathers from.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from code2vec_tpu.ann.kmeans import assign_cells, kmeans_fit
+
+__all__ = ["PQ_ENTRIES", "train_codebooks", "encode", "decode"]
+
+PQ_ENTRIES = 256  # one uint8 per subspace
+
+
+def _row_scales(residuals: np.ndarray) -> np.ndarray:
+    """Per-row absmax scale ``[N]`` via the shared ops/quant primitive."""
+    import jax
+
+    from code2vec_tpu.ops.quant import row_absmax
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        return np.asarray(row_absmax(residuals)).reshape(-1)
+
+
+def _unit_rows(residuals: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    safe = np.where(scales > 0, scales, 1.0).astype(np.float32)
+    return (residuals.astype(np.float32) / safe[:, None]).astype(np.float32)
+
+
+def _split(m: int, dim: int) -> int:
+    if m < 1 or dim % m:
+        raise ValueError(f"m must divide dim; got m={m}, dim={dim}")
+    return dim // m
+
+
+def train_codebooks(
+    residuals: np.ndarray,
+    m: int,
+    *,
+    seed: int = 0,
+    iters: int = 15,
+    batch_size: int | None = None,
+    mesh=None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Train per-subspace codebooks on absmax-normalized residuals.
+
+    Returns ``(codebooks f32 [M, 256, dsub], scales f32 [N])``. With fewer
+    than 256 samples the trailing codebook entries duplicate entry 0 —
+    the assignment argmin resolves ties to the first index, so duplicated
+    entries are never emitted as codes."""
+    n, dim = residuals.shape
+    dsub = _split(m, dim)
+    scales = _row_scales(residuals)
+    unit = _unit_rows(residuals, scales)
+    k_eff = min(PQ_ENTRIES, n)
+    codebooks = np.zeros((m, PQ_ENTRIES, dsub), np.float32)
+    for sub in range(m):
+        block = unit[:, sub * dsub : (sub + 1) * dsub]
+        cb = kmeans_fit(
+            block, k_eff, seed=seed + sub, iters=iters,
+            batch_size=batch_size, mesh=mesh,
+        )
+        codebooks[sub, :k_eff] = cb
+        if k_eff < PQ_ENTRIES:
+            codebooks[sub, k_eff:] = cb[0]
+    return codebooks, scales
+
+
+def encode(
+    residuals: np.ndarray,
+    codebooks: np.ndarray,
+    scales: np.ndarray,
+    *,
+    batch_size: int | None = None,
+    mesh=None,
+) -> np.ndarray:
+    """uint8 codes ``[N, M]``: nearest codebook entry per subspace of each
+    absmax-normalized residual row."""
+    m, entries, dsub = codebooks.shape
+    unit = _unit_rows(residuals, scales)
+    codes = np.empty((unit.shape[0], m), np.uint8)
+    for sub in range(m):
+        block = unit[:, sub * dsub : (sub + 1) * dsub]
+        codes[:, sub] = assign_cells(
+            block, codebooks[sub], batch_size=batch_size, mesh=mesh
+        ).astype(np.uint8)
+    return codes
+
+
+def decode(
+    codes: np.ndarray, codebooks: np.ndarray, scales: np.ndarray
+) -> np.ndarray:
+    """Reconstruct approximate residuals ``[N, E]`` (tests / error
+    analysis; the query path never materializes this)."""
+    m, _, dsub = codebooks.shape
+    parts = [
+        codebooks[sub][codes[:, sub].astype(np.int64)] for sub in range(m)
+    ]
+    unit = np.concatenate(parts, axis=1)
+    return unit * scales.astype(np.float32)[:, None]

@@ -83,6 +83,50 @@ class ShapeKey:
         )
 
 
+LUT_IMPLS = ("xla", "pallas")
+
+
+@dataclasses.dataclass(frozen=True)
+class LutSchedule:
+    """One tuned configuration of the ANN LUT-scoring kernel
+    (``ann/lut_kernel.py``) — the second variant axis this cache carries."""
+
+    impl: str = "xla"  # "xla" | "pallas"
+    chunk_c: int = 128  # cell rows DMA'd per chunk (pallas impl only)
+    dma_depth: int = 2  # double-buffer slots (pallas impl only)
+    source: str = "default"  # "default" | "dry" | "autotune" | "cache"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LutSchedule":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass(frozen=True)
+class LutShapeKey:
+    """LUT-kernel schedule key: device plus the knobs that change the
+    scoring economics — subspace count M (LUT height and per-row gather
+    width), cell count and padded cell capacity (the DMA'd slab), and the
+    shortlist (top-k width downstream of the kernel). The ``lut|`` prefix
+    keeps these entries disjoint from the forward-kernel keys in the one
+    shared cache file."""
+
+    device_kind: str
+    m: int
+    n_list: int
+    capacity: int
+    shortlist: int
+
+    def cache_key(self) -> str:
+        return (
+            f"lut|{self.device_kind}|m={self.m}|nl={self.n_list}"
+            f"|cap={self.capacity}|sl={self.shortlist}"
+        )
+
+
 def device_kind() -> str:
     import jax
 
@@ -161,6 +205,16 @@ class ScheduleCache:
             return None
         try:
             sched = KernelSchedule.from_dict(entry["schedule"])
+        except TypeError:
+            return None
+        return dataclasses.replace(sched, source="cache")
+
+    def get_lut(self, key: LutShapeKey) -> LutSchedule | None:
+        entry = self.entries.get(key.cache_key())
+        if not isinstance(entry, dict) or "schedule" not in entry:
+            return None
+        try:
+            sched = LutSchedule.from_dict(entry["schedule"])
         except TypeError:
             return None
         return dataclasses.replace(sched, source="cache")
@@ -260,6 +314,183 @@ def consult_schedules(
                 "cached": found is not None,
             }
         )
+    return out
+
+
+def default_lut_schedule() -> LutSchedule:
+    """The configured fallback on a cache miss: the take-based XLA
+    formulation off-TPU (XLA's gather lowering is the right tool there),
+    the Pallas DMA kernel on TPU."""
+    import jax
+
+    impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return LutSchedule(impl=impl, source="default")
+
+
+def lookup_lut_schedule(
+    m: int,
+    n_list: int,
+    capacity: int,
+    shortlist: int,
+    *,
+    default: LutSchedule | None = None,
+    cache: ScheduleCache | None = None,
+) -> LutSchedule:
+    """Trace-time LUT-kernel schedule lookup (``AnnSearcher``). Same
+    contract as :func:`lookup_schedule`: a hit returns the persisted
+    winner, a miss falls back WITHOUT timing anything; both land on the
+    shared ``autotune_*`` counters."""
+    key = LutShapeKey(
+        device_kind=device_kind(), m=int(m), n_list=int(n_list),
+        capacity=int(capacity), shortlist=int(shortlist),
+    )
+    cache = cache or get_cache()
+    c = _counters()
+    found = cache.get_lut(key)
+    if found is not None:
+        c["hit"].inc()
+        return found
+    c["miss"].inc()
+    return default or default_lut_schedule()
+
+
+def enumerate_lut_variants(capacity: int) -> list[LutSchedule]:
+    """The LUT kernel's search space: the XLA gather formulation plus the
+    Pallas DMA kernel across chunk size x pipeline depth. Chunks that do
+    not divide the padded cell capacity are pruned (the kernel would
+    silently clamp them to one lane)."""
+    cap = max(int(capacity), 1)
+    chunks = sorted({c for c in (128, 256, 512) if c <= cap and cap % c == 0})
+    if not chunks:
+        chunks = [cap]
+    variants = [LutSchedule(impl="xla")]
+    for cc in chunks:
+        for depth in (1, 2):
+            variants.append(
+                LutSchedule(impl="pallas", chunk_c=cc, dma_depth=depth)
+            )
+    return variants
+
+
+def _synth_lut_inputs(key: LutShapeKey, n_probe: int, q: int, seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    lut = jnp.asarray(
+        rng.normal(size=(q, key.m, 256)).astype(np.float32)
+    )
+    probed = jnp.asarray(
+        rng.integers(0, key.n_list, (q, n_probe)).astype(np.int32)
+    )
+    codes = jnp.asarray(
+        rng.integers(0, 256, (key.n_list, key.capacity, key.m)).astype(
+            np.uint8
+        )
+    )
+    scales = jnp.asarray(
+        rng.random((key.n_list, key.capacity)).astype(np.float32)
+    )
+    bias = jnp.zeros((key.n_list, key.capacity), jnp.float32)
+    return lut, probed, codes, scales, bias
+
+
+def time_lut_variant(
+    schedule: LutSchedule, inputs, iters: int = 3, repeats: int = 2
+) -> float:
+    """Best-of wall time (seconds per call) for one LUT variant; compile
+    excluded via an untimed warmup call."""
+    import jax
+
+    from code2vec_tpu.ann.lut_kernel import lut_score_cells
+
+    def fn():
+        return lut_score_cells(
+            *inputs, impl=schedule.impl, chunk_c=schedule.chunk_c,
+            dma_depth=schedule.dma_depth,
+        )
+
+    jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        for _ in range(max(iters, 1)):
+            out = fn()
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / max(iters, 1))
+    return best
+
+
+def _lut_variant_label(s: LutSchedule) -> str:
+    if s.impl == "xla":
+        return "xla"
+    return f"pallas/c{s.chunk_c}/d{s.dma_depth}"
+
+
+def autotune_lut(
+    keys: list[LutShapeKey],
+    *,
+    cache: ScheduleCache | None = None,
+    dry: bool = False,
+    iters: int = 3,
+    repeats: int = 2,
+    n_probe: int = 8,
+    q_batch: int = 8,
+    force: bool = False,
+) -> dict[str, LutSchedule]:
+    """Search (or dry-stamp) a LUT-kernel schedule per missing key and
+    persist — the :func:`autotune` contract on the LUT variant axis."""
+    import jax
+
+    cache = cache or get_cache()
+    c = _counters()
+    interpret = jax.default_backend() != "tpu"
+    out: dict[str, LutSchedule] = {}
+    dirty = False
+    for key in keys:
+        cached = None if force else cache.get_lut(key)
+        if cached is not None:
+            c["hit"].inc()
+            out[key.cache_key()] = cached
+            continue
+        c["miss"].inc()
+        if dry:
+            sched = dataclasses.replace(default_lut_schedule(), source="dry")
+            cache.put(key, sched, timings_ms=None, interpret=interpret)
+            out[key.cache_key()] = sched
+            dirty = True
+            continue
+        inputs = _synth_lut_inputs(key, min(n_probe, key.n_list), q_batch)
+        timings: dict[str, float] = {}
+        best_sched, best_t = None, float("inf")
+        for variant in enumerate_lut_variants(key.capacity):
+            c["timing"].inc()
+            try:
+                t = time_lut_variant(variant, inputs, iters=iters,
+                                     repeats=repeats)
+            except Exception as exc:  # noqa: BLE001 - same contract as the
+                # forward tuner: a variant that fails to lower is skipped
+                timings[_lut_variant_label(variant)] = float("nan")
+                print(
+                    f"autotune: lut variant {_lut_variant_label(variant)} "
+                    f"failed on {key.cache_key()}: "
+                    f"{type(exc).__name__}: {exc}",
+                    file=sys.stderr,
+                )
+                continue
+            timings[_lut_variant_label(variant)] = round(t * 1e3, 4)
+            if t < best_t:
+                best_sched, best_t = variant, t
+        if best_sched is None:
+            raise RuntimeError(
+                f"every LUT variant failed for {key.cache_key()}"
+            )
+        sched = dataclasses.replace(best_sched, source="autotune")
+        cache.put(key, sched, timings_ms=timings, interpret=interpret)
+        out[key.cache_key()] = sched
+        dirty = True
+    if dirty:
+        cache.save()
     return out
 
 
@@ -535,20 +766,41 @@ def main(argv: list[str] | None = None) -> int:
                         help="exit 2 if any shape missed the cache (the "
                              "round-trip assertion: a second identical run "
                              "must do zero search)")
+    parser.add_argument("--lut", action="store_true",
+                        help="tune the ANN LUT-scoring kernel "
+                             "(ann/lut_kernel.py) instead of the forward "
+                             "kernel; keys from the --lut-* knobs")
+    parser.add_argument("--lut-m", type=int, default=8)
+    parser.add_argument("--lut-n-list", type=int, default=64)
+    parser.add_argument("--lut-capacity", type=int, default=256)
+    parser.add_argument("--lut-shortlist", type=int, default=128)
     args = parser.parse_args(argv)
 
     cache = ScheduleCache(args.cache or default_cache_path())
-    keys = keys_for(
-        args.batch,
-        [int(w) for w in args.widths.split(",") if w.strip()],
-        args.terminal_embed, args.path_embed, args.encode,
-        [d.strip() for d in args.table_dtypes.split(",") if d.strip()],
-    )
     before = counters_snapshot()
-    schedules = autotune(
-        keys, cache=cache, dry=args.dry, iters=args.iters, vocab=args.vocab,
-        force=args.force,
-    )
+    if args.lut:
+        lut_keys = [
+            LutShapeKey(
+                device_kind=device_kind(), m=args.lut_m,
+                n_list=args.lut_n_list, capacity=args.lut_capacity,
+                shortlist=args.lut_shortlist,
+            )
+        ]
+        schedules = autotune_lut(
+            lut_keys, cache=cache, dry=args.dry, iters=args.iters,
+            force=args.force,
+        )
+    else:
+        keys = keys_for(
+            args.batch,
+            [int(w) for w in args.widths.split(",") if w.strip()],
+            args.terminal_embed, args.path_embed, args.encode,
+            [d.strip() for d in args.table_dtypes.split(",") if d.strip()],
+        )
+        schedules = autotune(
+            keys, cache=cache, dry=args.dry, iters=args.iters,
+            vocab=args.vocab, force=args.force,
+        )
     after = counters_snapshot()
     delta = {k: after[k] - before[k] for k in after}
     print(

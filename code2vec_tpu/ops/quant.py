@@ -68,6 +68,16 @@ class QuantTable:
         return n
 
 
+def row_absmax(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-row absmax ``[V, 1]`` of a ``[V, E]`` matrix — THE per-row scale
+    primitive. int8 table quantization divides it by 127 for the symmetric
+    grid; the ANN index (``ann/pq.py``) uses it directly as the per-row
+    residual scale so one magnitude convention covers both consumers. An
+    all-zero row yields scale 0 (the callers' exact-zero round-trip
+    contract hangs off that)."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1, keepdims=True)
+
+
 def quantize_table(table: jnp.ndarray, table_dtype: str) -> QuantTable:
     """f32 ``[V, E]`` master table -> quantized storage.
 
@@ -81,8 +91,7 @@ def quantize_table(table: jnp.ndarray, table_dtype: str) -> QuantTable:
             values=table.astype(jnp.bfloat16), scale=None, table_dtype="bf16"
         )
     if table_dtype == "int8":
-        absmax = jnp.max(jnp.abs(table.astype(jnp.float32)), axis=1, keepdims=True)
-        scale = absmax / 127.0
+        scale = row_absmax(table) / 127.0
         # guard the divide only — a zero row quantizes to zeros either way,
         # and its STORED scale stays 0 so dequant returns exact zeros
         q = jnp.round(table.astype(jnp.float32) / jnp.where(scale > 0, scale, 1.0))

@@ -154,6 +154,30 @@ def retrieval_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     }
 
 
+def ann_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Placement for the ANN index's search arrays (ann/index.py).
+
+    The cell-major stores — codes ``[n_list, C, M]``, per-row scales/bias
+    ``[n_list, C]``, row ids ``[n_list, C]`` — are tall-skinny in the
+    *cell* dimension, so cells take the embedding tables' rule: row-shard
+    over ``model``. The coarse centroids, PQ codebooks, per-query LUT, and
+    the query/shortlist blocks are tiny at any corpus scale and replicate.
+    Like ``_spec_for_param``, an indivisible cell count silently
+    replicates — the searcher pads ``n_list`` (with ``-inf`` coarse bias
+    so pad cells are never probed) so the shard actually happens."""
+    model_axis = AXIS_MODEL if mesh.shape[AXIS_MODEL] > 1 else None
+    return {
+        "codes": NamedSharding(mesh, P(model_axis, None, None)),
+        "scales": NamedSharding(mesh, P(model_axis, None)),
+        "bias": NamedSharding(mesh, P(model_axis, None)),
+        "ids": NamedSharding(mesh, P(model_axis, None)),
+        "centroids": NamedSharding(mesh, P()),
+        "cell_bias": NamedSharding(mesh, P()),
+        "codebooks": NamedSharding(mesh, P()),
+        "query": NamedSharding(mesh, P()),
+    }
+
+
 # ---------------------------------------------------------------------------
 # PartitionSpec serialization — the mesh-reshape restore primitive
 #

@@ -63,6 +63,22 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--code_vec_path", default=None,
                         help="exported code.vec for the neighbors op "
                         "(default: <model_path>/code.vec when present)")
+    parser.add_argument("--retrieval_backend", default="exact",
+                        choices=("exact", "ann"),
+                        help="neighbors backend: 'exact' = full O(N) "
+                        "matmul over code.vec (default, bitwise-stable); "
+                        "'ann' = IVF-PQ index with exact re-rank "
+                        "(tools/ann_build.py)")
+    parser.add_argument("--ann_index_path", default=None,
+                        help="ANN index container for --retrieval_backend "
+                        "ann (default: <model_path>/ann.index when "
+                        "present)")
+    parser.add_argument("--ann_n_probe", type=int, default=None,
+                        help="cells probed per ANN query (default: the "
+                        "index container's baked-in value)")
+    parser.add_argument("--ann_shortlist", type=int, default=None,
+                        help="ANN shortlist re-ranked exactly per query "
+                        "(default: the container's baked-in value)")
     parser.add_argument("--accelerator", action="store_true", default=False,
                         help="serve from the default device backend; off = "
                         "pin CPU (same contract as the predict CLI)")
@@ -117,6 +133,29 @@ def build_server(args):
         "compiled %d executables over ladder %s x batch sizes %s",
         len(provenance), list(engine.active_ladder), list(engine.batch_sizes),
     )
+
+    retrieval = None
+    if args.retrieval_backend == "ann":
+        from code2vec_tpu.serve.retrieval import load_retrieval_index
+
+        ann_path = args.ann_index_path
+        if ann_path is None:
+            default = os.path.join(args.model_path, "ann.index")
+            ann_path = default if os.path.exists(default) else None
+        retrieval = load_retrieval_index(
+            "ann",
+            ann_index_path=ann_path,
+            n_probe=args.ann_n_probe,
+            shortlist=args.ann_shortlist,
+        )
+    else:
+        code_vec_path = args.code_vec_path
+        if code_vec_path is None:
+            default = os.path.join(args.model_path, "code.vec")
+            code_vec_path = default if os.path.exists(default) else None
+        if code_vec_path:
+            retrieval = RetrievalIndex.from_code_vec(code_vec_path)
+
     if events is not None:
         events.write_manifest(
             serve={
@@ -130,20 +169,18 @@ def build_server(args):
                 # schedule each compiled shape consulted, and whether the
                 # cache covered it (the --expect-cached-style warmup)
                 "executables": provenance,
+                # retrieval-backend provenance, mirroring the executables:
+                # backend kind, index geometry, and (ann) the LUT-kernel
+                # schedule the searcher consulted
+                "retrieval": (
+                    retrieval.describe() if retrieval is not None else None
+                ),
             }
         )
         # attach the log only AFTER the manifest so it stays the first
         # line; later compiles (histogram-freeze, shape misses) still get
         # their own serve_executable events
         engine._events = events
-
-    retrieval = None
-    code_vec_path = args.code_vec_path
-    if code_vec_path is None:
-        default = os.path.join(args.model_path, "code.vec")
-        code_vec_path = default if os.path.exists(default) else None
-    if code_vec_path:
-        retrieval = RetrievalIndex.from_code_vec(code_vec_path)
 
     batcher = MicroBatcher(
         engine,

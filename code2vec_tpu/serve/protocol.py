@@ -160,6 +160,14 @@ class CodeServer:
             "executables": engine._cache_size(),
             "post_warmup_compiles": engine.post_warmup_compiles,
             "table_dtype": engine.table_dtype,
+            # the retrieval backend mirrors the engine's executable
+            # provenance: exact reports size + compiled query fns; ann
+            # adds n_list/n_probe/shortlist and its LUT-kernel schedule
+            "retrieval": (
+                self.retrieval.describe()
+                if self.retrieval is not None
+                else None
+            ),
             **self.health.snapshot(),
         }
 

@@ -1,16 +1,34 @@
-"""Top-k nearest-method retrieval over the exported code-vector matrix.
+"""Top-k nearest-method retrieval: exact matmul and ANN (IVF-PQ) backends.
 
 ``predict.nearest_from_rows`` is the offline NumPy lookup: one matvec per
-query on the host. The serving endpoint instead keeps the matrix resident
-on the device(s) — L2-normalized once at load, so cosine similarity is a
-plain matmul — and answers each query with one compiled
-``sims = q @ rows.T`` + ``lax.top_k`` call. On a mesh the matrix rows are
-sharded over the ``model`` axis by ``parallel/shardings
-.retrieval_shardings`` (the same tall-skinny rule as the embedding
-tables): the matmul is fully shard-local and the top-k over the sharded
-row axis is the only collective, inserted by GSPMD. Rows are padded to a
-multiple of the axis size so the shard actually happens; pad rows carry a
-``-inf`` similarity bias so they can never surface.
+query on the host. The serving endpoint instead offers two device-resident
+backends behind one interface (``labels``/``n``/``dim``/``top_k``/
+``top_k_batch``/``describe``/``_cache_size``):
+
+- :class:`RetrievalIndex` (``exact``, the default) keeps the matrix
+  resident on the device(s) — L2-normalized once at load, so cosine
+  similarity is a plain matmul — and answers each query with one compiled
+  ``sims = q @ rows.T`` + ``lax.top_k`` call. On a mesh the matrix rows
+  are sharded over the ``model`` axis by ``parallel/shardings
+  .retrieval_shardings`` (the same tall-skinny rule as the embedding
+  tables): the matmul is fully shard-local and the top-k over the sharded
+  row axis is the only collective, inserted by GSPMD. Rows are padded to
+  a multiple of the axis size so the shard actually happens; pad rows
+  carry a ``-inf`` similarity bias so they can never surface.
+
+- :class:`AnnRetrievalIndex` (``ann``) answers from an IVF-PQ index built
+  by ``tools/ann_build.py`` (``code2vec_tpu/ann/``): probe ``n_probe`` of
+  ``n_list`` cells, LUT-score their quantized codes, exact-f32 re-rank a
+  ``shortlist`` against the container's (mmap) unit rows — per-query cost
+  proportional to the probed fraction, not the corpus. The response
+  schema is identical to the exact backend's; the client's ``k`` only
+  enters the host-side re-rank, so the compiled table is keyed by query
+  bucket alone.
+
+Both backends bucket compiled entry points by power-of-two query-batch
+size AND (exact only) power-of-two k, so a client alternating single and
+batched queries (or sweeping top_k) cannot grow the executable table
+unboundedly — the ``_cache_size`` probes keep that assertable.
 
 Parity contract (tests/test_serve.py): identical ranking to a NumPy
 normalize→matmul→argsort reference on both the single-device and meshed
@@ -25,7 +43,11 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["RetrievalIndex"]
+__all__ = ["RetrievalIndex", "AnnRetrievalIndex", "load_retrieval_index"]
+
+# the one power-of-two executable-table keying rule (and the PR-9 k-bucket
+# fix), shared with the ANN searcher so the backends cannot drift
+from code2vec_tpu.ann.index import pow2_bucket as _pow2_bucket  # noqa: E402
 
 
 class RetrievalIndex:
@@ -95,30 +117,35 @@ class RetrievalIndex:
         return cls(labels, rows, mesh=mesh)
 
     # ---- query ----------------------------------------------------------
-    def _bucketed_k(self, k: int) -> int:
-        """Round ``k`` up to a power of two (capped at n): the jitted
-        query fn is compiled per BUCKET, not per client-supplied k, so a
-        client sweeping top_k 1..1000 costs at most log2(n) compiles over
-        the index's whole lifetime instead of one compile per distinct k
-        on the request path — results are sliced back to the exact k."""
-        bucket = 1
-        while bucket < k:
-            bucket *= 2
-        return min(bucket, self.n)
-
     def _cache_size(self) -> int:
         """Compiled query-fn count — lets the obs RecompileDetector track
         the index like the engine's executable table."""
         return len(self._fns)
 
-    def _fn(self, k: int):
-        fn = self._fns.get(k)
+    def describe(self) -> dict:
+        """The health op's retrieval block (serve/protocol.py)."""
+        return {
+            "backend": "exact",
+            "size": self.n,
+            "dim": self.dim,
+            "query_executables": self._cache_size(),
+        }
+
+    def _fn(self, k: int, qb: int):
+        """The jitted query fn for one (k bucket, query-batch bucket)
+        pair. Both axes round up to powers of two — k capped at n, the
+        batch uncapped — so a client alternating single and batched
+        neighbor queries AND sweeping top_k costs at most
+        log2(n) * log2(max Q) compiles over the index's lifetime, never
+        one per distinct request shape (the `_cache_size` regression test
+        pins this)."""
+        fn = self._fns.get((k, qb))
         if fn is None:
             import jax
 
             rows, bias = self._rows, self._bias
 
-            def query(q):  # q: [Q, E] unit-normalized
+            def query(q):  # q: [qb, E] unit-normalized
                 sims = q @ rows.T + bias[None, :]
                 return jax.lax.top_k(sims, k)
 
@@ -130,9 +157,7 @@ class RetrievalIndex:
                 )
             else:
                 fn = jax.jit(query)
-            # jit caches per (k bucket, Q): serving queries are Q=1 per
-            # request, so compiles are bounded by log2(n) buckets
-            self._fns[k] = fn
+            self._fns[(k, qb)] = fn
         return fn
 
     def top_k_batch(
@@ -145,17 +170,179 @@ class RetrievalIndex:
         q = np.asarray(vectors, np.float32).reshape(-1, self.dim)
         qn = np.linalg.norm(q, axis=1, keepdims=True)
         q = q / np.maximum(qn, 1e-12)
-        values, indices = self._fn(self._bucketed_k(k))(q)
-        values = np.asarray(values)[:, :k]
-        indices = np.asarray(indices)[:, :k]
+        n_q = q.shape[0]
+        qb = _pow2_bucket(max(n_q, 1), 1 << 30)
+        if n_q < qb:  # pad to the batch bucket; padded rows sliced away
+            q = np.concatenate([q, np.zeros((qb - n_q, self.dim), np.float32)])
+        values, indices = self._fn(_pow2_bucket(k, self.n), qb)(q)
+        values = np.asarray(values)[:n_q, :k]
+        indices = np.asarray(indices)[:n_q, :k]
         return [
             [
                 (self.labels[int(i)], float(v))
                 for i, v in zip(indices[row], values[row])
             ]
-            for row in range(q.shape[0])
+            for row in range(n_q)
         ]
 
     def top_k(self, vector: np.ndarray, k: int = 5) -> list[tuple[str, float]]:
         """Single-query convenience wrapper."""
         return self.top_k_batch(np.asarray(vector)[None, :], k)[0]
+
+
+class AnnRetrievalIndex:
+    """The ``ann`` backend: IVF-PQ shortlist + exact f32 re-rank.
+
+    Drop-in for :class:`RetrievalIndex` behind the ``neighbors`` op — the
+    response schema (ranked ``(label, cosine)`` pairs) is unchanged; only
+    the candidate set is approximate, and every returned similarity is the
+    EXACT cosine (re-ranked against the container's unit rows, which stay
+    an mmap view until the shortlist touches them)."""
+
+    def __init__(
+        self,
+        labels: list[str],
+        unit_rows: np.ndarray,
+        index,
+        *,
+        n_probe: int = 8,
+        shortlist: int = 128,
+        mesh=None,
+        schedule=None,
+        source: str | None = None,
+    ) -> None:
+        from code2vec_tpu.ann.index import AnnSearcher
+
+        if unit_rows.ndim != 2 or len(labels) != unit_rows.shape[0]:
+            raise ValueError(
+                f"rows must be [len(labels), E]; got {unit_rows.shape} "
+                f"for {len(labels)} labels"
+            )
+        self.labels = list(labels)
+        self.n = len(labels)
+        self.dim = int(unit_rows.shape[1])
+        self._rows = unit_rows  # unit-normalized; may be an mmap view
+        self._source = source
+        self.searcher = AnnSearcher(
+            index, n_probe=n_probe, shortlist=shortlist, mesh=mesh,
+            schedule=schedule,
+        )
+
+    @classmethod
+    def from_container(
+        cls,
+        path: str,
+        *,
+        n_probe: int | None = None,
+        shortlist: int | None = None,
+        mesh=None,
+    ) -> "AnnRetrievalIndex":
+        """Load a ``tools/ann_build.py`` container; ``n_probe``/
+        ``shortlist`` default to the values baked into its header."""
+        from code2vec_tpu.ann.index import load_index
+
+        index, rows, labels = load_index(path)
+        defaults = index.meta.get("defaults", {})
+        resolved_probe = int(
+            n_probe if n_probe is not None else defaults.get("n_probe", 8)
+        )
+        resolved_short = int(
+            shortlist
+            if shortlist is not None
+            else defaults.get("shortlist", 128)
+        )
+        logger.info(
+            "ann retrieval index: %d vectors of dim %d from %s "
+            "(n_list=%d m=%d n_probe=%d shortlist=%d)",
+            index.meta["n"], index.meta["dim"], path, index.meta["n_list"],
+            index.meta["m"], resolved_probe, resolved_short,
+        )
+        return cls(
+            labels, rows, index, n_probe=resolved_probe,
+            shortlist=resolved_short, mesh=mesh, source=path,
+        )
+
+    def _cache_size(self) -> int:
+        return self.searcher._cache_size()
+
+    def describe(self) -> dict:
+        out = {
+            "backend": "ann",
+            "size": self.n,
+            "dim": self.dim,
+            **self.searcher.describe(),
+        }
+        if self._source:
+            out["index_path"] = self._source
+        return out
+
+    def top_k_batch(
+        self, vectors: np.ndarray, k: int = 5
+    ) -> list[list[tuple[str, float]]]:
+        """ANN cosine top-k per query row: shortlist on device, exact
+        re-rank on the host (O(shortlist * E) — the client's ``k`` never
+        reaches the compiled path).
+
+        ``k`` beyond the shortlist is rejected loudly (the exact backend
+        would return ``k`` entries; silently truncating to the candidate
+        pool would break the identical-schema contract) — raise the
+        server's ``--ann_shortlist`` instead."""
+        k = min(int(k), self.n)
+        if k < 1:
+            return [[] for _ in range(len(vectors))]
+        if k > self.searcher.shortlist:
+            raise ValueError(
+                f"top_k={k} exceeds the ANN shortlist "
+                f"({self.searcher.shortlist}) — the re-rank pool cannot "
+                "fill the response; raise --ann_shortlist (or lower "
+                "top_k)"
+            )
+        q = np.asarray(vectors, np.float32).reshape(-1, self.dim)
+        qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        _, id_rows = self.searcher.search(qn)
+        out: list[list[tuple[str, float]]] = []
+        for row in range(qn.shape[0]):
+            ids = id_rows[row]
+            ids = ids[ids >= 0]
+            sims = self._rows[ids].astype(np.float32) @ qn[row]
+            order = np.argsort(-sims, kind="stable")[:k]
+            out.append(
+                [(self.labels[int(ids[i])], float(sims[i])) for i in order]
+            )
+        return out
+
+    def top_k(self, vector: np.ndarray, k: int = 5) -> list[tuple[str, float]]:
+        return self.top_k_batch(np.asarray(vector)[None, :], k)[0]
+
+    def probed_fraction(self, vectors: np.ndarray) -> float:
+        return self.searcher.probed_fraction(vectors)
+
+
+def load_retrieval_index(
+    backend: str,
+    *,
+    code_vec_path: str | None = None,
+    ann_index_path: str | None = None,
+    n_probe: int | None = None,
+    shortlist: int | None = None,
+    mesh=None,
+):
+    """Backend dispatch for the serve CLI (``--retrieval_backend``)."""
+    if backend == "exact":
+        if not code_vec_path:
+            raise ValueError(
+                "retrieval_backend 'exact' needs --code_vec_path"
+            )
+        return RetrievalIndex.from_code_vec(code_vec_path, mesh=mesh)
+    if backend == "ann":
+        if not ann_index_path:
+            raise ValueError(
+                "retrieval_backend 'ann' needs --ann_index_path (build one "
+                "with tools/ann_build.py)"
+            )
+        return AnnRetrievalIndex.from_container(
+            ann_index_path, n_probe=n_probe, shortlist=shortlist, mesh=mesh
+        )
+    raise ValueError(
+        f"retrieval_backend must be 'exact' or 'ann', got {backend!r}"
+    )

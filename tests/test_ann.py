@@ -1,0 +1,520 @@
+"""ANN retrieval (code2vec_tpu.ann): IVF-PQ index, LUT kernel, container.
+
+The load-bearing contracts pinned here:
+
+- k-means is seeded-DETERMINISTIC (same seed => bitwise-identical
+  centroids) and topology-independent (single-device vs 8-device mesh
+  assignment step => bitwise-identical fit — every float accumulation
+  folds on the host in fixed order, kmeans.py);
+- PQ round-trips within bounds, and all-zero residual rows round-trip
+  EXACTLY (the shared ops/quant per-row-absmax scale contract);
+- the Pallas LUT-scoring kernel matches the XLA take-based reference
+  bitwise-compatibly (allclose incl. the -inf pad positions), across
+  chunk sizes and DMA depths;
+- the on-disk container round-trips every array bitwise plus labels and
+  serving defaults;
+- recall@10 >= 0.95 at a pinned n_probe on a synthetic clustered corpus,
+  with a bounded executable table on the query path (the PR-9 compile
+  discipline, asserted through the `_cache_size` probe);
+- the serving `neighbors` op answers identically-SHAPED responses from
+  the ann backend, and `health` reports the backend provenance.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from code2vec_tpu.ann import pq
+from code2vec_tpu.ann.index import (
+    AnnSearcher,
+    build_index,
+    load_index,
+    normalize_rows,
+    save_index,
+)
+from code2vec_tpu.ann.kmeans import assign_cells, kmeans_fit
+from code2vec_tpu.ann.lut_kernel import lut_score_cells
+
+pytestmark = pytest.mark.ann
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def clustered_rows(n=3000, dim=16, k0=48, noise=0.15, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k0, dim)).astype(np.float32)
+    member = rng.integers(0, k0, n)
+    return (
+        centers[member] + noise * rng.normal(size=(n, dim))
+    ).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# k-means: determinism + mesh parity
+# ---------------------------------------------------------------------------
+
+
+def test_kmeans_same_seed_bitwise_identical():
+    x = clustered_rows(n=1500, dim=8, k0=16)
+    a = kmeans_fit(x, 16, seed=7, iters=10, batch_size=512)
+    b = kmeans_fit(x, 16, seed=7, iters=10, batch_size=512)
+    assert np.array_equal(a, b)
+    # a different seed must actually change the fit (the rng is live)
+    c = kmeans_fit(x, 16, seed=8, iters=10, batch_size=512)
+    assert not np.array_equal(a, c)
+
+
+def test_kmeans_mesh_parity_bitwise():
+    """Single-device vs 8-device data-sharded fit: the assignment step is
+    row-local and the centroid fold is host-side fixed-order float64, so
+    the mesh changes NOTHING — bitwise, not approximately."""
+    from code2vec_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual CPU platform")
+    x = clustered_rows(n=1600, dim=8, k0=12)
+    # batch_size 300 is NOT divisible by the 8-way data axis: the mesh may
+    # round the COMPILED batch shape up (padding inside the assigner), but
+    # the rng must still draw exactly 300 rows per iteration either way
+    single = kmeans_fit(x, 12, seed=3, iters=8, batch_size=300)
+    mesh = make_mesh(data=8, model=1, ctx=1)
+    meshed = kmeans_fit(x, 12, seed=3, iters=8, batch_size=300, mesh=mesh)
+    assert np.array_equal(single, meshed)
+    assert np.array_equal(
+        assign_cells(x, single), assign_cells(x, single, mesh=mesh)
+    )
+
+
+# ---------------------------------------------------------------------------
+# PQ round trip
+# ---------------------------------------------------------------------------
+
+
+def test_pq_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    residuals = rng.normal(size=(2000, 8)).astype(np.float32) * 0.2
+    codebooks, scales = pq.train_codebooks(residuals, 4, seed=0, iters=8)
+    codes = pq.encode(residuals, codebooks, scales)
+    decoded = pq.decode(codes, codebooks, scales)
+    assert codes.dtype == np.uint8 and codes.shape == (2000, 4)
+    norms = np.linalg.norm(residuals, axis=1)
+    errs = np.linalg.norm(decoded - residuals, axis=1)
+    # 256-entry codebooks over 2-dim subspaces: reconstruction must beat
+    # the trivial zero quantizer by a wide margin
+    assert float((errs / np.maximum(norms, 1e-12)).mean()) < 0.3
+    # absmax scale bound: no decoded coordinate exceeds the row's scale
+    assert np.all(np.abs(decoded) <= scales[:, None] + 1e-6)
+
+
+def test_pq_zero_rows_roundtrip_exact():
+    rng = np.random.default_rng(1)
+    residuals = rng.normal(size=(300, 8)).astype(np.float32)
+    residuals[::7] = 0.0  # scale-0 rows interleaved with real ones
+    codebooks, scales = pq.train_codebooks(residuals, 4, seed=0, iters=5)
+    codes = pq.encode(residuals, codebooks, scales)
+    decoded = pq.decode(codes, codebooks, scales)
+    assert np.all(scales[::7] == 0.0)
+    assert np.all(decoded[::7] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# LUT kernel: Pallas vs XLA parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk_c,dma_depth", [(128, 1), (128, 2), (256, 2)])
+def test_lut_kernel_parity(chunk_c, dma_depth):
+    rng = np.random.default_rng(0)
+    q, m, n_list, cap, n_probe = 3, 4, 10, 256, 5
+    lut = rng.normal(size=(q, m, 256)).astype(np.float32)
+    probed = rng.integers(0, n_list, (q, n_probe)).astype(np.int32)
+    codes = rng.integers(0, 256, (n_list, cap, m)).astype(np.uint8)
+    scales = rng.random((n_list, cap)).astype(np.float32)
+    bias = np.zeros((n_list, cap), np.float32)
+    bias[:, 200:] = -np.inf  # pad slots
+    ref = np.asarray(
+        lut_score_cells(lut, probed, codes, scales, bias, impl="xla")
+    )
+    got = np.asarray(
+        lut_score_cells(
+            lut, probed, codes, scales, bias, impl="pallas",
+            chunk_c=chunk_c, dma_depth=dma_depth, interpret=True,
+        )
+    )
+    assert np.array_equal(np.isneginf(ref), np.isneginf(got))
+    finite = np.isfinite(ref)
+    assert np.allclose(ref[finite], got[finite], atol=1e-5)
+
+
+def test_lut_kernel_rejects_unknown_impl():
+    z = np.zeros((1, 2, 256), np.float32)
+    with pytest.raises(ValueError, match="impl"):
+        lut_score_cells(
+            z, np.zeros((1, 1), np.int32), np.zeros((1, 128, 2), np.uint8),
+            np.zeros((1, 128), np.float32), np.zeros((1, 128), np.float32),
+            impl="cuda",
+        )
+
+
+# ---------------------------------------------------------------------------
+# index: build / container round trip / recall
+# ---------------------------------------------------------------------------
+
+
+def test_container_save_load_roundtrip(tmp_path):
+    rows = clustered_rows(n=600, dim=16, k0=12)
+    index, unit = build_index(
+        rows, n_list=8, m=4, seed=0, kmeans_iters=5, pq_iters=4
+    )
+    labels = [f"m{i}" for i in range(600)]
+    path = tmp_path / "ann.index"
+    save_index(
+        str(path), index, unit, labels,
+        defaults={"n_probe": 4, "shortlist": 64},
+    )
+    loaded, rows2, labels2 = load_index(str(path))
+    for field in ("centroids", "codebooks", "codes", "scales", "ids",
+                  "cell_counts"):
+        assert np.array_equal(
+            getattr(index, field), np.asarray(getattr(loaded, field))
+        ), field
+    assert np.array_equal(unit, np.asarray(rows2))
+    assert labels2 == labels
+    assert loaded.meta["defaults"] == {"n_probe": 4, "shortlist": 64}
+    for key in ("n", "dim", "n_list", "m", "capacity"):
+        assert loaded.meta[key] == index.meta[key]
+
+
+def test_container_rejects_foreign_file(tmp_path):
+    from code2vec_tpu.formats.ann_io import is_ann_index, read_ann_container
+
+    path = tmp_path / "not_an_index"
+    path.write_bytes(b"hello world, definitely not an index")
+    assert not is_ann_index(str(path))
+    with pytest.raises(ValueError, match="not an ANN index"):
+        read_ann_container(str(path))
+
+
+def test_every_row_lands_in_exactly_one_cell():
+    rows = clustered_rows(n=500, dim=8, k0=10)
+    index, _ = build_index(
+        rows, n_list=6, m=4, seed=0, kmeans_iters=5, pq_iters=4
+    )
+    real = index.ids[index.ids >= 0]
+    assert sorted(real.tolist()) == list(range(500))
+    assert int(index.cell_counts.sum()) == 500
+
+
+def test_recall_at_pinned_n_probe():
+    """The acceptance contract in miniature: clustered corpus, pinned
+    n_probe, recall@10 >= 0.95 vs the exact ranking."""
+    rows = clustered_rows(n=4000, dim=16, k0=64)
+    index, unit = build_index(
+        rows, n_list=32, m=4, seed=0, kmeans_iters=10, pq_iters=8
+    )
+    searcher = AnnSearcher(index, n_probe=8, shortlist=100)
+    rng = np.random.default_rng(5)
+    queries = rows[rng.integers(0, 4000, 25)] + 0.05 * rng.normal(
+        size=(25, 16)
+    ).astype(np.float32)
+    qn = normalize_rows(queries)
+    truth = np.argsort(-(qn @ unit.T), axis=1)[:, :10]
+    _, ids = searcher.search(queries)
+    recall = 0.0
+    for i in range(25):
+        valid = ids[i][ids[i] >= 0]
+        sims = unit[valid] @ qn[i]
+        top10 = valid[np.argsort(-sims)][:10]
+        recall += len(set(top10.tolist()) & set(truth[i].tolist())) / 10
+    assert recall / 25 >= 0.95
+
+
+def test_searcher_executable_table_bounded():
+    """Query batches bucket to powers of two; repeated shapes never
+    compile again (the RecompileDetector-visible contract)."""
+    from code2vec_tpu.obs.runtime import RecompileDetector, RuntimeHealth
+
+    rows = clustered_rows(n=800, dim=8, k0=10)
+    index, _ = build_index(
+        rows, n_list=8, m=4, seed=0, kmeans_iters=5, pq_iters=4
+    )
+    searcher = AnnSearcher(index, n_probe=4, shortlist=32)
+    rng = np.random.default_rng(0)
+    for q in (1, 3, 5, 2, 8, 1, 7):
+        searcher.search(rng.normal(size=(q, 8)).astype(np.float32))
+    assert searcher._cache_size() <= 4  # buckets {1, 2, 4, 8}
+    det = RecompileDetector(health=RuntimeHealth())
+    det.track("ann_search", searcher)
+    det.check()
+    for q in (1, 3, 5, 2, 8, 1, 7):
+        searcher.search(rng.normal(size=(q, 8)).astype(np.float32))
+    assert det.check() == 0
+
+
+def test_probed_fraction_ignores_empty_cells():
+    """The accounting must rank cells exactly like the compiled query
+    path: an empty cell's centroid (its k-means++ seed — a real data
+    point) can top the raw similarity, but the query path never probes it
+    (cell_bias = -inf), so probed_fraction must skip it too."""
+    from code2vec_tpu.ann.index import IvfPqIndex
+
+    dim, cap = 8, 128
+    centroids = np.zeros((2, dim), np.float32)
+    centroids[0, 0] = 1.0  # empty cell, dead-on the query direction
+    centroids[1, 1] = 1.0
+    codes = np.zeros((2, cap, 2), np.uint8)
+    scales = np.zeros((2, cap), np.float32)
+    ids = np.full((2, cap), -1, np.int32)
+    ids[1, :3] = np.arange(3)
+    scales[1, :3] = 1.0
+    index = IvfPqIndex(
+        centroids=centroids,
+        codebooks=np.zeros((2, 256, 4), np.float32),
+        codes=codes, scales=scales, ids=ids,
+        cell_counts=np.array([0, 3], np.int32),
+        meta={"version": 1, "n": 3, "dim": dim, "n_list": 2, "m": 2,
+              "dsub": 4, "capacity": cap, "seed": 0},
+    )
+    searcher = AnnSearcher(index, n_probe=1, shortlist=3)
+    q = np.zeros((1, dim), np.float32)
+    q[0, 0] = 1.0
+    # probes cell 1 (all 3 real rows), never the empty cell 0
+    assert searcher.probed_fraction(q) == 1.0
+    _, got_ids = searcher.search(q)
+    assert sorted(got_ids[0].tolist()) == [0, 1, 2]
+
+
+def test_ann_topk_beyond_shortlist_rejected(tmp_path):
+    """k beyond the shortlist cannot be served honestly (the exact
+    backend would return k entries) — loud bad_request, not silent
+    truncation."""
+    _, ann = _build_retrieval(tmp_path)
+    with pytest.raises(ValueError, match="shortlist"):
+        ann.top_k(np.ones(16, np.float32), 100)  # shortlist is 64
+    resp = _ann_server(ann).handle(
+        {"op": "neighbors", "vector": [1.0] * 16, "top_k": 100}
+    )
+    assert resp["error_kind"] == "bad_request"
+    assert "shortlist" in resp["error"]
+
+
+def test_searcher_mesh_parity():
+    """model=4-sharded cell arrays return the same shortlist as a single
+    device (n_list chosen indivisible to exercise the cell padding)."""
+    from code2vec_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 4:
+        pytest.skip("needs >= 4 devices (conftest forces 8 on CPU)")
+    rows = clustered_rows(n=1200, dim=16, k0=24)
+    index, _ = build_index(
+        rows, n_list=10, m=4, seed=0, kmeans_iters=6, pq_iters=4
+    )
+    single = AnnSearcher(index, n_probe=4, shortlist=48)
+    mesh = make_mesh(data=1, model=4, ctx=1, devices=jax.devices()[:4])
+    meshed = AnnSearcher(index, n_probe=4, shortlist=48, mesh=mesh)
+    q = np.random.default_rng(1).normal(size=(5, 16)).astype(np.float32)
+    s1, i1 = single.search(q)
+    s2, i2 = meshed.search(q)
+    assert np.array_equal(i1, i2)
+    assert np.allclose(s1, s2, atol=1e-5, equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# autotune: the LUT variant axis
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_lut_cache_roundtrip(tmp_path):
+    from code2vec_tpu.ops.autotune import (
+        LutShapeKey,
+        ScheduleCache,
+        autotune_lut,
+        counters_snapshot,
+        device_kind,
+        lookup_lut_schedule,
+    )
+
+    cache_path = str(tmp_path / "schedules.json")
+    cache = ScheduleCache(cache_path)
+    key = LutShapeKey(
+        device_kind=device_kind(), m=4, n_list=8, capacity=128, shortlist=32
+    )
+    before = counters_snapshot()
+    autotune_lut([key], cache=cache, dry=True)
+    # a second cache object (fresh load) must serve the stored schedule
+    reloaded = ScheduleCache(cache_path)
+    found = lookup_lut_schedule(4, 8, 128, 32, cache=reloaded)
+    assert found.source == "cache"
+    after = counters_snapshot()
+    delta = {k: after[k] - before[k] for k in after}
+    assert delta["autotune_cache_miss"] == 1  # the dry stamp
+    assert delta["autotune_cache_hit"] == 1  # the lookup
+    assert delta["autotune_timing_run"] == 0  # dry: zero search
+    # forward-kernel entries and LUT entries share the file disjointly
+    assert all(k.startswith("lut|") for k in reloaded.entries)
+
+
+def test_autotune_lut_timed_search_picks_a_variant(tmp_path):
+    from code2vec_tpu.ops.autotune import (
+        LutShapeKey,
+        ScheduleCache,
+        autotune_lut,
+        device_kind,
+    )
+
+    cache = ScheduleCache(str(tmp_path / "schedules.json"))
+    key = LutShapeKey(
+        device_kind=device_kind(), m=2, n_list=4, capacity=128, shortlist=16
+    )
+    out = autotune_lut([key], cache=cache, dry=False, iters=1, repeats=1,
+                       n_probe=2, q_batch=2)
+    sched = out[key.cache_key()]
+    assert sched.source == "autotune"
+    assert sched.impl in ("xla", "pallas")
+    entry = cache.entries[key.cache_key()]
+    assert entry["timings_ms"]  # per-variant provenance persisted
+
+
+# ---------------------------------------------------------------------------
+# serving: the ann backend behind the neighbors op
+# ---------------------------------------------------------------------------
+
+
+class _StubBatcher:
+    def close(self):
+        pass
+
+
+def _ann_server(retrieval):
+    from code2vec_tpu.serve.protocol import CodeServer
+
+    return CodeServer(
+        predictor=None, engine=None, batcher=_StubBatcher(),
+        retrieval=retrieval,
+    )
+
+
+def _build_retrieval(tmp_path, n=600, dim=16):
+    from code2vec_tpu.serve.retrieval import AnnRetrievalIndex
+
+    rows = clustered_rows(n=n, dim=dim, k0=12)
+    index, unit = build_index(
+        rows, n_list=8, m=4, seed=0, kmeans_iters=5, pq_iters=4
+    )
+    labels = [f"m{i}" for i in range(n)]
+    path = str(tmp_path / "ann.index")
+    save_index(path, index, unit, labels,
+               defaults={"n_probe": 6, "shortlist": 64})
+    return rows, AnnRetrievalIndex.from_container(path)
+
+
+def test_ann_neighbors_schema_matches_exact(tmp_path):
+    """Same request, both backends: identical response SHAPE, and on an
+    easy query (a corpus point) identical top-1 with exact similarity."""
+    from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+    rows, ann = _build_retrieval(tmp_path)
+    exact = RetrievalIndex(ann.labels, rows)
+    server_exact = _ann_server(exact)
+    server_ann = _ann_server(ann)
+    req = {"op": "neighbors", "vector": rows[17].tolist(), "top_k": 5}
+    a = server_exact.handle(req)
+    b = server_ann.handle(req)
+    assert a["ok"] and b["ok"]
+    assert [sorted(n) for n in a["neighbors"]] == [
+        sorted(n) for n in b["neighbors"]
+    ]
+    assert b["neighbors"][0]["name"] == "m17"
+    assert b["neighbors"][0]["similarity"] == pytest.approx(1.0, abs=1e-5)
+    # re-ranked similarities are EXACT cosines, not ADC approximations
+    assert a["neighbors"][0]["similarity"] == pytest.approx(
+        b["neighbors"][0]["similarity"], abs=1e-5
+    )
+
+
+def test_ann_backend_describe_and_health_fields(tmp_path):
+    _, ann = _build_retrieval(tmp_path)
+    desc = ann.describe()
+    assert desc["backend"] == "ann"
+    assert desc["size"] == 600
+    assert desc["n_probe"] == 6  # the container's baked-in default
+    assert desc["shortlist"] == 64
+    assert desc["n_list"] == 8
+    assert desc["schedule"]["impl"] in ("xla", "pallas")
+    assert "index_path" in desc
+
+
+def test_load_retrieval_index_dispatch(tmp_path):
+    from code2vec_tpu.serve.retrieval import load_retrieval_index
+
+    with pytest.raises(ValueError, match="ann_index_path"):
+        load_retrieval_index("ann")
+    with pytest.raises(ValueError, match="code_vec_path"):
+        load_retrieval_index("exact")
+    with pytest.raises(ValueError, match="retrieval_backend"):
+        load_retrieval_index("fuzzy")
+    _, ann = _build_retrieval(tmp_path)
+    loaded = load_retrieval_index(
+        "ann", ann_index_path=str(tmp_path / "ann.index"), n_probe=3
+    )
+    assert loaded.searcher.n_probe == 3  # CLI override beats the default
+
+
+def test_ann_build_cli_and_stdio_neighbors(tmp_path):
+    """The CI smoke satellite end to end: export a tiny code.vec, build an
+    index with the REAL tools/ann_build.py subprocess, then serve one
+    neighbors query through the stdio transport."""
+    from code2vec_tpu.formats.vectors_io import (
+        append_code_vectors,
+        write_code_vectors_header,
+    )
+    from code2vec_tpu.serve.protocol import serve_stdio
+    from code2vec_tpu.serve.retrieval import AnnRetrievalIndex
+
+    rng = np.random.default_rng(0)
+    n, dim = 400, 16
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    names = [f"meth{i}" for i in range(n)]
+    code_vec = tmp_path / "code.vec"
+    write_code_vectors_header(str(code_vec), n, dim)
+    append_code_vectors(str(code_vec), names, vecs)
+
+    out_path = tmp_path / "ann.index"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "ann_build.py"),
+            "--code_vec", str(code_vec), "--out", str(out_path),
+            "--n_list", "8", "--m", "4", "--kmeans_iters", "4",
+            "--pq_iters", "3",
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["n"] == n and summary["n_list"] == 8
+
+    ann = AnnRetrievalIndex.from_container(str(out_path))
+    server = _ann_server(ann)
+    requests = [
+        json.dumps(
+            {"id": 1, "op": "neighbors", "vector": vecs[3].tolist(),
+             "top_k": 3}
+        ),
+        json.dumps({"id": 2, "op": "shutdown"}),
+    ]
+    out_stream = io.StringIO()
+    serve_stdio(server, iter(requests), out_stream)
+    lines = [json.loads(l) for l in out_stream.getvalue().splitlines()]
+    assert lines[0]["id"] == 1
+    assert lines[0]["neighbors"][0]["name"] == "meth3"
+    assert lines[1]["shutting_down"] is True

@@ -413,6 +413,47 @@ def test_topk_compiles_bounded_by_k_buckets():
     assert index._cache_size() <= 6
 
 
+def test_topk_compiles_bounded_by_query_batch_buckets():
+    """Regression (ISSUE 11 satellite): the executable table also buckets
+    by QUERY-BATCH size. A client alternating single and batched neighbor
+    queries used to pay one hidden jit retrace per distinct Q while
+    `_cache_size` (keyed by k alone) reported no growth — now Q pads to a
+    power-of-two bucket, each table entry compiles exactly once, and the
+    probe is exact."""
+    from code2vec_tpu.obs.runtime import RecompileDetector, RuntimeHealth
+
+    rng = np.random.default_rng(6)
+    labels = [f"m{i}" for i in range(40)]
+    rows = rng.normal(size=(40, 8)).astype(np.float32)
+    index = RetrievalIndex(labels, rows)
+    for n_q in (1, 3, 1, 5, 2, 9, 4, 1, 7):
+        results = index.top_k_batch(
+            rng.normal(size=(n_q, 8)).astype(np.float32), 5
+        )
+        assert len(results) == n_q  # padded rows never surface
+    # Q 1..9 spans buckets {1, 2, 4, 8, 16} at one k bucket: five entries
+    assert index._cache_size() <= 5
+    # and repeats of the same shapes are zero-recompile (detector-visible)
+    det = RecompileDetector(health=RuntimeHealth())
+    det.track("retrieval_query_fns", index)
+    det.check()
+    for n_q in (1, 3, 5, 9, 2):
+        index.top_k_batch(rng.normal(size=(n_q, 8)).astype(np.float32), 5)
+    assert det.check() == 0
+    # batched results rank identically to one-at-a-time queries (sims
+    # approx: the padded matmul may tile its reduction differently)
+    batch = rng.normal(size=(3, 8)).astype(np.float32)
+    batched = index.top_k_batch(batch, 4)
+    singles = [index.top_k(batch[i], 4) for i in range(3)]
+    assert [[n for n, _ in row] for row in batched] == [
+        [n for n, _ in row] for row in singles
+    ]
+    for b_row, s_row in zip(batched, singles):
+        assert np.allclose(
+            [s for _, s in b_row], [s for _, s in s_row], atol=1e-5
+        )
+
+
 # ---------------------------------------------------------------------------
 # obs: latency histogram
 # ---------------------------------------------------------------------------
@@ -587,6 +628,10 @@ def test_server_predict_and_health(served):
     assert health["executables"] == len(served.engine.active_ladder) * len(
         served.engine.batch_sizes
     )
+    # the retrieval block mirrors the engine's executable provenance
+    assert health["retrieval"]["backend"] == "exact"
+    assert health["retrieval"]["size"] == served.retrieval.n
+    assert health["retrieval"]["query_executables"] >= 0
 
 
 def test_server_neighbors_from_source(served):

@@ -1,0 +1,124 @@
+"""Train and save an IVF-PQ ANN index from an exported ``code.vec``.
+
+The offline half of the ``--retrieval_backend ann`` serving path
+(serve/retrieval.py): read the exported code vectors, train the coarse
+k-means quantizer + per-subspace PQ codebooks (seeded-deterministic —
+same seed, same container bytes), lay the codes out cell-major, and write
+the versioned mmap-loadable container (formats/ann_io.py) with the
+serving defaults (``n_probe``/``shortlist``) baked into its header::
+
+    python tools/ann_build.py --code_vec out/code.vec --out out/ann.index \\
+        --n_list 256 --m 8 --n_probe 8 --shortlist 128
+
+Prints one JSON summary line (geometry, pad efficiency of the cell-major
+layout, build seconds, container bytes). ``--n_list 0`` (default) picks
+~sqrt(N) rounded to a multiple of 8.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: the package
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="IVF-PQ ANN index builder (see module docstring)"
+    )
+    parser.add_argument("--code_vec", required=True,
+                        help="exported code.vec (word2vec text format)")
+    parser.add_argument("--out", required=True,
+                        help="output container path (e.g. out/ann.index)")
+    parser.add_argument("--n_list", type=int, default=0,
+                        help="coarse cells; 0 = ~sqrt(N) rounded to 8")
+    parser.add_argument("--m", type=int, default=8,
+                        help="PQ subspaces (must divide the vector dim)")
+    parser.add_argument("--kmeans_iters", type=int, default=25)
+    parser.add_argument("--pq_iters", type=int, default=15)
+    parser.add_argument("--batch_size", type=int, default=16384,
+                        help="mini-batch rows per Lloyd's iteration")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--n_probe", type=int, default=8,
+                        help="serving default baked into the container")
+    parser.add_argument("--shortlist", type=int, default=128,
+                        help="serving default baked into the container")
+    parser.add_argument("--accelerator", action="store_true", default=False,
+                        help="train on the default device backend; off = "
+                        "pin CPU (same contract as the serve CLI)")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from code2vec_tpu.cli import pin_platform
+
+    pin_platform(not args.accelerator)
+
+    from code2vec_tpu.ann.index import build_index, save_index
+    from code2vec_tpu.formats.vectors_io import read_code_vectors
+
+    labels, rows = read_code_vectors(args.code_vec)
+    n = len(labels)
+    if n < 2:
+        print(f"ann_build: {args.code_vec} holds {n} vectors; need >= 2",
+              file=sys.stderr)
+        return 2
+    n_list = args.n_list
+    if n_list <= 0:
+        n_list = max(-(-int(round(n ** 0.5)) // 8) * 8, 8)
+    m = args.m
+    dim = rows.shape[1]
+    if dim % m:
+        divisors = [d for d in range(m, 0, -1) if dim % d == 0]
+        m = divisors[0]
+        print(
+            f"ann_build: --m {args.m} does not divide dim {dim}; using "
+            f"m={m}",
+            file=sys.stderr,
+        )
+
+    t0 = time.perf_counter()
+    index, unit = build_index(
+        rows, n_list=n_list, m=m, seed=args.seed,
+        kmeans_iters=args.kmeans_iters, pq_iters=args.pq_iters,
+        batch_size=args.batch_size,
+    )
+    build_seconds = time.perf_counter() - t0
+    save_index(
+        args.out, index, unit, labels,
+        defaults={"n_probe": args.n_probe, "shortlist": args.shortlist},
+    )
+
+    meta = index.meta
+    slots = meta["n_list"] * meta["capacity"]
+    print(
+        json.dumps(
+            {
+                "out": args.out,
+                "n": meta["n"],
+                "dim": meta["dim"],
+                "n_list": meta["n_list"],
+                "m": meta["m"],
+                "capacity": meta["capacity"],
+                "cell_pad_efficiency": round(meta["n"] / slots, 4),
+                "n_probe": args.n_probe,
+                "shortlist": args.shortlist,
+                "seed": args.seed,
+                "build_seconds": round(build_seconds, 2),
+                "container_bytes": os.path.getsize(args.out),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
