@@ -1707,6 +1707,18 @@ def _serve_bench() -> None:
     and the zero-post-warmup-recompile assertion: the obs
     RecompileDetector tracks the engine's executable table across the
     whole mixed-width stream and the metric line carries its verdict.
+
+    ``--rolling-swap`` adds the hot-swap arm (serve/swap.py): mid-stream,
+    a ``reload`` shadow-compiles a SECOND model version's full ladder on
+    a background thread, golden-validates it, and atomically swaps the
+    serving pointer while the open-loop load keeps arriving; after the
+    stream a ``rollback`` swaps back. The run FAILS unless: zero failed
+    requests across the swap, swap-window p99 bounded by
+    ``BENCH_SWAP_P99_FACTOR`` (default 3x) of the steady-state p99, zero
+    post-warmup recompiles on BOTH generations' engines, and the
+    rolled-back version reproduces its pre-swap embeddings BITWISE on the
+    very first request (nothing was rebuilt — the old executables stayed
+    resident).
     """
     jax, backend, fell_back = _init_backend()
     _bench_tracer(jax)
@@ -1813,20 +1825,103 @@ def _serve_bench() -> None:
     batcher = MicroBatcher(
         engine, deadline_ms=deadline_ms, max_pending=4096, health=health
     )
+
+    rolling_swap = "--rolling-swap" in sys.argv[1:]
+    controller = golden_request = ref_v0 = None
+    swap_at = None
+    if rolling_swap:
+        from code2vec_tpu.serve.swap import (
+            Generation,
+            GoldenSet,
+            SwapController,
+        )
+
+        def build_generation(target):
+            # the "new checkpoint": same architecture, different weights —
+            # compiled + validated entirely on the swap thread while the
+            # active generation keeps serving
+            seed = 1 if target == "v1" else 0
+            new_state = create_train_state(
+                config, model_config, jax.random.PRNGKey(seed), example
+            )
+            shadow = ServingEngine(
+                new_state,
+                max_width=bag,
+                model_dims=(embed_size, embed_size, encode_size),
+                ladder=ladder,
+                batch_sizes=batch_sizes,
+                health=health,
+                version=str(target),
+            )
+            shadow.prepare()
+            return Generation(
+                version=str(target),
+                engine=shadow,
+                batcher=MicroBatcher(
+                    shadow, deadline_ms=deadline_ms, max_pending=4096,
+                    health=health,
+                ),
+            )
+
+        controller = SwapController(
+            Generation(version="v0", engine=engine, batcher=batcher),
+            build=build_generation,
+            golden=GoldenSet(n_terminals=n_terminals, n_paths=n_paths),
+            health=health,
+        )
+        swap_at = max(1, int(n_requests * 0.4))
+        # the rollback contract's witness: one fixed request, served
+        # before the swap so its v0 embedding is on record
+        golden_request = requests[0]
+        ref_v0 = batcher.submit(golden_request).result()
+
     futures = []
+    submit_times: list[float] = []
+    done_times: dict = {}
     rejected = 0
+    swap_started_t = swap_committed_t = None
     t_start = time.perf_counter()
     for i, arr in enumerate(requests):
         delay = arrivals[i] - (time.perf_counter() - t_start)
         if delay > 0:
             time.sleep(delay)
+        if rolling_swap and i == swap_at:
+            swap_started_t = time.perf_counter()
+            controller.reload("v1", wait=False)
+        if (
+            swap_started_t is not None
+            and swap_committed_t is None
+            and controller.state == "idle"
+        ):
+            swap_committed_t = time.perf_counter()
         try:
-            futures.append(batcher.submit(arr))
+            live = controller.active.batcher if rolling_swap else batcher
+            future = live.submit(arr)
         except ServeOverloaded:
             rejected += 1
-    results = [f.result() for f in futures]
+            continue
+        submit_times.append(time.perf_counter())
+        future.add_done_callback(
+            lambda f: done_times.__setitem__(id(f), time.perf_counter())
+        )
+        futures.append(future)
+    failed = []
+    results = []
+    for future in futures:
+        try:
+            results.append(future.result())
+        except Exception as exc:  # noqa: BLE001 - counted, then reported
+            failed.append(f"{type(exc).__name__}: {exc}")
     t_wall = time.perf_counter() - t_start
-    batcher.close()
+    if failed and not rolling_swap:
+        # same contract as the old gather, which re-raised here: a broken
+        # serving path must die BEFORE any metric line reaches stdout
+        raise RuntimeError(
+            f"{len(failed)} request(s) failed during the load run "
+            f"(first: {failed[:3]})"
+        )
+    if not rolling_swap:
+        batcher.close()
 
     completed = len(results)
     real_contexts = sum(r.n_contexts for r in results)
@@ -1844,6 +1939,81 @@ def _serve_bench() -> None:
         )
     }
     qps = completed / t_wall if t_wall > 0 else 0.0
+
+    swap_detail = None
+    p99_factor = _env_float("BENCH_SWAP_P99_FACTOR", 3.0)
+    if rolling_swap:
+        status = controller.wait(600)
+        if swap_committed_t is None and controller.state == "idle":
+            swap_committed_t = time.perf_counter()
+        last = status["last_swap"] or {}
+        # window the per-request e2e samples by SUBMISSION time: steady =
+        # before the reload, swap = between reload start and commit (the
+        # interval where the shadow build competes for the host)
+        e2e = [
+            (t_submit, (done_times[id(future)] - t_submit) * 1e3)
+            for t_submit, future in zip(submit_times, futures)
+            if id(future) in done_times
+        ]
+        steady = [ms for t, ms in e2e if t < swap_started_t]
+        swap_end = swap_committed_t or (t_start + t_wall)
+        swap_window = [ms for t, ms in e2e if swap_started_t <= t <= swap_end]
+        p99_steady = float(np.percentile(steady, 99)) if steady else None
+        p99_swap = (
+            float(np.percentile(swap_window, 99)) if swap_window else None
+        )
+        p99_ratio = (
+            round(p99_swap / p99_steady, 3)
+            if p99_steady and p99_swap is not None
+            else None
+        )
+        # rollback: v1 serves (different weights), then one pointer swap
+        # back and the very next request must be v0-bitwise — the old
+        # generation's executables and tables were never torn down. Only
+        # reachable after a COMMIT: a failed/stuck swap has no previous
+        # generation to roll back to, and must reach the verdict below
+        # (not die here on the rollback's own ValueError).
+        rollback_bitwise = versions_differ = False
+        shadow_post_warmup = 0
+        if last.get("outcome") == "committed":
+            v1_result = controller.active.batcher.submit(
+                golden_request
+            ).result()
+            controller.rollback()
+            restored = controller.active.batcher.submit(
+                golden_request
+            ).result()
+            rollback_bitwise = bool(
+                np.array_equal(ref_v0.code_vector, restored.code_vector)
+                and np.array_equal(ref_v0.logits, restored.logits)
+            )
+            versions_differ = not np.array_equal(
+                ref_v0.code_vector, v1_result.code_vector
+            )
+            # v1, post-rollback
+            shadow_post_warmup = controller.previous.engine.post_warmup_compiles
+        swap_detail = {
+            "outcome": last.get("outcome"),
+            "swap_at_request": swap_at,
+            "build_ms": last.get("build_ms"),
+            "validate_ms": last.get("validate_ms"),
+            "golden_requests": last.get("golden_requests"),
+            "swap_window_s": (
+                round(swap_end - swap_started_t, 3)
+                if swap_started_t is not None
+                else None
+            ),
+            "requests_in_swap_window": len(swap_window),
+            "p99_steady_ms": round(p99_steady, 3) if p99_steady else None,
+            "p99_swap_ms": round(p99_swap, 3) if p99_swap else None,
+            "p99_ratio": p99_ratio,
+            "p99_factor": p99_factor,
+            "failed_requests": len(failed),
+            "versions_differ": versions_differ,
+            "rollback_bitwise": rollback_bitwise,
+            "post_warmup_recompiles_shadow": shadow_post_warmup,
+        }
+        controller.close()
 
     detail = {
         "backend": backend,
@@ -1874,34 +2044,79 @@ def _serve_bench() -> None:
         "schedule_provenance": provenance,
         "post_warmup_recompiles": engine.post_warmup_compiles,
         "detector_new_compiles": new_compiles,
+        "failed_requests": len(failed),
         "counters": health.snapshot()["counters"],
         "memory": memory_snapshot(),
     }
+    if swap_detail is not None:
+        detail["rolling_swap"] = swap_detail
     print(json.dumps({"detail": detail}), file=sys.stderr, flush=True)
-    print(
-        json.dumps(
-            {
-                "metric": "serve_requests_per_sec",
-                "value": round(qps, 2),
-                "unit": "req/sec",
-                # first serving benchmark: no prior round to compare to;
-                # the acceptance gate is the latency block + the recompile
-                # verdict below, not a speedup ratio
-                "vs_baseline": 1.0,
-                "p50_ms": lat["e2e"]["p50_ms"] if lat["e2e"] else None,
-                "p99_ms": lat["e2e"]["p99_ms"] if lat["e2e"] else None,
-                "post_warmup_recompiles": engine.post_warmup_compiles,
-                "backend": backend,
-            }
-        ),
-        flush=True,
+    metric = {
+        "metric": "serve_requests_per_sec",
+        "value": round(qps, 2),
+        "unit": "req/sec",
+        # first serving benchmark: no prior round to compare to;
+        # the acceptance gate is the latency block + the recompile
+        # verdict below, not a speedup ratio
+        "vs_baseline": 1.0,
+        "p50_ms": lat["e2e"]["p50_ms"] if lat["e2e"] else None,
+        "p99_ms": lat["e2e"]["p99_ms"] if lat["e2e"] else None,
+        "post_warmup_recompiles": engine.post_warmup_compiles,
+        "backend": backend,
+    }
+    if swap_detail is not None:
+        metric["rolling_swap"] = {
+            key: swap_detail[key]
+            for key in (
+                "outcome", "p99_steady_ms", "p99_swap_ms", "p99_ratio",
+                "failed_requests", "rollback_bitwise",
+            )
+        }
+    print(json.dumps(metric), flush=True)
+    total_post_warmup = engine.post_warmup_compiles + (
+        swap_detail["post_warmup_recompiles_shadow"] if swap_detail else 0
     )
-    if engine.post_warmup_compiles or new_compiles:
+    if total_post_warmup or new_compiles:
         raise RuntimeError(
             f"serving hot path recompiled post-warmup "
-            f"({engine.post_warmup_compiles} engine / {new_compiles} "
+            f"({total_post_warmup} engines / {new_compiles} "
             "detector) — the AOT ladder failed to cover the stream"
         )
+    if rolling_swap:
+        problems = []
+        if failed:
+            problems.append(
+                f"{len(failed)} request(s) failed across the swap "
+                f"(first: {failed[:2]})"
+            )
+        if swap_detail["outcome"] != "committed":
+            problems.append(f"swap outcome {swap_detail['outcome']!r}")
+        if (
+            swap_detail["p99_ratio"] is not None
+            and swap_detail["p99_ratio"] > p99_factor
+        ):
+            problems.append(
+                f"swap-window p99 {swap_detail['p99_swap_ms']} ms is "
+                f"{swap_detail['p99_ratio']}x steady-state "
+                f"{swap_detail['p99_steady_ms']} ms (> {p99_factor}x)"
+            )
+        if swap_detail["outcome"] == "committed":
+            # only meaningful after a commit — an uncommitted swap is
+            # already reported above, without piling on dependent checks
+            if not swap_detail["versions_differ"]:
+                problems.append(
+                    "v1 served identical outputs to v0 — the swap did not "
+                    "actually change the serving weights"
+                )
+            if not swap_detail["rollback_bitwise"]:
+                problems.append(
+                    "rollback did NOT restore v0's bitwise-identical "
+                    "outputs"
+                )
+        if problems:
+            raise RuntimeError(
+                "--rolling-swap verdict failed: " + "; ".join(problems)
+            )
 
 
 def main() -> None:

@@ -20,6 +20,16 @@ restore-time mesh shapes, and whether the arrays were resharded onto a new
 topology), ``preempted`` (clean SIGTERM exit), ``recompile``
 (obs.runtime.RecompileDetector), ``error``.
 
+The serving side (``serve/``) writes ``serve_executable`` (one per AOT
+compile, with schedule provenance and the model version), the hot-swap
+state machine's ``swap_started`` / ``swap_committed`` / ``swap_failed`` /
+``rollback`` / ``generation_retired`` (serve/swap.py — build/validate
+timings and the golden-validation report ride the commit event), and the
+fleet router's ``fleet_replica_spawned`` / ``fleet_replica_evicted`` /
+``fleet_swap_started`` / ``fleet_swap_committed`` / ``fleet_swap_failed``
+/ ``fleet_rollback`` (serve/fleet/router.py). Run manifests carry the
+serve/fleet topology blocks next to the config.
+
 **Sinks are consumers of this stream**: ``sink_consumer`` adapts the
 ``(epoch, metrics)`` metric sinks (``code2vec_tpu.sinks``) into an event
 consumer, and the train loop emits metrics ONLY as events — so the sink

@@ -26,6 +26,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "LatencyHistogram",
+    "NamespacedHealth",
     "RuntimeHealth",
     "RecompileDetector",
     "global_health",
@@ -151,6 +152,14 @@ class RuntimeHealth:
         with self._lock:
             return self._latencies.setdefault(name, LatencyHistogram())
 
+    def namespaced(self, prefix: str) -> "NamespacedHealth":
+        """A view of this registry that prefixes every metric name with
+        ``prefix`` + '.'. One registry, one snapshot, one schema — but
+        subsystems that exist N times per process (fleet replica slots,
+        SLO classes) get distinct, greppable metric names instead of
+        aliasing one counter."""
+        return NamespacedHealth(self, prefix)
+
     def snapshot(self) -> dict:
         with self._lock:
             counters = dict(self._counters)
@@ -169,6 +178,34 @@ class RuntimeHealth:
                 else {}
             ),
         }
+
+
+class NamespacedHealth:
+    """Name-prefixing facade over a :class:`RuntimeHealth` (see
+    :meth:`RuntimeHealth.namespaced`); metrics land in the PARENT registry
+    under ``<prefix>.<name>`` so its snapshot carries them all."""
+
+    def __init__(self, parent: RuntimeHealth, prefix: str) -> None:
+        self._parent = parent
+        self.prefix = str(prefix)
+
+    def _name(self, name: str) -> str:
+        return f"{self.prefix}.{name}"
+
+    def counter(self, name: str) -> Counter:
+        return self._parent.counter(self._name(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._parent.gauge(self._name(name))
+
+    def latency(self, name: str) -> LatencyHistogram:
+        return self._parent.latency(self._name(name))
+
+    def namespaced(self, prefix: str) -> "NamespacedHealth":
+        return NamespacedHealth(self._parent, self._name(prefix))
+
+    def snapshot(self) -> dict:
+        return self._parent.snapshot()
 
 
 _global_health: RuntimeHealth | None = None

@@ -25,22 +25,54 @@ north star"). Three pieces, composable and individually testable:
   the ``parallel/shardings.retrieval_shardings`` rule (row-sharded like
   the embedding tables).
 
+- :mod:`swap` — **live checkpoint hot-swap**: serving state is bundled
+  into swappable *generations* (predictor + AOT ladder + batcher +
+  retrieval); a ``reload`` control op shadow-compiles the new version on
+  a background thread, validates it against a golden request set (bitwise
+  embeddings, recall-bounded neighbors), and atomically swaps the serving
+  pointer without dropping in-flight requests — the old generation stays
+  resident for an instant ``rollback``.
+- :mod:`fleet` — **fleet serving**: a jax-free router process fanning
+  requests over N replica workers (subprocesses of this very CLI on
+  stdio), with per-SLO-class queue budgets/deadlines (tiered load
+  shedding), health-probe-driven eviction/respawn, and rolling hot-swap
+  across the fleet. ``python -m code2vec_tpu.serve.fleet`` is its CLI.
+
 :mod:`protocol` wires them behind a transport-thin server (stdio-JSONL or
 stdlib HTTP — the request handling is a plain ``dict -> dict`` function,
 testable without sockets), and ``python -m code2vec_tpu.serve`` is the
 CLI. Every phase is measured: per-request queue_wait / pad / device /
-postprocess spans and ``serve_*`` counters via ``obs``, with
-``bench.py --serve`` as the open-loop p50/p99 + QPS load harness.
+postprocess spans, per-op latency histograms, and ``serve_*`` counters
+via ``obs``, with ``bench.py --serve`` as the open-loop p50/p99 + QPS
+load harness (``--rolling-swap`` adds a mid-stream hot-swap + rollback).
 """
 
-from code2vec_tpu.serve.batcher import MicroBatcher, ServeOverloaded, ServerClosed
-from code2vec_tpu.serve.engine import ServingEngine
-from code2vec_tpu.serve.retrieval import RetrievalIndex
+# PEP 562 lazy exports (the analysis package's pattern): importing any
+# serve submodule must not drag in the whole stack — in particular the
+# fleet ROUTER process imports serve.protocol for its transports and is
+# deliberately jax-free (it moves dicts, never tensors); an eager
+# `from .engine import ...` here would cost it the full jax import.
+_EXPORTS = {
+    "Generation": "code2vec_tpu.serve.swap",
+    "GoldenSet": "code2vec_tpu.serve.swap",
+    "MicroBatcher": "code2vec_tpu.serve.batcher",
+    "RetrievalIndex": "code2vec_tpu.serve.retrieval",
+    "ServeOverloaded": "code2vec_tpu.serve.batcher",
+    "ServerClosed": "code2vec_tpu.serve.batcher",
+    "ServingEngine": "code2vec_tpu.serve.engine",
+    "SwapController": "code2vec_tpu.serve.swap",
+    "SwapValidationError": "code2vec_tpu.serve.swap",
+}
 
-__all__ = [
-    "MicroBatcher",
-    "RetrievalIndex",
-    "ServeOverloaded",
-    "ServerClosed",
-    "ServingEngine",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

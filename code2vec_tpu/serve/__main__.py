@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import sys
 
 logger = logging.getLogger(__name__)
 
@@ -56,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--warmup_requests", type=int, default=64,
                         help="histogram-fallback sample size when the "
                         "checkpoint's meta has no recorded bucket ladder")
+    parser.add_argument("--golden_min_recall", type=float, default=0.9,
+                        help="hot-swap validation: minimum neighbors "
+                        "recall@k the shadow generation's retrieval "
+                        "backend must hit against a brute-force reference "
+                        "before a reload may commit (serve/swap.py)")
     parser.add_argument("--autotune_cache", default="",
                         help="kernel-schedule cache consulted per compiled "
                         "executable (ops/autotune.py; default "
@@ -92,15 +96,95 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _build_retrieval(args, model_path: str):
+    """The retrieval backend for one generation — resolved against THAT
+    generation's model dir (a reloaded checkpoint brings its own exported
+    code.vec / ann.index along)."""
+    from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+    if args.retrieval_backend == "ann":
+        from code2vec_tpu.serve.retrieval import load_retrieval_index
+
+        ann_path = args.ann_index_path
+        if ann_path is None:
+            default = os.path.join(model_path, "ann.index")
+            ann_path = default if os.path.exists(default) else None
+        return load_retrieval_index(
+            "ann",
+            ann_index_path=ann_path,
+            n_probe=args.ann_n_probe,
+            shortlist=args.ann_shortlist,
+        )
+    code_vec_path = args.code_vec_path
+    if code_vec_path is None:
+        default = os.path.join(model_path, "code.vec")
+        code_vec_path = default if os.path.exists(default) else None
+    if code_vec_path:
+        return RetrievalIndex.from_code_vec(code_vec_path)
+    return None
+
+
+def make_generation_factory(args, events=None, start=0):
+    """``build(target) -> Generation``: load a checkpoint (``target`` is
+    its model dir; None = the CLI's ``--model_path``), AOT-compile its
+    full executable ladder, load retrieval, stand up a micro-batcher.
+    Called once at startup for generation 0 and again — on the swap
+    controller's background thread — for every ``reload``."""
+    import itertools
+
+    from code2vec_tpu.predict import Predictor
+    from code2vec_tpu.serve.batcher import MicroBatcher
+    from code2vec_tpu.serve.engine import ServingEngine
+    from code2vec_tpu.serve.swap import Generation
+
+    batch_sizes = tuple(
+        int(tok) for tok in str(args.batch_sizes).split(",") if tok.strip()
+    )
+    counter = itertools.count(start)
+
+    def build(target: str | None) -> "Generation":
+        model_path = target or args.model_path
+        if not os.path.isdir(model_path):
+            raise ValueError(f"model_path {model_path!r} is not a directory")
+        version = f"{model_path}#g{next(counter)}"
+        predictor = Predictor(
+            model_path, args.terminal_idx_path, args.path_idx_path,
+            table_dtype=args.table_dtype,
+        )
+        engine = ServingEngine.from_predictor(
+            predictor,
+            batch_sizes=batch_sizes,
+            autotune_cache=args.autotune_cache or None,
+            warmup_requests=args.warmup_requests,
+            events=events,
+            version=version,
+        )
+        provenance = engine.prepare()
+        logger.info(
+            "[%s] compiled %d executables over ladder %s x batch sizes %s",
+            version, len(provenance), list(engine.active_ladder),
+            list(engine.batch_sizes),
+        )
+        retrieval = _build_retrieval(args, model_path)
+        batcher = MicroBatcher(
+            engine,
+            deadline_ms=args.deadline_ms,
+            max_pending=args.max_pending,
+        )
+        return Generation(
+            version=version, predictor=predictor, engine=engine,
+            batcher=batcher, retrieval=retrieval, provenance=provenance,
+        )
+
+    return build
+
+
 def build_server(args):
     """Everything between arg parsing and the transport loop, importable
     so tests can drive a fully-assembled server without a subprocess."""
     from code2vec_tpu.obs.runtime import global_health
-    from code2vec_tpu.predict import Predictor
-    from code2vec_tpu.serve.batcher import MicroBatcher
-    from code2vec_tpu.serve.engine import ServingEngine
     from code2vec_tpu.serve.protocol import CodeServer
-    from code2vec_tpu.serve.retrieval import RetrievalIndex
+    from code2vec_tpu.serve.swap import GoldenSet
 
     # pin the schedule cache BEFORE the first trace, exactly like train()
     # and export_from_checkpoint do
@@ -115,52 +199,19 @@ def build_server(args):
 
         events = EventLog(args.events_dir)
 
-    predictor = Predictor(
-        args.model_path, args.terminal_idx_path, args.path_idx_path,
-        table_dtype=args.table_dtype,
-    )
-    batch_sizes = tuple(
-        int(tok) for tok in str(args.batch_sizes).split(",") if tok.strip()
-    )
-    engine = ServingEngine.from_predictor(
-        predictor,
-        batch_sizes=batch_sizes,
-        autotune_cache=args.autotune_cache or None,
-        warmup_requests=args.warmup_requests,
-    )
-    provenance = engine.prepare()
-    logger.info(
-        "compiled %d executables over ladder %s x batch sizes %s",
-        len(provenance), list(engine.active_ladder), list(engine.batch_sizes),
-    )
-
-    retrieval = None
-    if args.retrieval_backend == "ann":
-        from code2vec_tpu.serve.retrieval import load_retrieval_index
-
-        ann_path = args.ann_index_path
-        if ann_path is None:
-            default = os.path.join(args.model_path, "ann.index")
-            ann_path = default if os.path.exists(default) else None
-        retrieval = load_retrieval_index(
-            "ann",
-            ann_index_path=ann_path,
-            n_probe=args.ann_n_probe,
-            shortlist=args.ann_shortlist,
-        )
-    else:
-        code_vec_path = args.code_vec_path
-        if code_vec_path is None:
-            default = os.path.join(args.model_path, "code.vec")
-            code_vec_path = default if os.path.exists(default) else None
-        if code_vec_path:
-            retrieval = RetrievalIndex.from_code_vec(code_vec_path)
+    # the factory builds generation 0 WITHOUT the event log attached (the
+    # manifest must stay the log's first line), then every later
+    # generation with it
+    factory = make_generation_factory(args, events=None)
+    gen0 = factory(None)
+    engine, retrieval = gen0.engine, gen0.retrieval
 
     if events is not None:
         events.write_manifest(
             serve={
                 "model_path": args.model_path,
                 "transport": args.transport,
+                "version": gen0.version,
                 "table_dtype": engine.table_dtype,
                 "ladder": list(engine.active_ladder),
                 "batch_sizes": list(engine.batch_sizes),
@@ -168,7 +219,7 @@ def build_server(args):
                 # per-executable schedule provenance: which tuned kernel
                 # schedule each compiled shape consulted, and whether the
                 # cache covered it (the --expect-cached-style warmup)
-                "executables": provenance,
+                "executables": gen0.provenance,
                 # retrieval-backend provenance, mirroring the executables:
                 # backend kind, index geometry, and (ann) the LUT-kernel
                 # schedule the searcher consulted
@@ -178,16 +229,17 @@ def build_server(args):
             }
         )
         # attach the log only AFTER the manifest so it stays the first
-        # line; later compiles (histogram-freeze, shape misses) still get
-        # their own serve_executable events
+        # line; later compiles (histogram-freeze, shape misses, shadow
+        # builds) still get their own serve_executable events
         engine._events = events
+        factory = make_generation_factory(args, events=events, start=1)
 
-    batcher = MicroBatcher(
-        engine,
-        deadline_ms=args.deadline_ms,
-        max_pending=args.max_pending,
+    server = CodeServer(
+        gen0.predictor, engine, gen0.batcher, retrieval=retrieval,
+        version=gen0.version, factory=factory,
+        golden=GoldenSet(min_recall=args.golden_min_recall),
+        events=events,
     )
-    server = CodeServer(predictor, engine, batcher, retrieval=retrieval)
     health = global_health()
     health.gauge("serve_transport").set(args.transport)
     return server, events
@@ -213,15 +265,15 @@ def main(argv: list[str] | None = None) -> None:
         set_tracer(tracer)
 
     server, events = build_server(args)
+
+    # SIGTERM = graceful drain, not an abrupt exit (run_transport): the
+    # path fleet eviction and rolling restarts hit — a worker that drops
+    # queued requests on SIGTERM turns every eviction into client-visible
+    # failures.
+    from code2vec_tpu.serve.protocol import run_transport
+
     try:
-        if args.transport == "stdio":
-            from code2vec_tpu.serve.protocol import serve_stdio
-
-            serve_stdio(server, sys.stdin, sys.stdout)
-        else:
-            from code2vec_tpu.serve.protocol import serve_http
-
-            serve_http(server, args.host, args.port)
+        run_transport(server, args.transport, args.host, args.port)
     finally:
         if tracer is not None:
             from code2vec_tpu.obs.trace import set_tracer

@@ -30,8 +30,10 @@ error. Shutdown drains: queued and in-flight requests complete before
 Every phase is measured per request/group: ``queue_wait`` / ``pad`` /
 ``device`` / ``postprocess`` spans on the tracer, the same buckets as
 latency histograms on the health registry (``serve.queue_wait_ms`` etc.),
-plus ``serve_requests`` / ``serve_batches`` / ``serve_coalesced``
-counters — ``bench.py --serve`` reads p50/p99 straight from these.
+plus ``serve_requests`` / ``serve_batches`` / ``serve_coalesced`` /
+``serve_rejected`` counters and a live ``serve_queue_depth`` gauge —
+``bench.py --serve`` reads p50/p99 straight from these, and the fleet
+router's shedding decisions read the same schema (no ad-hoc state).
 """
 
 from __future__ import annotations
@@ -124,6 +126,11 @@ class MicroBatcher:
         self._batches = self._health.counter("serve_batches")
         self._coalesced = self._health.counter("serve_coalesced")
         self._rejected = self._health.counter("serve_rejected")
+        # live queue depth for the router's shedding decisions and
+        # dashboards — same registry/schema as the shed counter and the
+        # per-phase latency histograms (one obs schema, no ad-hoc state)
+        self._depth = self._health.gauge("serve_queue_depth")
+        self._depth.set(0)
         self._thread = threading.Thread(
             target=self._loop, name="c2v-micro-batcher", daemon=True
         )
@@ -157,6 +164,7 @@ class MicroBatcher:
                     f"serving queue is full ({self._queue.maxsize} pending); "
                     "retry with backoff"
                 ) from None
+            self._depth.set(self._queue.qsize())
         self._requests.inc()
         return pending.future
 
@@ -195,8 +203,21 @@ class MicroBatcher:
                 first = self._queue.get(timeout=self._POLL_S)
             except queue.Empty:
                 if self._closed.is_set():
-                    return
-                continue
+                    # closed observed: submit serializes its closed-check +
+                    # enqueue against close's flag-set, so every ACCEPTED
+                    # request is already visible in the queue — but an item
+                    # can land in the gap between this poll's timeout
+                    # expiring and the flag check. One final non-blocking
+                    # drain before exiting, or that accepted request would
+                    # be failed by close()'s sweep instead of served (the
+                    # drop the fleet's SIGTERM-eviction path would hit).
+                    try:
+                        first = self._queue.get_nowait()
+                    except queue.Empty:
+                        return
+                else:
+                    continue
+            self._depth.set(self._queue.qsize())
             group = [first]
             t_end = time.perf_counter() + self._deadline_s
             while len(group) < self._max_batch:

@@ -79,10 +79,15 @@ class ServingEngine:
         warmup_requests: int = 64,
         health: RuntimeHealth | None = None,
         events=None,
+        version: str = "v0",
     ) -> None:
         if not batch_sizes or any(b < 1 for b in batch_sizes):
             raise ValueError(f"batch_sizes must be >= 1, got {batch_sizes!r}")
         self._state = state
+        # which model version this ladder was compiled for — hot-swap
+        # (serve/swap.py) builds one engine per generation and the
+        # compile events/provenance must say whose executables they are
+        self.version = str(version)
         self.max_width = int(max_width)
         self.batch_sizes = tuple(sorted({int(b) for b in batch_sizes}))
         self.ladder: tuple[int, ...] | None = (
@@ -265,6 +270,7 @@ class ServingEngine:
                 record = {
                     "batch": b,
                     "width": w,
+                    "version": self.version,
                     "table_dtype": self.table_dtype,
                     "compile_ms": self._compile(b, w),
                     "schedule": schedules.get((b, w), {}).get("schedule"),
@@ -333,6 +339,7 @@ class ServingEngine:
                 record = {
                     "batch": key[0],
                     "width": key[1],
+                    "version": self.version,
                     "table_dtype": self.table_dtype,
                     "compile_ms": self._compile(*key),
                     "schedule": None,

@@ -1,0 +1,189 @@
+"""``python -m code2vec_tpu.serve.fleet`` — launch router + N replicas.
+
+The router process is jax-free; each replica is a full
+``python -m code2vec_tpu.serve --transport stdio`` subprocess that
+AOT-compiles its executable ladder before the router counts it placeable.
+Client-facing transports are the same stdio-JSONL/HTTP adapters the
+single-process server uses — a client cannot tell a fleet from one
+worker, except that ``health`` returns the fleet topology and ``reload``
+performs a ROLLING hot-swap across the replicas.
+
+    python -m code2vec_tpu.serve.fleet --replicas 4 \\
+        --model_path out \\
+        --terminal_idx_path ds/terminal_idxs.txt \\
+        --path_idx_path ds/path_idxs.txt \\
+        --transport http --port 8080 \\
+        --slo embed=512:1500,neighbors=64:8000
+
+    # zero-downtime rollout + instant rollback (any transport):
+    {"op": "reload", "model_path": "out_v2"}
+    {"op": "swap_status"}
+    {"op": "rollback"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="code2vec_tpu.serve.fleet",
+        description="fleet serving: replica router, tiered load shedding, "
+        "rolling live checkpoint hot-swap",
+    )
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="worker subprocess count")
+    parser.add_argument("--model_path", required=True)
+    parser.add_argument("--terminal_idx_path", required=True)
+    parser.add_argument("--path_idx_path", required=True)
+    parser.add_argument("--transport", default="stdio",
+                        choices=("stdio", "http"))
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--slo", default="",
+                        help="per-class budget/deadline overrides: "
+                        "class=budget:deadline_ms comma-separated over "
+                        "defaults health=16:1000,embed=256:2000,"
+                        "neighbors=64:5000")
+    parser.add_argument("--per_replica_inflight", type=int, default=8,
+                        help="max requests in flight per replica (the "
+                        "per-replica bounded queue)")
+    parser.add_argument("--probe_interval_s", type=float, default=2.0)
+    parser.add_argument("--probe_timeout_s", type=float, default=60.0)
+    parser.add_argument("--max_probe_failures", type=int, default=3,
+                        help="consecutive missed health probes before a "
+                        "replica is evicted and respawned")
+    parser.add_argument("--boot_timeout_s", type=float, default=900.0,
+                        help="per-replica AOT-compile + readiness budget")
+    parser.add_argument("--events_dir", default=None,
+                        help="router event log (fleet manifest, spawn/"
+                        "evict/swap events); replicas log under "
+                        "<events_dir>/r<slot>")
+    # worker passthrough (same semantics as code2vec_tpu.serve)
+    parser.add_argument("--table_dtype", default=None,
+                        choices=("f32", "bf16", "int8"))
+    parser.add_argument("--batch_sizes", default="1,8")
+    parser.add_argument("--deadline_ms", type=float, default=2.0,
+                        help="per-worker micro-batcher coalescing window")
+    parser.add_argument("--max_pending", type=int, default=256,
+                        help="per-worker micro-batcher queue bound")
+    parser.add_argument("--warmup_requests", type=int, default=64)
+    parser.add_argument("--golden_min_recall", type=float, default=0.9)
+    parser.add_argument("--autotune_cache", default="")
+    parser.add_argument("--code_vec_path", default=None)
+    parser.add_argument("--retrieval_backend", default="exact",
+                        choices=("exact", "ann"))
+    parser.add_argument("--ann_index_path", default=None)
+    parser.add_argument("--ann_n_probe", type=int, default=None)
+    parser.add_argument("--ann_shortlist", type=int, default=None)
+    parser.add_argument("--accelerator", action="store_true", default=False)
+    return parser
+
+
+def worker_argv(args, slot: int) -> list[str]:
+    """The replica subprocess command line (one worker, stdio)."""
+    argv = [
+        sys.executable, "-m", "code2vec_tpu.serve",
+        "--transport", "stdio",
+        "--model_path", args.model_path,
+        "--terminal_idx_path", args.terminal_idx_path,
+        "--path_idx_path", args.path_idx_path,
+        "--batch_sizes", str(args.batch_sizes),
+        "--deadline_ms", str(args.deadline_ms),
+        "--max_pending", str(args.max_pending),
+        "--warmup_requests", str(args.warmup_requests),
+        "--golden_min_recall", str(args.golden_min_recall),
+        "--retrieval_backend", args.retrieval_backend,
+    ]
+    if args.table_dtype:
+        argv += ["--table_dtype", args.table_dtype]
+    if args.autotune_cache:
+        argv += ["--autotune_cache", args.autotune_cache]
+    if args.code_vec_path:
+        argv += ["--code_vec_path", args.code_vec_path]
+    if args.ann_index_path:
+        argv += ["--ann_index_path", args.ann_index_path]
+    if args.ann_n_probe is not None:
+        argv += ["--ann_n_probe", str(args.ann_n_probe)]
+    if args.ann_shortlist is not None:
+        argv += ["--ann_shortlist", str(args.ann_shortlist)]
+    if args.accelerator:
+        argv += ["--accelerator"]
+    if args.events_dir:
+        argv += ["--events_dir", os.path.join(args.events_dir, f"r{slot}")]
+    return argv
+
+
+def build_router(args):
+    """Assemble the router (spawns + readies every replica); importable so
+    tests can drive a real fleet without the transport loop."""
+    from code2vec_tpu.serve.fleet.replica import ReplicaHandle
+    from code2vec_tpu.serve.fleet.router import FleetRouter
+    from code2vec_tpu.serve.fleet.slo import parse_slo_spec
+
+    events = None
+    if args.events_dir:
+        from code2vec_tpu.obs.events import EventLog
+
+        events = EventLog(args.events_dir)
+        events.write_manifest(
+            fleet={
+                "replicas": args.replicas,
+                "model_path": args.model_path,
+                "transport": args.transport,
+                "slo": args.slo or None,
+                "per_replica_inflight": args.per_replica_inflight,
+            }
+        )
+
+    def factory(slot: int, incarnation: int) -> ReplicaHandle:
+        return ReplicaHandle(
+            slot, worker_argv(args, slot), incarnation=incarnation,
+        )
+
+    router = FleetRouter(
+        factory,
+        args.replicas,
+        slo=parse_slo_spec(args.slo),
+        events=events,
+        per_replica_inflight=args.per_replica_inflight,
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        max_probe_failures=args.max_probe_failures,
+        boot_timeout_s=args.boot_timeout_s,
+    )
+    return router, events
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s: %(message)s",
+        datefmt="%m/%d/%Y %I:%M:%S %p",
+    )
+    args = build_parser().parse_args(argv)
+    router, events = build_router(args)
+    logger.info("fleet of %d replica(s) is ready", args.replicas)
+
+    # same SIGTERM-draining transport loop as the single worker — a
+    # client cannot tell a fleet from one process, shutdown included
+    from code2vec_tpu.serve.protocol import run_transport
+
+    try:
+        run_transport(router, args.transport, args.host, args.port)
+    finally:
+        if events is not None:
+            try:
+                events.close()
+            except Exception:
+                logger.warning("could not close event log", exc_info=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,765 @@
+"""The fleet router: SLO-classed queues -> least-loaded healthy replica.
+
+Request life cycle (all jax-free; the router moves dicts, never tensors):
+
+1. **admit**: ``handle_async`` classifies the op into its SLO class and
+   enqueues into that class's bounded queue — a full queue sheds with a
+   retryable ``overloaded`` error (the class's budget IS the admission
+   bound; there is no global ``max_pending`` anymore).
+2. **dispatch**: one dispatcher thread drains the class queues in tier
+   priority (embed before neighbors; health-class control ops never
+   queue — the router handles them inline at admission, which is how
+   they cut through saturation), placing each request on the
+   healthy replica with the fewest in-flight requests, bounded by
+   ``per_replica_inflight`` (per-replica backpressure — the
+   micro-batcher's bounded-queue idea one level up). A request still
+   undispatched past its class deadline is shed with a ``deadline``
+   error: serving it anyway would poison the queue for requests whose
+   clients are still waiting.
+3. **resolve**: the replica's FIFO future resolves the router future;
+   per-class latency histograms and counters land in the shared obs
+   registry (``slo.<class>.*``). A request stranded on a dying replica is
+   retried on a sibling (inference ops are idempotent) up to
+   ``retry_limit`` times before failing with ``unavailable``.
+
+A **prober** thread health-checks every replica each
+``probe_interval_s`` through the same pipes traffic uses (a probe stuck
+behind a wedged queue is exactly the signal wanted); ``max_probe_failures``
+consecutive misses evicts the replica — SIGTERM first, so its drain
+handler resolves whatever it accepted — and respawns the slot with a
+fresh incarnation.
+
+**Rolling hot-swap**: the ``reload`` op walks replicas ONE AT A TIME,
+driving each worker's in-process shadow-build/validate/commit
+(``serve/swap.py``) and polling its ``swap_status`` until the commit —
+each replica keeps serving its incumbent generation while its shadow
+compiles, so fleet capacity never drops during a rollout; a replica that
+fails validation aborts the roll with the rest of the fleet untouched.
+``rollback`` fans the instant pointer-swap to every replica.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.serve.fleet.replica import ReplicaDied
+from code2vec_tpu.serve.fleet.slo import (
+    DEFAULT_SLO,
+    PRIORITY,
+    SloClass,
+    classify_op,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FleetRouter"]
+
+
+@dataclass
+class _Queued:
+    request: dict
+    future: Future
+    cls: str
+    enqueued: float = field(default_factory=time.perf_counter)
+    attempts: int = 0
+
+    @property
+    def age_ms(self) -> float:
+        return (time.perf_counter() - self.enqueued) * 1e3
+
+
+class FleetRouter:
+    """Fan requests over N replica slots (see module docstring).
+
+    ``replica_factory(slot, incarnation) -> handle`` builds one worker
+    client (:class:`~code2vec_tpu.serve.fleet.replica.ReplicaHandle` in
+    production; tests inject in-process fakes). The router exposes the
+    same ``handle``/``handle_async``/``shutdown_requested``/``close``
+    surface as :class:`~code2vec_tpu.serve.protocol.CodeServer`, so the
+    stdio/HTTP transport adapters work unchanged.
+    """
+
+    def __init__(
+        self,
+        replica_factory,
+        n_replicas: int,
+        *,
+        slo: dict[str, SloClass] | None = None,
+        health: RuntimeHealth | None = None,
+        events=None,
+        per_replica_inflight: int = 8,
+        probe_interval_s: float = 2.0,
+        probe_timeout_s: float = 60.0,
+        max_probe_failures: int = 3,
+        boot_timeout_s: float = 900.0,
+        swap_timeout_s: float = 1800.0,
+        retry_limit: int = 2,
+    ) -> None:
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if per_replica_inflight < 1:
+            raise ValueError(
+                f"per_replica_inflight must be >= 1, got "
+                f"{per_replica_inflight}"
+            )
+        self._factory = replica_factory
+        self._slo = dict(slo if slo is not None else DEFAULT_SLO)
+        self.health = health or global_health()
+        self._events = events
+        self._cap = int(per_replica_inflight)
+        self._probe_interval_s = float(probe_interval_s)
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._max_probe_failures = int(max_probe_failures)
+        self._boot_timeout_s = float(boot_timeout_s)
+        self._swap_timeout_s = float(swap_timeout_s)
+        self._retry_limit = int(retry_limit)
+
+        self._queues: dict[str, queue.Queue] = {
+            name: queue.Queue(maxsize=cls.budget)
+            for name, cls in self._slo.items()
+        }
+        self._heads: dict[str, _Queued | None] = {
+            name: None for name in self._slo
+        }
+        self._retries: collections.deque[_Queued] = collections.deque()
+        self._wake = threading.Event()
+        self._closed = threading.Event()
+        self._shutdown = threading.Event()
+        self._stop_probe = threading.Event()
+
+        self._swap_lock = threading.Lock()
+        self._rolling: dict = {"state": "idle", "target": None,
+                               "outcome": None, "replicas": []}
+        self._rolling_thread: threading.Thread | None = None
+
+        self._evictions = self.health.counter("fleet.evictions")
+        self._respawns = self.health.counter("fleet.respawns")
+        self._retried = self.health.counter("fleet.retries")
+        self.health.gauge("fleet.replicas").set(int(n_replicas))
+
+        # ---- boot the fleet (parallel: each worker compiles its ladder)
+        self._slots: list = [None] * int(n_replicas)
+        errors: list = [None] * int(n_replicas)
+
+        def boot(slot: int) -> None:
+            try:
+                self._slots[slot] = self._spawn(slot, incarnation=0)
+            except Exception as exc:  # noqa: BLE001 - re-raised below
+                errors[slot] = exc
+
+        threads = [
+            threading.Thread(target=boot, args=(i,), daemon=True)
+            for i in range(int(n_replicas))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        failed = [i for i, e in enumerate(errors) if e is not None]
+        if failed:
+            for handle in self._slots:
+                if handle is not None:
+                    try:
+                        handle.stop(timeout=10.0)
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
+            raise RuntimeError(
+                f"replica slot(s) {failed} failed to boot: "
+                f"{[str(errors[i]) for i in failed]}"
+            )
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="c2v-fleet-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="c2v-fleet-probe", daemon=True
+        )
+        self._prober.start()
+
+    # ---- spawn / respawn ------------------------------------------------
+    def _spawn(self, slot: int, incarnation: int):
+        handle = self._factory(slot, incarnation)
+        handle.wait_ready(self._boot_timeout_s)
+        logger.info(
+            "replica r%d (incarnation %d) is ready", slot, incarnation
+        )
+        self._emit(
+            "fleet_replica_spawned", slot=slot, incarnation=incarnation,
+            pid=getattr(handle, "pid", None),
+        )
+        return handle
+
+    def _emit(self, event: str, **fields) -> None:
+        if self._events is not None:
+            try:
+                self._events.emit(event, **fields)
+            except Exception:  # pragma: no cover - closed log
+                logger.warning("could not emit %s", event, exc_info=True)
+
+    # ---- CodeServer-compatible surface ----------------------------------
+    @property
+    def shutdown_requested(self) -> bool:
+        return self._shutdown.is_set()
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    def handle(self, request: dict) -> dict:
+        resolver = self.handle_async(request)
+        try:
+            return resolver()
+        except Exception as exc:  # noqa: BLE001 - protocol boundary
+            from code2vec_tpu.serve.protocol import CodeServer
+
+            return CodeServer._error_payload(exc)
+
+    def handle_async(self, request: dict):
+        req_id = request.get("id")
+
+        def finish(payload: dict) -> dict:
+            if req_id is not None:
+                payload = {"id": req_id, **payload}
+            return payload
+
+        op = request.get("op")
+        cls_name = classify_op(op)
+        if cls_name is None:
+            payload = {"error": f"unknown op {op!r}",
+                       "error_kind": "bad_request"}
+            return lambda: finish(payload)
+        if self._closed.is_set():
+            payload = {"error": "fleet router is shutting down",
+                       "error_kind": "closed"}
+            return lambda: finish(payload)
+
+        # control plane handled in the router itself
+        if op == "health":
+            # resolve-time snapshot, like the single-process server
+            return lambda: finish(self._fleet_health())
+        if op == "shutdown":
+            self._shutdown.set()
+            return lambda: finish({"ok": True, "shutting_down": True})
+        if op == "reload":
+            try:
+                payload = self._start_rolling(request)
+            except ValueError as exc:
+                payload = {"error": str(exc), "error_kind": "bad_request"}
+            return lambda: finish(payload)
+        if op == "rollback":
+            payload = self._fleet_rollback()
+            return lambda: finish(payload)
+        if op == "swap_status":
+            payload = self._fleet_swap_status()
+            return lambda: finish(payload)
+
+        # data plane: admit into the class queue (budget = admission bound)
+        item = _Queued(request=request, future=Future(), cls=cls_name)
+        self.health.counter(f"slo.{cls_name}.submitted").inc()
+        try:
+            self._queues[cls_name].put_nowait(item)
+        except queue.Full:
+            self.health.counter(f"slo.{cls_name}.shed_budget").inc()
+            slo = self._slo[cls_name]
+            payload = {
+                "error": (
+                    f"{cls_name} queue budget ({slo.budget}) exhausted — "
+                    "shed; retry with backoff"
+                ),
+                "error_kind": "overloaded",
+                "slo_class": cls_name,
+            }
+            return lambda: finish(payload)
+        self.health.gauge(f"slo.{cls_name}.queued").set(
+            self._queues[cls_name].qsize()
+        )
+        self._wake.set()
+        return lambda: finish(item.future.result())
+
+    # ---- dispatch -------------------------------------------------------
+    def _pick_replica(self):
+        """Healthy replica with the fewest in-flight requests, below the
+        per-replica bound; None when every replica is full or dead."""
+        best = None
+        for handle in self._slots:
+            if handle is None or not handle.alive:
+                continue
+            if handle.in_flight >= self._cap:
+                continue
+            if best is None or handle.in_flight < best.in_flight:
+                best = handle
+        return best
+
+    def _any_alive(self) -> bool:
+        return any(h is not None and h.alive for h in self._slots)
+
+    def _shed_deadline(self, item: _Queued) -> None:
+        self.health.counter(f"slo.{item.cls}.shed_deadline").inc()
+        slo = self._slo[item.cls]
+        item.future.set_result({
+            "error": (
+                f"{item.cls} deadline ({slo.deadline_ms:.0f} ms) exceeded "
+                f"before dispatch (waited {item.age_ms:.0f} ms) — shed"
+            ),
+            "error_kind": "deadline",
+            "slo_class": item.cls,
+        })
+
+    def _fail_item(
+        self, item: _Queued, reason: str, kind: str = "unavailable"
+    ) -> None:
+        self.health.counter(f"slo.{item.cls}.failed").inc()
+        if not item.future.done():
+            item.future.set_result({
+                "error": reason,
+                "error_kind": kind,
+                "slo_class": item.cls,
+            })
+
+    def _next_item(self, cls: str) -> _Queued | None:
+        head = self._heads[cls]
+        if head is not None:
+            return head
+        try:
+            item = self._queues[cls].get_nowait()
+        except queue.Empty:
+            return None
+        self.health.gauge(f"slo.{cls}.queued").set(
+            self._queues[cls].qsize()
+        )
+        self._heads[cls] = item
+        return item
+
+    def _dispatch_once(self) -> bool:
+        """One placement attempt across the tiers; True if any progress
+        (dispatch or shed) was made."""
+        # stranded retries first — their original admission already waited
+        while self._retries:
+            item = self._retries.popleft()
+            if item.age_ms > self._slo[item.cls].deadline_ms:
+                self._shed_deadline(item)
+                return True
+            if item.attempts > self._retry_limit:
+                self._fail_item(
+                    item,
+                    f"request failed on {item.attempts} replica(s) — "
+                    "fleet unavailable",
+                )
+                return True
+            replica = self._pick_replica()
+            if replica is None:
+                if self._closed.is_set() and not self._any_alive():
+                    self._fail_item(item, "no replica alive during drain")
+                    return True
+                self._retries.appendleft(item)
+                break
+            if self._dispatch(item, replica):
+                return True
+            # the picked replica died at write time — it is no longer
+            # `alive`, so the next pass picks a sibling
+            self._retries.appendleft(item)
+        for cls in PRIORITY:
+            if cls not in self._heads:
+                continue
+            item = self._next_item(cls)
+            if item is None:
+                continue
+            if item.age_ms > self._slo[cls].deadline_ms:
+                self._heads[cls] = None
+                self._shed_deadline(item)
+                return True
+            replica = self._pick_replica()
+            if replica is None:
+                if self._closed.is_set() and not self._any_alive():
+                    # draining with a dead fleet: failing loudly beats a
+                    # future that never resolves
+                    self._heads[cls] = None
+                    self._fail_item(item, "no replica alive during drain")
+                    return True
+                continue
+            if self._dispatch(item, replica):
+                self._heads[cls] = None
+                return True
+        return False
+
+    def _dispatch(self, item: _Queued, replica) -> bool:
+        try:
+            inner = replica.send(item.request)
+        except ReplicaDied:
+            # no work reached a worker — not a retry attempt; the deadline
+            # bounds how long the item can keep looking for a replica
+            return False
+        inner.add_done_callback(
+            lambda fut, item=item, replica=replica: self._on_reply(
+                item, replica, fut
+            )
+        )
+        return True
+
+    def _on_reply(self, item: _Queued, replica, fut) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            # stranded on a dying replica — inference ops are idempotent,
+            # so retry on a sibling instead of surfacing the eviction
+            item.attempts += 1
+            self._retried.inc()
+            self._retries.append(item)
+            self._wake.set()
+            return
+        payload = fut.result()
+        self.health.latency(f"slo.{item.cls}.e2e_ms").record(item.age_ms)
+        self.health.counter(f"slo.{item.cls}.completed").inc()
+        if not item.future.done():
+            item.future.set_result(payload)
+
+    def _queues_empty(self) -> bool:
+        return (
+            not self._retries
+            and all(h is None for h in self._heads.values())
+            and all(q.qsize() == 0 for q in self._queues.values())
+        )
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            if self._dispatch_once():
+                continue
+            if self._closed.is_set() and self._queues_empty():
+                return
+            self._wake.wait(0.005)
+            self._wake.clear()
+
+    # ---- health probing / eviction --------------------------------------
+    def _probe_loop(self) -> None:
+        # one probe thread PER SLOT per cycle: a wedged replica blocks its
+        # own probe (up to probe_timeout_s) without delaying detection on
+        # any sibling; a slot whose probe/respawn is still running is
+        # simply skipped this cycle
+        busy = [False] * len(self._slots)
+
+        def probe(slot: int) -> None:
+            try:
+                self._probe_slot(slot)
+            finally:
+                busy[slot] = False
+
+        while not self._stop_probe.wait(self._probe_interval_s):
+            for slot in range(len(self._slots)):
+                if self._stop_probe.is_set():
+                    return
+                if busy[slot]:
+                    continue
+                busy[slot] = True
+                threading.Thread(
+                    target=probe, args=(slot,),
+                    name=f"c2v-fleet-probe-r{slot}", daemon=True,
+                ).start()
+
+    def _probe_slot(self, slot: int) -> None:
+        handle = self._slots[slot]
+        if handle is None:
+            return
+        if not handle.alive:
+            self._evict(slot, reason=handle.death_reason or "process exited")
+            return
+        try:
+            payload = handle.send({"op": "health"}).result(
+                self._probe_timeout_s
+            )
+            handle.last_health = payload
+            handle.probe_failures = 0
+        except Exception as exc:  # noqa: BLE001 - timeout or death
+            handle.probe_failures += 1
+            logger.warning(
+                "replica r%d missed health probe %d/%d: %s",
+                slot, handle.probe_failures, self._max_probe_failures, exc,
+            )
+            if handle.probe_failures >= self._max_probe_failures:
+                self._evict(slot, reason=f"missed {handle.probe_failures} "
+                            "consecutive health probes")
+
+    def _evict(self, slot: int, reason: str) -> None:
+        handle = self._slots[slot]
+        self._evictions.inc()
+        logger.warning("evicting replica r%d: %s", slot, reason)
+        self._emit(
+            "fleet_replica_evicted", slot=slot,
+            incarnation=getattr(handle, "incarnation", None), reason=reason,
+        )
+        try:
+            handle.kill()  # SIGTERM first: the worker drains, then exits
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+        if self._closed.is_set():
+            return
+        incarnation = getattr(handle, "incarnation", 0) + 1
+        try:
+            self._slots[slot] = self._spawn(slot, incarnation)
+            self._respawns.inc()
+            self._wake.set()
+        except Exception as exc:  # noqa: BLE001 - retried next probe cycle
+            logger.error(
+                "respawn of replica r%d failed (%s); retrying next probe "
+                "cycle", slot, exc,
+            )
+
+    # ---- fleet control plane --------------------------------------------
+    def _fleet_health(self) -> dict:
+        replicas = []
+        for slot, handle in enumerate(self._slots):
+            if handle is None:
+                replicas.append({"slot": slot, "alive": False})
+                continue
+            last = handle.last_health or {}
+            replicas.append({
+                "slot": slot,
+                "incarnation": handle.incarnation,
+                "pid": getattr(handle, "pid", None),
+                "alive": handle.alive,
+                "in_flight": handle.in_flight,
+                "probe_failures": handle.probe_failures,
+                "version": last.get("version"),
+                "post_warmup_compiles": last.get("post_warmup_compiles"),
+                "executables": last.get("executables"),
+            })
+        return {
+            "ok": all(r.get("alive") for r in replicas),
+            "fleet": {
+                "replicas": replicas,
+                "slo": {
+                    name: {
+                        "budget": cls.budget,
+                        "deadline_ms": cls.deadline_ms,
+                        "queued": self._queues[name].qsize(),
+                    }
+                    for name, cls in self._slo.items()
+                },
+                "rolling": self._rolling_status(),
+            },
+            **self.health.snapshot(),
+        }
+
+    def _rolling_status(self) -> dict:
+        with self._swap_lock:
+            return {
+                "state": self._rolling["state"],
+                "target": self._rolling["target"],
+                "outcome": self._rolling["outcome"],
+                "replicas": list(self._rolling["replicas"]),
+            }
+
+    def _start_rolling(self, request: dict) -> dict:
+        target = request.get("model_path")
+        wait = bool(request.get("wait", False))
+        with self._swap_lock:
+            if (
+                self._rolling_thread is not None
+                and self._rolling_thread.is_alive()
+            ):
+                raise ValueError(
+                    "a rolling swap is already in progress "
+                    f"(target={self._rolling['target']!r})"
+                )
+            self._rolling = {"state": "running", "target": target,
+                             "outcome": None, "replicas": []}
+            self._rolling_thread = threading.Thread(
+                target=self._rolling_swap, args=(target,),
+                name="c2v-fleet-rolling-swap", daemon=True,
+            )
+            thread = self._rolling_thread
+        self._emit("fleet_swap_started", target=target)
+        thread.start()
+        if wait:
+            thread.join()
+        status = self._rolling_status()
+        payload: dict = {"ok": status["outcome"] != "failed",
+                         "rolling": status}
+        if status["outcome"] == "failed":
+            failures = [
+                r for r in status["replicas"] if r.get("outcome") == "failed"
+            ]
+            payload["error"] = (
+                failures[0].get("error", "rolling swap failed")
+                if failures else "rolling swap failed"
+            )
+            payload["error_kind"] = "swap_failed"
+        return payload
+
+    def _rolling_swap(self, target) -> None:
+        """ONE replica at a time: drive its in-process hot-swap and poll
+        its state machine to completion before touching the next — the
+        fleet never has more than one replica compiling a shadow, and a
+        validation failure stops the roll with the rest untouched."""
+        outcome = "committed"
+        per_replica: list[dict] = []
+        for slot in range(len(self._slots)):
+            handle = self._slots[slot]
+            if handle is None or not handle.alive:
+                per_replica.append({"slot": slot, "outcome": "skipped_dead"})
+                continue
+            entry: dict = {"slot": slot, "incarnation": handle.incarnation}
+            try:
+                response = handle.send(
+                    {"op": "reload", "model_path": target}
+                ).result(self._swap_timeout_s)
+                if response.get("error"):
+                    raise RuntimeError(response["error"])
+                deadline = time.monotonic() + self._swap_timeout_s
+                while True:
+                    status = handle.send({"op": "swap_status"}).result(
+                        self._swap_timeout_s
+                    )
+                    swap = status.get("swap", {})
+                    if swap.get("state") == "idle":
+                        last = swap.get("last_swap") or {}
+                        if last.get("outcome") != "committed":
+                            raise RuntimeError(
+                                "replica swap failed: "
+                                f"{last.get('error', 'unknown error')}"
+                            )
+                        entry["outcome"] = "committed"
+                        entry["version"] = last.get("version")
+                        entry["build_ms"] = last.get("build_ms")
+                        entry["validate_ms"] = last.get("validate_ms")
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"swap did not finish within "
+                            f"{self._swap_timeout_s:.0f} s"
+                        )
+                    time.sleep(0.25)
+            except Exception as exc:  # noqa: BLE001 - abort the roll
+                entry["outcome"] = "failed"
+                entry["error"] = str(exc)
+                per_replica.append(entry)
+                outcome = "failed"
+                logger.warning(
+                    "rolling swap aborted at replica r%d: %s", slot, exc
+                )
+                break
+            per_replica.append(entry)
+            with self._swap_lock:
+                self._rolling["replicas"] = list(per_replica)
+        with self._swap_lock:
+            self._rolling = {"state": "idle", "target": target,
+                             "outcome": outcome, "replicas": per_replica}
+        self._emit(
+            "fleet_swap_committed" if outcome == "committed"
+            else "fleet_swap_failed",
+            target=target, replicas=per_replica,
+        )
+
+    def _fleet_rollback(self) -> dict:
+        """Fan the instant pointer-swap to every live replica."""
+        with self._swap_lock:
+            if (
+                self._rolling_thread is not None
+                and self._rolling_thread.is_alive()
+            ):
+                return {
+                    "error": "cannot roll back during a rolling swap",
+                    "error_kind": "bad_request",
+                }
+        results = []
+        ok = True
+        for slot, handle in enumerate(self._slots):
+            if handle is None or not handle.alive:
+                results.append({"slot": slot, "outcome": "skipped_dead"})
+                continue
+            try:
+                response = handle.send({"op": "rollback"}).result(
+                    self._probe_timeout_s
+                )
+            except Exception as exc:  # noqa: BLE001 - per-replica report
+                response = {"error": str(exc)}
+            if response.get("error"):
+                ok = False
+                results.append({"slot": slot, "outcome": "failed",
+                                "error": response["error"]})
+            else:
+                results.append({
+                    "slot": slot,
+                    "outcome": "rolled_back",
+                    "version": (response.get("swap") or {}).get(
+                        "active_version"
+                    ),
+                })
+        self._emit("fleet_rollback", replicas=results)
+        return {"ok": ok, "replicas": results}
+
+    def _fleet_swap_status(self) -> dict:
+        per_replica = []
+        for slot, handle in enumerate(self._slots):
+            if handle is None or not handle.alive:
+                per_replica.append({"slot": slot, "alive": False})
+                continue
+            try:
+                status = handle.send({"op": "swap_status"}).result(
+                    self._probe_timeout_s
+                )
+                per_replica.append({"slot": slot,
+                                    "swap": status.get("swap")})
+            except Exception as exc:  # noqa: BLE001 - per-replica report
+                per_replica.append({"slot": slot, "error": str(exc)})
+        return {
+            "ok": True,
+            "rolling": self._rolling_status(),
+            "replicas": per_replica,
+        }
+
+    # ---- lifecycle ------------------------------------------------------
+    def close(self, timeout: float = 60.0) -> None:
+        """Drain the class queues through the fleet, then stop every
+        replica gracefully. Idempotent."""
+        self._closed.set()
+        self._wake.set()
+        self._dispatcher.join(timeout)
+        self._stop_probe.set()
+        self._prober.join(self._probe_interval_s + 5.0)
+        rolling = self._rolling_thread
+        if rolling is not None and rolling.is_alive():
+            rolling.join(timeout)
+        threads = []
+        for handle in self._slots:
+            if handle is None:
+                continue
+            t = threading.Thread(
+                target=handle.stop, kwargs={"timeout": timeout}, daemon=True
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout)
+        # final sweep: an item admitted in the close race, or re-queued by
+        # a late replica-death callback AFTER the dispatcher exited, can
+        # never be dispatched — resolve it loudly instead of stranding its
+        # caller on a future that never completes (the same poll-gap class
+        # the micro-batcher's close fix covers one level down)
+        leftovers = list(self._retries)
+        self._retries.clear()
+        for cls, head in self._heads.items():
+            if head is not None:
+                leftovers.append(head)
+                self._heads[cls] = None
+        for q in self._queues.values():
+            while True:
+                try:
+                    leftovers.append(q.get_nowait())
+                except queue.Empty:
+                    break
+        for item in leftovers:
+            self._fail_item(
+                item, "fleet router closed before dispatch", kind="closed"
+            )
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()

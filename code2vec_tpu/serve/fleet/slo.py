@@ -1,0 +1,117 @@
+"""SLO classes: per-op queue budgets and deadlines, tiered shedding.
+
+The single-process batcher bounds load with ONE global ``max_pending`` —
+correct for one queue, wrong for a fleet where a burst of expensive
+``neighbors`` scans must not starve cheap ``embed`` calls or the health
+probes that decide evictions. Every op maps to one of three classes:
+
+==========  ======================================  ==================
+class       ops                                      default budget/deadline
+==========  ======================================  ==================
+health      health, swap_status, reload, rollback,   16 queued / 1000 ms
+            shutdown (the control plane)
+embed       predict, embed                           256 queued / 2000 ms
+neighbors   neighbors                                 64 queued / 5000 ms
+==========  ======================================  ==================
+
+Each DATA class owns a bounded router queue (its **budget** — admission
+control: a full queue sheds new arrivals with a retryable ``overloaded``
+error) and a **deadline**: a request still undispatched past its
+deadline is shed with a ``deadline`` error instead of being served
+uselessly late (its client has typically given up — serving it anyway is
+pure queue poison). Dispatch priority is the tier order above; under
+sustained overload the lowest tier backs up and sheds first. The
+``health`` tier is how the control plane cuts through saturated traffic:
+the router answers/orchestrates those ops INLINE at admission — they
+never enter a data queue, so no data-plane backlog can delay a probe or
+a swap (its budget/deadline numbers are accepted for config symmetry but
+currently have nothing to bound).
+
+``--slo`` grammar: ``class=budget:deadline_ms`` comma-separated, e.g.
+``embed=512:1500,neighbors=32:8000`` (unnamed classes keep defaults).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "DEFAULT_SLO",
+    "PRIORITY",
+    "SloClass",
+    "classify_op",
+    "parse_slo_spec",
+]
+
+
+@dataclass(frozen=True)
+class SloClass:
+    """One service tier: admission budget + usefulness deadline."""
+
+    name: str
+    budget: int  # max queued (not yet dispatched) requests router-wide
+    deadline_ms: float  # shed instead of dispatching past this age
+
+    def __post_init__(self) -> None:
+        if self.budget < 1:
+            raise ValueError(f"{self.name}: budget must be >= 1, got "
+                             f"{self.budget}")
+        if self.deadline_ms <= 0:
+            raise ValueError(f"{self.name}: deadline_ms must be > 0, got "
+                             f"{self.deadline_ms}")
+
+
+DEFAULT_SLO: dict[str, SloClass] = {
+    "health": SloClass("health", budget=16, deadline_ms=1000.0),
+    "embed": SloClass("embed", budget=256, deadline_ms=2000.0),
+    "neighbors": SloClass("neighbors", budget=64, deadline_ms=5000.0),
+}
+
+# dispatch order under contention: control plane > embed > neighbors
+PRIORITY: tuple[str, ...] = ("health", "embed", "neighbors")
+
+_OP_CLASS = {
+    "predict": "embed",
+    "embed": "embed",
+    "neighbors": "neighbors",
+    "health": "health",
+    "swap_status": "health",
+    "reload": "health",
+    "rollback": "health",
+    "shutdown": "health",
+}
+
+
+def classify_op(op) -> str | None:
+    """SLO class name for one request op; None = unknown op."""
+    return _OP_CLASS.get(op)
+
+
+def parse_slo_spec(
+    spec: str | None, base: dict[str, SloClass] | None = None
+) -> dict[str, SloClass]:
+    """Parse ``class=budget:deadline_ms,...`` over ``base`` defaults."""
+    classes = dict(base if base is not None else DEFAULT_SLO)
+    if not spec:
+        return classes
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            name, rest = clause.split("=", 1)
+            budget, deadline = rest.split(":", 1)
+        except ValueError:
+            raise ValueError(
+                f"bad --slo clause {clause!r}: expected "
+                "class=budget:deadline_ms"
+            ) from None
+        name = name.strip()
+        if name not in classes:
+            raise ValueError(
+                f"unknown SLO class {name!r}; have {sorted(classes)}"
+            )
+        classes[name] = SloClass(
+            name, budget=int(budget), deadline_ms=float(deadline)
+        )
+    return classes

@@ -22,7 +22,19 @@ Request schema (one ``op`` per object; unknown fields ignored)::
     {"op": "embed",     ... same selectors ...}
     {"op": "neighbors", "vector": [...] | source selectors, "top_k": 5}
     {"op": "health"}
+    {"op": "reload",    "model_path": str, "wait": false}   # hot-swap
+    {"op": "rollback"}
+    {"op": "swap_status"}
     {"op": "shutdown"}
+
+The three control ops drive **live checkpoint hot-swap**
+(:mod:`code2vec_tpu.serve.swap`): ``reload`` shadow-compiles the target
+checkpoint's full executable ladder on a background thread, validates it
+against the golden request set, and atomically swaps the serving pointer;
+``rollback`` swaps back to the still-resident previous generation;
+``swap_status`` reports the state machine. Every data request snapshots
+its generation AT SUBMISSION, so in-flight requests drain through the
+generation they were submitted to — a swap never drops them.
 
 Responses echo an optional ``"id"`` field (client-side correlation) and
 carry ``"error"`` instead of results on failure; :class:`~code2vec_tpu
@@ -36,13 +48,31 @@ import json
 import logging
 import queue
 import threading
+import time
 from typing import Callable
 
 import numpy as np
 
+from code2vec_tpu.serve.swap import Generation, SwapController
+
 logger = logging.getLogger(__name__)
 
-__all__ = ["CodeServer", "serve_stdio", "serve_http", "make_http_server"]
+__all__ = [
+    "CodeServer",
+    "make_http_server",
+    "run_transport",
+    "serve_http",
+    "serve_stdio",
+]
+
+# ops that get per-op obs metrics (`serve.op.<op>.e2e_ms` latency +
+# `serve.op.<op>.requests`/`.errors` counters — one schema for dashboards
+# and the fleet router's shedding decisions); unknown ops are excluded so
+# garbage requests cannot grow the registry unboundedly
+INSTRUMENTED_OPS = (
+    "predict", "embed", "neighbors", "health",
+    "reload", "rollback", "swap_status",
+)
 
 
 def _topk_predictions(logits: np.ndarray, label_vocab, top_k: int) -> list[dict]:
@@ -63,29 +93,77 @@ class CodeServer:
     ``predictor`` supplies vocab mapping and extraction (it already knows
     the corpus's extraction params and the ``@question`` framing);
     ``engine``/``batcher`` run the compiled forwards; ``retrieval`` is
-    optional (the ``neighbors`` op errors cleanly without it).
+    optional (the ``neighbors`` op errors cleanly without it). The four
+    live in one :class:`~code2vec_tpu.serve.swap.Generation` behind a
+    :class:`~code2vec_tpu.serve.swap.SwapController`; ``factory`` (a
+    ``build(target) -> Generation`` callable) plus ``golden`` enable the
+    ``reload``/``rollback`` hot-swap control ops.
     """
 
     def __init__(
         self, predictor, engine, batcher, retrieval=None, health=None,
+        *, version: str = "v0", factory=None, golden=None, events=None,
     ) -> None:
         from code2vec_tpu.obs.runtime import global_health
 
-        self.predictor = predictor
-        self.engine = engine
-        self.batcher = batcher
-        self.retrieval = retrieval
         self.health = health or global_health()
+        self.swap = SwapController(
+            Generation(
+                version=version, predictor=predictor, engine=engine,
+                batcher=batcher, retrieval=retrieval,
+            ),
+            build=factory, golden=golden, health=self.health, events=events,
+        )
         self._shutdown = threading.Event()
+
+    # ---- the active generation (swap-aware accessors) -------------------
+    # setters write into the CURRENT generation — existing callers (and
+    # tests) that monkeypatch e.g. `server.batcher` keep working
+    @property
+    def predictor(self):
+        return self.swap.active.predictor
+
+    @predictor.setter
+    def predictor(self, value) -> None:
+        self.swap.active.predictor = value
+
+    @property
+    def engine(self):
+        return self.swap.active.engine
+
+    @engine.setter
+    def engine(self, value) -> None:
+        self.swap.active.engine = value
+
+    @property
+    def batcher(self):
+        return self.swap.active.batcher
+
+    @batcher.setter
+    def batcher(self, value) -> None:
+        self.swap.active.batcher = value
+
+    @property
+    def retrieval(self):
+        return self.swap.active.retrieval
+
+    @retrieval.setter
+    def retrieval(self, value) -> None:
+        self.swap.active.retrieval = value
 
     # ---- lifecycle ------------------------------------------------------
     @property
     def shutdown_requested(self) -> bool:
         return self._shutdown.is_set()
 
+    def request_shutdown(self) -> None:
+        """Mark the server as shutting down (the SIGTERM handler's hook:
+        transports stop accepting, drain what was accepted, then exit)."""
+        self._shutdown.set()
+
     def close(self) -> None:
-        """Drain in-flight requests and stop the batcher."""
-        self.batcher.close()
+        """Drain in-flight requests and stop every resident generation."""
+        self.swap.close()
 
     # ---- request handling ----------------------------------------------
     def handle(self, request: dict) -> dict:
@@ -112,28 +190,98 @@ class CodeServer:
                 payload = {"id": req_id, **payload}
             return payload
 
+        op = request.get("op")
         try:
-            op = request.get("op")
+            # data requests snapshot the generation HERE: a swap that
+            # commits between submission and resolve must not reroute an
+            # in-flight request — it drains through the generation it was
+            # submitted to (whose batcher stays alive until retirement)
+            gen = self.swap.active
             if op == "health":
                 # resolve-time snapshot: in a pipelined stream the health
                 # line reports the state AFTER the requests ahead of it,
                 # not the instant it was read off the wire
-                return lambda: finish(self._health_payload())
-            if op == "shutdown":
+                resolver = self._health_payload
+            elif op == "shutdown":
                 self._shutdown.set()
-                return lambda: finish({"ok": True, "shutting_down": True})
-            if op in ("predict", "embed"):
-                resolver = self._submit_methods(request, op)
-                return lambda: finish(resolver())
-            if op == "neighbors":
-                resolver = self._submit_neighbors(request)
-                return lambda: finish(resolver())
-            return lambda: finish(
-                {"error": f"unknown op {op!r}", "error_kind": "bad_request"}
-            )
+                payload = {"ok": True, "shutting_down": True}
+                resolver = lambda: payload  # noqa: E731
+            elif op in ("predict", "embed"):
+                resolver = self._submit_methods(request, op, gen)
+            elif op == "neighbors":
+                resolver = self._submit_neighbors(request, gen)
+            elif op == "reload":
+                status = self.swap.reload(
+                    request.get("model_path"),
+                    wait=bool(request.get("wait", False)),
+                )
+                resolver = self._swap_resolver(status)
+            elif op == "rollback":
+                status = self.swap.rollback()
+                resolver = self._swap_resolver(status)
+            elif op == "swap_status":
+                status = self.swap.status()
+                resolver = lambda: {"ok": True, "swap": status}  # noqa: E731
+            else:
+                payload = {
+                    "error": f"unknown op {op!r}",
+                    "error_kind": "bad_request",
+                }
+                resolver = lambda: payload  # noqa: E731
         except Exception as exc:  # noqa: BLE001 - protocol boundary
             payload = self._error_payload(exc)
-            return lambda: finish(payload)
+            resolver = lambda: payload  # noqa: E731
+        return self._instrument(op, resolver, finish)
+
+    def _instrument(
+        self, op, resolver: Callable[[], dict], finish: Callable[[dict], dict]
+    ) -> Callable[[], dict]:
+        """Per-op obs metrics around the resolver: one latency histogram +
+        request/error counters per SLO-relevant op, on the same registry
+        as the batcher's phase histograms (ONE metric schema)."""
+        if op not in INSTRUMENTED_OPS:
+            return lambda: finish(resolver())
+        t0 = time.perf_counter()
+        self.health.counter(f"serve.op.{op}.requests").inc()
+
+        def run() -> dict:
+            try:
+                payload = resolver()
+            except Exception:
+                # resolve-time failures (a future carrying the device
+                # call's exception, a retired generation's closed batcher)
+                # are exactly what error dashboards must see — count them
+                # before the transport maps the exception to a payload
+                self.health.latency(f"serve.op.{op}.e2e_ms").record(
+                    (time.perf_counter() - t0) * 1e3
+                )
+                self.health.counter(f"serve.op.{op}.errors").inc()
+                raise
+            self.health.latency(f"serve.op.{op}.e2e_ms").record(
+                (time.perf_counter() - t0) * 1e3
+            )
+            if "error" in payload:
+                self.health.counter(f"serve.op.{op}.errors").inc()
+            return finish(payload)
+
+        return run
+
+    @staticmethod
+    def _swap_resolver(status: dict) -> Callable[[], dict]:
+        # a swap still running (wait=false) is an accepted request, not a
+        # failure — only an idle state whose latest outcome is "failed"
+        # reports the error (and then it IS this reload's: reload() flips
+        # the state to building before the status snapshot, so an idle
+        # snapshot means the started swap already finished)
+        failed = (
+            status.get("state") == "idle"
+            and (status.get("last_swap") or {}).get("outcome") == "failed"
+        )
+        payload: dict = {"ok": not failed, "swap": status}
+        if failed:
+            payload["error"] = status["last_swap"].get("error", "swap failed")
+            payload["error_kind"] = "swap_failed"
+        return lambda: payload
 
     @staticmethod
     def _error_payload(exc: BaseException) -> dict:
@@ -150,11 +298,26 @@ class CodeServer:
             logger.exception("request failed")
         return {"error": f"{type(exc).__name__}: {exc}", "error_kind": kind}
 
+    # transports map error kinds to HTTP statuses with this table; the
+    # fleet router adds "deadline"/"unavailable" kinds of its own
+    HTTP_STATUS = {
+        None: 200,
+        "bad_request": 400,
+        "overloaded": 429,
+        "deadline": 429,
+        "closed": 503,
+        "unavailable": 503,
+        "swap_failed": 500,
+        "internal": 500,
+    }
+
     # ---- ops ------------------------------------------------------------
     def _health_payload(self) -> dict:
-        engine = self.engine
+        gen = self.swap.active
+        engine = gen.engine
         return {
             "ok": True,
+            "version": gen.version,
             "ladder": list(engine.active_ladder),
             "batch_sizes": list(engine.batch_sizes),
             "executables": engine._cache_size(),
@@ -164,18 +327,22 @@ class CodeServer:
             # provenance: exact reports size + compiled query fns; ann
             # adds n_list/n_probe/shortlist and its LUT-kernel schedule
             "retrieval": (
-                self.retrieval.describe()
-                if self.retrieval is not None
+                gen.retrieval.describe()
+                if gen.retrieval is not None
                 else None
             ),
+            "swap": self.swap.status(),
             **self.health.snapshot(),
         }
 
-    def _submit_methods(self, request: dict, op: str) -> Callable[[], dict]:
+    def _submit_methods(
+        self, request: dict, op: str, gen: Generation
+    ) -> Callable[[], dict]:
+        predictor, engine, batcher = gen.predictor, gen.engine, gen.batcher
         source = request.get("source")
         if not isinstance(source, str) or not source.strip():
             raise ValueError(f"{op!r} needs a non-empty 'source' string")
-        if op == "predict" and not self.predictor.meta.get(
+        if op == "predict" and not predictor.meta.get(
             "infer_method_name", True
         ):
             # same guard as Predictor.predict_source: a variable-task-only
@@ -192,24 +359,24 @@ class CodeServer:
         # extraction + vocab mapping on THIS thread (CPU-bound, no device):
         # the batcher only ever sees mapped id arrays
         submitted = []  # (label, n_oov, future | None, n_contexts)
-        for label, contexts, _ in self.predictor._extract(
+        for label, contexts, _ in predictor._extract(
             source, method_name, language
         ):
-            mapped, n_oov = self.predictor._map_contexts(contexts)
-            if len(mapped) > self.engine.max_width:
+            mapped, n_oov = predictor._map_contexts(contexts)
+            if len(mapped) > engine.max_width:
                 # same seeded subsample rule as the offline Predictor
                 rng = np.random.default_rng(0)
                 keep = rng.choice(
-                    len(mapped), self.engine.max_width, replace=False
+                    len(mapped), engine.max_width, replace=False
                 )
                 mapped = [mapped[i] for i in sorted(keep)]
             if not mapped:
                 submitted.append((label, n_oov, None, 0))
                 continue
             arr = np.asarray(mapped, np.int32).reshape(-1, 3)
-            submitted.append((label, n_oov, self.batcher.submit(arr), len(mapped)))
+            submitted.append((label, n_oov, batcher.submit(arr), len(mapped)))
 
-        label_vocab = self.predictor.label_vocab
+        label_vocab = predictor.label_vocab
 
         def resolve() -> dict:
             methods = []
@@ -246,8 +413,11 @@ class CodeServer:
 
         return resolve
 
-    def _submit_neighbors(self, request: dict) -> Callable[[], dict]:
-        if self.retrieval is None:
+    def _submit_neighbors(
+        self, request: dict, gen: Generation
+    ) -> Callable[[], dict]:
+        retrieval = gen.retrieval
+        if retrieval is None:
             raise ValueError(
                 "no retrieval index loaded — start the server with "
                 "--code_vec_path (an exported code.vec)"
@@ -256,12 +426,12 @@ class CodeServer:
         vector = request.get("vector")
         if vector is not None:
             vec = np.asarray(vector, np.float32)
-            if vec.shape != (self.retrieval.dim,):
+            if vec.shape != (retrieval.dim,):
                 raise ValueError(
-                    f"'vector' must have dim {self.retrieval.dim}, got "
+                    f"'vector' must have dim {retrieval.dim}, got "
                     f"{vec.shape}"
                 )
-            neighbors = self.retrieval.top_k(vec, top_k)
+            neighbors = retrieval.top_k(vec, top_k)
             payload = {
                 "ok": True,
                 "neighbors": [
@@ -275,9 +445,8 @@ class CodeServer:
         # the CLIENT also asked for the vector so their flag survives
         want_vector = bool(request.get("include_vector", False))
         embed_resolver = self._submit_methods(
-            {**request, "include_vector": True}, "embed"
+            {**request, "include_vector": True}, "embed", gen
         )
-        retrieval = self.retrieval
 
         def resolve() -> dict:
             embedded = embed_resolver()
@@ -302,31 +471,52 @@ class CodeServer:
 # ---------------------------------------------------------------------------
 
 
-def serve_stdio(server: CodeServer, in_stream, out_stream) -> None:
+def serve_stdio(
+    server: CodeServer, in_stream, out_stream, stop_event=None
+) -> None:
     """JSONL over any line-iterable/writable stream pair (stdin/stdout in
     production, in-memory pipes in tests). Responses keep request order;
-    submission outpaces resolution, so pipelined clients coalesce."""
+    submission outpaces resolution, so pipelined clients coalesce.
+
+    ``stop_event`` (the SIGTERM path — ``__main__`` wires its handler to
+    it): when set, the loop stops WAITING for new requests but still
+    RESOLVES everything already accepted — every submitted request gets
+    its response written before the process exits (the drain contract
+    fleet eviction relies on; without it queued requests die with the
+    process)."""
     pending: "queue.Queue" = queue.Queue()
     _EOF = object()
+    # set while the reader holds a line it has not yet enqueued a resolver
+    # for — the SIGTERM drain must not declare the stream empty while a
+    # read-but-unsubmitted request is still in the reader's hands (source
+    # extraction inside handle_async can take well over the poll window)
+    reader_busy = threading.Event()
 
     def reader() -> None:
         try:
             for line in in_stream:
-                line = line.strip()
-                if not line:
-                    continue
+                reader_busy.set()
                 try:
-                    request = json.loads(line)
-                    if not isinstance(request, dict):
-                        raise ValueError("request must be a JSON object")
-                except ValueError as exc:
-                    payload = {
-                        "error": f"bad request line: {exc}",
-                        "error_kind": "bad_request",
-                    }
-                    pending.put(lambda payload=payload: payload)
-                    continue
-                pending.put(server.handle_async(request))
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        request = json.loads(line)
+                        if not isinstance(request, dict):
+                            raise ValueError("request must be a JSON object")
+                    except ValueError as exc:
+                        # malformed JSONL — including a mid-stream EOF's
+                        # truncated final line — answers with a structured
+                        # error and the stream keeps serving
+                        payload = {
+                            "error": f"bad request line: {exc}",
+                            "error_kind": "bad_request",
+                        }
+                        pending.put(lambda payload=payload: payload)
+                        continue
+                    pending.put(server.handle_async(request))
+                finally:
+                    reader_busy.clear()
                 if server.shutdown_requested:
                     break
         finally:
@@ -334,9 +524,25 @@ def serve_stdio(server: CodeServer, in_stream, out_stream) -> None:
 
     thread = threading.Thread(target=reader, name="c2v-serve-stdin", daemon=True)
     thread.start()
+    empty_strikes = 0
     try:
         while True:
-            resolver = pending.get()
+            try:
+                resolver = pending.get(timeout=0.1)
+            except queue.Empty:
+                if (
+                    stop_event is not None
+                    and stop_event.is_set()
+                    and not reader_busy.is_set()
+                ):
+                    # SIGTERM drain: the reader holds nothing and two
+                    # consecutive empty polls (200 ms) passed — everything
+                    # accepted has been resolved and written; exit cleanly
+                    empty_strikes += 1
+                    if empty_strikes >= 2:
+                        break
+                continue
+            empty_strikes = 0
             if resolver is _EOF:
                 break
             try:
@@ -387,13 +593,7 @@ def make_http_server(server: CodeServer, host: str, port: int):
                 return
             response = server.handle(request)
             kind = response.get("error_kind")
-            code = {
-                None: 200,
-                "bad_request": 400,
-                "overloaded": 429,
-                "closed": 503,
-                "internal": 500,
-            }.get(kind, 200)
+            code = CodeServer.HTTP_STATUS.get(kind, 200)
             self._respond(code, response)
             if server.shutdown_requested:
                 threading.Thread(
@@ -416,3 +616,42 @@ def serve_http(server: CodeServer, host: str, port: int) -> None:
     finally:
         server.close()
         httpd.server_close()
+
+
+def run_transport(server, transport: str, host: str, port: int) -> None:
+    """The SIGTERM-draining transport loop shared by the serve and fleet
+    CLIs: SIGTERM stops ACCEPTING, resolves + writes a response for
+    everything already accepted (stdio writer drain + server close drain),
+    and exits 0 — the contract fleet eviction and rolling restarts rely
+    on. ``server`` is anything with the CodeServer surface (CodeServer
+    itself, or the fleet router)."""
+    import signal
+    import sys
+
+    stop_event = threading.Event()
+    httpd_box: list = []
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 - signal API
+        logger.info("SIGTERM: draining accepted requests, then exiting")
+        stop_event.set()
+        server.request_shutdown()
+        for httpd in httpd_box:
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    previous_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    try:
+        if transport == "stdio":
+            serve_stdio(server, sys.stdin, sys.stdout, stop_event=stop_event)
+        else:
+            httpd = make_http_server(server, host, port)
+            httpd_box.append(httpd)
+            try:
+                logger.info(
+                    "serving HTTP on %s:%d", *httpd.server_address[:2]
+                )
+                httpd.serve_forever(poll_interval=0.1)
+            finally:
+                server.close()
+                httpd.server_close()
+    finally:
+        signal.signal(signal.SIGTERM, previous_handler)

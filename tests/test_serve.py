@@ -295,6 +295,43 @@ def test_graceful_shutdown_drains_in_flight(engine):
         b.submit(requests_of([3])[0])
 
 
+def test_close_drains_request_enqueued_in_poll_gap(engine):
+    """Regression (fleet eviction path): a request accepted just as the
+    batcher thread's idle poll times out and close() flips the flag must
+    still be SERVED by the final drain — not failed by close()'s sweep.
+    The race is forced deterministically: a queue whose timeout-ful get
+    claims to be empty, so the loop can only see the item through the
+    post-closed get_nowait drain."""
+    import queue as _queue
+
+    class RacyQueue(_queue.Queue):
+        force_empty = False
+
+        def get(self, block=True, timeout=None):
+            if self.force_empty and timeout is not None:
+                raise _queue.Empty
+            return super().get(block, timeout)
+
+    b = MicroBatcher(engine, deadline_ms=0.0, health=RuntimeHealth())
+    racy = RacyQueue(maxsize=256)
+    racy.force_empty = True  # the polling loop never sees the item
+    b._queue = racy
+    future = b.submit(requests_of([5])[0])
+    b.close()
+    result = future.result(timeout=30)  # old code: ServerClosed here
+    assert result.n_contexts == 5
+    assert np.isfinite(result.code_vector).all()
+
+
+def test_queue_depth_gauge_exported(engine):
+    health = RuntimeHealth()
+    with MicroBatcher(engine, deadline_ms=0.0, health=health) as b:
+        b.submit(requests_of([4])[0]).result(timeout=60)
+    gauges = health.snapshot()["gauges"]
+    assert "serve_queue_depth" in gauges  # one obs schema, no ad-hoc state
+    assert gauges["serve_queue_depth"] == 0  # drained
+
+
 def test_engine_errors_propagate_to_futures(engine):
     class _Exploding(_GatedEngine):
         def run(self, *a):
@@ -670,6 +707,78 @@ def test_server_bad_requests(served):
     assert resp["error_kind"] == "bad_request"
 
 
+def test_protocol_error_paths_are_structured_never_fatal(served):
+    """Satellite contract: malformed JSONL, unknown op, oversized bag and
+    mid-stream EOF each produce a structured error response — the worker
+    process must never crash on any of them (fleet probing would read a
+    crash as an eviction)."""
+    from code2vec_tpu.serve.protocol import serve_stdio
+
+    # oversized bag: the protocol normally subsamples to the bag, so the
+    # batcher's loud submit-time reject is the defense line — pin that a
+    # bag overflow surfaces as a structured bad_request, never an escape
+    class _OversizeBatcher:
+        def submit(self, arr):
+            raise ValueError(
+                f"request has {len(arr)} contexts, more than the model's "
+                "max bag width 4; subsample before submitting"
+            )
+
+    real_batcher = served.batcher
+    served.batcher = _OversizeBatcher()
+    try:
+        resp = served.handle(
+            {"op": "embed", "source": PY, "language": "python"}
+        )
+    finally:
+        served.batcher = real_batcher
+    assert resp["error_kind"] == "bad_request"
+    assert "max bag width" in resp["error"]
+
+    in_lines = [
+        '{"op": "health", "id": 1}\n',
+        "{not json at all\n",
+        '{"op": "frobnicate", "id": 2}\n',
+        '["a", "list", "not", "object"]\n',
+        '{"op": "embed", "id": 3}\n',            # missing source
+        '{"op": "neighbors", "vector": "x"}\n',  # malformed vector
+        '{"op": "health", "id": 4',              # mid-stream EOF: truncated
+    ]
+
+    class _Out:
+        lines: list = []
+
+        def write(self, s):
+            self.lines.append(s)
+
+        def flush(self):
+            pass
+
+    out = _Out()
+    out.lines = []
+    serve_stdio(served, iter(in_lines), out)
+    responses = [json.loads(line) for line in out.lines]
+    assert len(responses) == len(in_lines)
+    assert responses[0]["ok"] and responses[0]["id"] == 1
+    for bad in (1, 2, 3, 4, 5, 6):
+        assert responses[bad]["error_kind"] == "bad_request", responses[bad]
+    assert "bad request line" in responses[1]["error"]
+    assert "unknown op" in responses[2]["error"]
+    assert "bad request line" in responses[6]["error"]  # the truncated tail
+
+
+def test_per_op_metrics_one_schema(served):
+    served.handle({"op": "predict", "source": PY, "language": "python"})
+    served.handle({"op": "health"})
+    served.handle({"op": "nope"})
+    snap = served.health.snapshot()
+    assert snap["counters"]["serve.op.predict.requests"] >= 1
+    assert snap["counters"]["serve.op.health.requests"] >= 1
+    assert snap["latencies_ms"]["serve.op.predict.e2e_ms"]["count"] >= 1
+    # unknown ops never mint metric names
+    assert "serve.op.nope.requests" not in snap["counters"]
+
+
 def test_variable_only_checkpoint_rejects_predict_op(served):
     """Same guard as Predictor.predict_source: a variable-task-only head
     must not serve method-name predictions (embed still works — the code
@@ -868,3 +977,49 @@ def test_cli_stdio_end_to_end(trained_py):
     assert by_id[98]["post_warmup_compiles"] == 0
     assert by_id[98]["counters"]["serve_requests"] >= 12  # 4 reqs x 3 methods
     assert by_id[99]["shutting_down"]
+
+
+def test_cli_sigterm_drains_accepted_requests(trained_py):
+    """Satellite regression: SIGTERM mid-stream must DRAIN — every
+    request written before the signal gets its response, the process
+    exits 0 (the contract fleet eviction and rolling restarts rely on;
+    previously queued requests died with the process)."""
+    import signal
+
+    ds, out = trained_py
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "code2vec_tpu.serve",
+            "--model_path", str(out),
+            "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+            "--path_idx_path", str(ds / "path_idxs.txt"),
+            "--transport", "stdio",
+            "--deadline_ms", "5",
+        ],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, bufsize=1, env=env,
+    )
+    try:
+        n_requests = 6
+        for i in range(n_requests):
+            proc.stdin.write(json.dumps({
+                "id": i, "op": "embed", "source": PY, "language": "python",
+                "method_name": "add",
+            }) + "\n")
+        proc.stdin.flush()
+        # first response proves the server is mid-stream, then SIGTERM
+        first = json.loads(proc.stdout.readline())
+        assert first["ok"]
+        proc.send_signal(signal.SIGTERM)
+        remaining = [json.loads(line) for line in proc.stdout]
+        stderr = proc.stderr.read()
+        returncode = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:  # pragma: no cover - hung server
+            proc.kill()
+    assert returncode == 0, stderr[-4000:]
+    responses = [first] + remaining
+    # every accepted request was answered before exit
+    assert sorted(r["id"] for r in responses) == list(range(n_requests))
+    assert all(r["ok"] for r in responses), responses

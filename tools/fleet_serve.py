@@ -1,0 +1,9 @@
+#!/usr/bin/env python
+"""Thin wrapper: ``python tools/fleet_serve.py`` == ``python -m
+code2vec_tpu.serve.fleet`` (router + N replica workers + rolling live
+checkpoint hot-swap; see docs/ARCHITECTURE.md "Fleet serving")."""
+
+from code2vec_tpu.serve.fleet.__main__ import main
+
+if __name__ == "__main__":
+    main()
