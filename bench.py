@@ -81,6 +81,8 @@ def _metric_id() -> tuple[str, str]:
         return "mmap_csr_real_contexts_per_sec", "contexts/sec"
     if "--ann-ab" in sys.argv[1:]:
         return "ann_queries_per_sec", "queries/sec"
+    if "--longbag-ab" in sys.argv[1:]:
+        return "longbag_real_contexts_per_sec", "contexts/sec"
     return "path_contexts_per_sec_per_chip", "contexts/sec"
 
 
@@ -998,6 +1000,283 @@ def _bucket_ab() -> None:
                 "unit": "contexts/sec",
                 # in AB mode the baseline IS the same-spec fixed-L arm
                 "vs_baseline": round(speedup, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _longbag_ab() -> None:
+    """``--longbag-ab``: truncated-at-top-rung vs chunked (longbag) A/B.
+
+    Heavy-tailed synthetic corpus (lognormal bag lengths); the truncated
+    arm is today's default — every bag subsampled down to ``BENCH_BAG``
+    and batched over the base bucket ladder — while the chunked arm feeds
+    the SAME corpus with ``--max_contexts 0`` semantics: the ladder grows
+    longbag rungs above the base top (multiples of the kernel chunk) and
+    those widths stream through the fused kernel's flash-style chunked
+    softmax (interpret mode on CPU; the same code path the TPU compiles).
+    One model config (longbag dispatch) and ONE step function serve both
+    arms — base widths run identically in both — so the recompile
+    detector's budget is exactly the full ladder. ABBA best-of like the
+    other arms.
+
+    Reported: per-arm REAL-context accounting (the chunked arm does
+    strictly more real work — ``truncated_context_fraction`` goes to 0
+    there, and that is the headline honesty number), per-arm wall clock
+    and real-context throughput, the eval-F1 of each arm's trained state
+    on UN-truncated test bags (the delta is what truncation costs), and
+    the zero-post-warmup-recompiles verdict (the run FAILS on churn).
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.pipeline import (
+        build_method_epoch,
+        derive_bucket_ladder,
+        derive_longbag_ladder,
+        epoch_context_counts,
+        iter_batches,
+        iter_bucketed_batches,
+        truncated_fraction_of_counts,
+    )
+    from code2vec_tpu.data.synth import (
+        SynthSpec,
+        corpus_data_from_raw,
+        generate_corpus_data,
+    )
+    from code2vec_tpu.metrics import evaluate
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.obs.runtime import RecompileDetector, memory_snapshot
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import (
+        create_train_state,
+        make_eval_step,
+        make_train_step,
+    )
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    batch_size = knob("BENCH_BATCH", 256, 8)
+    bag = knob("BENCH_BAG", 200, 16)
+    steps = knob("BENCH_AB_STEPS", 20, 2)
+    embed_size = knob("BENCH_EMBED", 100, 4)
+    encode_size = knob("BENCH_ENCODE", 100, 8)
+    mean_ctx = knob("BENCH_AB_MEAN_CTX", 60, 10)
+    chunk_l = knob("BENCH_PALLAS_CHUNK_L", 128, 128)
+    sigma = _env_float("BENCH_LENGTH_SIGMA", 1.2)
+
+    # heavy tail past the bag cap IS the experiment: a lognormal with
+    # sigma >= 1 puts a real fraction of contexts beyond BENCH_BAG, which
+    # the truncated arm silently drops and the chunked arm streams
+    spec = SynthSpec(
+        n_methods=max(batch_size * steps * 2, 64),
+        n_terminals=knob("BENCH_AB_TERMINALS", 100_000, 200),
+        n_paths=knob("BENCH_AB_PATHS", 100_000, 150),
+        n_labels=knob("BENCH_AB_LABELS", 2_000, 20),
+        mean_contexts=float(mean_ctx),
+        length_sigma=sigma,
+        max_contexts=16 * bag,
+        seed=0,
+    )
+    data = corpus_data_from_raw(generate_corpus_data(spec))
+    counts = np.diff(data.row_splits)
+    base_ladder = derive_bucket_ladder(counts, bag)
+    lengths, weights = np.unique(counts, return_counts=True)
+    longbag_rungs = derive_longbag_ladder(
+        lengths, weights, bag, chunk_l=chunk_l
+    )
+    full_ladder = tuple(base_ladder) + longbag_rungs
+    top_width = full_ladder[-1]
+
+    # ONE model config drives both arms: base widths dispatch exactly as
+    # the truncated arm would alone, widths above `bag` force the fused
+    # kernel's online chunked softmax (the longbag_width dispatch)
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(data.label_vocab),
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,
+        dropout_prob=0.0,
+        dtype=jnp.float32,
+        use_pallas=True,
+        pallas_impl="pool_only",
+        pallas_block_b=min(8, batch_size),
+        pallas_chunk_l=chunk_l,
+        longbag_width=bag,
+    )
+    config = TrainConfig(
+        batch_size=batch_size,
+        max_path_length=bag,
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
+    )
+    class_weights = jnp.ones(model_config.label_count, jnp.float32)
+
+    split = max(int(spec.n_methods * 0.8), 1)
+    train_items = np.arange(split)
+    test_items = np.arange(split, spec.n_methods)
+
+    # one epoch build per arm: truncated subsamples down to `bag`, the
+    # chunked build keeps every context up to the top longbag rung
+    epoch_truncated = build_method_epoch(
+        data, train_items, bag, np.random.default_rng(1)
+    )
+    epoch_full = build_method_epoch(
+        data, train_items, top_width, np.random.default_rng(1)
+    )
+    real_truncated = int(epoch_context_counts(epoch_truncated).sum())
+    real_full = int(epoch_context_counts(epoch_full).sum())
+    trunc_fraction = truncated_fraction_of_counts(counts[train_items], bag)
+
+    example = next(
+        iter_batches(epoch_truncated, batch_size, rng=None, pad_final=True)
+    )
+    # two states from the SAME key (identical init values, separate
+    # buffers): the step donates its state, so the arms cannot share one
+    state_truncated = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+    state_chunked = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+    train_step = make_train_step(model_config, class_weights)
+    detector = RecompileDetector()
+    detector.track(
+        "train_step", train_step, expected_compiles=len(full_ladder)
+    )
+
+    def one_pass(state, batches) -> tuple[object, float]:
+        t0 = time.perf_counter()
+        for b in batches:
+            state, loss = train_step(state, jax.device_put(b))
+        jax.block_until_ready(loss)
+        return state, time.perf_counter() - t0
+
+    def truncated_batches():
+        return iter_bucketed_batches(
+            epoch_truncated, base_ladder, batch_size,
+            rng=np.random.default_rng(2), pad_final=True,
+        )
+
+    def chunked_batches():
+        return iter_bucketed_batches(
+            epoch_full, full_ladder, batch_size,
+            rng=np.random.default_rng(2), pad_final=True,
+        )
+
+    # warmup compiles every width of both arms (untimed), then the ABBA
+    # passes must add zero compiles
+    state_truncated, _ = one_pass(state_truncated, truncated_batches())
+    state_chunked, _ = one_pass(state_chunked, chunked_batches())
+    detector.check()
+
+    repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 2)), 1)
+    t_times: list[float] = []
+    c_times: list[float] = []
+    for _ in range(repeats):
+        state_truncated, t = one_pass(state_truncated, truncated_batches())
+        t_times.append(t)
+        state_chunked, t = one_pass(state_chunked, chunked_batches())
+        c_times.append(t)
+        state_chunked, t = one_pass(state_chunked, chunked_batches())
+        c_times.append(t)
+        state_truncated, t = one_pass(state_truncated, truncated_batches())
+        t_times.append(t)
+    recompiles = detector.check()
+    if recompiles:
+        raise RuntimeError(
+            f"longbag-ab verdict FAILED: {recompiles} post-warmup "
+            "recompile(s) — a shape escaped the ladder"
+        )
+
+    # eval both trained states on UN-truncated test bags through ONE eval
+    # step (identical param trees across impls): the f1 delta is what the
+    # truncated arm's dropped contexts cost at evaluation time
+    eval_step = make_eval_step(model_config, class_weights)
+    test_epoch = build_method_epoch(
+        data, test_items, top_width, np.random.default_rng(3)
+    )
+
+    def eval_f1(state) -> float:
+        preds = []
+        labels = []
+        for b in iter_bucketed_batches(
+            test_epoch, full_ladder, batch_size, rng=None, pad_final=True
+        ):
+            out = eval_step(state, jax.device_put(b))
+            valid = b["example_mask"].astype(bool)
+            preds.append(np.asarray(out["preds"])[valid])
+            labels.append(b["labels"][valid])
+        if not preds:
+            return 0.0
+        _, _, _, f1 = evaluate(
+            "subtoken", np.concatenate(labels), np.concatenate(preds),
+            data.label_vocab,
+        )
+        return float(f1)
+
+    f1_truncated = eval_f1(state_truncated)
+    f1_chunked = eval_f1(state_chunked)
+
+    chunked_rps = real_full / min(c_times)
+    truncated_rps = real_truncated / min(t_times)
+
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": backend,
+                    "mode": "longbag_ab",
+                    "interpret": backend != "tpu",
+                    "batch": batch_size,
+                    "bag": bag,
+                    "base_ladder": list(base_ladder),
+                    "longbag_rungs": list(longbag_rungs),
+                    "length_sigma": sigma,
+                    "n_methods": spec.n_methods,
+                    # real-context accounting: what each arm actually fed
+                    "real_contexts_truncated": real_truncated,
+                    "real_contexts_chunked": real_full,
+                    "truncated_context_fraction_truncated": round(
+                        trunc_fraction, 6
+                    ),
+                    "truncated_context_fraction_chunked": 0.0,
+                    "truncated_real_contexts_per_sec": round(
+                        truncated_rps, 1
+                    ),
+                    "chunked_real_contexts_per_sec": round(chunked_rps, 1),
+                    "eval_f1_truncated": round(f1_truncated, 4),
+                    "eval_f1_chunked": round(f1_chunked, 4),
+                    "eval_f1_delta": round(f1_chunked - f1_truncated, 4),
+                    "post_warmup_recompiles": recompiles,
+                    "verdict_ok": recompiles == 0,
+                    "memory": memory_snapshot(),
+                }
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "longbag_real_contexts_per_sec",
+                "value": round(chunked_rps, 1),
+                "unit": "contexts/sec",
+                # the baseline is the truncated arm's REAL-context rate;
+                # note the chunked arm is doing strictly more real work
+                # per example (the whole point), so <1 on CPU interpret
+                # is expected and honest
+                "vs_baseline": round(chunked_rps / truncated_rps, 4)
+                if truncated_rps else None,
                 "backend": backend,
             }
         ),
@@ -2124,7 +2403,11 @@ def main() -> None:
     _bench_tracer(jax)
     import jax.numpy as jnp
 
-    from code2vec_tpu.data.pipeline import iter_batches, build_method_epoch
+    from code2vec_tpu.data.pipeline import (
+        build_method_epoch,
+        iter_batches,
+        truncated_fraction_of_counts as _truncated_fraction_of_counts,
+    )
     from code2vec_tpu.data.synth import (
         SynthSpec,
         corpus_data_from_raw,
@@ -2455,6 +2738,12 @@ def main() -> None:
                     "real_contexts_per_sec": round(contexts_per_sec, 1),
                     "padded_slots_per_sec": round(padded_slots_per_sec, 1),
                     "pad_efficiency": round(pad_efficiency, 4),
+                    # fraction of the corpus's real contexts the bag cap
+                    # silently drops — the loss --max_contexts 0 /
+                    # --longbag-ab removes
+                    "truncated_context_fraction": round(
+                        _truncated_fraction_of_counts(item_counts, bag), 6
+                    ),
                     "batch": batch_size,
                     "bag": bag,
                     "mesh": None if mesh is None else dict(mesh.shape),
@@ -2512,6 +2801,8 @@ if __name__ == "__main__":
             _ooc_ab()
         elif "--ann-ab" in sys.argv[1:]:
             _ann_ab()
+        elif "--longbag-ab" in sys.argv[1:]:
+            _longbag_ab()
         else:
             main()
     except Exception as exc:  # noqa: BLE001 - always leave a JSON record for the driver

@@ -197,6 +197,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "--max_path_length (e.g. 25,50,100,200); empty = "
                         "derive a geometric ladder from the corpus length "
                         "histogram (see tools/corpus_stats.py)")
+    parser.add_argument("--max_contexts", type=int, default=-1,
+                        help="per-example context cap: -1 = follow "
+                        "--max_path_length (long bags subsample down, the "
+                        "historical behavior); 0 = UNBOUNDED (requires "
+                        "--bucketed): nothing is truncated — the ladder "
+                        "grows longbag rungs above the top width and those "
+                        "shapes stream through the fused kernel's chunked "
+                        "softmax in bounded VMEM")
+    parser.add_argument("--pallas_softmax", type=str, default="auto",
+                        choices=("auto", "materialize", "online", "two_pass"),
+                        help="bag-softmax numerics of the fused Pallas "
+                        "kernel: materialize = VMEM-resident encoded bag; "
+                        "online/two_pass = flash-style chunked softmax "
+                        "(bounded VMEM at any bag length); auto = "
+                        "materialize at base ladder widths, online above "
+                        "(longbag rungs)")
     parser.add_argument("--corpus_format", type=str, default="auto",
                         choices=("auto", "text", "csr"),
                         help="corpus file format: text (L1 corpus.txt), "
@@ -342,6 +358,8 @@ def config_from_args(args: argparse.Namespace):
         shard_staged_corpus=args.shard_staged_corpus,
         bucketed=args.bucketed,
         bucket_ladder=args.bucket_ladder,
+        max_contexts=args.max_contexts,
+        pallas_softmax=args.pallas_softmax,
         stream_chunk_items=args.stream_chunk_items,
         device_chunk_batches=args.device_chunk_batches,
         prefetch_batches=args.prefetch_batches,

@@ -524,6 +524,82 @@ def parse_bucket_ladder(spec: str, max_contexts: int) -> tuple[int, ...] | None:
     return tuple(widths)
 
 
+def derive_longbag_ladder(
+    lengths: np.ndarray,
+    weights: np.ndarray,
+    base_top: int,
+    chunk_l: int = 128,
+    max_rungs: int = 4,
+) -> tuple[int, ...]:
+    """Longbag rungs ABOVE a base ladder's top width — the ``--max_contexts
+    0`` arm (no truncation anywhere).
+
+    Widths double geometrically from ``base_top``, each rounded up to a
+    multiple of ``chunk_l`` (the fused kernel's chunked softmax streams the
+    bag in ``chunk_l`` tiles, so rung widths that are chunk multiples waste
+    no lane padding inside the kernel), until the longest observed bag is
+    covered; if ``max_rungs`` doublings fall short, the last rung jumps
+    straight to the (chunk-rounded) maximum. Rungs holding no examples are
+    pruned, except the top one — the ladder must cover the tail, that is
+    the whole point. Returns ``()`` when nothing exceeds ``base_top``.
+
+    ``lengths``/``weights``: the corpus context-count histogram (the CSR
+    footer, ``np.unique`` of ``np.diff(row_splits)``, or a request-stream
+    histogram — the same inputs as :func:`derive_bucket_ladder_hist`).
+    """
+    if chunk_l < 1:
+        raise ValueError(f"chunk_l must be >= 1, got {chunk_l}")
+    lengths = np.asarray(lengths, np.int64)
+    weights = np.asarray(weights, np.int64)
+    over = lengths > base_top
+    if not over.any():
+        return ()
+    max_len = int(lengths[over].max())
+
+    def round_chunk(w: int) -> int:
+        return -(-int(w) // chunk_l) * chunk_l
+
+    rungs: list[int] = []
+    w = int(base_top)
+    while w < max_len and len(rungs) < max_rungs:
+        w = round_chunk(w * 2)  # ceil-to-chunk of 2w: > w, so always advances
+        rungs.append(w)
+    if rungs and rungs[-1] < max_len:
+        rungs[-1] = round_chunk(max_len)
+    kept: list[int] = []
+    prev = int(base_top)
+    for width in rungs:
+        occupied = int(weights[(lengths > prev) & (lengths <= width)].sum())
+        if occupied or width == rungs[-1]:
+            kept.append(width)
+            prev = width
+    return tuple(kept)
+
+
+def truncated_fraction(
+    lengths: np.ndarray, weights: np.ndarray, cap: int
+) -> float:
+    """Fraction of REAL contexts a per-example cap of ``cap`` drops — the
+    ``truncated_context_fraction`` accounting (obs gauge, epoch metrics,
+    ``tools/corpus_stats.py``, ``bench.py --longbag-ab``). Today that loss
+    is invisible: ``max_contexts`` subsampling silently discards the tail
+    of every long bag. 0.0 means no truncation (the longbag arm's
+    acceptance bar)."""
+    lengths = np.asarray(lengths, np.int64)
+    weights = np.asarray(weights, np.int64)
+    total = int((lengths * weights).sum())
+    if total == 0:
+        return 0.0
+    dropped = int((np.maximum(lengths - int(cap), 0) * weights).sum())
+    return dropped / total
+
+
+def truncated_fraction_of_counts(counts: np.ndarray, cap: int) -> float:
+    """Per-example-counts front end of :func:`truncated_fraction`."""
+    lengths, weights = np.unique(np.asarray(counts), return_counts=True)
+    return truncated_fraction(lengths, weights, cap)
+
+
 def nearest_bucket_width(count: int, ladder: tuple[int, ...]) -> int:
     """The smallest ladder width holding ``count`` real contexts (the top
     width for anything longer). THE padding rule shared by every consumer

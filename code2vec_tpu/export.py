@@ -150,6 +150,45 @@ def print_sample(
         return
 
 
+def export_file_vectors(
+    method_vectors: np.ndarray,  # [N, H] f32 (e.g. read from code.vec)
+    group_ids,  # length-N file/class key per method
+    vectors_path: str,
+    attn_param: np.ndarray | None = None,
+    group_names=None,  # optional key -> written label (default: str(key))
+) -> tuple[list, np.ndarray]:
+    """Hierarchical file/class export: attention-pool method vectors per
+    group (``models/hierarchical.py``) and write the pooled rows in the
+    ``code.vec`` word2vec format — one row per FILE, label = group name.
+
+    The output is format-identical to ``code.vec``, so the whole existing
+    retrieval stack consumes it untouched: ``serve/retrieval.py``'s exact
+    index (``--code_vec_path file.vec``), the IVF-PQ builder
+    (``tools/ann_build.py``), and the ``neighbors`` op — whole-file code
+    search through the same serving machinery as method search.
+
+    ``attn_param``: the checkpoint's method-level attention param (the
+    trained salience direction — see models/hierarchical.py for why it
+    transfers); None = mean pooling. Returns ``(group_keys, [G, H])``.
+    """
+    from code2vec_tpu.models.hierarchical import pool_vectors_by_group
+
+    keys, pooled = pool_vectors_by_group(
+        method_vectors, group_ids, attn_param
+    )
+    names = [
+        str(group_names[k]) if group_names is not None else str(k)
+        for k in keys
+    ]
+    write_code_vectors_header(vectors_path, len(names), pooled.shape[-1])
+    append_code_vectors(vectors_path, names, pooled)
+    logger.info(
+        "exported %d file vectors (from %d method vectors) to %s",
+        len(names), len(method_vectors), vectors_path,
+    )
+    return keys, pooled
+
+
 def export_from_checkpoint(
     config,
     data: CorpusData,

@@ -1,3 +1,7 @@
 """Model family: the code2vec attention model and its head variants."""
 
 from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+from code2vec_tpu.models.hierarchical import (
+    HierarchicalAttentionPool,
+    pool_vectors_by_group,
+)

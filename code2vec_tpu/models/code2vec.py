@@ -60,6 +60,20 @@ class Code2VecConfig:
     pallas_impl: str = "pool_only"
     pallas_dma_depth: int = 2  # fused-impl gather double-buffer slots
     pallas_chunk_l: int = 128  # fused-impl bag-chunk lane tile
+    # bag-softmax numerics of the fused kernel (ops/fused_encode_pool.py):
+    # "materialize" (VMEM-resident encoded bag — the original kernel),
+    # "online" / "two_pass" (flash-style chunked softmax, bounded VMEM at
+    # any bag length), or "auto": materialize at widths <= longbag_width
+    # (or everywhere when longbag_width is 0), online above it — unless a
+    # cached autotune schedule says otherwise
+    pallas_softmax: str = "auto"
+    # widths STRICTLY ABOVE this are "longbag" shapes (0 = none): their
+    # traces force the fused kernel with a chunked softmax, because every
+    # other Pallas impl materializes O(L*E) VMEM and would not fit. Set by
+    # the train loop to max_path_length when --max_contexts 0 extends the
+    # ladder past the top rung; plain-XLA forwards (use_pallas=False) need
+    # no forcing — XLA is HBM-bound at any width.
+    longbag_width: int = 0
     # embedding-table storage for the gathers: "f32" (master weights) |
     # "bf16" | "int8" (per-row scale, dequant on load — ops.quant).
     # Serving/eval only: the train loop rejects quantized tables, and the
@@ -189,13 +203,27 @@ class Code2Vec(nn.Module):
         c = self.config
         if not c.use_pallas:
             return None, None
+        import dataclasses as _dc
+
         from code2vec_tpu.ops.autotune import KernelSchedule, lookup_schedule
 
+        if c.pallas_softmax not in ("auto", "materialize", "online", "two_pass"):
+            raise ValueError(
+                f"unknown pallas_softmax {c.pallas_softmax!r}: expected "
+                "'auto', 'materialize', 'online', or 'two_pass'"
+            )
+        longbag = bool(c.longbag_width) and width > c.longbag_width
+        configured_softmax = (
+            c.pallas_softmax
+            if c.pallas_softmax != "auto"
+            else ("online" if longbag else "materialize")
+        )
         configured = KernelSchedule(
             impl=c.pallas_impl if c.pallas_impl != "auto" else "pool_only",
             block_b=c.pallas_block_b,
             dma_depth=c.pallas_dma_depth,
             chunk_l=c.pallas_chunk_l,
+            softmax=configured_softmax,
             source="config",
         )
         if c.pallas_impl == "auto":
@@ -203,13 +231,31 @@ class Code2Vec(nn.Module):
                 batch, width, c.terminal_embed_size, c.path_embed_size,
                 c.encode_size, c.table_dtype, default=configured,
             )
-            return sched.impl, sched
-        if c.pallas_impl not in ("pool_only", "gather_split", "fused"):
+        elif c.pallas_impl in ("pool_only", "gather_split", "fused"):
+            sched = configured
+        else:
             raise ValueError(
                 f"unknown pallas_impl {c.pallas_impl!r}: expected "
                 "'pool_only', 'gather_split', 'fused', or 'auto'"
             )
-        return c.pallas_impl, configured
+        if longbag and (
+            sched.impl != "fused" or sched.softmax == "materialize"
+        ):
+            # a longbag width must stream: force the fused kernel with a
+            # chunked softmax (honoring an explicit two_pass preference /
+            # a cached chunked schedule) — any other variant materializes
+            # O(L*E) or O(L*H) VMEM and cannot fit an unbounded bag
+            sched = _dc.replace(
+                sched,
+                impl="fused",
+                softmax=(
+                    sched.softmax
+                    if sched.softmax != "materialize"
+                    else ("online" if c.pallas_softmax in ("auto", "materialize")
+                          else c.pallas_softmax)
+                ),
+            )
+        return sched.impl, sched
 
     def _lookup(self, store, ids: jnp.ndarray) -> jnp.ndarray:
         """Quant-aware row gather: the f32 master table goes through
@@ -328,6 +374,7 @@ class Code2Vec(nn.Module):
                 drop_mask=drop_mask, off_se=off_se, off_p=off_p,
                 impl=impl, block_b=sched.block_b,
                 dma_depth=sched.dma_depth, chunk_l=sched.chunk_l,
+                softmax_mode=sched.softmax,
                 compute_dtype=c.dtype,
             )
         else:

@@ -1,0 +1,128 @@
+"""Hierarchical two-level pooling: method vectors → file/class vectors.
+
+The base model embeds one METHOD per forward (a bag of path-contexts →
+attention pool → ``[H]`` code vector). Whole-file / whole-class code
+search needs one vector per FILE, and the natural second level is the
+same aggregation applied one tier up: the file's method vectors form a
+bag, a learned salience direction scores them, masked softmax weights
+them, and the weighted sum is the file vector — structurally identical
+to ``ops.attention.attention_pool`` with methods in the bag axis.
+
+Two entry points:
+
+- :func:`pool_vectors_by_group` — host-side (numpy) pooling of exported
+  method vectors grouped by an arbitrary key (source file, class, repo
+  directory). This is what ``export.export_file_vectors`` and the serving
+  ``embed_file`` op run: it needs no new trained parameters, because the
+  checkpoint's method-level ``attention`` param is reused as the salience
+  direction — method vectors live in the SAME ``H``-dim space as the
+  encoded contexts that param was trained to score (a code vector is a
+  convex combination of them), so the trained direction transfers one
+  level up. ``attn_param=None`` falls back to masked mean pooling.
+
+- :class:`HierarchicalAttentionPool` — the flax module form ([G, M, H]
+  batched groups with a mask), carrying its OWN ``file_attention`` param
+  for runs that fine-tune the file level (e.g. a contrastive file-search
+  head, ROADMAP item 1). Init matches the method-level attention param's
+  (xavier-normal over the reference's [H, 1] shape).
+
+File vectors round-trip through the existing stack untouched: they are
+``[H]`` f32 rows, so ``formats/vectors_io.py`` writes them (``file.vec``),
+``serve/retrieval.py`` indexes them (exact or IVF-PQ), and the
+``neighbors`` op returns them — whole-file code search with zero new
+serving machinery.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.nn.initializers import normal
+
+from code2vec_tpu.ops.attention import attention_pool
+
+__all__ = [
+    "HierarchicalAttentionPool",
+    "pool_vectors",
+    "pool_vectors_by_group",
+]
+
+
+def pool_vectors(
+    vectors: np.ndarray,  # [M, H] f32 method vectors (one group)
+    attn_param: np.ndarray | None,  # [H] salience direction; None = mean
+) -> np.ndarray:
+    """Attention-pool one group of method vectors into one ``[H]`` vector.
+
+    Same arithmetic as ``ops.attention.attention_pool`` for a single row
+    with an all-ones mask (scores → shifted softmax → weighted sum),
+    computed in float64 host-side so group size cannot perturb the result
+    at f32 resolution.
+    """
+    vectors = np.asarray(vectors, np.float64)
+    if vectors.ndim != 2 or not len(vectors):
+        raise ValueError(
+            f"need a non-empty [M, H] vector matrix, got {vectors.shape}"
+        )
+    if attn_param is None:
+        pooled = vectors.mean(axis=0)
+    else:
+        scores = vectors @ np.asarray(attn_param, np.float64)
+        z = np.exp(scores - scores.max())
+        weights = z / z.sum()
+        pooled = weights @ vectors
+    return pooled.astype(np.float32)
+
+
+def pool_vectors_by_group(
+    vectors: np.ndarray,  # [N, H] f32 method vectors
+    group_ids,  # length-N group key per method (str/int, any hashable)
+    attn_param: np.ndarray | None = None,
+) -> tuple[list, np.ndarray]:
+    """Group method vectors by key and pool each group —
+    ``(group_keys, [G, H] f32)``, groups in first-appearance order (the
+    corpus/export row order, so repeated exports are stable)."""
+    vectors = np.asarray(vectors, np.float32)
+    if len(vectors) != len(group_ids):
+        raise ValueError(
+            f"{len(vectors)} vectors but {len(group_ids)} group ids"
+        )
+    members: dict = {}
+    for row, gid in enumerate(group_ids):
+        members.setdefault(gid, []).append(row)
+    keys = list(members)
+    if not keys:
+        dim = vectors.shape[-1] if vectors.ndim == 2 else 0
+        return keys, np.zeros((0, dim), np.float32)
+    pooled = np.stack(
+        [pool_vectors(vectors[members[gid]], attn_param) for gid in keys]
+    )
+    return keys, pooled
+
+
+class HierarchicalAttentionPool(nn.Module):
+    """``(file_vector [G, H] f32, attention [G, M] f32)`` from batched
+    method-vector groups; ``mask`` marks real methods (1) vs padding rows
+    (0). Masking semantics are ``attention_pool``'s (an all-masked group
+    degenerates to uniform over M), so padded groups pool exactly like
+    padded bags do one level down."""
+
+    encode_size: int
+
+    @nn.compact
+    def __call__(self, method_vectors: jnp.ndarray, mask: jnp.ndarray):
+        attn = self.param(
+            "file_attention",
+            normal(stddev=math.sqrt(2.0 / (self.encode_size + 1))),
+            (self.encode_size,),
+            jnp.float32,
+        )
+        file_vector, attention = attention_pool(
+            method_vectors.astype(jnp.float32),
+            mask.astype(jnp.float32),
+            attn,
+        )
+        return file_vector.astype(jnp.float32), attention

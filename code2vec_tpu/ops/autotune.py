@@ -50,6 +50,12 @@ class KernelSchedule:
     block_b: int = 8  # batch-tile rows per kernel program
     dma_depth: int = 2  # gather double-buffer slots (fused impl only)
     chunk_l: int = 128  # bag-chunk lane tile the gather pipelines over
+    # bag-softmax numerics of the fused impl (ops/fused_encode_pool.py):
+    # "materialize" keeps the encoded bag in VMEM scratch; "online" /
+    # "two_pass" stream it flash-style in bounded VMEM (the longbag modes).
+    # Pre-PR-13 cache entries deserialize with the default — unchanged
+    # behavior, no cache version bump.
+    softmax: str = "materialize"
     source: str = "default"  # "default" | "dry" | "autotune" | "cache"
 
     def to_dict(self) -> dict:
@@ -496,9 +502,14 @@ def autotune_lut(
 
 def enumerate_variants(batch: int, width: int, table_dtype: str) -> list[KernelSchedule]:
     """The search space for one shape: plain XLA, pool-only, gather-split,
-    and fully-fused, across batch tiling / DMA pipeline depth / lane chunk.
-    Tile sizes larger than the (padded) batch are pruned — they would all
-    alias the same single-program grid."""
+    and fully-fused — the fused impl additionally across the chunked-
+    softmax axis (``chunk_l`` × ``dma_depth`` × two-pass-vs-online, PR 13)
+    — across batch tiling / DMA pipeline depth / lane chunk. Tile sizes
+    larger than the (padded) batch are pruned — they would all alias the
+    same single-program grid. Variants that fail to lower on a shape
+    (e.g. ``materialize`` blowing VMEM at a longbag width) are skipped by
+    the tuner's try/except, so the space can stay uniform across widths.
+    """
     bp = max(batch, 1)
     blocks = [b for b in (8, 16, 32) if b <= max(bp, 8)]
     if not blocks:
@@ -516,6 +527,18 @@ def enumerate_variants(batch: int, width: int, table_dtype: str) -> list[KernelS
                 variants.append(
                     KernelSchedule(
                         impl="fused", block_b=b, dma_depth=depth, chunk_l=cl
+                    )
+                )
+    # the chunked-softmax axis: one block size (the schedule dimension that
+    # matters here is the streaming strategy, not batch tiling) × depth ×
+    # chunk × {online, two_pass}
+    for mode in ("online", "two_pass"):
+        for depth in (1, 2):
+            for cl in chunks:
+                variants.append(
+                    KernelSchedule(
+                        impl="fused", block_b=blocks[0], dma_depth=depth,
+                        chunk_l=cl, softmax=mode,
                     )
                 )
     return variants
@@ -604,6 +627,7 @@ def _build_forward(schedule: KernelSchedule, t_table, p_table, data):
                 data["ln_bias"], data["attn_param"],
                 impl=schedule.impl, block_b=schedule.block_b,
                 dma_depth=schedule.dma_depth, chunk_l=schedule.chunk_l,
+                softmax_mode=schedule.softmax,
             )[0]
 
     else:
@@ -713,7 +737,10 @@ def _variant_label(s: KernelSchedule) -> str:
         return f"pool_only/b{s.block_b}"
     if s.impl == "gather_split":
         return f"gather_split/b{s.block_b}"
-    return f"fused/b{s.block_b}/d{s.dma_depth}/c{s.chunk_l}"
+    label = f"fused/b{s.block_b}/d{s.dma_depth}/c{s.chunk_l}"
+    if s.softmax != "materialize":
+        label += f"/{s.softmax}"
+    return label
 
 
 def keys_for(

@@ -38,6 +38,22 @@ Masking semantics are identical to ``pallas_attention_pool``: user-masked
 positions score the finite ``NINF`` sentinel, lane-padding columns score a
 hard ``-inf`` below it, so a fully-masked row degenerates to uniform over
 the REAL bag length exactly like the XLA path.
+
+Long bags — the chunked softmax (``softmax_mode``, PR 13): the default
+``"materialize"`` numerics accumulate every encoded chunk into an
+``[TB, L, H]`` VMEM scratch before one softmax+pool pass, so the bag
+width is VMEM-bounded (the last static-shape ceiling the bucket ladder
+papered over). The flash-attention-style modes stream the bag instead,
+visiting each ``chunk_l`` tile once (``"online"``: carry a running max
+``m``, rescaled denominator ``d``, and rescaled weighted sum — one
+gather+encode pass with per-chunk rescaling) or twice (``"two_pass"``:
+pass A computes the global max and masked scores, pass B re-gathers and
+accumulates the weighted sum with no rescaling), so VMEM residency is
+O(chunk_l·H) + O(L) score lanes regardless of bag length. Both modes
+reuse the same DMA double-buffer machinery and reproduce the exact
+masking semantics above (the running max starts at ``-inf`` and column 0
+is always a real lane, so no NaN path exists). ``fused`` impl only —
+the other impls materialize O(L·E) inputs by construction.
 """
 
 from __future__ import annotations
@@ -58,6 +74,10 @@ _LANE = 128
 _LN_EPS = 1e-6  # flax nn.LayerNorm default
 
 FUSED_IMPLS = ("fused", "gather_split")
+# bag-softmax numerics of the fused kernel (see module docstring):
+# "materialize" keeps the encoded bag in VMEM scratch; "online"/"two_pass"
+# stream it flash-style in bounded VMEM (the longbag modes)
+SOFTMAX_MODES = ("materialize", "online", "two_pass")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,6 +95,7 @@ class FusedStatic:
     has_drop: bool
     has_off: bool
     interpret: bool
+    softmax: str = "materialize"  # "materialize" | "online" | "two_pass"
 
 
 # full primal layout of the custom_vjp op (entries may be None per static)
@@ -181,14 +202,20 @@ def _make_split_kernel(real_l: int, has_drop: bool):
 
 def _make_fused_kernel(
     real_l: int, lp: int, cl: int, depth: int, table_dtype: str,
-    has_drop: bool, block_b: int,
+    has_drop: bool, block_b: int, softmax: str = "materialize",
 ):
     """The full kernel: in-kernel DMA row gather (``depth``-buffered across
-    bag chunks of ``cl``), then the same encode→attend→pool as the split
-    kernel, accumulating encoded rows + scores in VMEM scratch."""
+    bag chunks of ``cl``), then encode→attend→pool.
+
+    ``softmax`` selects the bag-softmax numerics: ``"materialize"``
+    accumulates encoded rows in an ``[TB, L, H]`` VMEM scratch and pools
+    once at the end (bag bounded by VMEM); ``"online"`` and ``"two_pass"``
+    stream the bag chunk by chunk with flash-style running statistics so
+    the only O(L) VMEM residency is the 2D score/weight lanes."""
 
     quant = table_dtype == "int8"
     n_chunks = lp // cl
+    chunked = softmax != "materialize"
 
     def _kernel(*refs):
         i = 0
@@ -210,7 +237,12 @@ def _make_fused_kernel(
         s_scl = p_scl = e_scl = None
         if quant:
             s_scl, p_scl, e_scl = refs[i : i + 3]; i += 3
-        enc_buf, sems = refs[i : i + 2]
+        if chunked:
+            acc_buf, m_buf, d_buf, sems = refs[i : i + 4]
+            enc_buf = None
+        else:
+            enc_buf, sems = refs[i : i + 2]
+            acc_buf = m_buf = d_buf = None
 
         def _copies(slot, c):
             """The chunk's row DMAs, as (src, dst) pairs rebuilt identically
@@ -259,7 +291,7 @@ def _make_fused_kernel(
                 lambda j, x: (row(j, lambda d: d.wait()), x)[1], zero,
             )
 
-        def compute_chunk(slot, c):
+        def encode_chunk(slot, c):
             base = c * cl
             s = _dequant(
                 s_rows[slot], s_scl[slot] if quant else None, table_dtype
@@ -273,18 +305,32 @@ def _make_fused_kernel(
             enc = _encode_f32(s, p, e, kern_ref, lns_ref, lnb_ref)
             if drop_ref is not None:
                 enc = enc * drop_ref[:, pl.ds(base, cl), :].astype(jnp.float32)
-            enc_buf[:, pl.ds(base, cl), :] = enc
+            return enc
 
-        if depth <= 1:
-            # no pipeline: strictly issue → wait → compute per chunk
-            def serial_body(c, x):
-                issue_chunk(0, c)
-                wait_chunk(0, c)
-                compute_chunk(0, c)
-                return x
+        def chunk_scores(enc, base):
+            """Masked attention scores of one chunk — the same arithmetic
+            as ``_pool_f32`` (finite NINF user mask, hard -inf lane pad),
+            applied tile-locally."""
+            scores = jnp.sum(enc * attn_ref[0][None, None, :], axis=2)
+            msk = mask_ref[:, pl.ds(base, cl)].astype(jnp.float32)
+            masked = scores * msk + (1.0 - msk) * NINF
+            col = jax.lax.broadcasted_iota(jnp.int32, masked.shape, 1) + base
+            return jnp.where(col < real_l, masked, -jnp.inf)
 
-            jax.lax.fori_loop(0, n_chunks, serial_body, zero)
-        else:
+        def run_pipeline(compute_chunk):
+            """Drive the DMA double-buffer over every chunk, calling
+            ``compute_chunk(slot, c)`` once per chunk — shared by the
+            materialized pass and both chunked-softmax passes."""
+            if depth <= 1:
+                # no pipeline: strictly issue → wait → compute per chunk
+                def serial_body(c, x):
+                    issue_chunk(0, c)
+                    wait_chunk(0, c)
+                    compute_chunk(0, c)
+                    return x
+
+                jax.lax.fori_loop(0, n_chunks, serial_body, zero)
+                return
             issue_chunk(0, 0)
 
             def pipe_body(c, x):
@@ -300,11 +346,84 @@ def _make_fused_kernel(
 
             jax.lax.fori_loop(0, n_chunks, pipe_body, zero)
 
-        cv, weights = _pool_f32(
-            enc_buf[:], mask_ref[:].astype(jnp.float32), attn_ref, real_l
-        )
-        cv_ref[:] = cv.astype(cv_ref.dtype)
-        w_ref[:] = weights
+        if softmax == "materialize":
+
+            def compute_chunk(slot, c):
+                enc_buf[:, pl.ds(c * cl, cl), :] = encode_chunk(slot, c)
+
+            run_pipeline(compute_chunk)
+            cv, weights = _pool_f32(
+                enc_buf[:], mask_ref[:].astype(jnp.float32), attn_ref, real_l
+            )
+            cv_ref[:] = cv.astype(cv_ref.dtype)
+            w_ref[:] = weights
+            return
+
+        # chunked softmax: each chunk is encoded, scored, and folded into
+        # running statistics; its encoded rows are then DISCARDED. The
+        # masked scores land in w_ref (the [TB, L] output block doubles as
+        # scratch) so the final normalized weights come from one vectorized
+        # pass. No-NaN invariant: column 0 is always a real lane (L >= 1),
+        # so the running max is finite from chunk 0 on, and -inf lanes
+        # always subtract a finite max (exp -> exact 0).
+        m_buf[:] = jnp.full((block_b, 1), -jnp.inf, jnp.float32)
+        d_buf[:] = jnp.zeros((block_b, 1), jnp.float32)
+        acc_buf[:] = jnp.zeros(acc_buf.shape, jnp.float32)
+
+        if softmax == "online":
+            # one streamed pass: rescale d and the weighted sum whenever
+            # the running max moves (the flash-attention recurrence)
+            def compute_chunk(slot, c):
+                base = c * cl
+                enc = encode_chunk(slot, c)
+                masked = chunk_scores(enc, base)
+                w_ref[:, pl.ds(base, cl)] = masked
+                m_prev = m_buf[:]
+                m_new = jnp.maximum(
+                    m_prev, jnp.max(masked, axis=-1, keepdims=True)
+                )
+                # chunk 0: exp(-inf - finite) = 0 and d/acc are zero, so
+                # the first fold is exact; fully-padded chunks leave the
+                # max unchanged (scale = exp(0) = 1)
+                scale = jnp.exp(m_prev - m_new)
+                e = jnp.exp(masked - m_new)
+                d_buf[:] = d_buf[:] * scale + jnp.sum(
+                    e, axis=-1, keepdims=True
+                )
+                acc_buf[:] = acc_buf[:] * scale + jnp.sum(
+                    e[:, :, None] * enc, axis=1
+                )
+                m_buf[:] = m_new
+
+            run_pipeline(compute_chunk)
+        else:  # two_pass
+            # pass A: global max + masked scores (scores persist in w_ref)
+            def pass_a(slot, c):
+                base = c * cl
+                masked = chunk_scores(encode_chunk(slot, c), base)
+                w_ref[:, pl.ds(base, cl)] = masked
+                m_buf[:] = jnp.maximum(
+                    m_buf[:], jnp.max(masked, axis=-1, keepdims=True)
+                )
+
+            run_pipeline(pass_a)
+            d_buf[:] = jnp.sum(
+                jnp.exp(w_ref[:] - m_buf[:]), axis=-1, keepdims=True
+            )
+
+            # pass B: re-gather + re-encode, accumulate the weighted sum
+            # against the now-fixed max (no rescaling)
+            def pass_b(slot, c):
+                base = c * cl
+                enc = encode_chunk(slot, c)
+                e = jnp.exp(w_ref[:, pl.ds(base, cl)] - m_buf[:])
+                acc_buf[:] = acc_buf[:] + jnp.sum(e[:, :, None] * enc, axis=1)
+
+            run_pipeline(pass_b)
+
+        d = d_buf[:]
+        w_ref[:] = jnp.exp(w_ref[:] - m_buf[:]) / d
+        cv_ref[:] = (acc_buf[:] / d).astype(cv_ref.dtype)
 
     return _kernel
 
@@ -430,12 +549,22 @@ def _kernel_forward(static: FusedStatic, args: dict):
                 pltpu.VMEM((depth, block_b, cl, 1), jnp.float32),
                 pltpu.VMEM((depth, block_b, cl, 1), jnp.float32),
             ]
-        scratch_shapes += [
-            pltpu.VMEM((block_b, lp, h), jnp.float32),
-            pltpu.SemaphoreType.DMA((depth,)),
-        ]
+        if static.softmax == "materialize":
+            # the whole encoded bag stays resident — O(L*H) VMEM, the
+            # bound the chunked modes exist to remove
+            scratch_shapes += [pltpu.VMEM((block_b, lp, h), jnp.float32)]
+        else:
+            # flash-style running statistics: weighted-sum accumulator +
+            # running max + denominator — O(H) per row however long the bag
+            scratch_shapes += [
+                pltpu.VMEM((block_b, h), jnp.float32),
+                pltpu.VMEM((block_b, 1), jnp.float32),
+                pltpu.VMEM((block_b, 1), jnp.float32),
+            ]
+        scratch_shapes += [pltpu.SemaphoreType.DMA((depth,))]
         kernel = _make_fused_kernel(
-            l, lp, cl, depth, static.table_dtype, drop is not None, block_b
+            l, lp, cl, depth, static.table_dtype, drop is not None, block_b,
+            softmax=static.softmax,
         )
     else:
         raise ValueError(
@@ -695,6 +824,7 @@ def fused_encode_attend_pool(
     block_b: int = 8,
     dma_depth: int = 2,
     chunk_l: int = _LANE,
+    softmax_mode: str = "materialize",
     compute_dtype=jnp.float32,
     interpret: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -710,11 +840,28 @@ def fused_encode_attend_pool(
     the backward differentiates w.r.t. them so the lazy optimizer's
     per-slot gradients come out exactly as on the unfused path.
 
+    ``softmax_mode``: bag-softmax numerics (module docstring) —
+    ``"materialize"`` (VMEM-resident encoded bag, the original kernel) or
+    the flash-style chunked ``"online"``/``"two_pass"`` (bounded VMEM,
+    arbitrary bag length; ``impl="fused"`` only — the other impls
+    materialize O(L·E) inputs by construction).
+
     ``interpret=None`` auto-selects: compiled on TPU, interpreter
     elsewhere (tests and the CPU mesh run the same code path).
     """
     if impl not in FUSED_IMPLS:
         raise ValueError(f"impl must be one of {FUSED_IMPLS}, got {impl!r}")
+    if softmax_mode not in SOFTMAX_MODES:
+        raise ValueError(
+            f"softmax_mode must be one of {SOFTMAX_MODES}, got "
+            f"{softmax_mode!r}"
+        )
+    if softmax_mode != "materialize" and impl != "fused":
+        raise ValueError(
+            f"chunked softmax ({softmax_mode!r}) requires impl='fused': "
+            f"{impl!r} materializes the full bag before the kernel runs, "
+            "so streaming the softmax would not bound VMEM"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     t_vals, t_scale, table_dtype = _split_table(t_table)
@@ -738,6 +885,7 @@ def fused_encode_attend_pool(
         has_drop=drop_mask is not None,
         has_off=off_se is not None,
         interpret=bool(interpret),
+        softmax=softmax_mode,
     )
     args = (
         t_vals, t_scale, p_vals, p_scale,

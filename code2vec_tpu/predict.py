@@ -188,6 +188,9 @@ class Predictor:
         self.label_vocab = read_vocab(os.path.join(model_path, LABEL_VOCAB))
 
         self.bag = int(meta["max_path_length"])
+        # the TRAINING bag width, before any longbag raise below — the
+        # serving engine keys its base/longbag split off this
+        self.base_bag = self.bag
         # bag-width ladder for single-forward padding: each prediction is
         # padded to the nearest ladder width (shared rule with the serving
         # micro-batcher — data/pipeline.nearest_bucket_width), so the jitted
@@ -208,6 +211,11 @@ class Predictor:
             if recorded
             else derive_bucket_ladder(np.zeros(0, np.int64), self.bag)
         )
+        if self.ladder_recorded and self.ladder[-1] > self.bag:
+            # longbag rungs (a --max_contexts 0 run recorded widths above
+            # its base bag): single forwards pad oversized bags to a rung
+            # instead of subsampling them — no truncation offline either
+            self.bag = int(self.ladder[-1])
         # extraction hyperparameters: the corpus records them in params.txt
         # next to the vocab files (reference format, typo'd 'nomalize_' keys
         # included) — new sources must be extracted identically or their
@@ -470,6 +478,39 @@ class Predictor:
                 m.target_variable = original
                 out.append(m)
         return out
+
+    def embed_file(
+        self,
+        source: str,
+        language: str = "java",
+        method_name: str = "*",
+        rng: np.random.Generator | None = None,
+    ) -> tuple[np.ndarray, list[str]]:
+        """One vector for a whole SOURCE FILE: embed every matching method,
+        then attention-pool the method vectors with the checkpoint's
+        trained method-level attention param (the hierarchical two-level
+        head — models/hierarchical.py). Returns ``(file_vector [H] f32,
+        method_names)``; raises ValueError when no method embeds (nothing
+        extracted, or everything OOV)."""
+        from code2vec_tpu.models.hierarchical import pool_vectors
+
+        names: list[str] = []
+        vectors: list[np.ndarray] = []
+        for label, contexts, _ in self._extract(source, method_name, language):
+            mapped, _oov = self._map_contexts(contexts)
+            if not mapped:
+                continue
+            m = self._predict_contexts(label, mapped, 0, top_k=1, rng=rng)
+            names.append(label)
+            vectors.append(m.code_vector)
+        if not vectors:
+            raise ValueError(
+                "no method in the source produced an embedding (nothing "
+                "extracted, or every context is OOV against the training "
+                "vocab)"
+            )
+        attn = np.asarray(self.state.params["attention"], np.float32)
+        return pool_vectors(np.stack(vectors), attn), names
 
     def _predict_contexts(
         self, label: str, contexts, n_oov: int, top_k: int, rng

@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--warmup_requests", type=int, default=64,
                         help="histogram-fallback sample size when the "
                         "checkpoint's meta has no recorded bucket ladder")
+    parser.add_argument("--longbag_widths", default="",
+                        help="comma list of longbag rungs to compile ABOVE "
+                        "the checkpoint's bag width (e.g. 512,2048): "
+                        "oversized requests then serve through these "
+                        "executables instead of being rejected. Runs "
+                        "trained with --max_contexts 0 record their rungs "
+                        "in model_meta.json and need no flag")
     parser.add_argument("--golden_min_recall", type=float, default=0.9,
                         help="hot-swap validation: minimum neighbors "
                         "recall@k the shadow generation's retrieval "
@@ -151,6 +158,26 @@ def make_generation_factory(args, events=None, start=0):
             model_path, args.terminal_idx_path, args.path_idx_path,
             table_dtype=args.table_dtype,
         )
+        engine_kw = {}
+        longbag = tuple(sorted({
+            int(tok)
+            for tok in str(getattr(args, "longbag_widths", "") or "").split(",")
+            if tok.strip()
+        }))
+        if longbag:
+            # operator-pinned longbag rungs (old checkpoints without
+            # recorded rungs): extend whatever ladder the meta carries
+            base = (
+                predictor.ladder if predictor.ladder_recorded
+                else (predictor.bag,)
+            )
+            extra = tuple(w for w in longbag if w > base[-1])
+            if len(extra) != len(longbag):
+                raise ValueError(
+                    f"--longbag_widths must all exceed the ladder top "
+                    f"{base[-1]}, got {list(longbag)}"
+                )
+            engine_kw["ladder"] = tuple(base) + extra
         engine = ServingEngine.from_predictor(
             predictor,
             batch_sizes=batch_sizes,
@@ -158,6 +185,7 @@ def make_generation_factory(args, events=None, start=0):
             warmup_requests=args.warmup_requests,
             events=events,
             version=version,
+            **engine_kw,
         )
         provenance = engine.prepare()
         logger.info(

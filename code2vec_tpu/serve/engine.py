@@ -89,15 +89,30 @@ class ServingEngine:
         # compile events/provenance must say whose executables they are
         self.version = str(version)
         self.max_width = int(max_width)
+        # the training bag width (requests up to here always serve); kept
+        # distinct from max_width, which longbag rungs may raise below
+        self.base_width = self.max_width
         self.batch_sizes = tuple(sorted({int(b) for b in batch_sizes}))
         self.ladder: tuple[int, ...] | None = (
             tuple(int(w) for w in ladder) if ladder else None
         )
-        if self.ladder and self.ladder[-1] != self.max_width:
+        if self.ladder and self.ladder[-1] < self.max_width:
             raise ValueError(
-                f"ladder must end at max_width ({self.max_width}), got "
+                f"ladder must reach max_width ({self.max_width}), got "
                 f"{self.ladder}"
             )
+        if self.ladder and self.ladder[-1] > self.max_width:
+            # longbag rungs (PR 13): the training run fed unbounded bags
+            # (--max_contexts 0) and recorded rungs above the base bag
+            # width. Oversized requests route through these compiled
+            # executables instead of being rejected at submit; the loud
+            # reject now applies only beyond the TOP rung.
+            logger.info(
+                "ladder carries longbag rungs above the base bag width "
+                "%d: oversized requests up to %d serve through the "
+                "chunked executables", self.max_width, self.ladder[-1],
+            )
+            self.max_width = int(self.ladder[-1])
         self._model_dims = model_dims
         self._quant_tables = quant_tables
         self.table_dtype = table_dtype
@@ -145,7 +160,15 @@ class ServingEngine:
                 int(meta["encode_size"]),
             ),
         )
-        return cls(predictor.state, max_width=predictor.bag, **kw)
+        # the TRAINING bag (base_bag), not predictor.bag: the Predictor
+        # raises its own bag to the ladder top for offline padding, but the
+        # engine owns the base-vs-longbag split itself (ladder rungs above
+        # max_width raise it in __init__, with base_width kept honest)
+        return cls(
+            predictor.state,
+            max_width=getattr(predictor, "base_bag", predictor.bag),
+            **kw,
+        )
 
     # ---- forward construction ------------------------------------------
     def _forward_fn(self):

@@ -17,10 +17,16 @@ are adapters over the same :class:`CodeServer`:
 
 Request schema (one ``op`` per object; unknown fields ignored)::
 
-    {"op": "predict",   "source": str, "language": "java"|"python",
+    {"op": "predict",    "source": str, "language": "java"|"python",
      "method_name": "*", "top_k": 5, "include_vector": false}
-    {"op": "embed",     ... same selectors ...}
-    {"op": "neighbors", "vector": [...] | source selectors, "top_k": 5}
+    {"op": "embed",      ... same selectors ...}
+    {"op": "embed_file", ... same selectors ...}   # one pooled vector for
+                                                   # the whole source (the
+                                                   # hierarchical head)
+    {"op": "neighbors",  "vector": [...] | source selectors, "top_k": 5,
+     "granularity": "method"|"file"}   # file = pool the source's method
+                                       # vectors first (whole-file search
+                                       # against an exported file.vec)
     {"op": "health"}
     {"op": "reload",    "model_path": str, "wait": false}   # hot-swap
     {"op": "rollback"}
@@ -70,7 +76,7 @@ __all__ = [
 # and the fleet router's shedding decisions); unknown ops are excluded so
 # garbage requests cannot grow the registry unboundedly
 INSTRUMENTED_OPS = (
-    "predict", "embed", "neighbors", "health",
+    "predict", "embed", "embed_file", "neighbors", "health",
     "reload", "rollback", "swap_status",
 )
 
@@ -208,6 +214,8 @@ class CodeServer:
                 resolver = lambda: payload  # noqa: E731
             elif op in ("predict", "embed"):
                 resolver = self._submit_methods(request, op, gen)
+            elif op == "embed_file":
+                resolver = self._submit_file(request, gen)
             elif op == "neighbors":
                 resolver = self._submit_neighbors(request, gen)
             elif op == "reload":
@@ -413,6 +421,51 @@ class CodeServer:
 
         return resolve
 
+    def _submit_file(
+        self, request: dict, gen: Generation
+    ) -> Callable[[], dict]:
+        """The hierarchical two-level head online: embed every method of
+        the source through the micro-batcher, then attention-pool the
+        method vectors with the checkpoint's trained attention param
+        (models/hierarchical.py) into ONE file vector — whole-file
+        embedding with the same per-method device path as ``embed``."""
+        predictor = gen.predictor
+        embed_resolver = self._submit_methods(
+            {**request, "include_vector": True}, "embed", gen
+        )
+
+        def resolve() -> dict:
+            from code2vec_tpu.models.hierarchical import pool_vectors
+
+            embedded = embed_resolver()
+            names, vectors = [], []
+            for entry in embedded["methods"]:
+                cv = entry.get("code_vector")
+                if cv is not None:
+                    names.append(entry["method_name"])
+                    vectors.append(cv)
+            if not vectors:
+                return {
+                    "error": "no method in the source produced an "
+                    "embedding (nothing extracted, or every context is "
+                    "OOV against the training vocab)",
+                    "error_kind": "bad_request",
+                }
+            attn = np.asarray(
+                predictor.state.params["attention"], np.float32
+            )
+            file_vector = pool_vectors(
+                np.asarray(vectors, np.float32), attn
+            )
+            return {
+                "ok": True,
+                "file_vector": [float(v) for v in file_vector],
+                "n_methods": len(vectors),
+                "method_names": names,
+            }
+
+        return resolve
+
     def _submit_neighbors(
         self, request: dict, gen: Generation
     ) -> Callable[[], dict]:
@@ -423,6 +476,12 @@ class CodeServer:
                 "--code_vec_path (an exported code.vec)"
             )
         top_k = int(request.get("top_k", 5))
+        granularity = request.get("granularity", "method")
+        if granularity not in ("method", "file"):
+            raise ValueError(
+                f"granularity must be 'method' or 'file', got "
+                f"{granularity!r}"
+            )
         vector = request.get("vector")
         if vector is not None:
             vec = np.asarray(vector, np.float32)
@@ -439,6 +498,33 @@ class CodeServer:
                 ],
             }
             return lambda: payload
+
+        # source-form at FILE granularity: pool the source's method
+        # vectors into one file vector (the hierarchical head), then
+        # retrieve — whole-file search against a file.vec-backed index
+        # (export.export_file_vectors) through the unchanged stack
+        if granularity == "file":
+            want_vector = bool(request.get("include_vector", False))
+            file_resolver = self._submit_file(request, gen)
+
+            def resolve_file() -> dict:
+                payload = file_resolver()
+                if "error" in payload:
+                    return payload
+                vec = np.asarray(payload["file_vector"], np.float32)
+                out = {
+                    "ok": True,
+                    "n_methods": payload["n_methods"],
+                    "neighbors": [
+                        {"name": n, "similarity": s}
+                        for n, s in retrieval.top_k(vec, top_k)
+                    ],
+                }
+                if want_vector:
+                    out["file_vector"] = payload["file_vector"]
+                return out
+
+            return resolve_file
 
         # source-form: embed through the micro-batcher, then retrieve.
         # include_vector=True here is internal plumbing — remember whether

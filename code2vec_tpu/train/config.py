@@ -66,6 +66,11 @@ class TrainConfig:
     pallas_impl: str = "pool_only"
     pallas_dma_depth: int = 2  # fused-impl gather double-buffer slots
     pallas_chunk_l: int = 128  # fused-impl bag-chunk lane tile
+    # bag-softmax numerics of the fused kernel (ops/fused_encode_pool.py):
+    # "auto" (materialize at ladder widths, flash-style online above the
+    # base top when --max_contexts 0 adds longbag rungs) | "materialize" |
+    # "online" | "two_pass"
+    pallas_softmax: str = "auto"
     # embedding-table storage dtype for SERVING/EVAL forwards: f32 (train
     # master weights; the only dtype train() accepts) | bf16 | int8 (per-row
     # scale, dequant on load — ops/quant.py). Export/predict accept it.
@@ -116,6 +121,16 @@ class TrainConfig:
     # comma list of bag widths ending at max_path_length (e.g. "25,50,100,200");
     # empty = derive a geometric ladder from the corpus length histogram
     bucket_ladder: str = ""
+    # per-example context cap: -1 = follow max_path_length (the historical
+    # behavior — every path silently subsamples long bags down to the bag
+    # width); 0 = UNBOUNDED (longbag mode, requires --bucketed): nothing is
+    # truncated — the bucket ladder grows longbag rungs above
+    # max_path_length (multiples of pallas_chunk_l, derived from the corpus
+    # length histogram / CSR footer — data/pipeline.derive_longbag_ladder)
+    # and widths above the base top stream through the fused kernel's
+    # chunked softmax in bounded VMEM. A positive value is rejected: the
+    # bounded cap IS max_path_length — two knobs for one cap would drift.
+    max_contexts: int = -1
     # streaming epochs: build at most this many epoch rows at a time instead
     # of materializing the whole [N, L] epoch (0 = materialize). Bounds host
     # RSS at java-large scale — see docs/ARCHITECTURE.md memory budget

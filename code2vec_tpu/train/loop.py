@@ -50,6 +50,7 @@ from code2vec_tpu.data.pipeline import (
     bucket_batch_counts,
     build_epoch,
     derive_bucket_ladder,
+    derive_longbag_ladder,
     empty_batch,
     iter_batches,
     make_batch_source,
@@ -59,6 +60,7 @@ from code2vec_tpu.data.pipeline import (
     parse_bucket_ladder,
     skip_batches,
     split_items,
+    truncated_fraction_of_counts,
 )
 from code2vec_tpu.data.reader import CorpusData
 from code2vec_tpu.metrics import evaluate
@@ -286,6 +288,13 @@ def model_config_from(config: TrainConfig, data: CorpusData) -> Code2VecConfig:
         pallas_impl=config.pallas_impl,
         pallas_dma_depth=config.pallas_dma_depth,
         pallas_chunk_l=config.pallas_chunk_l,
+        pallas_softmax=config.pallas_softmax,
+        # --max_contexts 0: widths above the base ladder top are longbag
+        # shapes — the model forces them through the fused kernel's
+        # chunked softmax (bounded VMEM) when Pallas is on
+        longbag_width=(
+            config.max_path_length if config.max_contexts == 0 else 0
+        ),
         table_dtype=config.table_dtype,
         attn_impl=config.attn_impl,
         encoder_impl=config.encoder_impl,
@@ -585,6 +594,61 @@ def train(
             len(bucket_ladder),
         )
 
+    # --max_contexts 0: the longbag arm — nothing is truncated. The ladder
+    # grows rungs above max_path_length (multiples of pallas_chunk_l,
+    # derived from the corpus length histogram) and epoch builds cap at the
+    # TOP rung, so every context of every method is fed; widths above the
+    # base top stream through the fused kernel's chunked softmax (the
+    # model's longbag_width dispatch) in bounded VMEM.
+    if config.max_contexts > 0:
+        raise ValueError(
+            "--max_contexts accepts -1 (follow --max_path_length) or 0 "
+            "(unbounded longbag mode); for a bounded cap set "
+            "--max_path_length itself — two knobs for one cap would drift"
+        )
+    bag_width = config.max_path_length  # the epoch-build context cap
+    if config.max_contexts == 0:
+        if not config.bucketed:
+            raise ValueError(
+                "--max_contexts 0 (unbounded bags) requires --bucketed: "
+                "without a ladder every example would pad to the longest "
+                "bag in the corpus"
+            )
+        if config.device_epoch:
+            raise ValueError(
+                "--max_contexts 0 does not compose with --device_epoch "
+                "(device staging samples at fixed ladder widths resolved "
+                "before the longbag rungs existed); drop one flag"
+            )
+        if data.shard is not None:
+            raise ValueError(
+                "--max_contexts 0 with a host-sharded corpus would derive "
+                "a different longbag ladder on every host (each sees only "
+                "its shard's length histogram); load the corpus unsharded "
+                "or pin the full ladder explicitly in a follow-up run"
+            )
+        lengths, weights = np.unique(
+            np.diff(data.row_splits), return_counts=True
+        )
+        longbag_rungs = derive_longbag_ladder(
+            lengths, weights, config.max_path_length,
+            chunk_l=config.pallas_chunk_l,
+        )
+        if longbag_rungs:
+            bucket_ladder = tuple(bucket_ladder) + longbag_rungs
+            bag_width = bucket_ladder[-1]
+            logger.info(
+                "longbag: ladder extended to %s (rungs above %d stream "
+                "through the chunked softmax; zero truncation)",
+                list(bucket_ladder), config.max_path_length,
+            )
+        else:
+            logger.info(
+                "longbag: no bag exceeds max_path_length %d — ladder "
+                "unchanged, truncation already zero",
+                config.max_path_length,
+            )
+
     np_rng = np.random.default_rng(config.random_seed)
     jax_rng = jax.random.PRNGKey(config.random_seed)
 
@@ -604,6 +668,23 @@ def train(
         "OOV rate: %s",
         oov_rate(data, train_idx, test_idx, exact=config.eval_method == "exact"),
     )
+
+    # corpus-static truncation accounting (method-task row geometry): the
+    # fraction of real contexts the per-example cap drops. The subsample
+    # redraws per epoch but the capped LOSS is pure geometry, so one
+    # computation serves every epoch's metrics/gauge; --max_contexts 0
+    # drives it to exactly 0 (the longbag acceptance bar).
+    truncated_ctx_fraction = None
+    if data.infer_method and len(train_idx):
+        truncated_ctx_fraction = truncated_fraction_of_counts(
+            np.diff(data.row_splits)[train_idx], bag_width
+        )
+        if truncated_ctx_fraction > 0:
+            logger.info(
+                "context cap %d truncates %.2f%% of real train contexts "
+                "(--max_contexts 0 feeds them all)",
+                bag_width, 100.0 * truncated_ctx_fraction,
+            )
 
     model_config = model_config_from(config, data)
     class_weights = class_weights_from(config, data)
@@ -1141,11 +1222,13 @@ def train(
             stream_chunk_items=config.stream_chunk_items,
             shuffle_variable_indexes=config.shuffle_variable_indexes,
         )
+        # bag_width, not max_path_length: in longbag mode the epoch builds
+        # cap at the TOP rung, so nothing is truncated
         train_source = make_batch_source(
-            data, train_idx, feed_batch, config.max_path_length, **source_kw
+            data, train_idx, feed_batch, bag_width, **source_kw
         )
         test_source = make_batch_source(
-            data, test_idx, feed_batch, config.max_path_length, **source_kw
+            data, test_idx, feed_batch, bag_width, **source_kw
         )
         logger.info(
             "host feed: %s (ladder %s)",
@@ -1376,6 +1459,14 @@ def train(
                 # no wasted gathers/FLOPs/HBM traffic on PAD)
                 metrics["pad_efficiency"] = pad_efficiency
                 health.gauge("pad_efficiency").set(pad_efficiency)
+            if truncated_ctx_fraction is not None:
+                # the truncation-loss gauge the longbag arm drives to 0:
+                # fraction of the corpus's REAL contexts the per-example
+                # cap silently drops — invisible until PR 13
+                metrics["truncated_context_fraction"] = truncated_ctx_fraction
+                health.gauge("truncated_context_fraction").set(
+                    truncated_ctx_fraction
+                )
             if profiler is not None:
                 attribution = profiler.summary()
                 if attribution is not None:
@@ -1427,10 +1518,12 @@ def train(
                 # methods with more contexts than the bag size an exported
                 # prediction can differ from the one behind the logged F1
                 # (host mode re-runs forward on the same sampled epoch).
+                # bag_width = the ladder top, so longbag exports embed the
+                # UNTRUNCATED bags.
                 return build_epoch(
                     data,
                     item_idx,
-                    config.max_path_length,
+                    bag_width,
                     np_rng,
                     config.shuffle_variable_indexes,
                 )

@@ -1,0 +1,787 @@
+"""Long-bag encoding (PR 13): flash-style chunked softmax in the fused
+kernel, longbag ladder rungs, truncation accounting, the hierarchical
+file/class head, and serve-side longbag routing.
+
+Everything runs in Pallas interpreter mode on CPU (the same code path the
+TPU compiles); kernel parity is always against the unfused XLA reference.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.data.pipeline import (
+    derive_bucket_ladder,
+    derive_longbag_ladder,
+    truncated_fraction,
+    truncated_fraction_of_counts,
+)
+from code2vec_tpu.ops.fused_encode_pool import SOFTMAX_MODES
+from tests.test_fused import call, op_inputs, reference
+
+pytestmark = pytest.mark.longbag
+
+
+# ---------------------------------------------------------------------------
+# chunked softmax: kernel parity
+# ---------------------------------------------------------------------------
+
+
+class TestChunkedSoftmaxParity:
+    """The acceptance matrix: both chunked modes match the unfused XLA
+    reference across the chunk_l x dma_depth grid, including multi-chunk
+    bags (L spans several chunk tiles), the single-chunk degenerate case
+    (L below one chunk), partial batch tiles, and all-masked rows."""
+
+    @pytest.mark.parametrize("mode", ["online", "two_pass"])
+    @pytest.mark.parametrize("chunk_l,dma_depth", [
+        (128, 1), (128, 2), (64, 2), (64, 3), (256, 2),
+    ])
+    def test_multi_chunk_matches_xla(self, mode, chunk_l, dma_depth):
+        # L=300 pads to 384 lanes: 3-6 chunks depending on chunk_l (256
+        # does not divide 384 and falls back to 128 — still chunked)
+        inp = op_inputs(5, 300, seed=chunk_l + dma_depth)
+        cv_ref, w_ref = reference(inp)
+        cv, w = call(
+            inp, impl="fused", block_b=4, dma_depth=dma_depth,
+            chunk_l=chunk_l, softmax_mode=mode,
+        )
+        np.testing.assert_allclose(
+            np.asarray(cv), np.asarray(cv_ref), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(w_ref), rtol=2e-5, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("mode", ["online", "two_pass"])
+    def test_single_chunk_degenerate(self, mode):
+        # L=21 pads to one 128-lane chunk: the streamed recurrence must
+        # collapse to the one-shot softmax exactly
+        inp = op_inputs(3, 21, seed=9)
+        cv_ref, w_ref = reference(inp)
+        cv, w = call(inp, impl="fused", block_b=4, softmax_mode=mode)
+        np.testing.assert_allclose(
+            np.asarray(cv), np.asarray(cv_ref), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(w_ref), rtol=1e-5, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("mode", ["online", "two_pass"])
+    def test_all_masked_row_uniform_over_real_length(self, mode):
+        inp = op_inputs(5, 150, seed=7, all_masked_row=2)
+        cv_ref, w_ref = reference(inp)
+        cv, w = call(inp, impl="fused", block_b=4, softmax_mode=mode)
+        np.testing.assert_allclose(
+            np.asarray(w[2]), np.asarray(w_ref[2]), rtol=1e-5
+        )
+        np.testing.assert_allclose(float(np.asarray(w)[2].sum()), 1.0,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(cv[2]), np.asarray(cv_ref[2]), rtol=1e-4, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("mode", ["online", "two_pass"])
+    def test_grads_exact_to_unfused(self, mode):
+        # the custom_vjp backward (XLA recompute over saved primals) is
+        # softmax-mode-independent by construction; pin it anyway — a
+        # forward/backward split bug would show here first
+        inp = op_inputs(4, 140, seed=11)
+        names = ("t_table", "p_table", "dense_kernel", "ln_scale",
+                 "ln_bias", "attn_param")
+
+        def loss(fn):
+            def inner(*diff):
+                d = dict(inp, **dict(zip(names, diff)))
+                cv, w = fn(d)
+                return jnp.sum(cv**2) + jnp.sum(w * jnp.cos(w))
+
+            return inner
+
+        args = tuple(inp[n] for n in names)
+        g_ref = jax.grad(loss(reference), argnums=tuple(range(6)))(*args)
+        g_chunked = jax.grad(
+            loss(lambda d: call(
+                d, impl="fused", block_b=4, chunk_l=64, softmax_mode=mode
+            )),
+            argnums=tuple(range(6)),
+        )(*args)
+        for a, b in zip(g_chunked, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            )
+
+    def test_int8_tables_through_chunked_modes(self):
+        from code2vec_tpu.ops.quant import quantize_table
+
+        inp = op_inputs(4, 150, seed=5)
+        qinp = dict(
+            inp,
+            t_table=quantize_table(inp["t_table"], "int8"),
+            p_table=quantize_table(inp["p_table"], "int8"),
+        )
+        cv_ref, _ = reference(qinp)
+        for mode in ("online", "two_pass"):
+            cv, _ = call(qinp, impl="fused", block_b=4, softmax_mode=mode)
+            np.testing.assert_allclose(
+                np.asarray(cv), np.asarray(cv_ref), rtol=1e-4, atol=1e-4
+            )
+
+    def test_chunked_requires_fused_impl(self):
+        inp = op_inputs(3, 16, seed=1)
+        with pytest.raises(ValueError, match="impl='fused'"):
+            call(inp, impl="gather_split", softmax_mode="online")
+
+    def test_unknown_mode_fails_loudly(self):
+        inp = op_inputs(3, 16, seed=1)
+        with pytest.raises(ValueError, match="softmax_mode"):
+            call(inp, impl="fused", softmax_mode="typo")
+        assert "materialize" in SOFTMAX_MODES
+
+
+class TestChunkedOnMesh:
+    """The chunked kernel composed with mesh axes: the op's
+    custom_partitioning rule shards the batch dim (same contract as
+    TestFusedOnMesh for the materialized kernel), on the 8-device CPU
+    harness."""
+
+    @pytest.mark.parametrize("mode", ["online", "two_pass"])
+    def test_matches_xla_path_on_mesh(self, mode):
+        from code2vec_tpu.models.code2vec import Code2VecConfig
+        from code2vec_tpu.parallel.mesh import make_mesh
+        from code2vec_tpu.parallel.shardings import shard_batch, shard_state
+        from code2vec_tpu.parallel.step import make_parallel_train_step
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state
+
+        mesh = make_mesh(data=4, model=2, ctx=1)
+        rng = np.random.default_rng(0)
+        B, L = 16, 150  # two 128-lane chunks
+        base = dict(
+            terminal_count=60, path_count=50, label_count=9,
+            terminal_embed_size=8, path_embed_size=8, encode_size=16,
+            dropout_prob=0.0,
+        )
+        batch = {
+            "ids": np.arange(B, dtype=np.int64),
+            "starts": rng.integers(1, 60, (B, L)).astype(np.int32),
+            "paths": rng.integers(1, 50, (B, L)).astype(np.int32),
+            "ends": rng.integers(1, 60, (B, L)).astype(np.int32),
+            "labels": rng.integers(0, 9, B).astype(np.int32),
+            "example_mask": np.ones(B, np.float32),
+        }
+        batch["starts"][:, L // 2 :] = 0
+
+        losses = {}
+        for use_chunked in (False, True):
+            mc = Code2VecConfig(
+                **base,
+                use_pallas=use_chunked,
+                pallas_impl="fused",
+                pallas_block_b=4,
+                pallas_softmax=mode,
+            )
+            tc = TrainConfig(batch_size=B, max_path_length=L)
+            state = create_train_state(tc, mc, jax.random.PRNGKey(0), batch)
+            state = shard_state(mesh, state)
+            cw = jnp.ones(mc.label_count, jnp.float32)
+            step = make_parallel_train_step(mc, cw, mesh, state)
+            device_batch = shard_batch(mesh, batch)
+            state, loss = step(state, device_batch)
+            state, loss2 = step(state, device_batch)
+            losses[use_chunked] = (float(loss), float(loss2))
+        np.testing.assert_allclose(losses[False], losses[True], rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# longbag ladder derivation + truncation accounting
+# ---------------------------------------------------------------------------
+
+
+class TestLongbagLadder:
+    def test_empty_when_nothing_exceeds_base(self):
+        lengths = np.array([5, 20, 64])
+        weights = np.array([10, 10, 10])
+        assert derive_longbag_ladder(lengths, weights, 64) == ()
+
+    def test_rungs_are_chunk_multiples_and_cover_max(self):
+        lengths = np.array([10, 100, 900])
+        weights = np.array([50, 20, 3])
+        rungs = derive_longbag_ladder(lengths, weights, 64, chunk_l=128)
+        assert rungs
+        assert all(w % 128 == 0 for w in rungs)
+        assert rungs[-1] >= 900
+        assert all(w > 64 for w in rungs)
+        assert list(rungs) == sorted(rungs)
+
+    def test_empty_rungs_pruned_but_top_kept(self):
+        # tail jumps straight from 70 to 4000: intermediate doublings hold
+        # nothing and are pruned; the top rung must still cover 4000
+        lengths = np.array([10, 70, 4000])
+        weights = np.array([100, 5, 1])
+        rungs = derive_longbag_ladder(lengths, weights, 64, chunk_l=128)
+        assert rungs[-1] >= 4000
+        prev = 64
+        for w in rungs[:-1]:
+            held = ((lengths > prev) & (lengths <= w) & (weights > 0)).any()
+            assert held, f"rung {w} holds nothing"
+            prev = w
+
+    def test_max_rungs_respected(self):
+        lengths = np.arange(65, 100_000, 997)
+        weights = np.ones_like(lengths)
+        rungs = derive_longbag_ladder(
+            lengths, weights, 64, chunk_l=128, max_rungs=3
+        )
+        assert len(rungs) <= 3
+        assert rungs[-1] >= lengths.max()
+
+    def test_truncated_fraction(self):
+        lengths = np.array([10, 100])
+        weights = np.array([1, 1])
+        # cap 50: drops 50 of 110 contexts
+        assert truncated_fraction(lengths, weights, 50) == pytest.approx(
+            50 / 110
+        )
+        assert truncated_fraction(lengths, weights, 100) == 0.0
+        assert truncated_fraction_of_counts(
+            np.array([10, 100, 100]), 50
+        ) == pytest.approx(100 / 210)
+        assert truncated_fraction(np.zeros(0), np.zeros(0), 10) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# --max_contexts 0 end to end
+# ---------------------------------------------------------------------------
+
+
+def heavy_tailed_corpus(seed=0, n_methods=48):
+    from code2vec_tpu.data.synth import (
+        SynthSpec,
+        corpus_data_from_raw,
+        generate_corpus_data,
+    )
+
+    spec = SynthSpec(
+        n_methods=n_methods, n_terminals=60, n_paths=50, n_labels=8,
+        mean_contexts=10.0, length_sigma=1.2, max_contexts=200, seed=seed,
+    )
+    return corpus_data_from_raw(generate_corpus_data(spec))
+
+
+class TestLongbagTrain:
+    def test_unbounded_trains_with_zero_truncation(self, tmp_path):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        data = heavy_tailed_corpus()
+        counts = np.diff(data.row_splits)
+        assert (counts > 16).any(), "synth corpus lost its tail"
+        cfg = TrainConfig(
+            max_epoch=1, batch_size=8, encode_size=8,
+            terminal_embed_size=4, path_embed_size=4, max_path_length=16,
+            print_sample_cycle=0, bucketed=True, max_contexts=0,
+            use_pallas=True, pallas_impl="pool_only", pallas_block_b=4,
+        )
+        res = train(cfg, data)
+        h = res.history[-1]
+        assert np.isfinite(h["train_loss"])
+        # the acceptance bar: NOTHING was truncated
+        assert h["truncated_context_fraction"] == 0.0
+
+    def test_bounded_control_reports_the_loss(self):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        data = heavy_tailed_corpus()
+        cfg = TrainConfig(
+            max_epoch=1, batch_size=8, encode_size=8,
+            terminal_embed_size=4, path_embed_size=4, max_path_length=16,
+            print_sample_cycle=0, bucketed=True,
+        )
+        h = train(cfg, data).history[-1]
+        expected = truncated_fraction_of_counts(
+            np.diff(data.row_splits)[
+                # the loop computes the fraction over the TRAIN split
+                # (seeded split, first 20% test) — recompute it here
+                np.random.default_rng(cfg.random_seed).permutation(
+                    data.n_items
+                )[int(data.n_items * 0.2):]
+            ],
+            16,
+        )
+        assert h["truncated_context_fraction"] == pytest.approx(expected)
+        assert h["truncated_context_fraction"] > 0
+
+    def test_unbounded_requires_bucketed(self):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        data = heavy_tailed_corpus()
+        with pytest.raises(ValueError, match="--bucketed"):
+            train(TrainConfig(max_contexts=0, max_epoch=1), data)
+
+    def test_positive_max_contexts_rejected(self):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        data = heavy_tailed_corpus()
+        with pytest.raises(ValueError, match="max_path_length"):
+            train(
+                TrainConfig(max_contexts=99, bucketed=True, max_epoch=1),
+                data,
+            )
+
+    def test_unbounded_rejects_device_epoch(self):
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        data = heavy_tailed_corpus()
+        with pytest.raises(ValueError, match="device_epoch"):
+            train(
+                TrainConfig(
+                    max_contexts=0, bucketed=True, device_epoch=True,
+                    max_epoch=1,
+                ),
+                data,
+            )
+
+    def test_meta_records_longbag_ladder(self, tmp_path):
+        from code2vec_tpu.predict import MODEL_META
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        data = heavy_tailed_corpus()
+        out_dir = str(tmp_path / "model")
+        cfg = TrainConfig(
+            max_epoch=1, batch_size=8, encode_size=8,
+            terminal_embed_size=4, path_embed_size=4, max_path_length=16,
+            print_sample_cycle=0, bucketed=True, max_contexts=0,
+        )
+        train(cfg, data, out_dir=out_dir)
+        meta = json.load(open(f"{out_dir}/{MODEL_META}"))
+        ladder = meta["bucket_ladder"]
+        # the recorded ladder carries rungs ABOVE the base bag width, so
+        # the serving engine inherits longbag routing with no corpus
+        assert ladder[-1] > meta["max_path_length"]
+        assert meta["max_path_length"] == 16
+
+
+# ---------------------------------------------------------------------------
+# serve: longbag routing vs the loud reject
+# ---------------------------------------------------------------------------
+
+
+class TestServeLongbag:
+    BAG = 16
+    LONGBAG_LADDER = (8, 16, 128)  # one longbag rung above the bag
+
+    @pytest.fixture(scope="class")
+    def tiny_state(self):
+        from code2vec_tpu.models.code2vec import Code2VecConfig
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.step import create_train_state
+
+        cfg = TrainConfig(batch_size=4, max_path_length=self.BAG)
+        mc = Code2VecConfig(
+            terminal_count=50, path_count=40, label_count=6,
+            terminal_embed_size=8, path_embed_size=8, encode_size=12,
+            dropout_prob=0.0,
+        )
+        example = {
+            "starts": np.zeros((1, self.BAG), np.int32),
+            "paths": np.zeros((1, self.BAG), np.int32),
+            "ends": np.zeros((1, self.BAG), np.int32),
+            "labels": np.zeros(1, np.int32),
+            "example_mask": np.ones(1, np.float32),
+        }
+        return create_train_state(cfg, mc, jax.random.PRNGKey(0), example)
+
+    def request_of(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        return np.stack(
+            [
+                rng.integers(1, 50, n),
+                rng.integers(1, 40, n),
+                rng.integers(1, 50, n),
+            ],
+            axis=1,
+        ).astype(np.int32)
+
+    def test_longbag_rungs_serve_oversized_requests(self, tiny_state):
+        from code2vec_tpu.obs.runtime import RuntimeHealth
+        from code2vec_tpu.serve.batcher import MicroBatcher
+        from code2vec_tpu.serve.engine import ServingEngine
+
+        engine = ServingEngine(
+            tiny_state, max_width=self.BAG, ladder=self.LONGBAG_LADDER,
+            batch_sizes=(1, 4), health=RuntimeHealth(),
+        )
+        engine.prepare()
+        # the rungs raised the serveable width to the ladder top
+        assert engine.max_width == self.LONGBAG_LADDER[-1]
+        assert engine.base_width == self.BAG
+        with MicroBatcher(engine, deadline_ms=0.0,
+                          health=RuntimeHealth()) as batcher:
+            # a bag far beyond the training width serves — no reject, no
+            # truncation — through a pre-compiled longbag executable
+            result = batcher.submit(self.request_of(100)).result(timeout=60)
+            assert result.width == 128
+            assert result.n_contexts == 100
+            assert len(result.attention) == 100
+            assert np.isfinite(result.code_vector).all()
+        # ...and it hit a warm executable: zero post-warmup compiles
+        assert engine.post_warmup_compiles == 0
+
+    def test_beyond_top_rung_still_rejects_loudly(self, tiny_state):
+        from code2vec_tpu.obs.runtime import RuntimeHealth
+        from code2vec_tpu.serve.batcher import MicroBatcher
+        from code2vec_tpu.serve.engine import ServingEngine
+
+        engine = ServingEngine(
+            tiny_state, max_width=self.BAG, ladder=self.LONGBAG_LADDER,
+            batch_sizes=(1,), health=RuntimeHealth(),
+        )
+        engine.prepare()
+        with MicroBatcher(engine, deadline_ms=0.0,
+                          health=RuntimeHealth()) as batcher:
+            with pytest.raises(ValueError, match="subsample"):
+                batcher.submit(self.request_of(129))
+
+    def test_no_rungs_keeps_the_original_reject(self, tiny_state):
+        # regression: a ladder WITHOUT longbag rungs must reject oversized
+        # bags at submit exactly as before PR 13
+        from code2vec_tpu.obs.runtime import RuntimeHealth
+        from code2vec_tpu.serve.batcher import MicroBatcher
+        from code2vec_tpu.serve.engine import ServingEngine
+
+        engine = ServingEngine(
+            tiny_state, max_width=self.BAG, ladder=(8, 16),
+            batch_sizes=(1,), health=RuntimeHealth(),
+        )
+        engine.prepare()
+        assert engine.max_width == self.BAG
+        with MicroBatcher(engine, deadline_ms=0.0,
+                          health=RuntimeHealth()) as batcher:
+            with pytest.raises(ValueError, match="subsample"):
+                batcher.submit(self.request_of(self.BAG + 1))
+
+    def test_ladder_below_max_width_still_rejected(self, tiny_state):
+        from code2vec_tpu.serve.engine import ServingEngine
+
+        with pytest.raises(ValueError, match="reach max_width"):
+            ServingEngine(
+                tiny_state, max_width=self.BAG, ladder=(4, 8),
+                batch_sizes=(1,),
+            )
+
+
+# ---------------------------------------------------------------------------
+# hierarchical file/class pooling
+# ---------------------------------------------------------------------------
+
+
+class TestHierarchicalPool:
+    def test_group_pooling_matches_manual_softmax(self):
+        from code2vec_tpu.models.hierarchical import (
+            pool_vectors,
+            pool_vectors_by_group,
+        )
+
+        rng = np.random.default_rng(0)
+        vectors = rng.normal(size=(6, 4)).astype(np.float32)
+        attn = rng.normal(size=4).astype(np.float32)
+        groups = ["a.java", "b.java", "a.java", "c.java", "b.java", "a.java"]
+        keys, pooled = pool_vectors_by_group(vectors, groups, attn)
+        assert keys == ["a.java", "b.java", "c.java"]  # first appearance
+        rows_a = vectors[[0, 2, 5]]
+        s = rows_a @ attn
+        w = np.exp(s - s.max())
+        w /= w.sum()
+        np.testing.assert_allclose(
+            pooled[0], (w @ rows_a).astype(np.float32), rtol=1e-6
+        )
+        # mean fallback
+        _, pooled_mean = pool_vectors_by_group(vectors, groups, None)
+        np.testing.assert_allclose(
+            pooled_mean[2], vectors[[3]].mean(axis=0), rtol=1e-6
+        )
+        with pytest.raises(ValueError, match="non-empty"):
+            pool_vectors(np.zeros((0, 4), np.float32), attn)
+
+    def test_flax_module_matches_numpy_pooling(self):
+        from code2vec_tpu.models.hierarchical import (
+            HierarchicalAttentionPool,
+            pool_vectors,
+        )
+
+        rng = np.random.default_rng(1)
+        G, M, H = 3, 5, 8
+        vectors = rng.normal(size=(G, M, H)).astype(np.float32)
+        mask = np.ones((G, M), np.float32)
+        mask[1, 3:] = 0.0  # padded group
+        module = HierarchicalAttentionPool(encode_size=H)
+        params = module.init(jax.random.PRNGKey(0), vectors, mask)
+        (fv, attn_w), p = (
+            module.apply(params, vectors, mask),
+            params["params"]["file_attention"],
+        )
+        fv = np.asarray(fv)
+        for g in range(G):
+            real = vectors[g][mask[g].astype(bool)]
+            np.testing.assert_allclose(
+                fv[g], pool_vectors(real, np.asarray(p)), rtol=1e-5,
+                atol=1e-6,
+            )
+        # masked slots carry ~zero attention weight
+        assert np.asarray(attn_w)[1, 3:].max() < 1e-30
+
+    def test_file_vectors_round_trip_through_retrieval(self, tmp_path):
+        """The acceptance criterion: file-level vectors from the
+        hierarchical head round-trip export -> retrieval — `neighbors`
+        returns them through the EXISTING serving stack."""
+        from code2vec_tpu.export import export_file_vectors
+        from code2vec_tpu.formats.vectors_io import read_code_vectors
+        from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+        rng = np.random.default_rng(2)
+        method_vectors = rng.normal(size=(12, 8)).astype(np.float32)
+        groups = [f"file_{i % 4}.java" for i in range(12)]
+        attn = rng.normal(size=8).astype(np.float32)
+        path = str(tmp_path / "file.vec")
+        keys, pooled = export_file_vectors(
+            method_vectors, groups, path, attn_param=attn
+        )
+        assert len(keys) == 4 and pooled.shape == (4, 8)
+
+        labels, rows = read_code_vectors(path)
+        assert labels == [str(k) for k in keys]
+        np.testing.assert_allclose(rows, pooled, rtol=1e-5)
+
+        index = RetrievalIndex.from_code_vec(path)
+        # querying a file's own vector returns that file first, sim ~1
+        for g, key in enumerate(keys):
+            neighbors = index.top_k(pooled[g], 2)
+            assert neighbors[0][0] == str(key)
+            assert neighbors[0][1] == pytest.approx(1.0, abs=1e-4)
+
+    PY = (
+        "def add(a, b):\n    total = a + b\n    return total\n\n\n"
+        "def mul(a, b):\n    product = a * b\n    return product\n"
+    )
+
+    def test_predictor_embed_file(self, tmp_path):
+        """Online path: pyextract-train tiny -> Predictor.embed_file pools
+        the source's per-method vectors with the checkpoint's attention."""
+        from code2vec_tpu.data.reader import load_corpus
+        from code2vec_tpu.models.hierarchical import pool_vectors
+        from code2vec_tpu.predict import Predictor
+        from code2vec_tpu.pyextract import extract_python_dataset
+        from code2vec_tpu.train.config import TrainConfig
+        from code2vec_tpu.train.loop import train
+
+        src, ds, out = tmp_path / "src", tmp_path / "ds", tmp_path / "out"
+        for d in (src, ds, out):
+            d.mkdir()
+        (src / "util.py").write_text(self.PY)
+        extract_python_dataset(str(ds), str(src), [("util.py", "*")])
+        data = load_corpus(
+            ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+        )
+        cfg = TrainConfig(
+            max_epoch=2, batch_size=2, encode_size=16,
+            terminal_embed_size=8, path_embed_size=8, max_path_length=32,
+            print_sample_cycle=0,
+        )
+        train(cfg, data, out_dir=str(out))
+        predictor = Predictor(
+            str(out), str(ds / "terminal_idxs.txt"), str(ds / "path_idxs.txt")
+        )
+        file_vector, names = predictor.embed_file(self.PY, language="python")
+        assert file_vector.shape == (16,)
+        assert np.isfinite(file_vector).all()
+        assert len(names) == 2
+        # cross-check against manual per-method embed + pool
+        vectors = [
+            m.code_vector
+            for m in predictor.predict_source(self.PY, language="python")
+        ]
+        attn = np.asarray(predictor.state.params["attention"], np.float32)
+        np.testing.assert_allclose(
+            file_vector, pool_vectors(np.stack(vectors), attn),
+            rtol=1e-5, atol=1e-6,
+        )
+
+        # the serving surface on the same checkpoint: embed_file op + the
+        # file-granularity neighbors path against an exported file.vec
+        from code2vec_tpu.export import export_file_vectors
+        from code2vec_tpu.obs.runtime import RuntimeHealth
+        from code2vec_tpu.serve.batcher import MicroBatcher
+        from code2vec_tpu.serve.engine import ServingEngine
+        from code2vec_tpu.serve.protocol import CodeServer
+        from code2vec_tpu.serve.retrieval import RetrievalIndex
+
+        file_vec_path = str(tmp_path / "file.vec")
+        export_file_vectors(
+            np.stack(vectors), ["util.py", "util.py"], file_vec_path,
+            attn_param=attn,
+        )
+        engine = ServingEngine.from_predictor(
+            predictor, health=RuntimeHealth()
+        )
+        engine.prepare()
+        batcher = MicroBatcher(engine, deadline_ms=0.0, health=RuntimeHealth())
+        server = CodeServer(
+            predictor, engine, batcher,
+            retrieval=RetrievalIndex.from_code_vec(file_vec_path),
+        )
+        try:
+            resp = server.handle(
+                {"op": "embed_file", "source": self.PY, "language": "python"}
+            )
+            assert resp["ok"] and resp["n_methods"] == 2
+            np.testing.assert_allclose(
+                np.asarray(resp["file_vector"], np.float32), file_vector,
+                rtol=1e-4, atol=1e-5,
+            )
+            nn = server.handle({
+                "op": "neighbors", "source": self.PY, "language": "python",
+                "granularity": "file", "top_k": 1,
+            })
+            assert nn["ok"]
+            # the whole-file query comes back as its own exported file row
+            assert nn["neighbors"][0]["name"] == "util.py"
+            assert nn["neighbors"][0]["similarity"] == pytest.approx(
+                1.0, abs=1e-3
+            )
+            bad = server.handle({
+                "op": "neighbors", "source": self.PY, "language": "python",
+                "granularity": "typo",
+            })
+            assert bad["error_kind"] == "bad_request"
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# tools + autotune surface
+# ---------------------------------------------------------------------------
+
+
+class TestTruncationTooling:
+    def test_corpus_stats_reports_truncation_and_longbag(self, tmp_path):
+        import os
+
+        corpus = tmp_path / "corpus.txt"
+        records = []
+        for n in (3, 5, 40):
+            rows = "\n".join("1\t2\t3" for _ in range(n))
+            records.append(f"id:0\nlabel:m\npaths:\n{rows}\n")
+        corpus.write_text("\n".join(records) + "\n")
+        tool = os.path.join(
+            os.path.dirname(__file__), "..", "tools", "corpus_stats.py"
+        )
+        proc = subprocess.run(
+            [sys.executable, tool, str(corpus), "--max_contexts", "8"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = proc.stdout
+        payload = json.loads(out.strip().splitlines()[-1])
+        # 48 contexts total, cap 8 keeps 8 of the 40-bag: (40-8)/48
+        assert payload["truncated_context_fraction"] == pytest.approx(
+            32 / 48
+        )
+        assert payload["longbag_ladder"]
+        assert payload["longbag_ladder"][-1] >= 40
+        assert "truncated at L=8" in out
+
+    def test_autotune_softmax_axis_round_trips(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        variants = at.enumerate_variants(8, 300, "f32")
+        modes = {
+            v.softmax for v in variants if v.impl == "fused"
+        }
+        assert modes == {"materialize", "online", "two_pass"}
+        # labels disambiguate the chunked variants
+        labels = {at._variant_label(v) for v in variants}
+        assert any(label.endswith("/online") for label in labels)
+
+        # a chunked schedule persists and loads back intact
+        cache = at.ScheduleCache(str(tmp_path / "sched.json"))
+        key = at.ShapeKey(
+            device_kind="cpu", batch=8, width=384, terminal_embed=4,
+            path_embed=4, encode=8, table_dtype="f32",
+        )
+        cache.put(
+            key,
+            at.KernelSchedule(impl="fused", chunk_l=128, softmax="online"),
+        )
+        cache.save()
+        loaded = at.ScheduleCache(cache.path).get(key)
+        assert loaded.softmax == "online" and loaded.source == "cache"
+        # pre-PR-13 entries (no softmax field) default to materialize
+        old = at.KernelSchedule.from_dict({"impl": "fused", "block_b": 8})
+        assert old.softmax == "materialize"
+
+
+class TestBenchLongbagAB:
+    def test_metric_id(self):
+        import importlib.util
+        import os
+
+        bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        spec = importlib.util.spec_from_file_location("_bench_lab", bench_path)
+        bench = importlib.util.module_from_spec(spec)
+        old = sys.argv
+        try:
+            sys.argv = ["bench.py", "--longbag-ab"]
+            spec.loader.exec_module(bench)
+            assert bench._metric_id() == (
+                "longbag_real_contexts_per_sec", "contexts/sec"
+            )
+        finally:
+            sys.argv = old
+
+    @pytest.mark.slow
+    def test_end_to_end_cpu_interpret(self, tmp_path):
+        import os
+
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            BENCH_SUPERVISED="1",
+            BENCH_AB_REPEATS="1",
+        )
+        bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        proc = subprocess.run(
+            [sys.executable, bench_path, "--longbag-ab"],
+            env=env, capture_output=True, text=True, timeout=540,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        metric = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert metric["metric"] == "longbag_real_contexts_per_sec"
+        assert metric["value"] and metric["value"] > 0
+        detail = None
+        for line in proc.stderr.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"detail"' in line:
+                detail = json.loads(line)["detail"]
+        assert detail["verdict_ok"] is True
+        assert detail["post_warmup_recompiles"] == 0
+        # the acceptance numbers: the chunked arm truncates NOTHING while
+        # the control drops a real fraction
+        assert detail["truncated_context_fraction_chunked"] == 0.0
+        assert detail["truncated_context_fraction_truncated"] > 0
+        assert detail["real_contexts_chunked"] > detail[
+            "real_contexts_truncated"
+        ]

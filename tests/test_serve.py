@@ -151,9 +151,13 @@ def test_off_ladder_shape_is_a_post_warmup_compile(tiny_state):
     assert det.check() == 1
 
 
-def test_ladder_must_end_at_max_width(tiny_state):
-    with pytest.raises(ValueError, match="end at max_width"):
+def test_ladder_must_reach_max_width(tiny_state):
+    # below max_width still rejects; ABOVE it is the longbag contract
+    # (rungs raise the serveable width — tests/test_longbag.py pins it)
+    with pytest.raises(ValueError, match="reach max_width"):
         make_engine(tiny_state, ladder=(4, 8))
+    eng = make_engine(tiny_state, ladder=(4, 8, BAG, 128))
+    assert eng.max_width == 128 and eng.base_width == BAG
 
 
 def test_narrow_bag_ladder_is_never_empty():

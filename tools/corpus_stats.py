@@ -37,7 +37,9 @@ sys.path.insert(0, os.path.dirname(_HERE))  # repo root: the package
 from code2vec_tpu.data.pipeline import (  # noqa: E402
     assign_buckets,
     derive_bucket_ladder,
+    derive_longbag_ladder,
     pad_stats,
+    truncated_fraction_of_counts,
 )
 
 
@@ -79,6 +81,10 @@ def main(argv: list[str] | None = None) -> None:
                         help="ladder size cap (= expected step compiles)")
     parser.add_argument("--batch_size", type=int, default=1024,
                         help="batch size for the pad-efficiency estimate")
+    parser.add_argument("--chunk_l", type=int, default=128,
+                        help="chunk size of the fused kernel's streamed "
+                        "softmax — longbag rung widths round up to a "
+                        "multiple of it")
     args = parser.parse_args(argv)
 
     from code2vec_tpu.formats.corpus_io import is_csr_corpus
@@ -128,6 +134,22 @@ def main(argv: list[str] | None = None) -> None:
     print()
     print(f"pad efficiency at fixed L={args.max_contexts}: {fixed_eff:.1%}"
           f"  |  bucketed over {list(ladder)}: {ladder_eff:.1%}")
+
+    # truncation accounting: the loss the cap silently takes — every
+    # context beyond max_contexts is dropped by the per-epoch subsample,
+    # invisible in the loss curves. --max_contexts 0 (longbag rungs) feeds
+    # them all; the rung suggestion below is what that run would use.
+    trunc = truncated_fraction_of_counts(counts, args.max_contexts)
+    lengths, weights = np.unique(counts, return_counts=True)
+    longbag = derive_longbag_ladder(
+        lengths, weights, args.max_contexts, chunk_l=args.chunk_l
+    )
+    n_truncated = int((counts > args.max_contexts).sum())
+    print(f"truncated at L={args.max_contexts}: {trunc:.2%} of real "
+          f"contexts dropped ({n_truncated} methods exceed the cap)")
+    if longbag:
+        print(f"longbag rungs for --max_contexts 0: {list(longbag)} "
+              f"(truncation -> 0)")
     print(f"suggested: --bucketed --bucket_ladder "
           f"{','.join(str(w) for w in ladder)}")
     print(json.dumps({
@@ -137,6 +159,8 @@ def main(argv: list[str] | None = None) -> None:
         "ladder": list(ladder),
         "pad_efficiency_fixed": round(fixed_eff, 4),
         "pad_efficiency_bucketed": round(ladder_eff, 4),
+        "truncated_context_fraction": round(trunc, 6),
+        "longbag_ladder": list(longbag),
     }))
 
 
