@@ -65,6 +65,21 @@ import time
 import numpy as np
 
 
+def _compile_cache_dir() -> str:
+    """The persistent XLA compile-cache dir, keyed by a host CPU-feature
+    fingerprint: cached executables embed the compiling host's ISA
+    features, and reusing a dir written on a different host logs XLA's
+    "machine features mismatch ... could lead to SIGILL" warning (seen in
+    BENCH_r05) — so each CPU population gets its own dir. BENCH_COMPILE_CACHE
+    pins an explicit path."""
+    pinned = os.environ.get("BENCH_COMPILE_CACHE", "").strip()
+    if pinned:
+        return pinned
+    from code2vec_tpu.obs.runtime import host_cpu_fingerprint
+
+    return f"/tmp/jaxcache_{host_cpu_fingerprint()}"
+
+
 def _metric_id() -> tuple[str, str]:
     """(metric, unit) for this invocation's mode — failure records must be
     keyed to the benchmark that actually ran, or a crashed --prefetch-ab
@@ -79,6 +94,8 @@ def _metric_id() -> tuple[str, str]:
         return "serve_requests_per_sec", "req/sec"
     if "--ooc-ab" in sys.argv[1:]:
         return "mmap_csr_real_contexts_per_sec", "contexts/sec"
+    if "--feed-ab" in sys.argv[1:]:
+        return "feed_real_contexts_per_sec", "contexts/sec"
     if "--ann-ab" in sys.argv[1:]:
         return "ann_queries_per_sec", "queries/sec"
     if "--longbag-ab" in sys.argv[1:]:
@@ -305,7 +322,7 @@ def _probe_default_backend(timeout_s: float) -> bool:
                 # share main()'s persistent compile cache so a healthy
                 # probe costs ~1s instead of a fresh 20-40s tunnel compile
                 "import jax;"
-                "jax.config.update('jax_compilation_cache_dir', '/tmp/jaxcache');"
+                f"jax.config.update('jax_compilation_cache_dir', '{_compile_cache_dir()}');"
                 "jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0);"
                 "import jax.numpy as jnp;"
                 "jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64)))"
@@ -609,7 +626,7 @@ def _prefetch_ab() -> None:
     from code2vec_tpu.train.prefetch import StepProfiler, device_batches
     from code2vec_tpu.train.step import create_train_state, make_train_step
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     # recipe: top11 shape on a device backend; the CPU fallback shrinks the
@@ -847,7 +864,7 @@ def _bucket_ab() -> None:
     from code2vec_tpu.train.config import TrainConfig
     from code2vec_tpu.train.step import create_train_state, make_train_step
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def knob(name: str, device_default: int, cpu_default: int) -> int:
@@ -1057,7 +1074,7 @@ def _longbag_ab() -> None:
         make_train_step,
     )
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def knob(name: str, device_default: int, cpu_default: int) -> int:
@@ -1322,7 +1339,7 @@ def _ooc_ab() -> None:
     from code2vec_tpu.train.config import TrainConfig
     from code2vec_tpu.train.step import create_train_state, make_train_step
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def knob(name: str, device_default: int, cpu_default: int) -> int:
@@ -1499,6 +1516,305 @@ def _ooc_ab() -> None:
     )
 
 
+def _feed_ab() -> None:
+    """``--feed-ab``: coordinator-build vs parallel host ingest at equal
+    real-context work (ISSUE 14 acceptance instrument).
+
+    One skewed synth corpus converted to the mmap-CSR container feeds a
+    deliberately HOST-HEAVY bucketed recipe (large bags, tiny model: the
+    classic feed-starved accelerator shape) twice through the prefetched
+    host pipeline — arm A with ``--feed_workers 0`` (single-threaded
+    coordinator builds, the historical path), arm B with ``--feed_workers
+    N`` (``data/parallel_feed.py``: plans on the coordinator, builds on N
+    forked workers through the shared-memory arena). Same seeds → the two
+    arms dispatch IDENTICAL batches in identical order, so the wall-clock
+    ratio is pure feed cost. The run FAILS its verdict unless the fresh-
+    state loss trajectories match bitwise, the recompile detector saw
+    exactly the ladder's compiles, and the workers arm's measured
+    ``feed_wait_ms`` undercuts the sync arm's ``host_build_ms``
+    attribution (input-boundness must measurably shrink, not vibes).
+    ABBA best-of like the other AB arms.
+    """
+    jax, backend, fell_back = _init_backend()
+    _bench_tracer(jax)
+    import jax.numpy as jnp
+
+    from code2vec_tpu.data.parallel_feed import FeedPool, ParallelFeed
+    from code2vec_tpu.data.pipeline import MmapCorpusSource, derive_bucket_ladder
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.data.synth import SynthSpec, generate_corpus_files
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.obs.runtime import RecompileDetector
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.prefetch import StepProfiler, device_batches
+    from code2vec_tpu.train.step import create_train_state, make_train_step
+
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    def knob(name: str, device_default: int, cpu_default: int) -> int:
+        return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
+
+    batch_size = knob("BENCH_BATCH", 512, 256)
+    bag = knob("BENCH_BAG", 200, 64)
+    steps = knob("BENCH_AB_STEPS", 40, 14)  # top-width batches per pass
+    embed_size = knob("BENCH_EMBED", 64, 8)
+    encode_size = knob("BENCH_ENCODE", 64, 16)
+    # host-heavy by construction: long raw bags mean every batch pays a
+    # large subsample sort + CSR gather while the model stays tiny
+    mean_ctx = knob("BENCH_FEED_MEAN_CTX", 300, 220)
+    feed_workers = knob("BENCH_FEED_WORKERS", 4, 4)
+    prefetch = knob("BENCH_PREFETCH", 2, 2)
+    sigma = _env_float("BENCH_LENGTH_SIGMA", 0.8)
+
+    import tempfile
+
+    spec = SynthSpec(
+        n_methods=max(batch_size * steps, 2048),
+        n_terminals=knob("BENCH_AB_TERMINALS", 80_000, 20_000),
+        n_paths=knob("BENCH_AB_PATHS", 80_000, 20_000),
+        n_labels=knob("BENCH_AB_LABELS", 2_000, 800),
+        mean_contexts=float(mean_ctx),
+        length_sigma=sigma,
+        max_contexts=3 * bag,
+        seed=0,
+    )
+    tmp = tempfile.mkdtemp(prefix="c2v_feed_ab_")
+    paths = generate_corpus_files(tmp, spec)
+    csr_path = os.path.join(tmp, "corpus.csr")
+    from tools.corpus_convert import text_to_csr
+
+    text_to_csr(paths["corpus"], csr_path)
+    data = load_corpus(csr_path, paths["path_idx"], paths["terminal_idx"])
+    assert data.mmap_backed
+
+    ladder = derive_bucket_ladder(np.diff(data.row_splits), bag)
+    counts = np.minimum(np.diff(data.row_splits), bag)
+    real_total = int(counts.sum())
+    item_idx = np.arange(data.n_items)
+
+    model_config = Code2VecConfig(
+        terminal_count=spec.n_terminals + 2,
+        path_count=spec.n_paths + 1,
+        label_count=len(data.label_vocab),
+        terminal_embed_size=embed_size,
+        path_embed_size=embed_size,
+        encode_size=encode_size,
+        dropout_prob=0.25,
+        dtype=jnp.float32,
+    )
+    config = TrainConfig(
+        batch_size=batch_size,
+        max_path_length=bag,
+        rng_impl=os.environ.get("BENCH_RNG_IMPL", "unsafe_rbg"),
+    )
+    class_weights = jnp.ones(model_config.label_count, jnp.float32)
+
+    sync_source = MmapCorpusSource(
+        data, item_idx, batch_size, bag, ladder=ladder
+    )
+    pool = FeedPool(
+        data, feed_workers, batch_size, int(ladder[-1]),
+        tracer=None,
+    )
+    feed_source = ParallelFeed(
+        MmapCorpusSource(data, item_idx, batch_size, bag, ladder=ladder),
+        pool,
+    )
+
+    example_stream = sync_source.batches(np.random.default_rng(0))
+    example = next(example_stream)
+    example_stream.close()
+
+    # ONE template state, leaf-copied per pass: the step donates its state
+    # buffers, so passes that must start from the SAME weights need their
+    # own copy — and it must be a leaf copy of one state, not a second
+    # create_train_state(), whose fresh optax closures are new treedef aux
+    # data and would recompile the step per state
+    state_template = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+
+    def fresh_state():
+        return jax.tree_util.tree_map(jnp.copy, state_template)
+
+    train_step = make_train_step(model_config, class_weights)
+    detector = RecompileDetector()
+    detector.track("train_step", train_step, expected_compiles=len(ladder))
+
+    def one_pass(source, state, profiler=None, collect_losses=False):
+        """One full epoch (seeded rng → identical batch stream per arm);
+        windowed dispatch like the train loop — per-step host syncs would
+        hide exactly the overlap this A/B measures. ``profiler`` fences
+        its sampled steps (mirroring _train_pass) so the attribution
+        split is real device time, not async dispatch."""
+        losses = []
+        t0 = time.perf_counter()
+        with device_batches(
+            source.batches(np.random.default_rng(2)), jax.device_put,
+            prefetch, profiler,
+        ) as stream:
+            for step, (_, device_batch) in enumerate(stream):
+                sampled = profiler is not None and profiler.sampled(step)
+                if sampled and losses:
+                    jax.block_until_ready(losses[-1])
+                ts = time.perf_counter()
+                state, loss = train_step(state, device_batch)
+                if sampled:
+                    jax.block_until_ready(loss)
+                    profiler.record_compute(
+                        step, (time.perf_counter() - ts) * 1e3
+                    )
+                losses.append(loss)
+                if step >= 2:
+                    jax.block_until_ready(losses[step - 2])
+        jax.block_until_ready(losses[-1])
+        elapsed = time.perf_counter() - t0
+        fetched = (
+            [float(x) for x in jax.device_get(losses)]
+            if collect_losses else None
+        )
+        return state, elapsed, len(losses), fetched
+
+    # warmup: compile every ladder width (not timed), both arms' plumbing
+    state, *_ = one_pass(sync_source, fresh_state())
+    state, *_ = one_pass(feed_source, state)
+    detector.check()  # warmup baseline: exactly the ladder's compiles
+
+    # bitwise-identical loss trajectory: fresh state + same seed per arm —
+    # the workers must change WHERE batches are built, not what is trained
+    _, _, _, losses_sync = one_pass(
+        sync_source, fresh_state(), collect_losses=True
+    )
+    _, _, _, losses_feed = one_pass(
+        feed_source, fresh_state(), collect_losses=True
+    )
+    bitwise_equal = losses_sync == losses_feed
+
+    # profiler attribution per arm (separate pass so fencing can't taint
+    # the timed ABBA window); stride spans the epoch after the first pass
+    prof_sync = StepProfiler(sample_steps=8)
+    prof_feed = StepProfiler(sample_steps=8)
+    for prof in (prof_sync, prof_feed):
+        prof.observe_epoch_length(max(steps, 1))
+        prof.reset()
+    state, *_ = one_pass(sync_source, state, profiler=prof_sync)
+    state, *_ = one_pass(feed_source, state, profiler=prof_feed)
+    attribution_sync = prof_sync.summary()
+    attribution_feed = prof_feed.summary()
+
+    try:
+        repeats = max(int(os.environ.get("BENCH_AB_REPEATS", 3)), 1)
+        sync_times: list[float] = []
+        feed_times: list[float] = []
+        n_steps = 0
+        for _ in range(repeats):
+            state, t, n_steps, _ = one_pass(sync_source, state)
+            sync_times.append(t)
+            state, t, n_steps, _ = one_pass(feed_source, state)
+            feed_times.append(t)
+            state, t, n_steps, _ = one_pass(feed_source, state)
+            feed_times.append(t)
+            state, t, n_steps, _ = one_pass(sync_source, state)
+            sync_times.append(t)
+    finally:
+        pool.close()
+
+    post_warmup = detector.check()
+    speedup = min(sync_times) / min(feed_times)
+    feed_rps = real_total / min(feed_times)
+    real, slots = feed_source.pad_stats()
+    feed_wait_shrank = bool(
+        attribution_sync and attribution_feed
+        and attribution_feed["feed_wait_ms"]
+        < attribution_sync["host_build_ms"]
+    )
+    # the wall-clock clauses need hardware that can actually parallelize:
+    # worker processes inherit the CPU affinity mask, so on a host with
+    # too few usable cores the two arms do identical serial work and no
+    # feed can win — correctness clauses (bitwise, zero recompiles) still
+    # gate, the speedup clauses are reported but skipped
+    try:
+        host_cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # non-linux
+        host_cores = os.cpu_count() or 1
+    min_cores = int(os.environ.get("BENCH_FEED_MIN_CORES", 4))
+    min_speedup = _env_float("BENCH_FEED_MIN_SPEEDUP", 1.2)
+    speedup_applicable = host_cores >= min_cores
+    speedup_ok = speedup >= min_speedup and feed_wait_shrank
+    verdict_ok = bool(
+        bitwise_equal
+        and post_warmup == 0
+        and (speedup_ok or not speedup_applicable)
+    )
+
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "backend": backend,
+                    "mode": "feed_ab",
+                    "batch": batch_size,
+                    "bag": bag,
+                    "ladder": list(ladder),
+                    "mean_contexts": mean_ctx,
+                    "length_sigma": sigma,
+                    "n_methods": spec.n_methods,
+                    "steps_per_pass": n_steps,
+                    "prefetch_batches": prefetch,
+                    "feed": {
+                        "workers": feed_workers,
+                        "arena_slots": pool.slots,
+                        "delivery": pool.deliver_mode(),
+                    },
+                    "pad_efficiency": round(real / slots, 4) if slots else None,
+                    "sync_real_contexts_per_sec": round(
+                        real_total / min(sync_times), 1
+                    ),
+                    "feed_real_contexts_per_sec": round(feed_rps, 1),
+                    "feed_vs_sync": round(speedup, 4),
+                    "attribution_sync": attribution_sync,
+                    "attribution_feed": attribution_feed,
+                    "feed_wait_shrank": feed_wait_shrank,
+                    "bitwise_loss_equal": bitwise_equal,
+                    "post_warmup_compiles": post_warmup,
+                    "host_cores": host_cores,
+                    "speedup_verdict": (
+                        ("pass" if speedup_ok else "fail")
+                        if speedup_applicable
+                        else f"skipped ({host_cores} host cores < "
+                        f"{min_cores}: both arms serialize on the same "
+                        "CPUs, no feed can win)"
+                    ),
+                    "verdict_ok": verdict_ok,
+                }
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    if not verdict_ok:
+        raise SystemExit(
+            f"--feed-ab verdict failed: bitwise_loss_equal={bitwise_equal}, "
+            f"post_warmup_compiles={post_warmup}, "
+            f"feed_vs_sync={speedup:.3f} (need >= {min_speedup}), "
+            f"feed_wait_shrank={feed_wait_shrank}"
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "feed_real_contexts_per_sec",
+                "value": round(feed_rps, 1),
+                "unit": "contexts/sec",
+                # in AB mode the baseline IS the same-recipe workers=0 arm
+                "vs_baseline": round(speedup, 4),
+                "backend": backend,
+            }
+        ),
+        flush=True,
+    )
+
+
 def _ann_ab() -> None:
     """``--ann-ab``: ANN (IVF-PQ) vs exact retrieval on one synthetic
     clustered index — the ISSUE-11 acceptance instrument.
@@ -1525,7 +1841,7 @@ def _ann_ab() -> None:
     from code2vec_tpu.obs.runtime import RecompileDetector, RuntimeHealth
     from code2vec_tpu.serve.retrieval import AnnRetrievalIndex, RetrievalIndex
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def knob(name: str, device_default: int, cpu_default: int) -> int:
@@ -1773,7 +2089,7 @@ def _kernel_ab() -> None:
     from code2vec_tpu.ops import autotune as at
     from code2vec_tpu.ops.quant import quantize_table
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def knob(name: str, device_default: int, cpu_default: int) -> int:
@@ -2014,7 +2330,7 @@ def _serve_bench() -> None:
     from code2vec_tpu.train.config import TrainConfig
     from code2vec_tpu.train.step import create_train_state
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def knob(name: str, device_default: int, cpu_default: int) -> int:
@@ -2420,7 +2736,7 @@ def main() -> None:
 
     # persistent compilation cache: repeat runs (and retries after tunnel
     # resets) skip the ~30s XLA compile
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+    jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     batch_size = int(os.environ.get("BENCH_BATCH", 1024))
@@ -2763,6 +3079,17 @@ def main() -> None:
                     # accounting when --pallas_impl auto consulted the cache
                     "kernel": _kernel_provenance(model_config),
                     "sample_prefetch": sample_prefetch,
+                    # host-ingest provenance: the headline measures the
+                    # device-epoch path (batches sampled ON device — no
+                    # host batch builds to parallelize), so feed workers
+                    # are structurally idle here; --feed-ab is the host-
+                    # pipeline instrument where BENCH_FEED_WORKERS bites
+                    "feed": {
+                        "workers": _recipe_knob(
+                            "BENCH_FEED_WORKERS", 0, 0, fell_back, backend
+                        ),
+                        "host_pipeline": False,
+                    },
                     "attribution": attribution,
                     "memory": memory,
                 }
@@ -2799,6 +3126,8 @@ if __name__ == "__main__":
             _serve_bench()
         elif "--ooc-ab" in sys.argv[1:]:
             _ooc_ab()
+        elif "--feed-ab" in sys.argv[1:]:
+            _feed_ab()
         elif "--ann-ab" in sys.argv[1:]:
             _ann_ab()
         elif "--longbag-ab" in sys.argv[1:]:

@@ -229,10 +229,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "this many batches ahead of compute on a background "
                         "thread (0 = synchronous; identical batches in "
                         "identical order)")
+    parser.add_argument("--feed_workers", type=int, default=0,
+                        help="parallel host ingest: execute each epoch's "
+                        "batch plan on this many forked worker processes "
+                        "(RNG stays on the coordinator — feed order, loss "
+                        "history, and resume cursors are bitwise identical "
+                        "to 0 = build on the coordinator). Method-task "
+                        "host pipeline only; composes with bucketed/"
+                        "streaming/mmap and --prefetch_batches")
     parser.add_argument("--profile_steps", type=int, default=0,
                         help="fence the first N train steps of each epoch "
-                        "and log the host-build / H2D / compute wall-time "
-                        "split (0 = off)")
+                        "and log the host-build / H2D / feed-wait / "
+                        "compute wall-time split (0 = off)")
     parser.add_argument("--device_chunk_batches", type=int, default=16,
                         help="batches per device-epoch dispatch")
     parser.add_argument("--shard_staged_corpus", action="store_true",
@@ -363,6 +371,7 @@ def config_from_args(args: argparse.Namespace):
         stream_chunk_items=args.stream_chunk_items,
         device_chunk_batches=args.device_chunk_batches,
         prefetch_batches=args.prefetch_batches,
+        feed_workers=args.feed_workers,
         profile_steps=args.profile_steps,
     )
 

@@ -1,0 +1,635 @@
+"""Parallel host ingest: multi-worker zero-copy batch building.
+
+code2vec training is input-bound at accelerator speeds — the step is tiny
+matmuls over ``[B, L]`` integer batches while batch construction (subsample
+sort + CSR gather + pad) runs as single-threaded numpy on the coordinator.
+:class:`ParallelFeed` wraps any :class:`~code2vec_tpu.data.pipeline.BatchSource`
+behind the same protocol and executes its batch **plan** on ``--feed_workers
+N`` forked worker processes:
+
+- **RNG stays on the coordinator.** The wrapped source's
+  ``plan_batches(rng, shuffle)`` draws every random value its
+  ``batches()`` would — epoch plans, bucket interleaves, shuffles, the
+  per-item subsample uniforms — in the identical order and sizes; workers
+  only run the pure ``execute_plan`` build. Feed order, loss history, and
+  mid-epoch resume cursors are **bitwise identical** to ``--feed_workers
+  0`` (tests/test_feed.py pins the matrix).
+- **Zero-copy transport.** Workers write finished batches into
+  preallocated ``multiprocessing.shared_memory`` arena slots; the
+  coordinator hands them to the consumer as numpy views — no pickling of
+  batch tensors. Corpus arrays are fork-inherited: mmap-CSR views stay
+  one shared OS mapping (zero per-worker context RSS), in-RAM arrays are
+  shared copy-on-write pages.
+- **In-order delivery.** Results are resequenced through a reorder
+  buffer, so the consumer sees the exact sync stream order.
+- **Arena recycling.** A delivered slot is reused only after the consumer
+  moves past it. In ``views`` delivery a slot is recycled at the NEXT
+  pull — and the pull/transfer loop is sequential, so a view is never
+  overwritten before ``to_device`` returned (the prefetch producer
+  additionally fences the async H2D; see ``fence_h2d``). On backends
+  whose ``device_put`` zero-copy ALIASES page-aligned host buffers (jax's
+  CPU client does), recycling a slot would corrupt the live device batch,
+  so the pool probes once and falls back to ``copy`` delivery: one
+  memcpy per batch, still a fraction of the build it displaced.
+- **Failure propagation.** A worker exception ships its full traceback
+  text back and re-raises on the coordinator as :class:`FeedWorkerError`
+  (with an ``error`` event); a killed worker is detected by liveness
+  polling and fails the stream instead of hanging it.
+
+The small per-row fields (``ids``/``labels``/``example_mask``) are always
+delivered as owned copies: eval reads them after later batches were pulled
+(and their slots recycled); the big ``[B, L]`` context tensors are only
+read by ``to_device`` before the next pull.
+"""
+
+from __future__ import annotations
+
+import collections
+import multiprocessing
+import os
+import queue as queue_mod
+import time
+import traceback
+import warnings
+import weakref
+from dataclasses import dataclass
+
+import numpy as np
+
+from code2vec_tpu.data.pipeline import (
+    BatchSource,
+    execute_plan,
+    plan_real_slots,
+)
+
+__all__ = ["FeedPool", "FeedWorkerError", "ParallelFeed"]
+
+# trace-span sampling for delivered batches — mirrors the prefetch
+# producer's policy (a 16k-step epoch must not flood the tracer)
+_SPAN_WARMUP = 8
+_SPAN_STRIDE = 64
+_POLL_S = 0.2  # result-wait poll cadence (worker-liveness check interval)
+
+
+class FeedWorkerError(RuntimeError):
+    """A feed worker failed (exception or death). ``remote_traceback``
+    carries the child's formatted traceback when one crossed the process
+    boundary (a SIGKILLed worker has none)."""
+
+    def __init__(self, message: str, remote_traceback: str | None = None):
+        if remote_traceback:
+            message = (
+                f"{message}\n--- feed worker traceback ---\n"
+                f"{remote_traceback.rstrip()}"
+            )
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+@dataclass
+class _CorpusArrays:
+    """The slim, fork-shared corpus view workers build from. Extracted
+    BEFORE fork so workers never touch the full CorpusData (vocabs,
+    alias/label string lists) — touching Python objects dirties their
+    copy-on-write pages via refcounting; numpy DATA pages (and mmap
+    views) stay shared."""
+
+    starts: np.ndarray
+    paths: np.ndarray
+    ends: np.ndarray
+    row_splits: np.ndarray
+    row_base: np.ndarray | None
+    ids: np.ndarray
+    labels: np.ndarray
+    method_token_index: int | None
+
+    @classmethod
+    def from_data(cls, data) -> "_CorpusArrays":
+        return cls(
+            starts=data.starts,
+            paths=data.paths,
+            ends=data.ends,
+            row_splits=data.row_splits,
+            row_base=data.row_base,
+            ids=data.ids,
+            labels=data.labels,
+            method_token_index=data.method_token_index,
+        )
+
+
+class _ArenaLayout:
+    """Byte offsets of one arena slot: the per-row fields at the head,
+    then three compact ``[B, width]`` int32 planes sized for the ladder's
+    top width. A batch at a narrower width writes (and is viewed) as a
+    C-contiguous ``[B, width]`` block at each plane's base."""
+
+    def __init__(self, batch_size: int, max_width: int):
+        self.batch_size = int(batch_size)
+        self.max_width = int(max_width)
+        plane = self.batch_size * self.max_width * 4
+        self.off_ids = 0
+        self.off_labels = self.off_ids + self.batch_size * 8
+        self.off_mask = self.off_labels + self.batch_size * 4
+        base = self.off_mask + self.batch_size * 4
+        # 64-byte-align the context planes (harmless; keeps views friendly
+        # to vectorized gathers either side of the boundary)
+        base = -(-base // 64) * 64
+        self.off_starts = base
+        self.off_paths = base + plane
+        self.off_ends = base + 2 * plane
+        self.slot_bytes = base + 3 * plane
+
+    def views(self, buf, width: int):
+        """The slot's numpy views at ``width`` (no copies)."""
+        b = self.batch_size
+        return {
+            "ids": np.ndarray((b,), np.int64, buffer=buf, offset=self.off_ids),
+            "labels": np.ndarray(
+                (b,), np.int32, buffer=buf, offset=self.off_labels
+            ),
+            "example_mask": np.ndarray(
+                (b,), np.float32, buffer=buf, offset=self.off_mask
+            ),
+            "starts": np.ndarray(
+                (b, width), np.int32, buffer=buf, offset=self.off_starts
+            ),
+            "paths": np.ndarray(
+                (b, width), np.int32, buffer=buf, offset=self.off_paths
+            ),
+            "ends": np.ndarray(
+                (b, width), np.int32, buffer=buf, offset=self.off_ends
+            ),
+        }
+
+
+def _feed_worker_main(worker_id, arrays, shms, layout, task_q, result_q):
+    """Worker loop: pull ``(gen, seq, slot, plan)`` tasks, run the pure
+    build, write the batch into the slot's arena, post the result. Runs
+    numpy only — never jax (forking an initialized backend is safe as
+    long as the child stays out of it)."""
+    # the fork inherited the parent's process-wide tracer (and its lock,
+    # possibly mid-acquire on another thread at fork time): install the
+    # no-op tracer FIRST so no span in the build path can touch it
+    from code2vec_tpu.obs.trace import NullTracer, set_tracer
+
+    set_tracer(NullTracer())
+    bufs = [shm.buf for shm in shms]
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        gen, seq, slot, plan = task
+        try:
+            t0 = time.perf_counter()
+            batch = execute_plan(arrays, plan)
+            views = layout.views(bufs[slot], int(plan.width))
+            for key, view in views.items():
+                view[...] = batch[key]
+            result_q.put(
+                (
+                    "ok", gen, seq, slot, int(plan.width), int(plan.valid),
+                    worker_id, t0, time.perf_counter(),
+                )
+            )
+        except BaseException as exc:  # noqa: BLE001 - shipped to the coordinator
+            result_q.put(
+                (
+                    "error", gen, seq, slot,
+                    f"{type(exc).__name__}: {exc}", traceback.format_exc(),
+                )
+            )
+
+
+def _device_put_aliases_shared_memory(shm) -> bool:
+    """One-time probe: does this backend's ``device_put`` zero-copy ALIAS
+    a page-aligned host buffer? jax's CPU client does (mutating the numpy
+    source after ``device_put`` changes the device array), so arena slots
+    must not be recycled under live device batches there — the pool
+    switches to copy-on-delivery. TPU/GPU transfers are real copies."""
+    import jax
+
+    probe = np.ndarray((64,), np.int32, buffer=shm.buf)
+    probe[:] = np.arange(64, dtype=np.int32)
+    device = jax.device_put(probe)
+    jax.block_until_ready(device)
+    probe[0] = -12345
+    aliased = int(np.asarray(device)[0]) == -12345
+    probe[0] = 0
+    return aliased
+
+
+class FeedPool:
+    """``n_workers`` forked builder processes + a shared-memory batch
+    arena, shared by every :class:`ParallelFeed` wrapper of a run (the
+    train and test splits reuse one pool). One stream is active at a
+    time — exactly the train loop's epoch structure."""
+
+    def __init__(
+        self,
+        data,
+        n_workers: int,
+        batch_size: int,
+        max_width: int,
+        slots: int = 0,
+        deliver: str = "auto",
+        events=None,
+        health=None,
+        tracer=None,
+    ):
+        if n_workers < 1:
+            raise ValueError(f"feed_workers must be >= 1, got {n_workers}")
+        if deliver not in ("auto", "views", "copy"):
+            raise ValueError(f"unknown deliver mode: {deliver!r}")
+        if os.name != "posix":
+            raise ValueError(
+                "--feed_workers requires fork-capable multiprocessing "
+                "(POSIX); use --feed_workers 0 here"
+            )
+        from multiprocessing import shared_memory
+
+        self.n_workers = int(n_workers)
+        # enough slots that every worker can build while a full reorder
+        # window and the delivered batch stay pinned
+        self.slots = int(slots) if slots else 2 * self.n_workers + 2
+        self._layout = _ArenaLayout(batch_size, max_width)
+        self._events = events
+        self._health = health
+        self._tracer = tracer
+        self._ctx = multiprocessing.get_context("fork")
+        self._shms = [
+            shared_memory.SharedMemory(
+                create=True, size=self._layout.slot_bytes
+            )
+            for _ in range(self.slots)
+        ]
+        self._deliver = deliver
+        self._task_q = self._ctx.Queue()
+        self._result_q = self._ctx.Queue()
+        arrays = _CorpusArrays.from_data(data)
+        self._procs = [
+            self._ctx.Process(
+                target=_feed_worker_main,
+                args=(
+                    wid, arrays, self._shms, self._layout,
+                    self._task_q, self._result_q,
+                ),
+                name=f"c2v-feed-worker-{wid}",
+                daemon=True,
+            )
+            for wid in range(self.n_workers)
+        ]
+        with warnings.catch_warnings():
+            # jax warns on ANY fork of its (multithreaded) process; the
+            # hazard is a child calling into runtime state whose locks
+            # were mid-acquire at fork time. These workers run numpy only
+            # — they never touch jax — the standard dataloader-worker
+            # pattern, so the blanket warning is noise here.
+            warnings.filterwarnings(
+                "ignore", message=r"os\.fork\(\) was called",
+                category=RuntimeWarning,
+            )
+            for p in self._procs:
+                p.start()
+        self._free: collections.deque[int] = collections.deque(
+            range(self.slots)
+        )
+        self._gen = 0
+        self._active: _FeedStream | None = None
+        self._closed = False
+        # last-resort cleanup on GC/interpreter exit: a crash between
+        # pool creation and the owner's finally must not leak worker
+        # processes or named shared-memory segments
+        self._finalizer = weakref.finalize(
+            self, _release_pool_resources, self._procs, self._shms
+        )
+
+    # ---- delivery mode -------------------------------------------------
+    def deliver_mode(self) -> str:
+        """Resolve ``auto`` on first use (the probe touches jax, which the
+        jax-free RSS tests avoid by pinning ``views``)."""
+        if self._deliver == "auto":
+            self._deliver = (
+                "copy"
+                if _device_put_aliases_shared_memory(self._shms[0])
+                else "views"
+            )
+        return self._deliver
+
+    # ---- streams -------------------------------------------------------
+    def run(self, plans, feed: "ParallelFeed | None" = None) -> "_FeedStream":
+        if self._closed:
+            raise RuntimeError("feed pool is closed")
+        if self._active is not None and not self._active.finished:
+            # the train loop runs one epoch stream at a time; a second
+            # concurrent stream would interleave slot ownership
+            raise RuntimeError(
+                "a feed stream is already active on this pool; close or "
+                "exhaust it before starting another"
+            )
+        self._gen += 1
+        self._active = _FeedStream(self, plans, self._gen, feed)
+        return self._active
+
+    def check_workers(self) -> None:
+        for wid, p in enumerate(self._procs):
+            if not p.is_alive():
+                message = (
+                    f"feed worker {wid} died (exit code {p.exitcode}) "
+                    "without reporting an error — killed or crashed hard; "
+                    "restart the run (the pool cannot continue safely)"
+                )
+                if self._events is not None:
+                    try:
+                        self._events.emit(
+                            "error", error=message, feed_worker=wid
+                        )
+                    except Exception:
+                        pass
+                raise FeedWorkerError(message)
+
+    def worker_failed(self, wid_or_msg: str, tb_text: str) -> FeedWorkerError:
+        message = f"feed worker build failed: {wid_or_msg}"
+        if self._events is not None:
+            try:
+                self._events.emit(
+                    "error", error=message, feed_worker_traceback=tb_text
+                )
+            except Exception:
+                pass
+        return FeedWorkerError(message, remote_traceback=tb_text)
+
+    def close(self) -> None:
+        """Stop workers and release the arena. Idempotent; safe after
+        worker death (escalates to terminate)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._gen += 1  # orphan any in-flight results
+        for p in self._procs:
+            if p.is_alive():
+                try:
+                    self._task_q.put(None)
+                except Exception:
+                    break
+        deadline = time.monotonic() + 5.0
+        for p in self._procs:
+            p.join(timeout=max(deadline - time.monotonic(), 0.1))
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5.0)
+        for q in (self._task_q, self._result_q):
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
+        for shm in self._shms:
+            try:
+                shm.close()
+                shm.unlink()
+            except Exception:
+                pass
+        self._finalizer.detach()
+
+
+def _release_pool_resources(procs, shms) -> None:
+    """The :func:`weakref.finalize` fallback behind :meth:`FeedPool.close`
+    — hard teardown only (no queue draining): terminate stragglers and
+    unlink the arena segments."""
+    for p in procs:
+        try:
+            if p.is_alive():
+                p.terminate()
+        except Exception:
+            pass
+    for shm in shms:
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+
+
+class _FeedStream:
+    """One epoch's ordered batch stream off the pool.
+
+    Iterates batch dicts exactly like the wrapped source's ``batches()``
+    stream. Exposes the attributes the host pipeline probes:
+
+    - ``last_wait_ms`` — how long the previous pull blocked on the pool
+      (the ``feed_wait_ms`` profiler column; ~0 when workers keep up);
+    - ``fence_h2d`` — True in views delivery: the consumer must fence the
+      async H2D before pulling again (the next pull recycles the slot).
+    """
+
+    def __init__(self, pool: FeedPool, plans, gen: int, feed):
+        self._pool = pool
+        self._plans = iter(plans)
+        self._gen = gen
+        self._feed = feed
+        self._mode = pool.deliver_mode()
+        self._next_seq = 0
+        self._submit_seq = 0
+        self._plans_done = False
+        self._inflight: dict[int, int] = {}  # seq -> slot
+        self._ready: dict[int, tuple] = {}
+        self._delivered_slot: int | None = None
+        self._real = 0
+        self._slots_total = 0
+        self.finished = False
+        self.last_wait_ms = 0.0
+
+    @property
+    def fence_h2d(self) -> bool:
+        return self._mode == "views"
+
+    def __iter__(self) -> "_FeedStream":
+        return self
+
+    # ---- submission ----------------------------------------------------
+    def _submit_more(self) -> None:
+        pool = self._pool
+        while not self._plans_done and pool._free:
+            try:
+                plan = next(self._plans)
+            except StopIteration:
+                self._plans_done = True
+                self._close_plans()
+                break
+            slot = pool._free.popleft()
+            if self._feed is not None:
+                real, slots = plan_real_slots(plan, self._feed._row_splits)
+                self._real += real
+                self._slots_total += slots
+            self._inflight[self._submit_seq] = slot
+            pool._task_q.put((self._gen, self._submit_seq, slot, plan))
+            self._submit_seq += 1
+
+    def _close_plans(self) -> None:
+        close = getattr(self._plans, "close", None)
+        if close is not None:
+            close()
+
+    # ---- delivery ------------------------------------------------------
+    def _recycle_delivered(self) -> None:
+        if self._delivered_slot is not None:
+            self._pool._free.append(self._delivered_slot)
+            self._delivered_slot = None
+
+    def _handle(self, msg) -> None:
+        kind, gen = msg[0], msg[1]
+        if gen != self._gen:
+            # a previous (closed) stream's straggler: reclaim its slot
+            self._pool._free.append(msg[3])
+            return
+        if kind == "error":
+            _, _, seq, slot, summary, tb_text = msg
+            self._pool._free.append(slot)
+            self._inflight.pop(seq, None)
+            self._fail()
+            raise self._pool.worker_failed(summary, tb_text)
+        _, _, seq, slot, width, valid, wid, t0, t1 = msg
+        self._ready[seq] = (slot, width, valid, wid, t0, t1)
+
+    def __next__(self) -> dict[str, np.ndarray]:
+        if self.finished:
+            raise StopIteration
+        self._recycle_delivered()
+        self._submit_more()
+        if self._plans_done and self._next_seq >= self._submit_seq:
+            self._finish()
+            raise StopIteration
+        pool = self._pool
+        health = pool._health
+        # eager liveness check (one waitpid poll per worker): a dead
+        # worker fails the stream NOW, not only when its lost in-flight
+        # batch would have stalled the reorder window
+        try:
+            pool.check_workers()
+        except BaseException:
+            self._fail()
+            raise
+        waited = self._next_seq not in self._ready
+        t0 = time.perf_counter()
+        while self._next_seq not in self._ready:
+            try:
+                msg = pool._result_q.get(timeout=_POLL_S)
+            except queue_mod.Empty:
+                try:
+                    pool.check_workers()
+                except BaseException:
+                    self._fail()
+                    raise
+                continue
+            self._handle(msg)
+            self._submit_more()
+        self.last_wait_ms = (
+            (time.perf_counter() - t0) * 1e3 if waited else 0.0
+        )
+        if health is not None:
+            health.gauge("feed.queue_depth").set(len(self._ready))
+            if waited:
+                health.counter("feed.starved_steps").inc()
+        slot, width, valid, wid, bt0, bt1 = self._ready.pop(self._next_seq)
+        seq = self._next_seq
+        self._next_seq += 1
+        self._inflight.pop(seq, None)
+        self._emit_span(seq, wid, width, bt0, bt1)
+        views = pool._layout.views(pool._shms[slot].buf, width)
+        if self._mode == "copy":
+            batch = {key: np.array(view) for key, view in views.items()}
+            pool._free.append(slot)
+        else:
+            # zero-copy big planes (valid until the NEXT pull); the small
+            # per-row fields are owned copies — eval reads them after
+            # later pulls recycled this slot
+            batch = dict(
+                views,
+                ids=np.array(views["ids"]),
+                labels=np.array(views["labels"]),
+                example_mask=np.array(views["example_mask"]),
+            )
+            self._delivered_slot = slot
+        return batch
+
+    def _emit_span(self, seq, wid, width, t0, t1) -> None:
+        tracer = self._pool._tracer
+        if tracer is None or not getattr(tracer, "enabled", False):
+            return
+        if seq >= _SPAN_WARMUP and seq % _SPAN_STRIDE:
+            return
+        # perf_counter is CLOCK_MONOTONIC (system-wide on Linux), so the
+        # child's stamps land directly on this process's span clock
+        tracer.span_complete(
+            "feed_build", category="data", start_s=t0, end_s=t1,
+            track=f"feed-worker-{wid}", seq=seq, width=width,
+        )
+
+    # ---- teardown ------------------------------------------------------
+    def _publish_pad(self) -> None:
+        if self._feed is not None and self._slots_total:
+            self._feed._last_pad = (self._real, self._slots_total)
+
+    def _finish(self) -> None:
+        self.finished = True
+        self._recycle_delivered()
+        self._publish_pad()
+
+    def _fail(self) -> None:
+        """Abandon the stream after an error: in-flight slots are orphaned
+        to the stale-gen reclaim path (the pool bumps the gen at the next
+        stream), ready ones are freed now."""
+        self.finished = True
+        self._recycle_delivered()
+        for slot, *_ in self._ready.values():
+            self._pool._free.append(slot)
+        self._ready.clear()
+        self._inflight.clear()
+        self._publish_pad()
+
+    def close(self) -> None:
+        """Early shutdown (epoch aborted / preemption drain / skip): free
+        what this stream holds; results still being built are reclaimed
+        by the next stream's stale-gen handling."""
+        if self.finished:
+            return
+        self._close_plans()
+        self._plans_done = True
+        self._fail()
+
+
+class ParallelFeed(BatchSource):
+    """A :class:`BatchSource` executing the wrapped source's plans on a
+    :class:`FeedPool`. ``ladder`` mirrors the wrapped source; ``last_epoch``
+    stays None (no epoch tensor ever exists on the coordinator), so
+    export/print_sample fall back to an on-demand build like the other
+    out-of-core sources."""
+
+    def __init__(self, source: BatchSource, pool: FeedPool):
+        self._source = source
+        self._pool = pool
+        self.ladder = source.ladder
+        self.last_epoch = None
+        self._row_splits = source.data.row_splits
+        self._last_pad: tuple[int, int] | None = None
+        # fail at wrap time, not at the first epoch: sources without a
+        # plan split (or with the variable task) raise here
+        probe = source.plan_batches(np.random.default_rng(0))
+        close = getattr(probe, "close", None)
+        if close is not None:
+            close()
+
+    def batches(self, rng, shuffle: bool = True):
+        return self._pool.run(
+            self._source.plan_batches(rng, shuffle), feed=self
+        )
+
+    def scheduled_batches(self, rng, schedule, shuffle: bool = True):
+        raise NotImplementedError(
+            "--feed_workers does not compose with host-sharded scheduled "
+            "feeding; drop --feed_workers (or feed this host unsharded)"
+        )
+
+    def pad_stats(self) -> tuple[int, int] | None:
+        return self._last_pad

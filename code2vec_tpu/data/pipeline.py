@@ -242,6 +242,13 @@ def _build_variable_epoch(
     shuffle_variable_indexes: bool = False,
     context_order: str = "shuffled",
 ) -> EpochArrays:
+    # RNG-consumption compatibility: every draw below (the per-item
+    # perm_map shuffle, the per-item context permutation) happens in
+    # exactly the calls, order, and sizes the historical per-alias loop
+    # made — the vectorization only replaces the ALIAS-dimension Python
+    # loop (per-alias boolean scans + per-row copy-in) with the same
+    # repeat/cumsum/scatter formulation the method task uses — so epochs
+    # (and hence loss histories and resume cursors) are bitwise unchanged.
     variable_indexes = data.variable_indexes
     perm_map = None
     if not shuffle_variable_indexes and len(variable_indexes):
@@ -251,9 +258,13 @@ def _build_variable_epoch(
 
     ids: list[int] = []
     labels: list[int] = []
-    rows_s: list[np.ndarray] = []
-    rows_p: list[np.ndarray] = []
-    rows_e: list[np.ndarray] = []
+    # kept (row, col, value) triples across ALL items/aliases; three
+    # scatters at the end instead of a Python loop per output row
+    out_rows: list[np.ndarray] = []
+    out_cols: list[np.ndarray] = []
+    out_s: list[np.ndarray] = []
+    out_p: list[np.ndarray] = []
+    out_e: list[np.ndarray] = []
 
     label_stoi = data.label_vocab.stoi
 
@@ -271,25 +282,45 @@ def _build_variable_epoch(
         if context_order == "shuffled":
             s, p, e = s[order], p[order], e[order]
 
-        for alias_name, var_idx in zip(alias_names, alias_idx):
-            mine = (s == var_idx) | (e == var_idx)
-            ms, mp, me = s[mine][:max_contexts], p[mine][:max_contexts], e[mine][:max_contexts]
-            ms = _rename_target(ms, var_idx, perm_map)
-            me = _rename_target(me, var_idx, perm_map)
+        base = len(ids)
+        for alias_name in alias_names:
             ids.append(int(data.ids[i]))
             labels.append(label_stoi[alias_map[alias_name]])
-            rows_s.append(ms)
-            rows_p.append(mp)
-            rows_e.append(me)
+
+        # one [A, C] membership pass over the whole alias set: nonzero()
+        # is row-major, so pair order is (alias, context-stream order) —
+        # identical to the old per-alias `(s == v) | (e == v)` scans
+        member = (s[None, :] == alias_idx[:, None]) | (
+            e[None, :] == alias_idx[:, None]
+        )
+        a_ids, c_ids = np.nonzero(member)
+        total = len(a_ids)
+        if not total:
+            continue
+        counts = member.sum(axis=1).astype(np.int64)
+        seg_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        within = np.arange(total, dtype=np.int64) - np.repeat(
+            seg_starts, counts
+        )
+        keep = within < max_contexts  # first-L per alias, as `[:max_contexts]` did
+        a_kept, c_kept = a_ids[keep], c_ids[keep]
+        targets = alias_idx[a_kept]  # per-element rename target
+        out_rows.append(base + a_kept)
+        out_cols.append(within[keep])
+        out_s.append(_rename_target(s[c_kept], targets, perm_map))
+        out_p.append(p[c_kept])
+        out_e.append(_rename_target(e[c_kept], targets, perm_map))
 
     n = len(ids)
     starts = np.full((n, max_contexts), PAD_INDEX, np.int32)
     paths = np.full((n, max_contexts), PAD_INDEX, np.int32)
     ends = np.full((n, max_contexts), PAD_INDEX, np.int32)
-    for r, (ms, mp, me) in enumerate(zip(rows_s, rows_p, rows_e)):
-        starts[r, : len(ms)] = ms
-        paths[r, : len(mp)] = mp
-        ends[r, : len(me)] = me
+    if out_rows:
+        rows = np.concatenate(out_rows)
+        cols = np.concatenate(out_cols)
+        starts[rows, cols] = np.concatenate(out_s)
+        paths[rows, cols] = np.concatenate(out_p)
+        ends[rows, cols] = np.concatenate(out_e)
 
     return EpochArrays(
         ids=np.asarray(ids, np.int64),
@@ -1053,6 +1084,22 @@ class BatchSource:
             "tools/corpus_convert.py and pass --corpus_format csr)"
         )
 
+    def plan_batches(
+        self, rng: np.random.Generator, shuffle: bool = True
+    ) -> "Iterator[BatchPlan]":
+        """The plan half of the plan/build split (parallel host ingest):
+        draw every RNG value ``batches(rng, shuffle)`` would — identical
+        order, identical sizes — and yield :class:`BatchPlan`s whose
+        :func:`execute_plan` rebuilds are bitwise the sync stream's
+        batches. Method task only: the variable expansion interleaves
+        per-item draws with data-dependent row counts and stays on the
+        coordinator."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no batch-plan split; "
+            "--feed_workers supports the in-RAM, streaming, and mmap-CSR "
+            "method-task sources"
+        )
+
     def pad_stats(self) -> tuple[int, int] | None:
         """(real, slots) of the last streamed epoch; None before any."""
         return None
@@ -1146,6 +1193,71 @@ class EpochSource(BatchSource):
 
         return self._accounted(gen())
 
+    def plan_batches(self, rng, shuffle: bool = True):
+        if self.data.infer_variable:
+            raise ValueError(
+                "the in-RAM source plans the method task only (the "
+                "variable expansion draws per-item rng on the "
+                "coordinator); run variable-task corpora with "
+                "--feed_workers 0"
+            )
+
+        def gen():
+            # mirrors batches(): the whole-epoch subsample draw happens at
+            # the stream's FIRST PULL (build laziness), then the batch-
+            # order draws — identical rng consumption to the sync path
+            entries, counts_full = _uniform_entries(
+                rng, self.data.row_splits, self.item_idx
+            )
+            built_counts = np.minimum(counts_full, self.max_contexts)
+            B = self.batch_size
+            if self._bucketed:
+                # iter_bucketed_batches' draws: per-bucket member
+                # permutations in ladder order, then the plan interleave;
+                # partial batches repeat the batch's own first row
+                bucket_of = assign_buckets(built_counts, self.ladder)
+                plans: list[tuple[int, np.ndarray]] = []
+                for b, width in enumerate(self.ladder):
+                    members = np.flatnonzero(bucket_of == b)
+                    if shuffle:
+                        members = members[rng.permutation(len(members))]
+                    for lo in range(0, len(members), B):
+                        plans.append((int(width), members[lo : lo + B]))
+                if shuffle:
+                    plans = [plans[i] for i in rng.permutation(len(plans))]
+                for width, rows in plans:
+                    valid = len(rows)
+                    if valid < B:
+                        rows = np.concatenate(
+                            [rows, np.full(B - valid, rows[0], rows.dtype)]
+                        )
+                    yield _plan_of(
+                        width, [entries[r] for r in rows], valid,
+                        self._context_order,
+                    )
+            else:
+                # iter_batches' draws: one row permutation when shuffling;
+                # the final partial batch repeats EPOCH row 0
+                n = len(self.item_idx)
+                order = rng.permutation(n) if shuffle else None
+                for lo in range(0, n, B):
+                    hi = min(lo + B, n)
+                    valid = hi - lo
+                    rows = (
+                        order[lo:hi] if order is not None
+                        else np.arange(lo, hi)
+                    )
+                    if valid < B:
+                        rows = np.concatenate(
+                            [rows, np.zeros(B - valid, rows.dtype)]
+                        )
+                    yield _plan_of(
+                        self.max_contexts, [entries[r] for r in rows],
+                        valid, self._context_order,
+                    )
+
+        return gen()
+
     def pad_stats(self) -> tuple[int, int] | None:
         if self._last_pad is not None:
             # a scheduled stream ran: report the DISPATCHED slots (incl.
@@ -1209,6 +1321,79 @@ class StreamingSource(BatchSource):
                 ladder=self._bucket_ladder,
             )
         )
+
+    def plan_batches(self, rng, shuffle: bool = True):
+        if self.data.infer_variable:
+            raise ValueError(
+                "streaming plans the method task only (the variable "
+                "expansion draws per-item rng on the coordinator); run "
+                "variable-task corpora with --feed_workers 0"
+            )
+
+        def gen():
+            # mirrors iter_streaming_batches: global item-order draw, then
+            # one chunk-sized subsample draw per chunk, carrying sub-batch
+            # remainders (per bucket when laddered) across chunk
+            # boundaries as (item, uniform-segment) row entries
+            order = (
+                rng.permutation(len(self.item_idx)) if shuffle
+                else np.arange(len(self.item_idx))
+            )
+            B = self.batch_size
+            ladder = self._bucket_ladder
+            pending: list = []  # fixed-L carry
+            carry: list[list] = [[] for _ in (ladder or ())]
+            for lo in range(0, len(order), self.chunk_items):
+                chunk_idx = self.item_idx[order[lo : lo + self.chunk_items]]
+                entries, counts_full = _uniform_entries(
+                    rng, self.data.row_splits, chunk_idx
+                )
+                final = lo + self.chunk_items >= len(order)
+                if ladder is None:
+                    pending.extend(entries)
+                    n_full = len(pending) // B * B
+                    for s in range(0, n_full, B):
+                        yield _plan_of(
+                            self.max_contexts, pending[s : s + B], B,
+                            self._context_order,
+                        )
+                    pending = pending[n_full:]
+                    if final and pending:
+                        rows = pending + [pending[0]] * (B - len(pending))
+                        yield _plan_of(
+                            self.max_contexts, rows, len(pending),
+                            self._context_order,
+                        )
+                        pending = []
+                    continue
+                # bucketed: per-bucket carry + per-chunk seeded interleave
+                built = np.minimum(counts_full, self.max_contexts)
+                bucket_of = assign_buckets(built, ladder)
+                plans: list[tuple[int, list, int]] = []
+                for b, width in enumerate(ladder):
+                    part = carry[b] + [
+                        entries[j] for j in np.flatnonzero(bucket_of == b)
+                    ]
+                    n_full = len(part) // B * B
+                    for s in range(0, n_full, B):
+                        plans.append((int(width), part[s : s + B], B))
+                    rest = part[n_full:]
+                    if final and rest:
+                        plans.append(
+                            (
+                                int(width),
+                                rest + [rest[0]] * (B - len(rest)),
+                                len(rest),
+                            )
+                        )
+                        rest = []
+                    carry[b] = rest
+                if shuffle:
+                    plans = [plans[i] for i in rng.permutation(len(plans))]
+                for width, rows, valid in plans:
+                    yield _plan_of(width, rows, valid, self._context_order)
+
+        return gen()
 
     def pad_stats(self) -> tuple[int, int] | None:
         return self._last_pad
@@ -1276,20 +1461,38 @@ class MmapCorpusSource(BatchSource):
             plans = [plans[i] for i in rng.permutation(len(plans))]
         return plans
 
-    def _gather(
+    def _batch_plan(
         self, items: np.ndarray, width: int, rng: np.random.Generator
-    ) -> dict[str, np.ndarray]:
-        sub = build_method_epoch(
-            self.data, items, width, rng, self._context_order
-        )
-        return _bucket_batch(sub, np.arange(len(items)), width, self.batch_size)
+    ) -> "BatchPlan":
+        """(items, width) → plan: THE per-batch subsample draw + padding
+        rule of this source. The sync stream is defined as executing these
+        plans inline, so the ``--feed_workers`` bitwise contract is
+        structural here — there is no second draw schedule to drift."""
+        entries, _ = _uniform_entries(rng, self.data.row_splits, items)
+        valid = len(items)
+        if valid < self.batch_size:
+            # the _bucket_batch rule: pad by repeating the batch's row 0
+            entries = entries + [entries[0]] * (self.batch_size - valid)
+        return _plan_of(width, entries, valid, self._context_order)
 
     def batches(self, rng, shuffle: bool = True):
         def gen():
             for width, items in self._plan(rng if shuffle else None):
-                yield self._gather(items, width, rng)
+                yield execute_plan(
+                    self.data, self._batch_plan(items, width, rng)
+                )
 
         return self._accounted(gen())
+
+    def plan_batches(self, rng, shuffle: bool = True):
+        def gen():
+            # the (width, items) plan draws up front, then each batch's
+            # subsample uniforms lazily at yield time — exactly when the
+            # sync stream draws them
+            for width, items in self._plan(rng if shuffle else None):
+                yield self._batch_plan(items, width, rng)
+
+        return gen()
 
     def scheduled_batches(self, rng, schedule, shuffle: bool = True):
         """Follow an external width schedule (host-sharded lockstep): the
@@ -1314,7 +1517,9 @@ class MmapCorpusSource(BatchSource):
                 if len(items) == 0:
                     yield empty_batch(self.batch_size, width)
                 else:
-                    yield self._gather(items, width, rng)
+                    yield execute_plan(
+                        self.data, self._batch_plan(items, width, rng)
+                    )
 
         return self._accounted(gen())
 
@@ -1359,6 +1564,142 @@ def make_batch_source(
         shuffle_variable_indexes=shuffle_variable_indexes,
         context_order=context_order,
     )
+
+
+# ---------------------------------------------------------------------------
+# Plan/build split: parallel host ingest (data/parallel_feed.py)
+#
+# Every batch a method-task source emits is a pure function of (item set,
+# bag width, the per-item subsample uniforms) — all the gathers, sorts,
+# padding and the @question substitution contain no randomness of their
+# own. So each source can split its epoch stream into:
+#
+# - ``plan_batches(rng, shuffle)``: COORDINATOR side — draws every RNG
+#   value its ``batches(rng, shuffle)`` would (epoch plans, bucket
+#   interleaves, shuffles, the subsample uniforms), in the identical
+#   order and sizes, and yields :class:`BatchPlan`s;
+# - ``execute_plan(data, plan)``: PURE — rebuilds the planned batch from
+#   the corpus arrays, safe to run in a worker process.
+#
+# ``execute_plan(plan_k)`` is bitwise-equal to the k-th batch of
+# ``batches()`` under the same rng, and consuming a whole plan stream
+# leaves the generator in the identical state — which is what makes
+# ``--feed_workers N`` runs (feed order, loss history, mid-epoch resume
+# cursors) bitwise-identical to ``--feed_workers 0``.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BatchPlan:
+    """One executable batch: every RNG draw already made.
+
+    ``items`` has one entry per OUTPUT ROW (already padded to the full
+    batch size by repeating a real row — the row-0-repeat padding rule of
+    :func:`iter_batches` / :func:`_bucket_batch`); ``uniforms`` holds each
+    row's subsample draws back to back (``len == sum of the rows' FULL
+    context counts``, the exact ``rng.random(total)`` the sync build
+    consumes). Rebuilding a duplicated pad row from the duplicated draws
+    reproduces the repeated row bitwise.
+    """
+
+    width: int
+    valid: int  # rows with example_mask 1.0
+    items: np.ndarray  # int64 [batch_size]
+    uniforms: np.ndarray  # float64 [sum counts(items)]
+    context_order: str = "shuffled"
+
+
+class _PlannedDraws:
+    """``np.random.Generator`` stand-in replaying coordinator-drawn
+    uniforms inside :func:`execute_plan` — the builder code path is the
+    SAME :func:`build_method_epoch` the sync stream runs, so there is no
+    second implementation of the subsample to keep in sync."""
+
+    def __init__(self, uniforms: np.ndarray):
+        self._uniforms = uniforms
+        self._pos = 0
+
+    def random(self, n: int) -> np.ndarray:
+        out = self._uniforms[self._pos : self._pos + n]
+        if len(out) != n:
+            raise ValueError(
+                f"batch plan carries {len(self._uniforms)} uniforms but the "
+                f"build asked for {self._pos + n}: the plan and the corpus "
+                "disagree (corpus changed since planning?)"
+            )
+        self._pos += n
+        return out
+
+
+def execute_plan(data, plan: BatchPlan) -> dict[str, np.ndarray]:
+    """Build the planned batch — PURE (all randomness lives in
+    ``plan.uniforms``), corpus arrays in, batch dict out. This is the
+    function ``--feed_workers`` worker processes run; ``data`` may be any
+    object with the CSR array attributes (a :class:`CorpusData` or the
+    feed's slim fork-shared view)."""
+    sub = build_method_epoch(
+        data, plan.items, plan.width, _PlannedDraws(plan.uniforms),
+        plan.context_order,
+    )
+    mask = np.zeros(len(plan.items), np.float32)
+    mask[: plan.valid] = 1.0
+    return {
+        "ids": sub.ids,
+        "starts": sub.starts,
+        "paths": sub.paths,
+        "ends": sub.ends,
+        "labels": sub.labels,
+        "example_mask": mask,
+    }
+
+
+def plan_real_slots(plan: BatchPlan, row_splits) -> tuple[int, int]:
+    """(real context slots, padded slots) this plan's batch will carry —
+    the :meth:`BatchSource.pad_stats` accounting computed from geometry
+    alone (the feed never scans the built arrays)."""
+    items = plan.items[: plan.valid]
+    counts = (row_splits[items + 1] - row_splits[items]).astype(np.int64)
+    real = int(np.minimum(counts, plan.width).sum())
+    return real, len(plan.items) * int(plan.width)
+
+
+def _plan_of(
+    width: int,
+    entries: list[tuple[int, np.ndarray]],
+    valid: int,
+    context_order: str,
+) -> BatchPlan:
+    """Assemble a plan from per-row ``(item, uniform-segment)`` entries
+    (already padded to the batch size by the caller's padding rule)."""
+    items = np.asarray([e[0] for e in entries], np.int64)
+    segs = [e[1] for e in entries]
+    uniforms = (
+        np.concatenate(segs) if segs else np.zeros(0, np.float64)
+    )
+    return BatchPlan(
+        width=int(width), valid=int(valid), items=items, uniforms=uniforms,
+        context_order=context_order,
+    )
+
+
+def _uniform_entries(
+    rng, row_splits: np.ndarray, items: np.ndarray
+) -> tuple[list[tuple[int, np.ndarray]], np.ndarray]:
+    """Draw the subsample uniforms for ``items`` exactly as one
+    ``build_method_epoch(items, ...)`` call would — ONE ``rng.random(total
+    full contexts)`` draw, nothing when total is 0 (mirroring the early
+    return in :func:`flat_context_indices`) — and slice them into per-item
+    segments. Returns ``(entries, full_counts)``."""
+    items = np.asarray(items)
+    counts = (row_splits[items + 1] - row_splits[items]).astype(np.int64)
+    seg = np.zeros(len(items) + 1, np.int64)
+    np.cumsum(counts, out=seg[1:])
+    total = int(seg[-1])
+    u = rng.random(total) if total else np.zeros(0, np.float64)
+    entries = [
+        (int(items[j]), u[seg[j] : seg[j + 1]]) for j in range(len(items))
+    ]
+    return entries, counts
 
 
 def oov_rate(

@@ -30,6 +30,7 @@ __all__ = [
     "RuntimeHealth",
     "RecompileDetector",
     "global_health",
+    "host_cpu_fingerprint",
     "host_rss_bytes",
     "device_memory_stats",
     "memory_snapshot",
@@ -335,6 +336,35 @@ class RecompileDetector:
                     self._events.emit("recompile", **fields)
                 slot[1] = size
         return new
+
+
+def host_cpu_fingerprint() -> str:
+    """8-hex digest of the host's CPU feature set (ISA flags + arch).
+
+    XLA's persistent compile cache stores machine code specialized to the
+    compiling host's CPU features; reusing one cache dir across hosts with
+    different feature sets logs ``machine features mismatch ... could lead
+    to SIGILL`` (seen in BENCH_r05) and can crash outright. Consumers
+    (tests/conftest.py, bench.py) key their cache dirs by this fingerprint
+    so each CPU population gets its own cache. Stdlib-only, stable within
+    a host across runs."""
+    import hashlib
+    import platform
+
+    parts = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                # x86 exposes "flags", arm64 "Features"; sort so kernel
+                # ordering changes don't churn the digest
+                if line.startswith(("flags", "Features")):
+                    parts.append(
+                        " ".join(sorted(line.split(":", 1)[1].split()))
+                    )
+                    break
+    except OSError:
+        parts.append(platform.processor() or "")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:8]
 
 
 def host_rss_bytes() -> int | None:

@@ -97,8 +97,36 @@ class Tracer:
         """A zero-duration mark (Chrome "i" phase) — e.g. a recompile."""
         self._record(name, category, self._now_us(), None, args)
 
-    def _record(self, name, category, ts, dur, args) -> None:
-        thread_key = (threading.get_ident(), threading.current_thread().name)
+    def span_complete(
+        self,
+        name: str,
+        category: str = "run",
+        start_s: float = 0.0,
+        end_s: float = 0.0,
+        track: str | None = None,
+        **args,
+    ) -> None:
+        """Record a span measured OUTSIDE this thread — e.g. inside a feed
+        worker process. ``start_s``/``end_s`` are ``time.perf_counter()``
+        stamps (CLOCK_MONOTONIC is system-wide on Linux, so a forked
+        child's stamps share this process's span clock). ``track`` names
+        the trace row the span lands on (its own tid, e.g.
+        ``feed-worker-3``) instead of the recording thread's."""
+        ts = (start_s - self._t0) * 1e6
+        self._record(
+            name, category, ts, max((end_s - start_s) * 1e6, 0.0), args,
+            track=track,
+        )
+
+    def _record(self, name, category, ts, dur, args, track=None) -> None:
+        if track is not None:
+            # synthetic per-track row: the key shape matches the thread
+            # keys ((unique, display-name)) so naming metadata just works
+            thread_key = (f"__track__{track}", track)
+        else:
+            thread_key = (
+                threading.get_ident(), threading.current_thread().name
+            )
         # pid is stamped at export (one tracer = one process) so recording
         # never has to resolve the process index
         event = {
@@ -191,6 +219,17 @@ class NullTracer:
         return self._NULL
 
     def instant(self, name: str, category: str = "run", **args) -> None:
+        return None
+
+    def span_complete(
+        self,
+        name: str,
+        category: str = "run",
+        start_s: float = 0.0,
+        end_s: float = 0.0,
+        track: str | None = None,
+        **args,
+    ) -> None:
         return None
 
 

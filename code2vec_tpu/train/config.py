@@ -135,6 +135,15 @@ class TrainConfig:
     # of materializing the whole [N, L] epoch (0 = materialize). Bounds host
     # RSS at java-large scale — see docs/ARCHITECTURE.md memory budget
     stream_chunk_items: int = 0
+    # parallel host ingest (data/parallel_feed.py): N forked worker
+    # processes execute each epoch's batch PLAN while every RNG draw stays
+    # on the coordinator — feed order, loss history, and mid-epoch resume
+    # cursors are bitwise identical to 0 (= build on the coordinator, the
+    # historical path). Batches travel through preallocated shared-memory
+    # arenas as zero-copy views. Method task, host pipeline only; composes
+    # with bucketed/streaming/mmap x prefetch; device_epoch, the variable
+    # task, and host-sharded feeding reject it loudly.
+    feed_workers: int = 0
     # host-epoch input pipeline (train/prefetch.py): a background thread
     # builds + transfers this many batches ahead of compute (0 = synchronous).
     # Identical batches in the identical order — the overlap is free of

@@ -1216,6 +1216,38 @@ def train(
     # sharded lockstep padding, and mid-epoch resume compose with all of
     # them through the same four protocol points
     train_source = test_source = None
+    feed_pool = None
+    if config.feed_workers < 0:
+        raise ValueError(
+            f"--feed_workers must be >= 0, got {config.feed_workers}"
+        )
+    if config.feed_workers:
+        # loud rejects for the non-composable paths: the parallel feed
+        # executes host batch PLANS, so anything without a host batch
+        # stream (device_epoch) or whose rng draws can't be planned ahead
+        # (the variable expansion) or whose lockstep schedule pads
+        # per-host (sharded feeding) must fail at startup, not mid-epoch
+        if config.device_epoch:
+            raise ValueError(
+                "--feed_workers parallelizes the HOST batch pipeline; "
+                "--device_epoch samples batches on device and has no host "
+                "builds to parallelize — drop one flag"
+            )
+        if data.infer_variable:
+            raise ValueError(
+                "--feed_workers supports the method task only: the "
+                "variable-name expansion interleaves per-item rng draws "
+                "with data-dependent row counts, so its builds cannot be "
+                "planned ahead for workers; run variable-task corpora "
+                "with --feed_workers 0"
+            )
+        if sharded_feed:
+            raise ValueError(
+                "--feed_workers does not compose with host-sharded "
+                "feeding (the lockstep width schedule pads per host); "
+                "each host already builds only 1/n_groups of every batch "
+                "— drop --feed_workers or feed unsharded"
+            )
     if not use_device_epoch:
         source_kw = dict(
             ladder=bucket_ladder,
@@ -1230,6 +1262,30 @@ def train(
         test_source = make_batch_source(
             data, test_idx, feed_batch, bag_width, **source_kw
         )
+        if config.feed_workers:
+            # parallel host ingest (data/parallel_feed.py): one worker
+            # pool + shared-memory arena serves both splits; the wrappers
+            # keep the BatchSource protocol, so everything downstream
+            # (prefetch, resume replay, pad accounting) is unchanged
+            from code2vec_tpu.data.parallel_feed import FeedPool, ParallelFeed
+
+            feed_pool = FeedPool(
+                data,
+                config.feed_workers,
+                feed_batch,
+                int(train_source.ladder[-1]),
+                events=events,
+                health=health,
+                tracer=tracer,
+            )
+            train_source = ParallelFeed(train_source, feed_pool)
+            test_source = ParallelFeed(test_source, feed_pool)
+            logger.info(
+                "parallel host ingest: %d feed workers, %d arena slots, "
+                "%s delivery",
+                feed_pool.n_workers, feed_pool.slots,
+                feed_pool.deliver_mode(),
+            )
         logger.info(
             "host feed: %s (ladder %s)",
             type(train_source).__name__, list(train_source.ladder),
@@ -1474,11 +1530,12 @@ def train(
                     logger.info(
                         "step-time attribution (%d sampled train steps, "
                         "stride %d): host_build %.2f ms | h2d %.2f ms | "
-                        "compute %.2f ms",
+                        "feed_wait %.2f ms | compute %.2f ms",
                         attribution["profiled_steps"],
                         profiler.stride,
                         attribution["host_build_ms"],
                         attribution["h2d_ms"],
+                        attribution["feed_wait_ms"],
                         attribution["compute_ms"],
                     )
                 for rec in profiler.per_step():
@@ -1519,12 +1576,19 @@ def train(
                 # prediction can differ from the one behind the logged F1
                 # (host mode re-runs forward on the same sampled epoch).
                 # bag_width = the ladder top, so longbag exports embed the
-                # UNTRUNCATED bags.
+                # UNTRUNCATED bags. The draw comes from a SIDE rng seeded
+                # by (run seed, epoch) — not np_rng — so whether a path
+                # materializes epochs (in-RAM reuses last_epoch; mmap/
+                # streaming/parallel-feed rebuild here) cannot shift the
+                # main feed stream: --feed_workers N histories stay
+                # bitwise --feed_workers 0 even with exports enabled.
                 return build_epoch(
                     data,
                     item_idx,
                     bag_width,
-                    np_rng,
+                    np.random.default_rng(
+                        [config.random_seed, 0xE902, epoch]
+                    ),
                     config.shuffle_variable_indexes,
                 )
 
@@ -1661,6 +1725,8 @@ def train(
         raise
     finally:
         restore_sigterm_handler(previous_sigterm)
+        if feed_pool is not None:
+            feed_pool.close()
         if writer is not None:
             # exception-path drain: joins the persist thread and LOGS any
             # stored failure (finish() above already raised on the normal

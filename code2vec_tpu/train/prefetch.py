@@ -39,6 +39,7 @@ import contextlib
 import queue
 import threading
 import time
+import traceback
 from typing import Callable, Iterable, Iterator
 
 import jax
@@ -99,7 +100,7 @@ class StepProfiler:
         self.sample_steps = int(sample_steps)
         self.stride = 1
         self._next_stride = 1
-        self._host: dict[int, tuple[float, float]] = {}
+        self._host: dict[int, tuple[float, float, float]] = {}
         self._compute: dict[int, float] = {}
 
     def sampled(self, step: int) -> bool:
@@ -114,8 +115,20 @@ class StepProfiler:
         if self.sample_steps > 0 and n_steps > 0:
             self._next_stride = max(1, n_steps // self.sample_steps)
 
-    def record_host(self, step: int, host_build_ms: float, h2d_ms: float) -> None:
-        self._host[step] = (host_build_ms, h2d_ms)
+    def record_host(
+        self,
+        step: int,
+        host_build_ms: float,
+        h2d_ms: float,
+        feed_wait_ms: float = 0.0,
+    ) -> None:
+        """``feed_wait_ms``: how long the pull blocked on the parallel
+        feed pool for this batch (``--feed_workers``; 0.0 on the
+        coordinator-build path). It is a SUBSET of ``host_build_ms`` —
+        with workers on, the residual build time is plan generation plus
+        delivery, so a shrinking feed_wait_ms is the direct evidence the
+        pool keeps the consumer fed."""
+        self._host[step] = (host_build_ms, h2d_ms, feed_wait_ms)
 
     def record_compute(self, step: int, compute_ms: float) -> None:
         self._compute[step] = compute_ms
@@ -124,12 +137,13 @@ class StepProfiler:
         """Attribution dicts for the fenced steps, in step order."""
         out = []
         for step in sorted(self._compute):
-            build, h2d = self._host.get(step, (0.0, 0.0))
+            build, h2d, feed_wait = self._host.get(step, (0.0, 0.0, 0.0))
             out.append(
                 {
                     "step": step,
                     "host_build_ms": round(build, 3),
                     "h2d_ms": round(h2d, 3),
+                    "feed_wait_ms": round(feed_wait, 3),
                     "compute_ms": round(self._compute[step], 3),
                 }
             )
@@ -144,6 +158,7 @@ class StepProfiler:
         return {
             "host_build_ms": round(sum(s["host_build_ms"] for s in steps) / n, 3),
             "h2d_ms": round(sum(s["h2d_ms"] for s in steps) / n, 3),
+            "feed_wait_ms": round(sum(s["feed_wait_ms"] for s in steps) / n, 3),
             "compute_ms": round(sum(s["compute_ms"] for s in steps) / n, 3),
             "profiled_steps": n,
         }
@@ -159,10 +174,15 @@ class _End:
 
 
 class _Raised:
-    """Producer-exception carrier; the consumer re-raises ``exc``."""
+    """Producer-exception carrier; the consumer re-raises ``exc`` with the
+    producer's formatted traceback text attached as ``remote_traceback``
+    (feed-worker errors arrive with their CHILD-process traceback already
+    embedded — this extends the same courtesy across the thread
+    boundary, where only the exception object survives cleanly)."""
 
-    def __init__(self, exc: BaseException):
+    def __init__(self, exc: BaseException, traceback_text: str | None = None):
         self.exc = exc
+        self.traceback_text = traceback_text
 
 
 class HostPrefetcher:
@@ -192,6 +212,11 @@ class HostPrefetcher:
         self._batches = batches
         self._to_device = to_device
         self._profiler = profiler
+        # a parallel-feed stream (data/parallel_feed.py) delivering
+        # zero-copy arena views recycles a slot at the NEXT pull; the
+        # async H2D must be fenced before that (fence_h2d False on the
+        # copy-delivery and coordinator-build paths)
+        self._fence = bool(getattr(batches, "fence_h2d", False))
         # train streams only (see device_batches): an eval stream that
         # drained on SIGTERM would silently compute metrics over a partial
         # test set and record them as a completed epoch. Single-process
@@ -251,6 +276,7 @@ class HostPrefetcher:
                 if batch is _End:
                     self._put(_End)
                     return
+                feed_wait_ms = getattr(it, "last_wait_ms", 0.0)
                 t1 = time.perf_counter()
                 with (
                     tracer.span("h2d", step=step, queue_depth=depth)
@@ -258,18 +284,23 @@ class HostPrefetcher:
                     else _NO_SPAN
                 ):
                     device_batch = self._to_device(batch)
+                    if self._fence:
+                        # views delivery: the next pull recycles this
+                        # batch's arena slot, so the transfer must be done
+                        jax.block_until_ready(device_batch)
                     if self._profiler is not None and self._profiler.sampled(step):
                         jax.block_until_ready(device_batch)
                         self._profiler.record_host(
                             step,
                             (t1 - t0) * 1e3,
                             (time.perf_counter() - t1) * 1e3,
+                            feed_wait_ms,
                         )
                 if not self._put((batch, device_batch)):
                     return
                 step += 1
         except BaseException as exc:  # noqa: BLE001 - re-raised at the consumer
-            self._put(_Raised(exc))
+            self._put(_Raised(exc, traceback.format_exc()))
         finally:
             close = getattr(it, "close", None)
             if close is not None:
@@ -290,6 +321,13 @@ class HostPrefetcher:
         if isinstance(item, _Raised):
             self._exhausted = True
             self._thread.join()
+            if item.traceback_text and not getattr(
+                item.exc, "remote_traceback", None
+            ):
+                try:
+                    item.exc.remote_traceback = item.traceback_text
+                except Exception:  # exceptions with __slots__ etc.
+                    pass
             raise item.exc
         return item
 
@@ -330,6 +368,7 @@ class _SyncBatches:
         self._to_device = to_device
         self._profiler = profiler
         self._step = 0
+        self._fence = bool(getattr(batches, "fence_h2d", False))
 
     def __iter__(self) -> Iterator[tuple[dict, dict]]:
         return self
@@ -342,13 +381,21 @@ class _SyncBatches:
             tracer.span("host_build", step=self._step) if spanned else _NO_SPAN
         ):
             batch = next(self._it)  # StopIteration ends the epoch
+        feed_wait_ms = getattr(self._it, "last_wait_ms", 0.0)
         t1 = time.perf_counter()
         with tracer.span("h2d", step=self._step) if spanned else _NO_SPAN:
             device_batch = self._to_device(batch)
+            if self._fence:
+                # views delivery: the next pull recycles this batch's
+                # arena slot (see HostPrefetcher._produce)
+                jax.block_until_ready(device_batch)
             if self._profiler is not None and self._profiler.sampled(self._step):
                 jax.block_until_ready(device_batch)
                 self._profiler.record_host(
-                    self._step, (t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3
+                    self._step,
+                    (t1 - t0) * 1e3,
+                    (time.perf_counter() - t1) * 1e3,
+                    feed_wait_ms,
                 )
         self._step += 1
         return batch, device_batch

@@ -19,10 +19,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # subprocess-spawning tests (multiprocess workers, tool drives) inherit the
 # compile cache through the env var form of the same knob. Per-user suffix:
 # a fixed /tmp path collides across users on shared machines (permission
-# errors, unbounded growth); a pre-set env var wins so operators can pin it
+# errors, unbounded growth); a pre-set env var wins so operators can pin it.
+# Per-CPU-feature suffix: XLA's cached executables embed the compiling
+# host's ISA features, and reusing a cache written on a different host logs
+# "machine features mismatch ... could lead to SIGILL" (BENCH_r05) — on a
+# shared filesystem each CPU population must get its own cache dir.
+# (obs.runtime is stdlib-only; importing it here initializes no backend.)
+from code2vec_tpu.obs.runtime import host_cpu_fingerprint
+
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
-    f"/tmp/jaxcache_tests_{getattr(os, 'getuid', lambda: 'na')()}",
+    f"/tmp/jaxcache_tests_{getattr(os, 'getuid', lambda: 'na')()}"
+    f"_{host_cpu_fingerprint()}",
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 

@@ -1,0 +1,743 @@
+"""Parallel host ingest (ISSUE 14): the plan/build split, the worker pool,
+and the bitwise-parity contract.
+
+The load-bearing guarantees:
+
+- every method-task source's ``plan_batches`` draws the SAME rng values its
+  ``batches`` would (identical end state) and ``execute_plan`` rebuilds are
+  bitwise the sync stream's batches — {fixed-L, bucketed, streaming, mmap}
+  x {shuffled, sequential} x {shuffled, corpus order};
+- with REAL forked workers and the shared-memory arena, delivered batches,
+  order, pad accounting, train histories, and kill->resume cursors are
+  bitwise ``--feed_workers 0``;
+- arena slots recycle under backpressure without ever overwriting a view
+  the consumer still owns (content correctness with slots << batches);
+- a worker exception re-raises on the coordinator WITH the child traceback
+  text; a killed worker fails the stream instead of hanging it; the pool
+  tears down cleanly either way;
+- feeding a 65 MB mmap corpus with workers stays O(arena) host RSS
+  (RLIMIT_AS-enforced, reusing the PR-10 harness);
+- the vectorized variable-task epoch build is bitwise the historical
+  per-alias loop (same rng consumption -> same loss multiset).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu import PAD_INDEX, faultinject
+from code2vec_tpu.data.pipeline import (
+    BatchPlan,
+    EpochSource,
+    MmapCorpusSource,
+    StreamingSource,
+    build_variable_epoch,
+    derive_bucket_ladder,
+    execute_plan,
+    variable_items,
+    _index_remap,
+    _rename_target,
+)
+from code2vec_tpu.data.parallel_feed import (
+    FeedPool,
+    FeedWorkerError,
+    ParallelFeed,
+)
+from code2vec_tpu.data.reader import load_corpus
+from code2vec_tpu.data.synth import SPECS, generate_corpus_files
+from code2vec_tpu.train.config import TrainConfig
+from code2vec_tpu.train.loop import train
+from tools.corpus_convert import text_to_csr
+
+pytestmark = pytest.mark.feed
+
+BAG = 32
+
+TINY_CFG = dict(
+    max_epoch=2,
+    batch_size=32,
+    encode_size=64,
+    terminal_embed_size=32,
+    path_embed_size=32,
+    max_path_length=BAG,
+    print_sample_cycle=0,
+)
+
+METRIC_KEYS = ("train_loss", "test_loss", "accuracy", "precision", "recall", "f1")
+
+
+@pytest.fixture(scope="module")
+def corpora(tmp_path_factory):
+    """(text paths, csr path, text-loaded data, mmap-loaded data)."""
+    out = tmp_path_factory.mktemp("feed")
+    paths = generate_corpus_files(out, SPECS["tiny"])
+    csr = str(out / "corpus.csr")
+    text_to_csr(paths["corpus"], csr)
+    data_text = load_corpus(
+        paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+        cache=False, native=False,
+    )
+    data_mmap = load_corpus(csr, paths["path_idx"], paths["terminal_idx"])
+    return paths, csr, data_text, data_mmap
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faultinject.install_plan(None)
+    yield
+    faultinject.install_plan(None)
+
+
+def assert_bitwise_history(r1, r2):
+    assert len(r1.history) == len(r2.history)
+    for h1, h2 in zip(r1.history, r2.history):
+        for key in METRIC_KEYS:
+            assert h1[key] == h2[key], (h1["epoch"], key, h1[key], h2[key])
+
+
+def _sources(data_text, data_mmap, ladder, context_order):
+    idx = np.arange(data_text.n_items)
+    kw = dict(context_order=context_order)
+    return {
+        "epoch-fixed": (EpochSource(data_text, idx, 8, BAG, **kw), data_text),
+        "epoch-bucketed": (
+            EpochSource(data_text, idx, 8, BAG, ladder=ladder, **kw),
+            data_text,
+        ),
+        "stream-fixed": (
+            StreamingSource(data_text, idx, 8, BAG, 48, **kw), data_text,
+        ),
+        "stream-bucketed": (
+            StreamingSource(data_text, idx, 8, BAG, 48, ladder=ladder, **kw),
+            data_text,
+        ),
+        "mmap-fixed": (
+            MmapCorpusSource(data_mmap, idx, 8, BAG, **kw), data_mmap,
+        ),
+        "mmap-bucketed": (
+            MmapCorpusSource(data_mmap, idx, 8, BAG, ladder=ladder, **kw),
+            data_mmap,
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the plan/build split (no workers: pure functions)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanBuildSplit:
+    def test_plan_matrix_bitwise_and_rng_end_state(self, corpora):
+        """THE split contract: execute_plan(plan_k) == batches()[k] bitwise
+        for every source variant, and a fully-consumed plan stream leaves
+        the generator in the identical state (later epochs stay aligned)."""
+        _, _, data_text, data_mmap = corpora
+        ladder = derive_bucket_ladder(np.diff(data_text.row_splits), BAG)
+        assert len(ladder) > 1
+        for context_order in ("shuffled", "corpus"):
+            sources = _sources(data_text, data_mmap, ladder, context_order)
+            for name, (source, data) in sources.items():
+                for shuffle in (True, False):
+                    tag = f"{name}/{context_order}/shuffle={shuffle}"
+                    r1 = np.random.default_rng(7)
+                    r2 = np.random.default_rng(7)
+                    sync = list(source.batches(r1, shuffle=shuffle))
+                    plans = list(source.plan_batches(r2, shuffle=shuffle))
+                    assert len(sync) == len(plans), tag
+                    for k, (b, p) in enumerate(zip(sync, plans)):
+                        got = execute_plan(data, p)
+                        for key in b:
+                            assert np.array_equal(b[key], got[key]), (
+                                tag, k, key,
+                            )
+                    assert (
+                        r1.bit_generator.state == r2.bit_generator.state
+                    ), tag
+
+    def test_planned_draws_mismatch_fails_loudly(self, corpora):
+        _, _, _, data_mmap = corpora
+        fat = int(np.argmax(np.diff(data_mmap.row_splits)))
+        plan = BatchPlan(
+            width=8, valid=1,
+            items=np.asarray([fat], np.int64),
+            uniforms=np.zeros(0, np.float64),  # too few for that item's row
+        )
+        with pytest.raises(ValueError, match="uniforms"):
+            execute_plan(data_mmap, plan)
+
+    def test_base_source_has_no_split(self, corpora):
+        from code2vec_tpu.data.pipeline import BatchSource
+
+        with pytest.raises(NotImplementedError, match="feed_workers"):
+            BatchSource().plan_batches(np.random.default_rng(0))
+
+    def test_variable_task_rejected(self, corpora):
+        paths, _, _, _ = corpora
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            cache=False, native=False, infer_method=False,
+            infer_variable=True,
+        )
+        source = EpochSource(data, np.arange(data.n_items), 8, BAG)
+        with pytest.raises(ValueError, match="variable"):
+            source.plan_batches(np.random.default_rng(0))
+
+
+# ---------------------------------------------------------------------------
+# satellite: the vectorized variable-task epoch build
+# ---------------------------------------------------------------------------
+
+
+def _naive_variable_epoch(
+    data, item_idx, max_contexts, rng, shuffle_variable_indexes=False,
+    context_order="shuffled",
+):
+    """The historical per-alias inner loop, kept as the test oracle."""
+    from code2vec_tpu import QUESTION_TOKEN_INDEX  # noqa: F401 - parity import
+
+    variable_indexes = data.variable_indexes
+    perm_map = None
+    if not shuffle_variable_indexes and len(variable_indexes):
+        perm_map = _index_remap(variable_indexes, variable_indexes)
+    ids, labels, rows_s, rows_p, rows_e = [], [], [], [], []
+    label_stoi = data.label_vocab.stoi
+    for i, alias_names, alias_idx, s, p, e in variable_items(data, item_idx):
+        alias_map = data.aliases[i]
+        if shuffle_variable_indexes:
+            shuffled = variable_indexes.copy()
+            rng.shuffle(shuffled)
+            perm_map = _index_remap(variable_indexes, shuffled)
+        order = rng.permutation(len(s))
+        if context_order == "shuffled":
+            s, p, e = s[order], p[order], e[order]
+        for alias_name, var_idx in zip(alias_names, alias_idx):
+            mine = (s == var_idx) | (e == var_idx)
+            ms = _rename_target(s[mine][:max_contexts], var_idx, perm_map)
+            mp = p[mine][:max_contexts]
+            me = _rename_target(e[mine][:max_contexts], var_idx, perm_map)
+            ids.append(int(data.ids[i]))
+            labels.append(label_stoi[alias_map[alias_name]])
+            rows_s.append(ms)
+            rows_p.append(mp)
+            rows_e.append(me)
+    n = len(ids)
+    starts = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    paths = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    ends = np.full((n, max_contexts), PAD_INDEX, np.int32)
+    for r, (ms, mp, me) in enumerate(zip(rows_s, rows_p, rows_e)):
+        starts[r, : len(ms)] = ms
+        paths[r, : len(mp)] = mp
+        ends[r, : len(me)] = me
+    return np.asarray(ids, np.int64), starts, paths, ends, np.asarray(
+        labels, np.int32
+    )
+
+
+class TestVariableVectorized:
+    @pytest.mark.parametrize("svi", [False, True])
+    @pytest.mark.parametrize("context_order", ["shuffled", "corpus"])
+    def test_bitwise_vs_naive_loop(self, corpora, svi, context_order):
+        """rng-consumption compatibility: the vectorized build makes the
+        SAME draws in the same order as the per-alias loop, so the epochs
+        are bitwise equal — which implies per-example loss-multiset
+        parity (the forward is a pure function of the rows)."""
+        paths, _, _, _ = corpora
+        data = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            cache=False, native=False, infer_method=False,
+            infer_variable=True,
+        )
+        idx = np.arange(data.n_items)
+        r1, r2 = np.random.default_rng(3), np.random.default_rng(3)
+        got = build_variable_epoch(
+            data, idx, 12, r1, shuffle_variable_indexes=svi,
+            context_order=context_order,
+        )
+        ids, starts, paths_a, ends, labels = _naive_variable_epoch(
+            data, idx, 12, r2, shuffle_variable_indexes=svi,
+            context_order=context_order,
+        )
+        assert (got.ids == ids).all()
+        assert (got.starts == starts).all()
+        assert (got.paths == paths_a).all()
+        assert (got.ends == ends).all()
+        assert (got.labels == labels).all()
+        assert r1.bit_generator.state == r2.bit_generator.state
+
+
+# ---------------------------------------------------------------------------
+# the worker pool (real forked processes + shared-memory arena)
+# ---------------------------------------------------------------------------
+
+
+class TestFeedPool:
+    def _consume_copy(self, stream):
+        out = []
+        for batch in stream:
+            out.append({k: np.array(v) for k, v in batch.items()})
+        return out
+
+    def test_delivered_stream_bitwise_vs_sync(self, corpora):
+        _, _, data_text, data_mmap = corpora
+        ladder = derive_bucket_ladder(np.diff(data_text.row_splits), BAG)
+        idx = np.arange(data_mmap.n_items)
+        pool = FeedPool(data_mmap, 2, 8, BAG, deliver="views")
+        try:
+            source = MmapCorpusSource(data_mmap, idx, 8, BAG, ladder=ladder)
+            feed = ParallelFeed(source, pool)
+            for shuffle in (True, False):
+                r1, r2 = np.random.default_rng(7), np.random.default_rng(7)
+                sync = list(source.batches(r1, shuffle=shuffle))
+                got = self._consume_copy(feed.batches(r2, shuffle=shuffle))
+                assert len(sync) == len(got)
+                for k, (a, b) in enumerate(zip(sync, got)):
+                    for key in a:
+                        assert np.array_equal(a[key], b[key]), (k, key)
+                assert r1.bit_generator.state == r2.bit_generator.state
+                assert feed.pad_stats() == source.pad_stats()
+        finally:
+            pool.close()
+
+    def test_arena_recycles_under_backpressure_without_overwrite(
+        self, corpora
+    ):
+        """slots << batches forces every slot through many recycles; a
+        slow consumer (device step stand-in) maximizes backpressure. The
+        invariant — a view is never overwritten before the consumer moved
+        past it — shows up as bitwise-correct content for EVERY batch."""
+        _, _, _, data_mmap = corpora
+        ladder = derive_bucket_ladder(np.diff(data_mmap.row_splits), BAG)
+        idx = np.arange(data_mmap.n_items)
+        pool = FeedPool(data_mmap, 2, 8, BAG, slots=3, deliver="views")
+        try:
+            source = MmapCorpusSource(data_mmap, idx, 8, BAG, ladder=ladder)
+            feed = ParallelFeed(source, pool)
+            sync = list(source.batches(np.random.default_rng(5)))
+            assert len(sync) > pool.slots  # recycling is actually exercised
+            stream = feed.batches(np.random.default_rng(5))
+            for k, batch in enumerate(stream):
+                if k % 7 == 0:
+                    time.sleep(0.02)  # let workers run ahead into the arena
+                for key in sync[k]:
+                    assert np.array_equal(sync[k][key], batch[key]), (k, key)
+        finally:
+            pool.close()
+
+    def test_worker_exception_carries_child_traceback(self, corpora):
+        _, _, _, data_mmap = corpora
+        pool = FeedPool(data_mmap, 1, 8, BAG, deliver="views")
+        try:
+            def bad_plans():
+                yield BatchPlan(
+                    width=8, valid=1,
+                    items=np.asarray([10**9], np.int64),
+                    uniforms=np.zeros(0, np.float64),
+                )
+
+            with pytest.raises(FeedWorkerError) as err:
+                list(pool.run(bad_plans()))
+            text = str(err.value)
+            assert "feed worker traceback" in text
+            assert "Traceback (most recent call last)" in text
+            assert err.value.remote_traceback
+            # the pool survives a failed stream
+            source = MmapCorpusSource(
+                data_mmap, np.arange(data_mmap.n_items), 8, BAG
+            )
+            got = self._consume_copy(
+                ParallelFeed(source, pool).batches(np.random.default_rng(1))
+            )
+            assert got
+        finally:
+            pool.close()
+
+    def test_worker_kill_fails_fast_and_tears_down(self, corpora):
+        from code2vec_tpu.obs.events import EventLog
+
+        _, _, _, data_mmap = corpora
+        seen = []
+        events = EventLog()
+        events.subscribe(lambda e: seen.append(e))
+        pool = FeedPool(data_mmap, 2, 8, BAG, deliver="views", events=events)
+        source = MmapCorpusSource(
+            data_mmap, np.arange(data_mmap.n_items), 8, BAG
+        )
+        feed = ParallelFeed(source, pool)
+        os.kill(pool._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(FeedWorkerError, match="died"):
+            for _ in feed.batches(np.random.default_rng(2)):
+                pass
+        assert [e for e in seen if e["event"] == "error"]
+        pool.close()
+        assert all(not p.is_alive() for p in pool._procs)
+
+    def test_stream_close_midway_then_pool_reusable(self, corpora):
+        _, _, _, data_mmap = corpora
+        ladder = derive_bucket_ladder(np.diff(data_mmap.row_splits), BAG)
+        idx = np.arange(data_mmap.n_items)
+        pool = FeedPool(data_mmap, 2, 8, BAG, deliver="views")
+        try:
+            source = MmapCorpusSource(data_mmap, idx, 8, BAG, ladder=ladder)
+            feed = ParallelFeed(source, pool)
+            stream = feed.batches(np.random.default_rng(3))
+            next(stream)
+            stream.close()
+            sync = list(source.batches(np.random.default_rng(4)))
+            got = self._consume_copy(feed.batches(np.random.default_rng(4)))
+            assert len(sync) == len(got)
+            for a, b in zip(sync, got):
+                assert np.array_equal(a["paths"], b["paths"])
+        finally:
+            pool.close()
+
+    def test_scheduled_batches_rejected(self, corpora):
+        _, _, _, data_mmap = corpora
+        pool = FeedPool(data_mmap, 1, 8, BAG, deliver="views")
+        try:
+            feed = ParallelFeed(
+                MmapCorpusSource(
+                    data_mmap, np.arange(data_mmap.n_items), 8, BAG
+                ),
+                pool,
+            )
+            with pytest.raises(NotImplementedError, match="sharded"):
+                feed.scheduled_batches(
+                    np.random.default_rng(0), np.asarray([BAG])
+                )
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch-boundary satellite: traceback text across the thread boundary
+# ---------------------------------------------------------------------------
+
+
+class TestPrefetchTraceback:
+    def test_producer_exception_carries_traceback_text(self):
+        from code2vec_tpu.train.prefetch import HostPrefetcher
+
+        def exploding():
+            yield {"x": np.zeros(1)}
+            raise ValueError("kaboom-in-producer")
+
+        pf = HostPrefetcher(exploding(), lambda b: b, depth=2)
+        with pytest.raises(ValueError, match="kaboom") as err:
+            for _ in pf:
+                pass
+        assert "kaboom-in-producer" in err.value.remote_traceback
+        assert "Traceback (most recent call last)" in err.value.remote_traceback
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through train(): bitwise vs --feed_workers 0
+# ---------------------------------------------------------------------------
+
+
+class TestTrainE2E:
+    def test_mmap_bucketed_bitwise_and_zero_recompiles(self, corpora):
+        """The flagship combination: mmap-CSR + bucketed + prefetched +
+        2 feed workers — bitwise the workers=0 history, ladder-only
+        compiles."""
+        from code2vec_tpu.obs.events import EventLog
+
+        _, _, _, data_mmap = corpora
+        base = dict(TINY_CFG, bucketed=True, prefetch_batches=2)
+        r0 = train(
+            TrainConfig(**base, feed_workers=0), data_mmap, sinks=()
+        )
+        seen = []
+        events = EventLog()
+        events.subscribe(lambda e: seen.append(e))
+        r2 = train(
+            TrainConfig(**base, feed_workers=2), data_mmap, sinks=(),
+            events=events,
+        )
+        assert_bitwise_history(r0, r2)
+        assert not [e for e in seen if e["event"] == "recompile"]
+        assert all(
+            0.0 < h["pad_efficiency"] <= 1.0 for h in r2.history
+        )
+
+    def test_streaming_sync_bitwise(self, corpora):
+        _, _, data_text, _ = corpora
+        base = dict(TINY_CFG, bucketed=True, stream_chunk_items=64)
+        r0 = train(TrainConfig(**base, feed_workers=0), data_text, sinks=())
+        r2 = train(TrainConfig(**base, feed_workers=2), data_text, sinks=())
+        assert_bitwise_history(r0, r2)
+
+    def test_kill_resume_bitwise_with_workers(self, corpora, tmp_path):
+        """Mid-epoch kill -> --resume with workers ON: the replay skips
+        planned batches through the pool and continues bitwise (the
+        stream stays a pure function of the epoch-start rng)."""
+        _, _, _, data_mmap = corpora
+        base = dict(
+            TINY_CFG, max_epoch=3, checkpoint_cycle=1,
+            bucketed=True, bucket_ladder=f"8,16,{BAG}", feed_workers=2,
+        )
+        r_full = train(
+            TrainConfig(**base), data_mmap, out_dir=str(tmp_path / "full"),
+            sinks=(),
+        )
+        with pytest.raises(faultinject.FaultInjected):
+            train(
+                TrainConfig(**base, checkpoint_every_steps=2,
+                            fault_plan="train_step@9:raise"),
+                data_mmap, out_dir=str(tmp_path / "killed"), sinks=(),
+            )
+        r_resumed = train(
+            TrainConfig(**base, resume=True), data_mmap,
+            out_dir=str(tmp_path / "killed"), sinks=(),
+        )
+        assert_bitwise_history(r_full, r_resumed)
+
+    def test_profiler_reports_feed_wait(self, corpora):
+        _, _, _, data_mmap = corpora
+        res = train(
+            TrainConfig(**dict(TINY_CFG, max_epoch=1), feed_workers=2,
+                        profile_steps=2),
+            data_mmap, sinks=(),
+        )
+        assert "feed_wait_ms" in res.history[0]
+        assert res.history[0]["feed_wait_ms"] >= 0.0
+
+    def test_loud_rejects(self, corpora):
+        paths, _, _, data_mmap = corpora
+        with pytest.raises(ValueError, match="feed_workers must be >= 0"):
+            train(TrainConfig(**TINY_CFG, feed_workers=-1), data_mmap)
+        with pytest.raises(ValueError, match="device_epoch"):
+            train(
+                TrainConfig(**TINY_CFG, feed_workers=2, device_epoch=True),
+                data_mmap,
+            )
+        data_var = load_corpus(
+            paths["corpus"], paths["path_idx"], paths["terminal_idx"],
+            cache=False, native=False, infer_method=False,
+            infer_variable=True,
+        )
+        with pytest.raises(ValueError, match="method task"):
+            train(
+                TrainConfig(
+                    **TINY_CFG, feed_workers=2, infer_method_name=False,
+                    infer_variable_name=True,
+                ),
+                data_var,
+            )
+
+    def test_cli_wiring(self):
+        from code2vec_tpu.cli import build_parser, config_from_args
+
+        args = build_parser().parse_args(["--feed_workers", "3"])
+        assert config_from_args(args).feed_workers == 3
+        assert config_from_args(
+            build_parser().parse_args([])
+        ).feed_workers == 0
+
+
+# ---------------------------------------------------------------------------
+# obs: fingerprint + worker trace tracks
+# ---------------------------------------------------------------------------
+
+
+class TestObsSatellites:
+    def test_host_cpu_fingerprint_stable_and_keyed_into_cache_dir(self):
+        from code2vec_tpu.obs.runtime import host_cpu_fingerprint
+
+        fp = host_cpu_fingerprint()
+        assert fp == host_cpu_fingerprint()
+        assert len(fp) == 8
+        int(fp, 16)  # hex digest
+        # conftest keyed the suite's compile-cache dir by it (unless an
+        # operator pinned the env var before pytest started)
+        cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+        if cache_dir.startswith("/tmp/jaxcache_tests_"):
+            assert cache_dir.endswith(fp)
+
+    def test_span_complete_lands_on_named_track(self):
+        from code2vec_tpu.obs.trace import Tracer
+
+        tracer = Tracer(process_index=0)
+        t0 = time.perf_counter()
+        tracer.span_complete(
+            "feed_build", category="data", start_s=t0,
+            end_s=t0 + 0.001, track="feed-worker-1", seq=0,
+        )
+        trace = tracer.chrome_trace()
+        names = [
+            e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e.get("name") == "thread_name"
+        ]
+        assert "feed-worker-1" in names
+        spans = [
+            e for e in trace["traceEvents"] if e.get("name") == "feed_build"
+        ]
+        assert spans and spans[0]["dur"] > 0
+
+    def test_feed_gauges_registered(self, corpora):
+        """queue-depth gauge + starved-steps counter ride the run's
+        RuntimeHealth and surface in epoch events."""
+        from code2vec_tpu.obs.events import EventLog
+
+        _, _, _, data_mmap = corpora
+        seen = []
+        events = EventLog()
+        events.subscribe(lambda e: seen.append(e))
+        train(
+            TrainConfig(**dict(TINY_CFG, max_epoch=1), feed_workers=2),
+            data_mmap, sinks=(), events=events,
+        )
+        epochs = [e for e in seen if e["event"] == "epoch"]
+        assert epochs
+        gauges = epochs[0]["health"]["gauges"]
+        assert "feed.queue_depth" in gauges
+
+
+# ---------------------------------------------------------------------------
+# bounded host RSS with workers on (the PR-10 RLIMIT_AS harness)
+# ---------------------------------------------------------------------------
+
+
+WORKER_RSS_SCRIPT = textwrap.dedent("""
+    import os, resource, sys
+    import numpy as np
+
+    from code2vec_tpu.data.reader import load_corpus_csr
+    from code2vec_tpu.data.pipeline import MmapCorpusSource, derive_bucket_ladder_hist
+    from code2vec_tpu.data.parallel_feed import FeedPool, ParallelFeed
+
+    csr_path, path_idx, terminal_idx = sys.argv[1:4]
+
+    def vm_size():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmSize:"):
+                    return int(line.split()[1]) * 1024
+        raise RuntimeError("no VmSize")
+
+    corpus_bytes = os.path.getsize(csr_path)
+    # budget BEFORE the load and the pool: the single corpus-sized term
+    # covers the (shared) mmap, the margin covers the arena + queues, and
+    # forked workers inherit the limit — so every process, coordinator
+    # AND builders, is bound; worker builds must never materialize
+    # corpus-sized memory anywhere
+    margin = 56 << 20
+    budget = vm_size() + corpus_bytes + margin
+    resource.setrlimit(resource.RLIMIT_AS, (budget, budget))
+    data = load_corpus_csr(csr_path, path_idx, terminal_idx)
+    assert data.mmap_backed
+    lengths, weights = np.unique(np.diff(data.row_splits), return_counts=True)
+    ladder = derive_bucket_ladder_hist(lengths, weights, 200)
+    source = MmapCorpusSource(
+        data, np.arange(data.n_items), 64, 200, ladder=ladder
+    )
+    pool = FeedPool(data, 2, 64, int(ladder[-1]), deliver="views")
+    feed = ParallelFeed(source, pool)
+
+    n = 0
+    stream = feed.batches(np.random.default_rng(0))
+    for batch in stream:
+        n += 1
+        if n >= 40:
+            break
+    stream.close()
+    assert n == 40, n
+    pool.close()
+
+    # negative control: materializing the context arrays (an in-RAM load)
+    # must blow the same budget
+    try:
+        hoard = [np.array(data.starts), np.array(data.paths), np.array(data.ends)]
+        print("CONTROL-SURVIVED", len(hoard))
+        sys.exit(3)
+    except MemoryError:
+        pass
+    print("BOUNDED-OK", n)
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="rlimit/VmSize probe")
+def test_worker_feed_bounded_by_rlimit(tmp_path, corpora):
+    """Workers on the 65 MB mmap corpus stay O(arena): the PR-10
+    address-space budget holds with the pool + arena live (jax-free
+    subprocess; views delivery needs no backend)."""
+    from code2vec_tpu.formats.corpus_io import CorpusRecord, write_corpus_csr
+
+    paths, _, _, _ = corpora
+    rng = np.random.default_rng(0)
+    big = str(tmp_path / "big.csr")
+    n_methods, ctx_per = 6000, 900  # ~65 MB of context sections
+    records = (
+        CorpusRecord(
+            id=i,
+            label=f"m{i}",
+            path_contexts=rng.integers(
+                1, 1000, size=(ctx_per, 3), dtype=np.int64
+            ).tolist(),
+            aliases=[],
+        )
+        for i in range(n_methods)
+    )
+    write_corpus_csr(big, records, terminal_shift=1)
+    assert os.path.getsize(big) > 60 << 20
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", WORKER_RSS_SCRIPT, big,
+         paths["path_idx"], paths["terminal_idx"]],
+        capture_output=True, text=True, timeout=300,
+        cwd=repo_root,
+        env={
+            "PATH": os.environ.get("PATH", ""),
+            "HOME": os.environ.get("HOME", "/tmp"),
+            "PYTHONPATH": repo_root,
+            "OMP_NUM_THREADS": "1",
+            "OPENBLAS_NUM_THREADS": "1",
+        },
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "BOUNDED-OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke: the feed-smoke core (real CLI, csr corpus, workers on)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_trains_with_feed_workers(corpora, tmp_path):
+    paths, csr, _, _ = corpora
+    from code2vec_tpu.cli import main
+
+    events_dir = tmp_path / "events"
+    main([
+        "--corpus_path", csr,
+        "--path_idx_path", paths["path_idx"],
+        "--terminal_idx_path", paths["terminal_idx"],
+        "--corpus_format", "csr",
+        "--bucketed",
+        "--prefetch_batches", "2",
+        "--feed_workers", "2",
+        "--batch_size", "32",
+        "--max_path_length", str(BAG),
+        "--encode_size", "64",
+        "--terminal_embed_size", "32",
+        "--path_embed_size", "32",
+        "--max_epoch", "1",
+        "--print_sample_cycle", "0",
+        "--model_path", str(tmp_path / "out"),
+        "--events_dir", str(events_dir),
+    ])
+    log_files = list(events_dir.glob("*.jsonl"))
+    assert log_files
+    events = [
+        json.loads(line) for line in log_files[0].read_text().splitlines()
+    ]
+    assert any(e.get("event") == "epoch" for e in events)
+    assert not [e for e in events if e.get("event") == "recompile"]

@@ -493,7 +493,8 @@ class TestStridedProfiler:
                 prof.record_compute(s, 3.0)
         summary = prof.summary()
         assert set(summary) == {
-            "host_build_ms", "h2d_ms", "compute_ms", "profiled_steps",
+            "host_build_ms", "h2d_ms", "feed_wait_ms", "compute_ms",
+            "profiled_steps",
         }
         assert summary["profiled_steps"] == 2
 
