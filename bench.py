@@ -2303,6 +2303,15 @@ def _serve_bench() -> None:
     RecompileDetector tracks the engine's executable table across the
     whole mixed-width stream and the metric line carries its verdict.
 
+    The fleet observability plane rides along (PR 15): one MID-LOAD
+    ``/metrics`` scrape parsed back through the exposition parser lands
+    in the detail block (the plane's provenance, like kernel/feed
+    provenance), a p99-sampling flight recorder counts how many tail
+    requests left full per-request timelines, and a rolling SLO
+    error-budget window over the outcome stream puts ``slo_burn_rate`` /
+    ``slo_budget_exhausted`` on the metric line next to the recompile
+    verdict.
+
     ``--rolling-swap`` adds the hot-swap arm (serve/swap.py): mid-stream,
     a ``reload`` shadow-compiles a SECOND model version's full ladder on
     a background thread, golden-validates it, and atomically swaps the
@@ -2321,12 +2330,16 @@ def _serve_bench() -> None:
     from code2vec_tpu.data.pipeline import derive_bucket_ladder
     from code2vec_tpu.models.code2vec import Code2VecConfig
     from code2vec_tpu.obs.runtime import (
+        FlightRecorder,
         RecompileDetector,
         RuntimeHealth,
         memory_snapshot,
+        parse_prometheus_text,
+        prometheus_text,
     )
     from code2vec_tpu.serve.batcher import MicroBatcher, ServeOverloaded
     from code2vec_tpu.serve.engine import ServingEngine
+    from code2vec_tpu.serve.fleet.slo import SloBurnTracker
     from code2vec_tpu.train.config import TrainConfig
     from code2vec_tpu.train.step import create_train_state
 
@@ -2417,8 +2430,16 @@ def _serve_bench() -> None:
     gaps = rng.exponential(1.0 / target_qps, n_requests)
     arrivals = np.cumsum(gaps)
 
+    # the observability plane rides the load run like it rides production:
+    # a p99-sampling flight recorder behind the batcher, and a rolling
+    # SLO error-budget window over the request outcomes — both land in
+    # the detail block so bench JSONs carry the plane's provenance the
+    # way they carry kernel/feed provenance
+    flight = FlightRecorder(health=health)
+    burn = SloBurnTracker(["serve"], health=health)
     batcher = MicroBatcher(
-        engine, deadline_ms=deadline_ms, max_pending=4096, health=health
+        engine, deadline_ms=deadline_ms, max_pending=4096, health=health,
+        flight=flight,
     )
 
     rolling_swap = "--rolling-swap" in sys.argv[1:]
@@ -2454,7 +2475,7 @@ def _serve_bench() -> None:
                 engine=shadow,
                 batcher=MicroBatcher(
                     shadow, deadline_ms=deadline_ms, max_pending=4096,
-                    health=health,
+                    health=health, flight=flight,
                 ),
             )
 
@@ -2475,11 +2496,36 @@ def _serve_bench() -> None:
     done_times: dict = {}
     rejected = 0
     swap_started_t = swap_committed_t = None
+    metrics_scrape = None
+    scrape_at = max(1, n_requests // 2)
     t_start = time.perf_counter()
     for i, arr in enumerate(requests):
         delay = arrivals[i] - (time.perf_counter() - t_start)
         if delay > 0:
             time.sleep(delay)
+        if i == scrape_at:
+            # one MID-LOAD /metrics scrape, parsed back through the same
+            # exposition parser a monitoring stack would use — recorded
+            # in the detail block as the plane's provenance (and proof
+            # the scrape is a lock-light snapshot: it runs inline on the
+            # submission thread without perturbing the open loop)
+            t_scrape = time.perf_counter()
+            parsed = parse_prometheus_text(
+                prometheus_text([({}, health.snapshot())])
+            )
+            types = parsed.pop("# types")
+            metrics_scrape = {
+                "at_request": i,
+                "scrape_ms": round(
+                    (time.perf_counter() - t_scrape) * 1e3, 3
+                ),
+                "series": len(types),
+                "samples": {
+                    name: rows[0]["value"]
+                    for name, rows in parsed.items()
+                    if not rows[0]["labels"]
+                },
+            }
         if rolling_swap and i == swap_at:
             swap_started_t = time.perf_counter()
             controller.reload("v1", wait=False)
@@ -2505,8 +2551,12 @@ def _serve_bench() -> None:
     for future in futures:
         try:
             results.append(future.result())
+            burn.record("serve", good=True)
         except Exception as exc:  # noqa: BLE001 - counted, then reported
             failed.append(f"{type(exc).__name__}: {exc}")
+            burn.record("serve", good=False)
+    for _ in range(rejected):
+        burn.record("serve", good=False)
     t_wall = time.perf_counter() - t_start
     if failed and not rolling_swap:
         # same contract as the old gather, which re-raised here: a broken
@@ -2641,6 +2691,12 @@ def _serve_bench() -> None:
         "detector_new_compiles": new_compiles,
         "failed_requests": len(failed),
         "counters": health.snapshot()["counters"],
+        # the observability plane's provenance: the mid-load scrape
+        # (parsed exposition, not raw text), the flight recorder's tail
+        # captures, and the rolling SLO error-budget verdict
+        "metrics_scrape": metrics_scrape,
+        "flight": {"recorded": flight.count, "seen": flight.seen},
+        "slo_burn": burn.snapshot()["serve"],
         "memory": memory_snapshot(),
     }
     if swap_detail is not None:
@@ -2657,6 +2713,12 @@ def _serve_bench() -> None:
         "p50_ms": lat["e2e"]["p50_ms"] if lat["e2e"] else None,
         "p99_ms": lat["e2e"]["p99_ms"] if lat["e2e"] else None,
         "post_warmup_recompiles": engine.post_warmup_compiles,
+        # the SLO burn verdict rides the metric line next to the
+        # recompile verdict: burn >= 1 with the window's budget consumed
+        # means the run would be paging a human in production
+        "slo_burn_rate": detail["slo_burn"]["burn_rate"],
+        "slo_budget_exhausted": detail["slo_burn"]["exhausted"],
+        "flight_recorded": flight.count,
         "backend": backend,
     }
     if swap_detail is not None:

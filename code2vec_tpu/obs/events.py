@@ -30,6 +30,24 @@ fleet router's ``fleet_replica_spawned`` / ``fleet_replica_evicted`` /
 / ``fleet_rollback`` (serve/fleet/router.py). Run manifests carry the
 serve/fleet topology blocks next to the config.
 
+The fleet observability plane (PR 15) adds two more:
+
+- ``flight`` (obs.runtime.FlightRecorder) — one slow/tail request's full
+  span breakdown: ``flight_seq``, ``e2e_ms``, ``trace_id``, and per-kind
+  fields (worker ``kind: "serve"``: queue_wait/pad/device/postprocess ms,
+  batch/width/coalesced, queue_depth_at_admission; router ``kind:
+  "router"``: op, slo_class, outcome, dispatch_wait_ms, replica_slot,
+  attempts, queue_depth_at_admission). The same records dump as
+  ``flight_<seq>.json`` files at process exit.
+- ``slo_budget_exhausted`` (serve/fleet/slo.SloBurnTracker) —
+  edge-triggered once per exhaustion episode: ``slo_class``,
+  ``burn_rate``, ``objective``, ``window_s``, window ``good``/``bad``.
+
+Health snapshots embedded in ``epoch``/``health`` payloads additionally
+carry ``started_unix`` + ``snapshot_seq`` (obs.runtime.RuntimeHealth),
+so consumers can compute rates and detect counter resets across replica
+respawns.
+
 **Sinks are consumers of this stream**: ``sink_consumer`` adapts the
 ``(epoch, metrics)`` metric sinks (``code2vec_tpu.sinks``) into an event
 consumer, and the train loop emits metrics ONLY as events — so the sink

@@ -16,14 +16,19 @@ Two detectors wired into the train loop (``train/loop.py``):
 
 from __future__ import annotations
 
+import collections
+import json
 import logging
 import os
+import re
 import threading
+import time
 
 logger = logging.getLogger(__name__)
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "LatencyHistogram",
     "NamespacedHealth",
@@ -34,6 +39,9 @@ __all__ = [
     "host_rss_bytes",
     "device_memory_stats",
     "memory_snapshot",
+    "parse_prometheus_text",
+    "prometheus_metric_name",
+    "prometheus_text",
 ]
 
 
@@ -85,12 +93,14 @@ class LatencyHistogram:
     def __init__(self, max_samples: int = 200_000) -> None:
         self._samples: list[float] = []
         self._count = 0
+        self._sum = 0.0  # over ALL samples ever (Prometheus summary _sum)
         self._max = int(max_samples)
         self._lock = threading.Lock()
 
     def record(self, value_ms: float) -> None:
         with self._lock:
             self._count += 1
+            self._sum += float(value_ms)
             if len(self._samples) < self._max:
                 self._samples.append(float(value_ms))
             else:
@@ -110,6 +120,7 @@ class LatencyHistogram:
         with self._lock:
             samples = list(self._samples)
             count = self._count
+            total = self._sum
         if not samples:
             return None
         ordered = sorted(samples)
@@ -128,6 +139,10 @@ class LatencyHistogram:
             "p99_ms": at(99),
             "max_ms": round(ordered[-1], 3),
             "mean_ms": round(sum(ordered) / len(ordered), 3),
+            # all-time sum (not just the window): with count it lets two
+            # /metrics scrapes compute an honest rate — the Prometheus
+            # summary contract (_sum/_count)
+            "sum_ms": round(total, 3),
         }
 
 
@@ -140,6 +155,13 @@ class RuntimeHealth:
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._latencies: dict[str, LatencyHistogram] = {}
+        # identity fields every snapshot carries: process start time and a
+        # strictly increasing snapshot sequence number. Two /metrics
+        # scrapes (or two health polls) can then compute honest rates and
+        # DETECT a counter reset — a respawned replica restarts both at
+        # zero, which otherwise reads as a huge negative rate.
+        self._started_unix = time.time()
+        self._snapshot_seq = 0
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -166,7 +188,11 @@ class RuntimeHealth:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             latencies = dict(self._latencies)
+            self._snapshot_seq += 1
+            seq = self._snapshot_seq
         return {
+            "started_unix": self._started_unix,
+            "snapshot_seq": seq,
             "counters": {k: c.value for k, c in counters.items()},
             "gauges": {k: g.value for k, g in gauges.items()},
             **(
@@ -207,6 +233,335 @@ class NamespacedHealth:
 
     def snapshot(self) -> dict:
         return self._parent.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (text/plain; version=0.0.4)
+# ---------------------------------------------------------------------------
+
+_PROM_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_metric_name(dotted: str, prefix: str = "c2v_") -> str:
+    """Sanitize one of the registry's dotted metric names into a legal
+    Prometheus metric name: ``serve.op.embed.e2e_ms`` ->
+    ``c2v_serve_op_embed_e2e_ms``. The prefix namespaces the whole
+    exporter; a leading digit after sanitization gets an underscore."""
+    name = _PROM_INVALID.sub("_", str(dotted))
+    name = prefix + name
+    if not re.match(r"[a-zA-Z_:]", name):  # pragma: no cover - empty prefix
+        name = "_" + name
+    return name
+
+
+def _prom_label_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for key, value in sorted(labels.items()):
+        # the exposition format's three label escapes: backslash, quote,
+        # newline (an unescaped newline would split the sample line)
+        value = (
+            str(value)
+            .replace("\\", r"\\")
+            .replace('"', r"\"")
+            .replace("\n", r"\n")
+        )
+        parts.append(f'{key}="{value}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _prom_number(value) -> str:
+    # integers stay exact; floats use repr (full precision, strict JSON
+    # numbers are valid Prometheus values)
+    if isinstance(value, bool):  # pragma: no cover - gauges never store bools
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def prometheus_text(
+    sources, prefix: str = "c2v_"
+) -> str:
+    """Serialize health snapshots as Prometheus text exposition 0.0.4.
+
+    ``sources``: iterable of ``(labels_dict, snapshot_dict)`` pairs — one
+    pair for a single process, one per replica (``{"replica": "r0"}``)
+    for the fleet router's aggregated view. Snapshots are the plain
+    dicts :meth:`RuntimeHealth.snapshot` returns (or the same block
+    embedded in a replica's ``health`` payload), so serialization never
+    touches live registries, locks, or device state — the lock-light
+    scrape contract.
+
+    Counters export as ``counter``, numeric gauges as ``gauge``
+    (non-numeric gauges — e.g. the transport name — are skipped), and
+    latency histograms as ``summary`` series: ``quantile`` labels for
+    p50/p90/p99 plus ``_sum``/``_count``. ``started_unix`` becomes the
+    conventional ``process_start_time_seconds`` and ``snapshot_seq`` a
+    gauge, so scrapers can compute honest rates and detect counter
+    resets across replica respawns.
+    """
+    # metric name -> {"type": t, "samples": [(labels, value)]}; insertion
+    # order preserved so the output groups each metric's series under ONE
+    # # TYPE header (the exposition format requires it)
+    series: dict[str, dict] = {}
+
+    def add(name: str, mtype: str, labels: dict, value) -> None:
+        entry = series.setdefault(name, {"type": mtype, "samples": []})
+        entry["samples"].append((labels, value))
+
+    for labels, snapshot in sources:
+        labels = dict(labels or {})
+        started = snapshot.get("started_unix")
+        if isinstance(started, (int, float)):
+            add(
+                prometheus_metric_name("process_start_time_seconds", prefix),
+                "gauge", labels, float(started),
+            )
+        seq = snapshot.get("snapshot_seq")
+        if isinstance(seq, (int, float)):
+            add(
+                prometheus_metric_name("health_snapshot_seq", prefix),
+                "gauge", labels, seq,
+            )
+        for key, value in (snapshot.get("counters") or {}).items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                add(
+                    prometheus_metric_name(key, prefix) + "_total",
+                    "counter", labels, value,
+                )
+        for key, value in (snapshot.get("gauges") or {}).items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                add(prometheus_metric_name(key, prefix), "gauge", labels, value)
+        for key, summary in (snapshot.get("latencies_ms") or {}).items():
+            if not isinstance(summary, dict):
+                continue
+            base = prometheus_metric_name(key, prefix)
+            for quantile, field in (
+                ("0.5", "p50_ms"), ("0.9", "p90_ms"), ("0.99", "p99_ms"),
+            ):
+                if isinstance(summary.get(field), (int, float)):
+                    add(
+                        base, "summary",
+                        {**labels, "quantile": quantile}, summary[field],
+                    )
+            if isinstance(summary.get("sum_ms"), (int, float)):
+                add(base + "_sum", "summary:sum", labels, summary["sum_ms"])
+            if isinstance(summary.get("count"), (int, float)):
+                add(base + "_count", "summary:count", labels, summary["count"])
+
+    lines = []
+    emitted_type: set[str] = set()
+    for name, entry in series.items():
+        mtype = entry["type"]
+        # _sum/_count ride their summary's TYPE header, not their own
+        base = name
+        if mtype.startswith("summary:"):
+            base = name[: -len("_sum")] if mtype == "summary:sum" else (
+                name[: -len("_count")]
+            )
+            mtype = "summary"
+        if base not in emitted_type:
+            lines.append(f"# TYPE {base} {mtype}")
+            emitted_type.add(base)
+        for labels, value in entry["samples"]:
+            lines.append(f"{name}{_prom_label_str(labels)} {_prom_number(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_PROM_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)\s*$"
+)
+# label values may contain escaped quotes/backslashes/newlines — match
+# escape pairs atomically so \" does not terminate the value early
+_PROM_LABEL = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
+)
+
+
+_PROM_ESCAPES = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _prom_unescape(value: str) -> str:
+    # left-to-right over escape PAIRS: sequential str.replace would turn
+    # the escaped-backslash-then-n sequence into a spurious newline
+    return re.sub(
+        r"\\(.)",
+        lambda m: _PROM_ESCAPES.get(m.group(1), m.group(0)),
+        value,
+    )
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse exposition text back into
+    ``{metric_name: [{"labels": {...}, "value": float}, ...]}`` plus a
+    ``"# types"`` entry mapping metric -> declared type. Strict enough to
+    catch a malformed exporter (tests and ``bench.py --serve``'s mid-load
+    scrape use it); raises ``ValueError`` on an unparseable line."""
+    metrics: dict = {}
+    types: dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        match = _PROM_SAMPLE.match(line)
+        if match is None:
+            raise ValueError(f"bad exposition line {lineno}: {line!r}")
+        labels = {
+            m.group("key"): _prom_unescape(m.group("value"))
+            for m in _PROM_LABEL.finditer(match.group("labels") or "")
+        }
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            raise ValueError(
+                f"bad sample value on line {lineno}: {line!r}"
+            ) from None
+        metrics.setdefault(match.group("name"), []).append(
+            {"labels": labels, "value": value}
+        )
+    metrics["# types"] = types
+    return metrics
+
+
+# ---------------------------------------------------------------------------
+# slow-request flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded reservoir of full per-request span breakdowns for the tail.
+
+    A latency histogram says *that* p99 spiked; a tail-latency incident
+    needs to know *where one slow request spent its time*. The batcher
+    and the fleet router feed every finished request's breakdown
+    (queue-wait / pad / device / postprocess, queue depths at admission,
+    trace id) through :meth:`observe`; a request is CAPTURED when its
+    end-to-end latency exceeds ``threshold_ms`` (when set) or the
+    recorder's own rolling p99 estimate — so roughly the worst ~1% of
+    requests always leave a concrete per-request timeline behind.
+
+    O(1) per request on the hot path: one deque append plus comparisons;
+    the p99 estimate re-sorts a small recent-latency window only every
+    ``_REFRESH`` observations (amortized O(1)). Captured records land in
+    a bounded deque (oldest evicted), are emitted as ``flight`` events
+    when an event log is attached, and :meth:`dump` writes them as
+    ``flight_<seq>.json`` files for offline forensics.
+    """
+
+    _REFRESH = 64  # re-estimate p99 every this many observations
+    _MIN_SAMPLES = 100  # p99 sampling stays off until this many seen
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        threshold_ms: float | None = None,
+        p99_window: int = 512,
+        events=None,
+        health: RuntimeHealth | None = None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.threshold_ms = (
+            float(threshold_ms) if threshold_ms is not None else None
+        )
+        self._records: collections.deque[dict] = collections.deque(
+            maxlen=int(capacity)
+        )
+        self._recent: collections.deque[float] = collections.deque(
+            maxlen=int(p99_window)
+        )
+        self._p99: float | None = None
+        self._since_refresh = 0
+        self._seen = 0
+        self._capture_seq = 0
+        self._events = events
+        self._captured = (
+            health.counter("flight.recorded") if health is not None else Counter()
+        )
+        self._lock = threading.Lock()
+
+    @property
+    def count(self) -> int:
+        """How many requests have been captured (all-time, not capacity)."""
+        return self._captured.value
+
+    @property
+    def seen(self) -> int:
+        return self._seen
+
+    def observe(self, e2e_ms: float, record: dict) -> bool:
+        """Feed one finished request; returns True when it was captured.
+        ``record`` is the caller-built span breakdown (shallow-copied on
+        capture, untouched otherwise)."""
+        e2e_ms = float(e2e_ms)
+        with self._lock:
+            self._seen += 1
+            self._recent.append(e2e_ms)
+            self._since_refresh += 1
+            if self._since_refresh >= self._REFRESH or (
+                self._p99 is None and self._seen >= self._MIN_SAMPLES
+            ):
+                ordered = sorted(self._recent)
+                rank = min(
+                    len(ordered) - 1, int(round(0.99 * (len(ordered) - 1)))
+                )
+                self._p99 = ordered[rank]
+                self._since_refresh = 0
+            capture = (
+                self.threshold_ms is not None and e2e_ms >= self.threshold_ms
+            ) or (
+                self._p99 is not None
+                and self._seen >= self._MIN_SAMPLES
+                and e2e_ms >= self._p99
+            )
+            if not capture:
+                return False
+            captured = {
+                "flight_seq": self._capture_seq,
+                "e2e_ms": round(e2e_ms, 3),
+                **record,
+            }
+            self._capture_seq += 1
+            self._records.append(captured)
+        self._captured.inc()
+        if self._events is not None:
+            try:
+                self._events.emit("flight", **captured)
+            except Exception:  # pragma: no cover - closed log
+                logger.warning("could not emit flight event", exc_info=True)
+        return True
+
+    def snapshot(self) -> list[dict]:
+        """The captured records currently in the reservoir (oldest first)."""
+        with self._lock:
+            return [dict(r) for r in self._records]
+
+    def dump(self, out_dir: str) -> list[str]:
+        """Write every resident record as ``<out_dir>/flight_<seq>.json``;
+        returns the paths (the ``flight_*.json`` artifacts a tail-latency
+        incident is debugged from)."""
+        records = self.snapshot()
+        os.makedirs(out_dir, exist_ok=True)
+        from code2vec_tpu.obs.events import sanitize
+
+        paths = []
+        for record in records:
+            path = os.path.join(
+                out_dir, f"flight_{record['flight_seq']:06d}.json"
+            )
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(sanitize(record), f, indent=1)
+            paths.append(path)
+        return paths
 
 
 _global_health: RuntimeHealth | None = None

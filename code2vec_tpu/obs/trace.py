@@ -18,6 +18,19 @@ Thread-safe: spans may close concurrently on any thread (the prefetch
 producer records ``host_build``/``h2d`` while the main thread records
 ``train_step``); each thread gets its own trace row (``tid``), named after
 ``threading.Thread.name`` via ``thread_name`` metadata events.
+
+**Cross-process request tracing** (the fleet observability plane): a
+:class:`TraceContext` rides an optional ``"trace"`` field in every serve
+protocol request dict — the fleet router stamps one at admission (or
+honors a client-supplied one, :func:`ensure_trace`), the replica pipe
+forwards the dict verbatim, and the worker's resolver installs it so the
+batcher-coalesce / engine-device-call / retrieval spans it triggers carry
+the originating ``trace_id`` as a span arg. Per-request cost is O(1) dict
+work — one 32-hex id, no locks, no allocation bursts. The per-process
+trace files (already unix-epoch-anchored) then merge into one fleet-wide
+view with ``tools/trace_stitch.py``, which indexes spans by trace id —
+including the coalesce-aware link: a batched device span records the N
+trace ids it served as ``trace_ids``.
 """
 
 from __future__ import annotations
@@ -27,10 +40,108 @@ import json
 import os
 import threading
 import time
+import uuid
+from dataclasses import dataclass
 
 from code2vec_tpu.obs.events import sanitize
 
-__all__ = ["NullTracer", "Tracer", "get_tracer", "set_tracer"]
+__all__ = [
+    "NullTracer",
+    "TraceContext",
+    "Tracer",
+    "current_trace_scope",
+    "ensure_trace",
+    "get_tracer",
+    "new_trace_id",
+    "set_tracer",
+    "trace_scope",
+]
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex request trace id (uuid4; no coordination needed)."""
+    return uuid.uuid4().hex
+
+
+@dataclass
+class TraceContext:
+    """One request's trace identity as it crosses process boundaries.
+
+    ``trace_id`` correlates every span the request touches — router
+    admission, the replica worker's resolver, the micro-batcher's
+    coalesced device call, retrieval — across separate trace files.
+    ``parent_span_id`` names the span that forwarded the context (the
+    router's request span), so a stitched trace can draw the handoff
+    edge; it is optional and purely informational.
+    """
+
+    trace_id: str
+    parent_span_id: str | None = None
+
+    WIRE_KEY = "trace"
+
+    @classmethod
+    def from_request(cls, request: dict) -> "TraceContext | None":
+        """Parse the optional ``"trace"`` field off a protocol request
+        dict; malformed values are ignored (None), never fatal — a
+        garbage trace field must not break serving."""
+        raw = request.get(cls.WIRE_KEY)
+        if not isinstance(raw, dict):
+            return None
+        trace_id = raw.get("trace_id")
+        if not isinstance(trace_id, str) or not trace_id:
+            return None
+        parent = raw.get("parent_span_id")
+        return cls(
+            trace_id=trace_id[:64],
+            parent_span_id=parent[:64] if isinstance(parent, str) else None,
+        )
+
+    def to_wire(self) -> dict:
+        wire = {"trace_id": self.trace_id}
+        if self.parent_span_id:
+            wire["parent_span_id"] = self.parent_span_id
+        return wire
+
+
+def ensure_trace(request: dict, parent_span_id: str | None = None) -> TraceContext:
+    """The admission hook: honor a client-supplied trace context or stamp
+    a fresh one INTO ``request`` (the same dict then crosses the replica
+    pipe, so downstream processes see the id without any extra wiring).
+    O(1) per request."""
+    ctx = TraceContext.from_request(request)
+    if ctx is None:
+        ctx = TraceContext(
+            trace_id=new_trace_id(), parent_span_id=parent_span_id
+        )
+        request[TraceContext.WIRE_KEY] = ctx.to_wire()
+    return ctx
+
+
+# thread-local span tags: lets a caller scope trace ids over a callee's
+# spans WITHOUT widening the callee's signature (the batcher wraps the
+# engine's device call; duck-typed fake engines in tests keep their
+# 3-arg run()). The batcher thread calls the engine synchronously, so
+# thread-locality is exactly the right propagation boundary.
+_scope = threading.local()
+
+
+@contextlib.contextmanager
+def trace_scope(**tags):
+    """Attach ``tags`` (e.g. ``trace_ids=[...]``) to every span the
+    wrapped block records via :func:`current_trace_scope` readers."""
+    previous = getattr(_scope, "tags", None)
+    _scope.tags = {**(previous or {}), **tags}
+    try:
+        yield
+    finally:
+        _scope.tags = previous
+
+
+def current_trace_scope() -> dict:
+    """The active :func:`trace_scope` tags for this thread ({} outside)."""
+    tags = getattr(_scope, "tags", None)
+    return dict(tags) if tags else {}
 
 
 class Tracer:

@@ -99,7 +99,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "serve_executable/... events)")
     parser.add_argument("--trace_dir", default=None,
                         help="Chrome trace of the serve spans "
-                        "(queue_wait/pad/device/postprocess)")
+                        "(queue_wait/pad/device/postprocess); spans carry "
+                        "the request's trace id when one rides the "
+                        "request's 'trace' field (the fleet router stamps "
+                        "it at admission) — tools/trace_stitch.py merges "
+                        "per-process files into one fleet-wide trace")
+    parser.add_argument("--flight_threshold_ms", type=float, default=0.0,
+                        help="slow-request flight recorder: capture a "
+                        "full per-request span breakdown for any request "
+                        "slower than this many ms (0 = p99 sampling "
+                        "only); records land as `flight` events and as "
+                        "flight_*.json dumps under <events_dir>/flight")
     return parser
 
 
@@ -131,12 +141,14 @@ def _build_retrieval(args, model_path: str):
     return None
 
 
-def make_generation_factory(args, events=None, start=0):
+def make_generation_factory(args, events=None, start=0, flight=None):
     """``build(target) -> Generation``: load a checkpoint (``target`` is
     its model dir; None = the CLI's ``--model_path``), AOT-compile its
     full executable ladder, load retrieval, stand up a micro-batcher.
     Called once at startup for generation 0 and again — on the swap
-    controller's background thread — for every ``reload``."""
+    controller's background thread — for every ``reload``. ``flight`` is
+    the process-wide slow-request recorder; every generation's batcher
+    feeds the same one (a swap must not reset tail forensics)."""
     import itertools
 
     from code2vec_tpu.predict import Predictor
@@ -198,6 +210,7 @@ def make_generation_factory(args, events=None, start=0):
             engine,
             deadline_ms=args.deadline_ms,
             max_pending=args.max_pending,
+            flight=flight,
         )
         return Generation(
             version=version, predictor=predictor, engine=engine,
@@ -227,10 +240,21 @@ def build_server(args):
 
         events = EventLog(args.events_dir)
 
+    # slow-request flight recorder: one per process, shared by every
+    # generation's batcher (constructed without the event log for the
+    # same manifest-first reason as the factory below; attached after)
+    from code2vec_tpu.obs.runtime import FlightRecorder
+
+    threshold = getattr(args, "flight_threshold_ms", 0.0)
+    flight = FlightRecorder(
+        threshold_ms=threshold if threshold > 0 else None,
+        health=global_health(),
+    )
+
     # the factory builds generation 0 WITHOUT the event log attached (the
     # manifest must stay the log's first line), then every later
     # generation with it
-    factory = make_generation_factory(args, events=None)
+    factory = make_generation_factory(args, events=None, flight=flight)
     gen0 = factory(None)
     engine, retrieval = gen0.engine, gen0.retrieval
 
@@ -260,13 +284,16 @@ def build_server(args):
         # line; later compiles (histogram-freeze, shape misses, shadow
         # builds) still get their own serve_executable events
         engine._events = events
-        factory = make_generation_factory(args, events=events, start=1)
+        flight._events = events
+        factory = make_generation_factory(
+            args, events=events, start=1, flight=flight
+        )
 
     server = CodeServer(
         gen0.predictor, engine, gen0.batcher, retrieval=retrieval,
         version=gen0.version, factory=factory,
         golden=GoldenSet(min_recall=args.golden_min_recall),
-        events=events,
+        events=events, flight=flight,
     )
     health = global_health()
     health.gauge("serve_transport").set(args.transport)
@@ -289,7 +316,9 @@ def main(argv: list[str] | None = None) -> None:
     if args.trace_dir:
         from code2vec_tpu.obs.trace import Tracer, set_tracer
 
-        tracer = Tracer()
+        # name the process row so a stitched fleet trace reads
+        # router/worker at a glance (the stitcher prefixes the source dir)
+        tracer = Tracer(process_name=f"serve-worker-{os.getpid()}")
         set_tracer(tracer)
 
     server, events = build_server(args)
@@ -311,6 +340,13 @@ def main(argv: list[str] | None = None) -> None:
                 tracer.export_dir(args.trace_dir)
             except Exception:
                 logger.warning("could not write chrome trace", exc_info=True)
+        if args.events_dir and server.flight is not None:
+            # tail forensics survive the process: every captured record
+            # as its own flight_<seq>.json next to the event log
+            try:
+                server.flight.dump(os.path.join(args.events_dir, "flight"))
+            except Exception:
+                logger.warning("could not dump flight records", exc_info=True)
         if events is not None:
             try:
                 events.close()

@@ -42,12 +42,17 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from contextlib import nullcontext
 from dataclasses import dataclass
 
 import numpy as np
 
-from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
-from code2vec_tpu.obs.trace import get_tracer
+from code2vec_tpu.obs.runtime import (
+    FlightRecorder,
+    RuntimeHealth,
+    global_health,
+)
+from code2vec_tpu.obs.trace import TraceContext, get_tracer, trace_scope
 
 __all__ = ["MicroBatcher", "ServeOverloaded", "ServerClosed", "ServeResult"]
 
@@ -76,12 +81,14 @@ class ServeResult:
 
 
 class _Pending:
-    __slots__ = ("contexts", "future", "enqueued")
+    __slots__ = ("contexts", "future", "enqueued", "trace", "depth")
 
-    def __init__(self, contexts: np.ndarray):
+    def __init__(self, contexts: np.ndarray, trace=None):
         self.contexts = contexts
         self.future: Future = Future()
         self.enqueued = time.perf_counter()
+        self.trace = trace  # TraceContext | None (cross-process tracing)
+        self.depth = 0  # queue depth observed at admission
 
 
 class MicroBatcher:
@@ -103,6 +110,7 @@ class MicroBatcher:
         max_batch: int | None = None,
         max_pending: int = 256,
         health: RuntimeHealth | None = None,
+        flight: FlightRecorder | None = None,
     ) -> None:
         if deadline_ms < 0:
             raise ValueError(f"deadline_ms must be >= 0, got {deadline_ms}")
@@ -115,6 +123,7 @@ class MicroBatcher:
         if self._max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self._max_batch}")
         self._health = health or global_health()
+        self._flight = flight
         self._queue: queue.Queue = queue.Queue(maxsize=int(max_pending))
         self._closed = threading.Event()
         # serializes submit's closed-check+enqueue against close's
@@ -137,12 +146,16 @@ class MicroBatcher:
         self._thread.start()
 
     # ---- caller side ----------------------------------------------------
-    def submit(self, contexts) -> Future:
+    def submit(self, contexts, trace: TraceContext | None = None) -> Future:
         """Enqueue one request (an ``[n, 3]`` array of mapped
         (start, path, end) vocab ids); resolves to a :class:`ServeResult`.
+        ``trace`` is the request's cross-process trace context: the
+        coalesced device call's span records every member's trace id.
         Raises :class:`ServerClosed` after close, :class:`ServeOverloaded`
         when ``max_pending`` requests are already waiting."""
-        pending = _Pending(np.asarray(contexts, np.int32).reshape(-1, 3))
+        pending = _Pending(
+            np.asarray(contexts, np.int32).reshape(-1, 3), trace=trace
+        )
         max_width = getattr(self._engine, "max_width", None)
         if max_width is not None and len(pending.contexts) > max_width:
             # reject loudly instead of silently truncating the bag: the
@@ -156,6 +169,9 @@ class MicroBatcher:
         with self._submit_lock:
             if self._closed.is_set():
                 raise ServerClosed("micro-batcher is closed")
+            # depth BEFORE this request joined: the flight recorder's
+            # "what did the queue look like at admission" field
+            pending.depth = self._queue.qsize()
             try:
                 self._queue.put_nowait(pending)
             except queue.Full:
@@ -246,9 +262,18 @@ class MicroBatcher:
         tracer = get_tracer()
         engine = self._engine
         t_start = time.perf_counter()
+        # the coalesce-aware link: a batched device span records the N
+        # trace ids it served, so a stitched trace can walk from any one
+        # request's id into the shared device call (and see who else rode
+        # it). Built only when someone traced — the untraced hot path
+        # stays an empty-list comprehension.
+        trace_ids = [p.trace.trace_id for p in group if p.trace is not None]
+        span_trace = {"trace_ids": trace_ids} if trace_ids else {}
         for pending in group:
             engine.observe_width(len(pending.contexts))
-        with tracer.span("serve_pad", category="serve", requests=len(group)):
+        with tracer.span(
+            "serve_pad", category="serve", requests=len(group), **span_trace
+        ):
             t0 = time.perf_counter()
             starts, paths, ends, batch, width = engine.pad_requests(
                 [p.contexts for p in group]
@@ -256,17 +281,22 @@ class MicroBatcher:
             pad_ms = (time.perf_counter() - t0) * 1e3
         with tracer.span(
             "serve_device", category="serve",
-            batch=batch, width=width, requests=len(group),
+            batch=batch, width=width, requests=len(group), **span_trace,
         ):
             t0 = time.perf_counter()
-            logits, vectors, attention = engine.run(starts, paths, ends)
+            # thread-local scope, not a signature change: the engine's own
+            # device-call span picks the trace ids up without widening
+            # run() (duck-typed engines keep their 3-arg surface)
+            with trace_scope(**span_trace) if span_trace else nullcontext():
+                logits, vectors, attention = engine.run(starts, paths, ends)
             # the scatter below reads host values anyway; fencing here
             # attributes the wait to the device phase, not postprocess
             logits = np.asarray(logits)
             vectors = np.asarray(vectors)
             attention = np.asarray(attention)
             device_ms = (time.perf_counter() - t0) * 1e3
-        with tracer.span("serve_postprocess", category="serve"):
+        t_device_end = time.perf_counter()
+        with tracer.span("serve_postprocess", category="serve", **span_trace):
             for i, pending in enumerate(group):
                 n = int(pending.contexts.shape[0])
                 queue_wait_ms = (t_start - pending.enqueued) * 1e3
@@ -283,10 +313,30 @@ class MicroBatcher:
                         device_ms=round(device_ms, 3),
                     )
                 )
+                now = time.perf_counter()
+                e2e_ms = (now - pending.enqueued) * 1e3
                 self._health.latency("serve.queue_wait_ms").record(queue_wait_ms)
-                self._health.latency("serve.e2e_ms").record(
-                    (time.perf_counter() - pending.enqueued) * 1e3
-                )
+                self._health.latency("serve.e2e_ms").record(e2e_ms)
+                if self._flight is not None:
+                    # full span breakdown for the tail: the recorder
+                    # decides (threshold / p99) whether to keep it
+                    self._flight.observe(e2e_ms, {
+                        "kind": "serve",
+                        "trace_id": (
+                            pending.trace.trace_id if pending.trace else None
+                        ),
+                        "n_contexts": n,
+                        "queue_wait_ms": round(queue_wait_ms, 3),
+                        "pad_ms": round(pad_ms, 3),
+                        "device_ms": round(device_ms, 3),
+                        "postprocess_ms": round(
+                            (now - t_device_end) * 1e3, 3
+                        ),
+                        "batch": batch,
+                        "width": width,
+                        "coalesced": len(group),
+                        "queue_depth_at_admission": pending.depth,
+                    })
         self._health.latency("serve.pad_ms").record(pad_ms)
         self._health.latency("serve.device_ms").record(device_ms)
         self._batches.inc()

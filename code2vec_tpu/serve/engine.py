@@ -46,7 +46,7 @@ from code2vec_tpu.data.pipeline import (
     nearest_bucket_width,
 )
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
-from code2vec_tpu.obs.trace import get_tracer
+from code2vec_tpu.obs.trace import current_trace_scope, get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -375,9 +375,18 @@ class ServingEngine:
                 self._health.gauge("serve_executables").set(len(self._compiled))
                 compiled = self._compiled[key]
             self._forward_counter.inc()
-            logits, code_vector, attention = compiled(
-                self._state, starts, paths, ends
-            )
+            # the engine's own device-call span: tagged with the caller's
+            # trace scope (the batcher publishes the group's trace_ids
+            # there), so a stitched trace shows router -> worker ->
+            # batcher -> THIS executable call under one trace id
+            with get_tracer().span(
+                "engine_run", category="serve",
+                batch=key[0], width=key[1], version=self.version,
+                **current_trace_scope(),
+            ):
+                logits, code_vector, attention = compiled(
+                    self._state, starts, paths, ends
+                )
         return logits, code_vector, attention
 
     def pad_requests(

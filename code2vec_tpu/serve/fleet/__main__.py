@@ -65,6 +65,23 @@ def build_parser() -> argparse.ArgumentParser:
                         help="router event log (fleet manifest, spawn/"
                         "evict/swap events); replicas log under "
                         "<events_dir>/r<slot>")
+    parser.add_argument("--trace_dir", default=None,
+                        help="fleet-wide Chrome tracing: the router "
+                        "writes its trace here and each replica writes "
+                        "under <trace_dir>/r<slot>; every request is "
+                        "stamped with a trace id at admission and "
+                        "tools/trace_stitch.py merges the per-process "
+                        "files into one viewable trace")
+    parser.add_argument("--slo_objective", type=float, default=0.999,
+                        help="per-class availability objective for "
+                        "error-budget burn accounting (0.999 = 0.1%% "
+                        "error budget over the rolling window)")
+    parser.add_argument("--slo_window_s", type=float, default=60.0,
+                        help="rolling error-budget window length")
+    parser.add_argument("--flight_threshold_ms", type=float, default=0.0,
+                        help="capture a full per-request flight record "
+                        "for any request slower than this (0 = p99 "
+                        "sampling only)")
     # worker passthrough (same semantics as code2vec_tpu.serve)
     parser.add_argument("--table_dtype", default=None,
                         choices=("f32", "bf16", "int8"))
@@ -117,6 +134,11 @@ def worker_argv(args, slot: int) -> list[str]:
         argv += ["--accelerator"]
     if args.events_dir:
         argv += ["--events_dir", os.path.join(args.events_dir, f"r{slot}")]
+    if getattr(args, "trace_dir", None):
+        argv += ["--trace_dir", os.path.join(args.trace_dir, f"r{slot}")]
+    threshold = getattr(args, "flight_threshold_ms", 0.0)
+    if threshold:
+        argv += ["--flight_threshold_ms", str(threshold)]
     return argv
 
 
@@ -147,6 +169,13 @@ def build_router(args):
             slot, worker_argv(args, slot), incarnation=incarnation,
         )
 
+    from code2vec_tpu.obs.runtime import FlightRecorder, global_health
+
+    threshold = getattr(args, "flight_threshold_ms", 0.0)
+    flight = FlightRecorder(
+        threshold_ms=threshold if threshold > 0 else None,
+        events=events, health=global_health(),
+    )
     router = FleetRouter(
         factory,
         args.replicas,
@@ -157,6 +186,9 @@ def build_router(args):
         probe_timeout_s=args.probe_timeout_s,
         max_probe_failures=args.max_probe_failures,
         boot_timeout_s=args.boot_timeout_s,
+        slo_objective=getattr(args, "slo_objective", 0.999),
+        slo_window_s=getattr(args, "slo_window_s", 60.0),
+        flight=flight,
     )
     return router, events
 
@@ -168,6 +200,16 @@ def main(argv: list[str] | None = None) -> None:
         datefmt="%m/%d/%Y %I:%M:%S %p",
     )
     args = build_parser().parse_args(argv)
+
+    tracer = None
+    if args.trace_dir:
+        from code2vec_tpu.obs.trace import Tracer, set_tracer
+
+        # the router is jax-free: pin its trace pid/row explicitly instead
+        # of letting export probe a backend that was never initialized
+        tracer = Tracer(process_index=0, process_name="fleet-router")
+        set_tracer(tracer)
+
     router, events = build_router(args)
     logger.info("fleet of %d replica(s) is ready", args.replicas)
 
@@ -178,6 +220,19 @@ def main(argv: list[str] | None = None) -> None:
     try:
         run_transport(router, args.transport, args.host, args.port)
     finally:
+        if tracer is not None:
+            from code2vec_tpu.obs.trace import set_tracer
+
+            set_tracer(None)
+            try:
+                tracer.export_dir(args.trace_dir)
+            except Exception:
+                logger.warning("could not write chrome trace", exc_info=True)
+        if args.events_dir and router._flight is not None:
+            try:
+                router._flight.dump(os.path.join(args.events_dir, "flight"))
+            except Exception:
+                logger.warning("could not dump flight records", exc_info=True)
         if events is not None:
             try:
                 events.close()

@@ -22,6 +22,14 @@ tests pin). What this module owns:
   process gets SIGTERM (the worker's drain handler — satellite fix of
   this PR) and only then SIGKILL.
 
+Trace contexts need no handling here: the router stamps the ``"trace"``
+field into the request dict at admission and this client forwards the
+dict verbatim over the pipe — the worker's resolver picks the id up on
+the far side. ``last_health`` (refreshed by every probe) doubles as the
+router's lock-light ``/metrics`` source for this replica;
+``last_health_unix`` records when it was captured so scrapers can judge
+staleness.
+
 Per-replica metrics live under the ``fleet.r<slot>.`` namespace of the
 shared obs registry (``RuntimeHealth.namespaced``): ``dispatched`` /
 ``responses`` / ``in_flight`` / ``deaths`` — one schema for the router's
@@ -76,6 +84,7 @@ class ReplicaHandle:
         # prober bookkeeping (owned by the router's probe thread)
         self.probe_failures = 0
         self.last_health: dict | None = None
+        self.last_health_unix: float | None = None
         self.started_unix = time.time()
         self._dispatched = self._health.counter("dispatched")
         self._responses = self._health.counter("responses")
@@ -150,6 +159,7 @@ class ReplicaHandle:
         is compiled and it is accepting traffic)."""
         payload = self.send({"op": "health"}).result(timeout)
         self.last_health = payload
+        self.last_health_unix = time.time()
         return payload
 
     # ---- reader ---------------------------------------------------------

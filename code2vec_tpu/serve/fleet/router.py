@@ -48,11 +48,17 @@ import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
-from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.runtime import (
+    FlightRecorder,
+    RuntimeHealth,
+    global_health,
+)
+from code2vec_tpu.obs.trace import ensure_trace, get_tracer
 from code2vec_tpu.serve.fleet.replica import ReplicaDied
 from code2vec_tpu.serve.fleet.slo import (
     DEFAULT_SLO,
     PRIORITY,
+    SloBurnTracker,
     SloClass,
     classify_op,
 )
@@ -61,14 +67,36 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["FleetRouter"]
 
+# outcome kinds that burn SLO error budget: the fleet failed the client
+# (shed, expired, unavailable, or a server-side error — wherever it arose);
+# a bad_request is the client's mistake and burns nothing. Distinct from
+# per-op error counting: the router only counts errors IT minted (the
+# _Queued.router_error flag) — a worker-relayed error already counted in
+# that replica's own registry, and counting it again here would make the
+# aggregated /metrics series double-count
+_BUDGET_BURNING_KINDS = frozenset(
+    ("overloaded", "deadline", "unavailable", "closed", "internal",
+     "swap_failed")
+)
+
 
 @dataclass
 class _Queued:
     request: dict
     future: Future
     cls: str
+    op: str | None = None
+    trace_id: str | None = None
     enqueued: float = field(default_factory=time.perf_counter)
+    depth: int = 0  # class-queue depth observed at admission
+    dispatched: float | None = None
+    slot: int | None = None
     attempts: int = 0
+    # True when the ROUTER resolved this item with an error it minted
+    # (deadline shed, unavailable, drain) — the per-op error counter
+    # counts exactly these; worker-relayed errors are already counted in
+    # the replica's own registry
+    router_error: bool = False
 
     @property
     def age_ms(self) -> float:
@@ -101,6 +129,9 @@ class FleetRouter:
         boot_timeout_s: float = 900.0,
         swap_timeout_s: float = 1800.0,
         retry_limit: int = 2,
+        slo_objective: float = 0.999,
+        slo_window_s: float = 60.0,
+        flight: FlightRecorder | None = None,
     ) -> None:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -143,6 +174,19 @@ class FleetRouter:
         self._respawns = self.health.counter("fleet.respawns")
         self._retried = self.health.counter("fleet.retries")
         self.health.gauge("fleet.replicas").set(int(n_replicas))
+
+        # SLO error-budget burn accounting: every finished data request
+        # records good/bad into its class's rolling window (slo.py) —
+        # burn-rate gauges + the slo_budget_exhausted event ride the same
+        # registry/event log as everything else
+        self._burn = SloBurnTracker(
+            [name for name in self._slo if name != "health"],
+            objective=slo_objective, window_s=slo_window_s,
+            health=self.health, events=events,
+        )
+        # slow-request flight recorder: a shed or tail-latency request
+        # leaves a concrete per-request timeline, not just a histogram
+        self._flight = flight
 
         # ---- boot the fleet (parallel: each worker compiles its ladder)
         self._slots: list = [None] * int(n_replicas)
@@ -260,13 +304,26 @@ class FleetRouter:
             payload = self._fleet_swap_status()
             return lambda: finish(payload)
 
-        # data plane: admit into the class queue (budget = admission bound)
-        item = _Queued(request=request, future=Future(), cls=cls_name)
+        # data plane: stamp (or honor) the request's trace context FIRST —
+        # the same dict crosses the replica pipe, so the worker's spans
+        # inherit the id with no extra wiring — then admit into the class
+        # queue (budget = admission bound)
+        trace = ensure_trace(request)
+        self.health.counter(f"serve.op.{op}.requests").inc()
+        item = _Queued(
+            request=request, future=Future(), cls=cls_name, op=op,
+            trace_id=trace.trace_id,
+            depth=self._queues[cls_name].qsize(),
+        )
         self.health.counter(f"slo.{cls_name}.submitted").inc()
         try:
             self._queues[cls_name].put_nowait(item)
         except queue.Full:
             self.health.counter(f"slo.{cls_name}.shed_budget").inc()
+            # the shed never reaches a worker's resolver: count it into
+            # the per-op error counter HERE or 429s stay invisible per op
+            self.health.counter(f"serve.op.{op}.errors").inc()
+            self._burn.record(cls_name, good=False)
             slo = self._slo[cls_name]
             payload = {
                 "error": (
@@ -276,12 +333,68 @@ class FleetRouter:
                 "error_kind": "overloaded",
                 "slo_class": cls_name,
             }
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.span_complete(
+                    "fleet_request", category="fleet",
+                    start_s=item.enqueued, end_s=time.perf_counter(),
+                    trace_id=trace.trace_id, op=op, slo_class=cls_name,
+                    outcome="overloaded",
+                )
             return lambda: finish(payload)
+        item.future.add_done_callback(
+            lambda fut, item=item: self._finalize(item, fut)
+        )
         self.health.gauge(f"slo.{cls_name}.queued").set(
             self._queues[cls_name].qsize()
         )
         self._wake.set()
         return lambda: finish(item.future.result())
+
+    def _finalize(self, item: _Queued, fut: Future) -> None:
+        """One exit point for every admitted data request (served, shed on
+        deadline, failed, drained): per-request router span tagged with
+        the trace id, SLO burn accounting, per-op error visibility, and
+        the flight-recorder breakdown. O(1) dict work per request."""
+        payload = fut.result()  # router futures always resolve to a dict
+        kind = payload.get("error_kind") if isinstance(payload, dict) else None
+        now = time.perf_counter()
+        if item.router_error:
+            # ROUTER-minted outcomes never reached a worker resolver —
+            # without this the per-op error counters undercount sheds.
+            # Worker-relayed errors are deliberately NOT counted here:
+            # the replica already counted them in its own registry, and
+            # the /metrics aggregation would otherwise show them twice
+            self.health.counter(f"serve.op.{item.op}.errors").inc()
+        self._burn.record(item.cls, good=kind not in _BUDGET_BURNING_KINDS)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.span_complete(
+                "fleet_request", category="fleet",
+                start_s=item.enqueued, end_s=now,
+                trace_id=item.trace_id, op=item.op, slo_class=item.cls,
+                outcome=kind or "ok", slot=item.slot,
+            )
+        if self._flight is not None:
+            dispatch_wait_ms = (
+                (item.dispatched - item.enqueued) * 1e3
+                if item.dispatched is not None
+                else None
+            )
+            self._flight.observe((now - item.enqueued) * 1e3, {
+                "kind": "router",
+                "trace_id": item.trace_id,
+                "op": item.op,
+                "slo_class": item.cls,
+                "outcome": kind or "ok",
+                "queue_depth_at_admission": item.depth,
+                "dispatch_wait_ms": (
+                    round(dispatch_wait_ms, 3)
+                    if dispatch_wait_ms is not None else None
+                ),
+                "replica_slot": item.slot,
+                "attempts": item.attempts,
+            })
 
     # ---- dispatch -------------------------------------------------------
     def _pick_replica(self):
@@ -303,6 +416,7 @@ class FleetRouter:
     def _shed_deadline(self, item: _Queued) -> None:
         self.health.counter(f"slo.{item.cls}.shed_deadline").inc()
         slo = self._slo[item.cls]
+        item.router_error = True
         item.future.set_result({
             "error": (
                 f"{item.cls} deadline ({slo.deadline_ms:.0f} ms) exceeded "
@@ -316,6 +430,7 @@ class FleetRouter:
         self, item: _Queued, reason: str, kind: str = "unavailable"
     ) -> None:
         self.health.counter(f"slo.{item.cls}.failed").inc()
+        item.router_error = True
         if not item.future.done():
             item.future.set_result({
                 "error": reason,
@@ -396,6 +511,8 @@ class FleetRouter:
             # no work reached a worker — not a retry attempt; the deadline
             # bounds how long the item can keep looking for a replica
             return False
+        item.dispatched = time.perf_counter()
+        item.slot = getattr(replica, "slot", None)
         inner.add_done_callback(
             lambda fut, item=item, replica=replica: self._on_reply(
                 item, replica, fut
@@ -473,6 +590,7 @@ class FleetRouter:
                 self._probe_timeout_s
             )
             handle.last_health = payload
+            handle.last_health_unix = time.time()
             handle.probe_failures = 0
         except Exception as exc:  # noqa: BLE001 - timeout or death
             handle.probe_failures += 1
@@ -524,6 +642,9 @@ class FleetRouter:
                 "alive": handle.alive,
                 "in_flight": handle.in_flight,
                 "probe_failures": handle.probe_failures,
+                "last_health_unix": getattr(
+                    handle, "last_health_unix", None
+                ),
                 "version": last.get("version"),
                 "post_warmup_compiles": last.get("post_warmup_compiles"),
                 "executables": last.get("executables"),
@@ -540,10 +661,55 @@ class FleetRouter:
                     }
                     for name, cls in self._slo.items()
                 },
+                # rolling error-budget state per class: burn rate, window
+                # good/bad, exhaustion — the numbers /metrics exports as
+                # slo.<class>.burn_rate / budget_remaining gauges
+                "slo_burn": self._burn.snapshot(),
                 "rolling": self._rolling_status(),
+                "flight_recorded": (
+                    self._flight.count if self._flight is not None else None
+                ),
             },
             **self.health.snapshot(),
         }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition for ``GET /metrics`` on the router:
+        the router's own registry unlabeled, plus each replica's last
+        health snapshot under a ``replica="r<slot>"`` label. Lock-light by
+        construction — replica blocks come from the prober's cached
+        ``last_health`` payloads (already plain dicts), so a scrape never
+        crosses the pipe, takes a replica lock, or touches device state.
+        Each replica block carries its own ``started_unix`` /
+        ``snapshot_seq``, so scrapers can detect counter resets across
+        respawns."""
+        from code2vec_tpu.obs.runtime import prometheus_text
+
+        sources = [({}, self.health.snapshot())]
+        for slot, handle in enumerate(self._slots):
+            if handle is None:
+                continue
+            last = handle.last_health
+            if not isinstance(last, dict) or "counters" not in last:
+                continue
+            snap = {
+                key: last[key]
+                for key in (
+                    "started_unix", "snapshot_seq", "counters",
+                    "gauges", "latencies_ms",
+                )
+                if key in last
+            }
+            captured_unix = getattr(handle, "last_health_unix", None)
+            if captured_unix is not None:
+                # when this replica's block was captured — the scrape's
+                # staleness signal (probe-refreshed, not scrape-time)
+                snap["gauges"] = {
+                    **(snap.get("gauges") or {}),
+                    "replica_last_health_unix": captured_unix,
+                }
+            sources.append(({"replica": f"r{slot}"}, snap))
+        return prometheus_text(sources)
 
     def _rolling_status(self) -> dict:
         with self._swap_lock:

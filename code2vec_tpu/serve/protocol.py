@@ -59,6 +59,7 @@ from typing import Callable
 
 import numpy as np
 
+from code2vec_tpu.obs.trace import TraceContext, get_tracer, new_trace_id
 from code2vec_tpu.serve.swap import Generation, SwapController
 
 logger = logging.getLogger(__name__)
@@ -109,10 +110,15 @@ class CodeServer:
     def __init__(
         self, predictor, engine, batcher, retrieval=None, health=None,
         *, version: str = "v0", factory=None, golden=None, events=None,
+        flight=None,
     ) -> None:
         from code2vec_tpu.obs.runtime import global_health
 
         self.health = health or global_health()
+        # slow-request flight recorder (obs.runtime.FlightRecorder): the
+        # batcher feeds it per-request breakdowns; kept on the server so
+        # the health payload and the CLI's exit-time dump can reach it
+        self.flight = flight
         self.swap = SwapController(
             Generation(
                 version=version, predictor=predictor, engine=engine,
@@ -197,6 +203,13 @@ class CodeServer:
             return payload
 
         op = request.get("op")
+        # install the request's trace context: honor the one the router
+        # (or a client) stamped into the "trace" field; mint one locally
+        # only when a real tracer is recording — the untraced hot path
+        # stays allocation-free
+        trace = TraceContext.from_request(request)
+        if trace is None and get_tracer().enabled and op in INSTRUMENTED_OPS:
+            trace = TraceContext(trace_id=new_trace_id())
         try:
             # data requests snapshot the generation HERE: a swap that
             # commits between submission and resolve must not reroute an
@@ -213,11 +226,11 @@ class CodeServer:
                 payload = {"ok": True, "shutting_down": True}
                 resolver = lambda: payload  # noqa: E731
             elif op in ("predict", "embed"):
-                resolver = self._submit_methods(request, op, gen)
+                resolver = self._submit_methods(request, op, gen, trace)
             elif op == "embed_file":
-                resolver = self._submit_file(request, gen)
+                resolver = self._submit_file(request, gen, trace)
             elif op == "neighbors":
-                resolver = self._submit_neighbors(request, gen)
+                resolver = self._submit_neighbors(request, gen, trace)
             elif op == "reload":
                 status = self.swap.reload(
                     request.get("model_path"),
@@ -239,18 +252,31 @@ class CodeServer:
         except Exception as exc:  # noqa: BLE001 - protocol boundary
             payload = self._error_payload(exc)
             resolver = lambda: payload  # noqa: E731
-        return self._instrument(op, resolver, finish)
+        return self._instrument(op, resolver, finish, trace)
 
     def _instrument(
-        self, op, resolver: Callable[[], dict], finish: Callable[[dict], dict]
+        self, op, resolver: Callable[[], dict],
+        finish: Callable[[dict], dict], trace: TraceContext | None = None,
     ) -> Callable[[], dict]:
         """Per-op obs metrics around the resolver: one latency histogram +
         request/error counters per SLO-relevant op, on the same registry
-        as the batcher's phase histograms (ONE metric schema)."""
+        as the batcher's phase histograms (ONE metric schema). With a
+        trace context, the whole submit->resolve interval is also recorded
+        as a ``serve_request`` span tagged with the trace id — the
+        worker-side anchor of the cross-process request trace."""
         if op not in INSTRUMENTED_OPS:
             return lambda: finish(resolver())
         t0 = time.perf_counter()
         self.health.counter(f"serve.op.{op}.requests").inc()
+
+        def span_done(error: bool) -> None:
+            tracer = get_tracer()
+            if trace is not None and tracer.enabled:
+                tracer.span_complete(
+                    "serve_request", category="serve",
+                    start_s=t0, end_s=time.perf_counter(),
+                    trace_id=trace.trace_id, op=op, error=error,
+                )
 
         def run() -> dict:
             try:
@@ -264,12 +290,14 @@ class CodeServer:
                     (time.perf_counter() - t0) * 1e3
                 )
                 self.health.counter(f"serve.op.{op}.errors").inc()
+                span_done(error=True)
                 raise
             self.health.latency(f"serve.op.{op}.e2e_ms").record(
                 (time.perf_counter() - t0) * 1e3
             )
             if "error" in payload:
                 self.health.counter(f"serve.op.{op}.errors").inc()
+            span_done(error="error" in payload)
             return finish(payload)
 
         return run
@@ -319,6 +347,15 @@ class CodeServer:
         "internal": 500,
     }
 
+    # ---- metrics --------------------------------------------------------
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (0.0.4) of the health registry —
+        what ``GET /metrics`` serves. A lock-light snapshot serialize:
+        never touches the engine, the batcher queue, or device state."""
+        from code2vec_tpu.obs.runtime import prometheus_text
+
+        return prometheus_text([({}, self.health.snapshot())])
+
     # ---- ops ------------------------------------------------------------
     def _health_payload(self) -> dict:
         gen = self.swap.active
@@ -340,11 +377,17 @@ class CodeServer:
                 else None
             ),
             "swap": self.swap.status(),
+            # slow-request flight recorder: how many tail requests have a
+            # captured per-request timeline (None = recorder not wired)
+            "flight_recorded": (
+                self.flight.count if self.flight is not None else None
+            ),
             **self.health.snapshot(),
         }
 
     def _submit_methods(
-        self, request: dict, op: str, gen: Generation
+        self, request: dict, op: str, gen: Generation,
+        trace: TraceContext | None = None,
     ) -> Callable[[], dict]:
         predictor, engine, batcher = gen.predictor, gen.engine, gen.batcher
         source = request.get("source")
@@ -382,7 +425,14 @@ class CodeServer:
                 submitted.append((label, n_oov, None, 0))
                 continue
             arr = np.asarray(mapped, np.int32).reshape(-1, 3)
-            submitted.append((label, n_oov, batcher.submit(arr), len(mapped)))
+            # the trace kwarg only when a context exists: untraced paths
+            # keep the 1-arg submit surface duck-typed batchers rely on
+            future = (
+                batcher.submit(arr, trace=trace)
+                if trace is not None
+                else batcher.submit(arr)
+            )
+            submitted.append((label, n_oov, future, len(mapped)))
 
         label_vocab = predictor.label_vocab
 
@@ -422,7 +472,8 @@ class CodeServer:
         return resolve
 
     def _submit_file(
-        self, request: dict, gen: Generation
+        self, request: dict, gen: Generation,
+        trace: TraceContext | None = None,
     ) -> Callable[[], dict]:
         """The hierarchical two-level head online: embed every method of
         the source through the micro-batcher, then attention-pool the
@@ -431,7 +482,7 @@ class CodeServer:
         embedding with the same per-method device path as ``embed``."""
         predictor = gen.predictor
         embed_resolver = self._submit_methods(
-            {**request, "include_vector": True}, "embed", gen
+            {**request, "include_vector": True}, "embed", gen, trace
         )
 
         def resolve() -> dict:
@@ -467,7 +518,8 @@ class CodeServer:
         return resolve
 
     def _submit_neighbors(
-        self, request: dict, gen: Generation
+        self, request: dict, gen: Generation,
+        trace: TraceContext | None = None,
     ) -> Callable[[], dict]:
         retrieval = gen.retrieval
         if retrieval is None:
@@ -475,6 +527,16 @@ class CodeServer:
                 "no retrieval index loaded — start the server with "
                 "--code_vec_path (an exported code.vec)"
             )
+        trace_args = {"trace_id": trace.trace_id} if trace else {}
+
+        def retrieve(vec: np.ndarray, k: int):
+            # retrieval spans carry the originating trace id too — the
+            # third worker-side hop of the cross-process request trace
+            with get_tracer().span(
+                "serve_retrieval", category="serve", top_k=k, **trace_args
+            ):
+                return retrieval.top_k(vec, k)
+
         top_k = int(request.get("top_k", 5))
         granularity = request.get("granularity", "method")
         if granularity not in ("method", "file"):
@@ -490,7 +552,7 @@ class CodeServer:
                     f"'vector' must have dim {retrieval.dim}, got "
                     f"{vec.shape}"
                 )
-            neighbors = retrieval.top_k(vec, top_k)
+            neighbors = retrieve(vec, top_k)
             payload = {
                 "ok": True,
                 "neighbors": [
@@ -505,7 +567,7 @@ class CodeServer:
         # (export.export_file_vectors) through the unchanged stack
         if granularity == "file":
             want_vector = bool(request.get("include_vector", False))
-            file_resolver = self._submit_file(request, gen)
+            file_resolver = self._submit_file(request, gen, trace)
 
             def resolve_file() -> dict:
                 payload = file_resolver()
@@ -517,7 +579,7 @@ class CodeServer:
                     "n_methods": payload["n_methods"],
                     "neighbors": [
                         {"name": n, "similarity": s}
-                        for n, s in retrieval.top_k(vec, top_k)
+                        for n, s in retrieve(vec, top_k)
                     ],
                 }
                 if want_vector:
@@ -531,7 +593,7 @@ class CodeServer:
         # the CLIENT also asked for the vector so their flag survives
         want_vector = bool(request.get("include_vector", False))
         embed_resolver = self._submit_methods(
-            {**request, "include_vector": True}, "embed", gen
+            {**request, "include_vector": True}, "embed", gen, trace
         )
 
         def resolve() -> dict:
@@ -541,7 +603,7 @@ class CodeServer:
                 if cv is not None:
                     entry["neighbors"] = [
                         {"name": n, "similarity": s}
-                        for n, s in retrieval.top_k(
+                        for n, s in retrieve(
                             np.asarray(cv, np.float32), top_k
                         )
                     ]
@@ -659,8 +721,27 @@ def make_http_server(server: CodeServer, host: str, port: int):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-            if self.path.rstrip("/") in ("", "/healthz".rstrip("/")):
+            path = self.path.rstrip("/")
+            if path in ("", "/healthz".rstrip("/")):
                 self._respond(200, server.handle({"op": "health"}))
+            elif path == "/metrics":
+                # Prometheus text exposition — the scrape plane. Served by
+                # both the single worker (its own registry) and the fleet
+                # router (aggregated across replicas with a `replica`
+                # label); either way a lock-light snapshot serialize.
+                metrics_text = getattr(server, "metrics_text", None)
+                if metrics_text is None:
+                    self._respond(404, {"error": "no metrics exporter"})
+                    return
+                body = metrics_text().encode("utf-8")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._respond(404, {"error": "unknown path"})
 

@@ -1,0 +1,901 @@
+"""Fleet-wide observability plane (ISSUE 15).
+
+The load-bearing contracts pinned here:
+
+- a :class:`TraceContext` stamped at router admission rides the request
+  dict across the stdio pipe UNCHANGED, and every hop tags its spans
+  with the id — so a real 2-replica subprocess fleet under a pipelined
+  burst yields per-process trace files that ``tools/trace_stitch.py``
+  merges into one valid Chrome trace in which at least one request has
+  router -> replica -> batcher -> engine spans sharing ONE trace id
+  (with the coalesce-aware ``trace_ids`` link on batched device spans);
+- ``GET /metrics`` serves Prometheus text exposition 0.0.4 that parses
+  and AGREES with the ``health`` op's counters, on the worker (its own
+  registry) and on the router (aggregated with a ``replica`` label from
+  the prober's lock-light ``last_health`` snapshots);
+- router-level sheds (``overloaded``/``deadline``) count into the
+  per-op ``serve.op.<op>.errors`` counters — 429s are visible per op,
+  not only per class (the PR's shed-visibility satellite);
+- ``RuntimeHealth.snapshot()`` carries ``started_unix`` + a monotonic
+  ``snapshot_seq`` so two scrapes can compute honest rates and detect
+  counter resets across replica respawns;
+- SLO burn accounting: rolling error-budget windows per class, burn-rate
+  gauges, an edge-triggered ``slo_budget_exhausted`` event, recovery;
+- the slow-request flight recorder captures full span breakdowns at a
+  threshold or sampled at p99, bounded, dumped as ``flight_*.json``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from code2vec_tpu.obs.events import EventLog
+from code2vec_tpu.obs.runtime import (
+    FlightRecorder,
+    LatencyHistogram,
+    RuntimeHealth,
+    parse_prometheus_text,
+    prometheus_metric_name,
+    prometheus_text,
+)
+from code2vec_tpu.obs.trace import (
+    TraceContext,
+    Tracer,
+    current_trace_scope,
+    ensure_trace,
+    get_tracer,
+    set_tracer,
+    trace_scope,
+)
+from code2vec_tpu.serve.fleet.replica import ReplicaDied
+from code2vec_tpu.serve.fleet.router import FleetRouter
+from code2vec_tpu.serve.fleet.slo import (
+    DEFAULT_SLO,
+    SloBurnTracker,
+    SloClass,
+)
+
+pytestmark = pytest.mark.obsfleet
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, TOOLS)
+import trace_stitch  # noqa: E402  (tools/ is script-style, not a package)
+
+
+# ---------------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_stamp_and_honor():
+    request = {"op": "embed", "source": "x"}
+    ctx = ensure_trace(request)
+    assert request["trace"]["trace_id"] == ctx.trace_id
+    # a second admission (or a downstream process) HONORS the stamp
+    again = ensure_trace(request)
+    assert again.trace_id == ctx.trace_id
+    parsed = TraceContext.from_request(request)
+    assert parsed is not None and parsed.trace_id == ctx.trace_id
+    # client-supplied contexts pass through verbatim
+    client = {"op": "embed", "trace": {"trace_id": "abc123",
+                                       "parent_span_id": "dead"}}
+    honored = ensure_trace(client)
+    assert honored.trace_id == "abc123"
+    assert honored.parent_span_id == "dead"
+
+
+def test_trace_context_ignores_garbage():
+    for garbage in (
+        {"trace": "not-a-dict"},
+        {"trace": {"trace_id": 7}},
+        {"trace": {"trace_id": ""}},
+        {"trace": {}},
+        {},
+    ):
+        assert TraceContext.from_request(dict(garbage)) is None
+    # ensure_trace replaces garbage with a fresh stamp instead of dying
+    request = {"op": "embed", "trace": "zzz"}
+    ctx = ensure_trace(request)
+    assert request["trace"]["trace_id"] == ctx.trace_id
+
+
+def test_trace_scope_nests_and_restores():
+    assert current_trace_scope() == {}
+    with trace_scope(trace_ids=["a"]):
+        assert current_trace_scope() == {"trace_ids": ["a"]}
+        with trace_scope(extra=1):
+            assert current_trace_scope() == {"trace_ids": ["a"], "extra": 1}
+        assert current_trace_scope() == {"trace_ids": ["a"]}
+    assert current_trace_scope() == {}
+
+
+def test_trace_scope_is_thread_local():
+    seen = {}
+
+    def other():
+        seen["scope"] = current_trace_scope()
+
+    with trace_scope(trace_ids=["a"]):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["scope"] == {}
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_metric_name_sanitization():
+    assert prometheus_metric_name("serve.op.embed.e2e_ms") == (
+        "c2v_serve_op_embed_e2e_ms"
+    )
+    assert prometheus_metric_name("fleet.r0.in_flight") == (
+        "c2v_fleet_r0_in_flight"
+    )
+    assert prometheus_metric_name("weird-name with spaces") == (
+        "c2v_weird_name_with_spaces"
+    )
+
+
+def test_prometheus_text_round_trip_and_agreement():
+    health = RuntimeHealth()
+    health.counter("serve_requests").inc(42)
+    health.counter("serve.op.embed.errors").inc(3)
+    health.gauge("serve_queue_depth").set(5)
+    health.gauge("serve_transport").set("stdio")  # non-numeric: skipped
+    for v in (1.0, 2.0, 3.0, 100.0):
+        health.latency("serve.e2e_ms").record(v)
+    snap = health.snapshot()
+    text = prometheus_text([({}, snap)])
+    assert text.startswith("# TYPE")
+    parsed = parse_prometheus_text(text)
+    types = parsed["# types"]
+    # agreement with the health snapshot, series for series
+    assert parsed["c2v_serve_requests_total"][0]["value"] == 42
+    assert types["c2v_serve_requests_total"] == "counter"
+    assert parsed["c2v_serve_op_embed_errors_total"][0]["value"] == 3
+    assert parsed["c2v_serve_queue_depth"][0]["value"] == 5
+    assert "c2v_serve_transport" not in parsed
+    assert types["c2v_serve_e2e_ms"] == "summary"
+    quantiles = {
+        row["labels"]["quantile"]: row["value"]
+        for row in parsed["c2v_serve_e2e_ms"]
+    }
+    assert quantiles["0.5"] == snap["latencies_ms"]["serve.e2e_ms"]["p50_ms"]
+    assert parsed["c2v_serve_e2e_ms_sum"][0]["value"] == 106.0
+    assert parsed["c2v_serve_e2e_ms_count"][0]["value"] == 4
+    assert parsed["c2v_process_start_time_seconds"][0]["value"] == pytest.approx(
+        snap["started_unix"]
+    )
+
+
+def test_prometheus_labels_and_merged_type_headers():
+    snap_a = {"counters": {"x": 1}}
+    snap_b = {"counters": {"x": 2}}
+    text = prometheus_text([
+        ({}, snap_a), ({"replica": "r0"}, snap_b),
+    ])
+    # ONE TYPE header for the metric, both series under it
+    assert text.count("# TYPE c2v_x_total counter") == 1
+    parsed = parse_prometheus_text(text)
+    by_labels = {
+        tuple(sorted(row["labels"].items())): row["value"]
+        for row in parsed["c2v_x_total"]
+    }
+    assert by_labels[()] == 1
+    assert by_labels[(("replica", "r0"),)] == 2
+
+
+def test_parse_prometheus_rejects_garbage():
+    with pytest.raises(ValueError, match="bad exposition line"):
+        parse_prometheus_text("this is { not exposition")
+
+
+def test_prometheus_label_escaping_round_trips():
+    hostile = 'node"1\\with\nnewline'
+    text = prometheus_text([({"replica": hostile}, {"counters": {"x": 1}})])
+    # the newline is escaped, not emitted: TYPE header + ONE sample line
+    assert len(text.splitlines()) == 2
+    parsed = parse_prometheus_text(text)
+    assert parsed["c2v_x_total"][0]["labels"]["replica"] == hostile
+
+
+def test_snapshot_start_time_and_sequence_detect_resets():
+    health = RuntimeHealth()
+    first = health.snapshot()
+    second = health.snapshot()
+    assert second["started_unix"] == first["started_unix"]
+    assert second["snapshot_seq"] == first["snapshot_seq"] + 1
+    # a "respawned" process = fresh registry: the reset is detectable
+    respawned = RuntimeHealth().snapshot()
+    assert respawned["snapshot_seq"] < second["snapshot_seq"] or (
+        respawned["started_unix"] >= first["started_unix"]
+    )
+
+
+def test_latency_histogram_tracks_all_time_sum():
+    hist = LatencyHistogram(max_samples=2)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        hist.record(v)  # window holds 2, sum holds all 4
+    summary = hist.summary()
+    assert summary["sum_ms"] == 10.0
+    assert summary["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_threshold_capture_and_bounds(tmp_path):
+    events = EventLog()
+    seen = []
+    events.subscribe(lambda e: seen.append(e))
+    health = RuntimeHealth()
+    flight = FlightRecorder(
+        capacity=3, threshold_ms=10.0, events=events, health=health
+    )
+    assert not flight.observe(5.0, {"trace_id": "fast"})
+    for i in range(5):
+        assert flight.observe(10.0 + i, {"trace_id": f"slow{i}"})
+    assert flight.count == 5
+    assert health.snapshot()["counters"]["flight.recorded"] == 5
+    records = flight.snapshot()
+    assert len(records) == 3  # bounded: oldest evicted
+    assert [r["trace_id"] for r in records] == ["slow2", "slow3", "slow4"]
+    assert all(r["e2e_ms"] >= 10.0 for r in records)
+    # every capture is also a `flight` event
+    flights = [e for e in seen if e["event"] == "flight"]
+    assert len(flights) == 5 and flights[0]["trace_id"] == "slow0"
+    # and dumps as flight_<seq>.json files
+    paths = flight.dump(str(tmp_path / "flight"))
+    assert len(paths) == 3
+    assert all(os.path.basename(p).startswith("flight_") for p in paths)
+    reloaded = json.loads(open(paths[0]).read())
+    assert reloaded["trace_id"] == "slow2"
+
+
+def test_flight_recorder_p99_sampling_captures_the_tail():
+    flight = FlightRecorder(capacity=256)
+    captured = 0
+    # 900 jittered-fast requests with a 60x outlier every 100th: past the
+    # warmup floor the outliers always clear the rolling p99 estimate,
+    # while the bulk of the stream stays uncaptured (~1% sampling)
+    for i in range(900):
+        jitter = ((i * 2654435761) % 4093) / 4093.0
+        e2e = 100.0 if i % 100 == 99 else 1.0 + jitter * 0.5
+        captured += bool(flight.observe(e2e, {"e2e_in": e2e}))
+    assert flight.seen == 900
+    outliers = [r for r in flight.snapshot() if r["e2e_ms"] == 100.0]
+    assert len(outliers) >= 5  # the tail past warmup
+    assert captured <= 90  # and NOT the bulk of the stream
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+# ---------------------------------------------------------------------------
+
+
+def test_burn_tracker_math_gauges_and_exhaustion_event():
+    events = EventLog()
+    seen = []
+    events.subscribe(lambda e: seen.append(e))
+    health = RuntimeHealth()
+    clock = [1000.0]
+    tracker = SloBurnTracker(
+        ["embed"], objective=0.9, window_s=10.0, min_requests=5,
+        health=health, events=events, clock=lambda: clock[0],
+    )
+    for _ in range(9):
+        tracker.record("embed", good=True)
+    tracker.record("embed", good=False)
+    snap = tracker.snapshot()["embed"]
+    # 1 bad of 10 at a 10% budget: burning at exactly 1.0
+    assert snap["burn_rate"] == pytest.approx(1.0)
+    assert snap["exhausted"] is True
+    gauges = health.snapshot()["gauges"]
+    assert gauges["slo.embed.burn_rate"] == pytest.approx(1.0)
+    assert gauges["slo.embed.budget_exhausted"] == 1
+    exhausted = [e for e in seen if e["event"] == "slo_budget_exhausted"]
+    assert len(exhausted) == 1  # edge-triggered, once per episode
+    assert exhausted[0]["slo_class"] == "embed"
+    # more bad traffic does NOT re-fire while still exhausted
+    tracker.record("embed", good=False)
+    assert len(
+        [e for e in seen if e["event"] == "slo_budget_exhausted"]
+    ) == 1
+    # recovery: the window rolls past the bad requests
+    clock[0] += 100.0
+    for _ in range(20):
+        tracker.record("embed", good=True)
+    snap = tracker.snapshot()["embed"]
+    assert snap["exhausted"] is False and snap["burn_rate"] == 0.0
+    assert health.snapshot()["gauges"]["slo.embed.budget_exhausted"] == 0
+    # ... and a NEW episode fires a NEW event
+    for _ in range(20):
+        tracker.record("embed", good=False)
+    assert len(
+        [e for e in seen if e["event"] == "slo_budget_exhausted"]
+    ) == 2
+
+
+def test_burn_tracker_min_requests_floor():
+    tracker = SloBurnTracker(
+        ["embed"], objective=0.999, window_s=10.0, min_requests=10,
+        clock=lambda: 0.0,
+    )
+    tracker.record("embed", good=False)  # 100% error rate, 1 request
+    assert tracker.snapshot()["embed"]["exhausted"] is False
+
+
+def test_burn_tracker_rejects_bad_config():
+    with pytest.raises(ValueError, match="objective"):
+        SloBurnTracker(["embed"], objective=1.5)
+    with pytest.raises(ValueError, match="window_s"):
+        SloBurnTracker(["embed"], window_s=0.1)
+    with pytest.raises(ValueError, match="at least one"):
+        SloBurnTracker([])
+
+
+# ---------------------------------------------------------------------------
+# router: trace stamping, per-op shed counters, /metrics aggregation
+# (in-process fake replicas — no jax, no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+class MiniReplica:
+    """Round-trips request dicts through JSON (like the real pipe) and
+    answers ok after ``latency_s`` on a worker thread."""
+
+    def __init__(self, slot, incarnation=0, latency_s=0.0):
+        self.slot = slot
+        self.incarnation = incarnation
+        self.latency_s = latency_s
+        self.sent: list[dict] = []
+        self.probe_failures = 0
+        self.last_health: dict | None = None
+        self.death_reason = None
+        self.pid = 50000 + slot
+        self._alive = True
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    @property
+    def alive(self):
+        return self._alive
+
+    @property
+    def in_flight(self):
+        return self._inflight
+
+    def send(self, request):
+        if not self._alive:
+            raise ReplicaDied(f"mini r{self.slot} dead")
+        self.sent.append(json.loads(json.dumps(request)))  # wire copy
+        future: Future = Future()
+        with self._lock:
+            self._inflight += 1
+
+        def run():
+            if self.latency_s:
+                time.sleep(self.latency_s)
+            with self._lock:
+                self._inflight -= 1
+            future.set_result(
+                {"ok": True, "op": request.get("op"), "slot": self.slot}
+            )
+
+        threading.Thread(target=run, daemon=True).start()
+        return future
+
+    def wait_ready(self, timeout):
+        return {"ok": True}
+
+    def stop(self, timeout=10.0):
+        self._alive = False
+
+    def kill(self, timeout=10.0):
+        self._alive = False
+
+
+def make_router(replicas, **kw):
+    kw.setdefault("health", RuntimeHealth())
+    kw.setdefault("probe_interval_s", 60.0)
+    return FleetRouter(
+        lambda slot, incarnation: replicas[slot], len(replicas), **kw
+    )
+
+
+def test_router_stamps_trace_at_admission_and_honors_client():
+    fakes = [MiniReplica(0)]
+    router = make_router(fakes)
+    try:
+        assert router.handle({"op": "embed", "source": "x"})["ok"]
+        stamped = fakes[0].sent[-1]
+        assert stamped["trace"]["trace_id"]  # router minted one
+        assert router.handle({
+            "op": "embed", "source": "x",
+            "trace": {"trace_id": "client-chose-this"},
+        })["ok"]
+        assert fakes[0].sent[-1]["trace"]["trace_id"] == "client-chose-this"
+    finally:
+        router.close()
+
+
+def test_router_budget_shed_counts_per_op_errors_and_burns():
+    """The shed-visibility satellite: router-level sheds never reach the
+    worker's resolver, so serve.op.<op>.errors must be counted AT the
+    router or 429s stay invisible per op."""
+    slo = {
+        "health": DEFAULT_SLO["health"],
+        "embed": SloClass("embed", budget=2, deadline_ms=10_000.0),
+        "neighbors": DEFAULT_SLO["neighbors"],
+    }
+    health = RuntimeHealth()
+    router = make_router(
+        [MiniReplica(0, latency_s=0.2)], slo=slo, health=health,
+        per_replica_inflight=1,
+    )
+    try:
+        resolvers = [
+            router.handle_async({"op": "embed", "source": "x"})
+            for _ in range(8)
+        ]
+        payloads = [r() for r in resolvers]
+        shed = [p for p in payloads if p.get("error_kind") == "overloaded"]
+        served = [p for p in payloads if p.get("ok")]
+        assert shed and served
+        counters = health.snapshot()["counters"]
+        # every admitted-or-shed request counted per op; every shed an
+        # error per op (NOT only under slo.embed.*)
+        assert counters["serve.op.embed.requests"] == 8
+        assert counters["serve.op.embed.errors"] >= len(shed)
+        assert counters["slo.embed.shed_budget"] == len(shed)
+        # and the shed traffic burned error budget
+        gauges = health.snapshot()["gauges"]
+        assert gauges["slo.embed.burn_rate"] > 0
+    finally:
+        router.close()
+
+
+def test_router_deadline_shed_counts_per_op_errors():
+    slo = {
+        "health": DEFAULT_SLO["health"],
+        "embed": SloClass("embed", budget=64, deadline_ms=80.0),
+        "neighbors": DEFAULT_SLO["neighbors"],
+    }
+    health = RuntimeHealth()
+    router = make_router(
+        [MiniReplica(0, latency_s=0.3)], slo=slo, health=health,
+        per_replica_inflight=1,
+    )
+    try:
+        payloads = [
+            r() for r in [
+                router.handle_async({"op": "embed", "source": "x"})
+                for _ in range(4)
+            ]
+        ]
+        kinds = [p.get("error_kind") for p in payloads]
+        assert "deadline" in kinds
+        counters = health.snapshot()["counters"]
+        assert counters["serve.op.embed.errors"] >= kinds.count("deadline")
+        assert counters["slo.embed.shed_deadline"] >= 1
+    finally:
+        router.close()
+
+
+def test_router_does_not_double_count_worker_relayed_errors():
+    """A worker-relayed error payload (e.g. the replica's own batcher
+    overloaded) was already counted in THAT replica's registry; the
+    router must not count it again into its per-op error series, or the
+    aggregated /metrics shows it twice. It still burns error budget."""
+
+    class OverloadedReplica(MiniReplica):
+        def send(self, request):
+            if request.get("op") == "embed":
+                self.sent.append(dict(request))
+                future: Future = Future()
+                future.set_result({
+                    "error": "serving queue is full",
+                    "error_kind": "overloaded",
+                })
+                return future
+            return super().send(request)
+
+    health = RuntimeHealth()
+    router = make_router([OverloadedReplica(0)], health=health)
+    try:
+        payload = router.handle({"op": "embed", "source": "x"})
+        assert payload["error_kind"] == "overloaded"
+        counters = health.snapshot()["counters"]
+        assert counters["serve.op.embed.requests"] == 1
+        # worker-origin error: NOT in the router's per-op error counter
+        assert counters.get("serve.op.embed.errors", 0) == 0
+        # but it DID burn budget (the fleet failed the client)
+        assert health.snapshot()["gauges"]["slo.embed.burn_rate"] > 0
+    finally:
+        router.close()
+
+
+def test_router_flight_recorder_captures_breakdowns():
+    health = RuntimeHealth()
+    flight = FlightRecorder(threshold_ms=0.001, health=health)
+    router = make_router([MiniReplica(0)], health=health, flight=flight)
+    try:
+        assert router.handle({"op": "embed", "source": "x"})["ok"]
+        deadline = time.time() + 5.0
+        while flight.count == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        records = flight.snapshot()
+        assert records, "router flight recorder captured nothing"
+        record = records[0]
+        assert record["kind"] == "router"
+        assert record["op"] == "embed" and record["slo_class"] == "embed"
+        assert record["trace_id"]
+        assert record["outcome"] == "ok"
+        assert record["replica_slot"] == 0
+        assert record["dispatch_wait_ms"] is not None
+        assert "queue_depth_at_admission" in record
+    finally:
+        router.close()
+
+
+def test_router_metrics_text_aggregates_with_replica_label():
+    fakes = [MiniReplica(0), MiniReplica(1)]
+    health = RuntimeHealth()
+    router = make_router(fakes, health=health)
+    try:
+        for _ in range(6):
+            assert router.handle({"op": "embed", "source": "x"})["ok"]
+        # the prober's snapshots are the replica-side scrape source
+        fakes[0].last_health = {
+            "started_unix": 111.0, "snapshot_seq": 4,
+            "counters": {"serve_requests": 4},
+            "gauges": {"serve_queue_depth": 0},
+            "latencies_ms": {
+                "serve.e2e_ms": {"count": 4, "p50_ms": 1.0, "p90_ms": 2.0,
+                                 "p99_ms": 3.0, "max_ms": 3.0,
+                                 "mean_ms": 1.5, "sum_ms": 6.0},
+            },
+        }
+        fakes[1].last_health = {
+            "started_unix": 222.0, "snapshot_seq": 9,
+            "counters": {"serve_requests": 2},
+        }
+        parsed = parse_prometheus_text(router.metrics_text())
+        requests = {
+            row["labels"].get("replica"): row["value"]
+            for row in parsed["c2v_serve_requests_total"]
+        }
+        assert requests == {"r0": 4, "r1": 2}
+        # router's own registry exports UNlabeled and agrees with health
+        own = {
+            row["labels"].get("replica"): row["value"]
+            for row in parsed["c2v_serve_op_embed_requests_total"]
+        }
+        assert own[None] == 6
+        assert own[None] == health.snapshot()["counters"][
+            "serve.op.embed.requests"
+        ]
+        # per-replica start times make counter resets detectable
+        starts = {
+            row["labels"].get("replica"): row["value"]
+            for row in parsed["c2v_process_start_time_seconds"]
+        }
+        assert starts["r0"] == 111.0 and starts["r1"] == 222.0
+        assert parsed["c2v_serve_e2e_ms_sum"][0]["labels"] == {
+            "replica": "r0"
+        }
+        # the burn gauges ride the same exposition
+        assert "c2v_slo_embed_burn_rate" in parsed
+        # and the health op carries the matching burn block
+        payload = router.handle({"op": "health"})
+        assert payload["fleet"]["slo_burn"]["embed"]["good"] >= 6
+    finally:
+        router.close()
+
+
+def test_http_get_metrics_route():
+    """GET /metrics on the HTTP transport: text/plain; version=0.0.4 that
+    parses as exposition (stub server — the transport route itself)."""
+    import urllib.request
+
+    from code2vec_tpu.serve.protocol import make_http_server
+
+    health = RuntimeHealth()
+    health.counter("serve_requests").inc(3)
+
+    class StubServer:
+        shutdown_requested = False
+
+        def handle(self, request):
+            return {"ok": True}
+
+        def metrics_text(self):
+            return prometheus_text([({}, health.snapshot())])
+
+    httpd = make_http_server(StubServer(), "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            content_type = resp.headers["Content-Type"]
+            body = resp.read().decode("utf-8")
+        assert content_type.startswith("text/plain")
+        assert "version=0.0.4" in content_type
+        parsed = parse_prometheus_text(body)
+        assert parsed["c2v_serve_requests_total"][0]["value"] == 3
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(10)
+
+
+# ---------------------------------------------------------------------------
+# trace stitching (unit: two real tracers, synthetic span chain)
+# ---------------------------------------------------------------------------
+
+
+def test_stitch_traces_remaps_pids_and_indexes_trace_ids(tmp_path):
+    router_tracer = Tracer(process_index=0, process_name="fleet-router")
+    worker_tracer = Tracer(process_index=0, process_name="serve-worker-1")
+    with router_tracer.span("fleet_request", category="fleet",
+                            trace_id="t1", op="embed"):
+        time.sleep(0.001)
+    with worker_tracer.span("serve_request", category="serve",
+                            trace_id="t1", op="embed"):
+        with worker_tracer.span("serve_device", category="serve",
+                                trace_ids=["t1", "t2"]):
+            time.sleep(0.001)
+    (tmp_path / "r0").mkdir()
+    router_tracer.export(str(tmp_path / "trace-p0.json"))
+    worker_tracer.export(str(tmp_path / "r0" / "trace-p0.json"))
+
+    paths = trace_stitch.find_trace_files([str(tmp_path)])
+    assert len(paths) == 2
+    merged = trace_stitch.stitch_traces(paths)
+    # both source processes got DISTINCT pids despite both exporting as 0
+    pids = {
+        e["pid"] for e in merged["traceEvents"] if e.get("ph") != "M"
+    }
+    assert len(pids) == 2
+    names = {
+        (e.get("args") or {}).get("name")
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "fleet-router" in names
+    assert "r0: serve-worker-1" in names
+    index = trace_stitch.trace_index(merged)
+    t1 = index["t1"]
+    assert len(t1["processes"]) == 2  # the cross-process chain
+    span_names = {s["name"] for s in t1["spans"]}
+    assert span_names == {"fleet_request", "serve_request", "serve_device"}
+    # the coalesce-aware link: t2 only rode the batched device span
+    t2 = index["t2"]
+    assert [s["name"] for s in t2["spans"]] == ["serve_device"]
+    assert t2["spans"][0]["coalesced"] is True
+
+
+def test_trace_stitch_cli(tmp_path):
+    tracer = Tracer(process_index=0, process_name="solo")
+    with tracer.span("serve_request", trace_id="cli-t"):
+        pass
+    tracer.export(str(tmp_path / "trace-p0.json"))
+    out = tmp_path / "merged.json"
+    index_out = tmp_path / "index.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "trace_stitch.py"),
+         "--out", str(out), "--index-out", str(index_out), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["files"] == 1 and summary["traces"] == 1
+    merged = json.loads(out.read_text())
+    assert any(
+        e.get("name") == "serve_request" for e in merged["traceEvents"]
+    )
+    index = json.loads(index_out.read_text())
+    assert "cli-t" in index
+
+
+# ---------------------------------------------------------------------------
+# the real thing: 2-replica subprocess fleet under a pipelined burst ->
+# stitched trace with a complete router->replica->batcher->engine chain,
+# /metrics agreement, burn accounting, flight dumps
+# ---------------------------------------------------------------------------
+
+PY = """
+def add(a, b):
+    total = a + b
+    return total
+
+
+def mul(a, b):
+    product = a * b
+    return product
+"""
+
+
+@pytest.fixture(scope="module")
+def trained_tiny(tmp_path_factory):
+    from code2vec_tpu.data.reader import load_corpus
+    from code2vec_tpu.pyextract import extract_python_dataset
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.loop import train
+
+    root = tmp_path_factory.mktemp("obsfleet_py")
+    src, ds, out = root / "src", root / "ds", root / "out"
+    for d in (src, ds, out):
+        d.mkdir()
+    (src / "util.py").write_text(PY)
+    extract_python_dataset(str(ds), str(src), [("util.py", "*")])
+    data = load_corpus(
+        ds / "corpus.txt", ds / "path_idxs.txt", ds / "terminal_idxs.txt"
+    )
+    cfg = TrainConfig(
+        max_epoch=3, batch_size=2, encode_size=16, terminal_embed_size=8,
+        path_embed_size=8, max_path_length=32, lr=0.01, print_sample_cycle=0,
+    )
+    train(cfg, data, out_dir=str(out))
+    return ds, out
+
+
+def test_fleet_trace_stitch_metrics_and_burn_end_to_end(
+    trained_tiny, tmp_path
+):
+    """Boot a REAL 2-replica subprocess fleet with tracing + events on,
+    push a pipelined embed burst through it, then assert the whole
+    observability plane: stitched cross-process trace with a complete
+    router -> replica(serve_request) -> batcher(serve_device) ->
+    engine(engine_run) chain under one trace id, /metrics that parses and
+    agrees with health counters on router AND replicas, SLO burn
+    accounting with an intact budget, and worker flight_*.json dumps."""
+    from code2vec_tpu.serve.fleet.__main__ import build_parser, build_router
+
+    ds, out = trained_tiny
+    trace_dir = tmp_path / "traces"
+    events_dir = tmp_path / "events"
+    args = build_parser().parse_args([
+        "--replicas", "2",
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--deadline_ms", "2",
+        "--boot_timeout_s", "600",
+        "--trace_dir", str(trace_dir),
+        "--events_dir", str(events_dir),
+        # every worker request leaves a flight record: the dump path is
+        # part of what this scenario pins
+        "--flight_threshold_ms", "0.0001",
+    ])
+    router_tracer = Tracer(process_index=0, process_name="fleet-router")
+    previous_tracer = set_tracer(router_tracer)
+    n_requests = 12
+    try:
+        router, events = build_router(args)
+        try:
+            # the fleet CLI rides the process-global registry — under the
+            # full test session earlier suites have already counted ops
+            # there, so every counter assertion below is a DELTA from here
+            base = router.health.snapshot()["counters"].get(
+                "serve.op.embed.requests", 0
+            )
+            # pipelined burst: submit everything, then resolve — the
+            # fleet analogue of the stdio transport's coalescing loop
+            resolvers = [
+                router.handle_async({
+                    "id": i, "op": "embed", "source": PY,
+                    "language": "python", "method_name": "add",
+                })
+                for i in range(n_requests)
+            ]
+            payloads = [r() for r in resolvers]
+            assert all(p.get("ok") for p in payloads), payloads[:2]
+            assert [p["id"] for p in payloads] == list(range(n_requests))
+
+            # ---- /metrics on the router: refresh the probe snapshots,
+            # then scrape (lock-light: served from last_health)
+            for slot in range(2):
+                router._probe_slot(slot)
+            parsed = parse_prometheus_text(router.metrics_text())
+            per_replica = {
+                row["labels"].get("replica"): row["value"]
+                for row in parsed["c2v_serve_op_embed_requests_total"]
+            }
+            # router's own count covers the burst; the replicas' counts
+            # (fresh subprocesses — no prior traffic) sum to it exactly
+            # (placement split may be uneven)
+            assert per_replica[None] - base == n_requests
+            replica_total = sum(
+                v for k, v in per_replica.items() if k is not None
+            )
+            assert replica_total == n_requests
+            # agreement with the health op, per replica
+            health_payload = router.handle({"op": "health"})
+            for replica_row in health_payload["fleet"]["replicas"]:
+                assert replica_row["alive"]
+                assert replica_row["post_warmup_compiles"] == 0
+            # replica-labeled start times present (reset detection)
+            start_labels = {
+                row["labels"].get("replica")
+                for row in parsed["c2v_process_start_time_seconds"]
+            }
+            assert {"r0", "r1"} <= start_labels
+
+            # ---- burn accounting: a clean burst leaves the budget alone
+            burn = health_payload["fleet"]["slo_burn"]["embed"]
+            assert burn["good"] == n_requests and burn["bad"] == 0
+            assert burn["exhausted"] is False
+            assert health_payload["fleet"]["flight_recorded"] is not None
+        finally:
+            # graceful close: workers drain, exit 0, and WRITE their
+            # trace files + flight dumps on the way out
+            router.close()
+            if events is not None:
+                events.close()
+    finally:
+        set_tracer(previous_tracer)
+    router_tracer.export_dir(str(trace_dir))
+
+    # ---- worker flight dumps survived the processes
+    flight_files = glob.glob(
+        str(events_dir / "r*" / "flight" / "flight_*.json")
+    )
+    assert flight_files, "no worker flight_*.json dumps found"
+    record = json.loads(open(flight_files[0]).read())
+    assert record["kind"] == "serve" and record["trace_id"]
+    assert "device_ms" in record and "queue_wait_ms" in record
+
+    # ---- stitch: 3 per-process files -> one valid Chrome trace
+    paths = trace_stitch.find_trace_files([str(trace_dir)])
+    assert len(paths) == 3, paths  # router + 2 replicas
+    merged = trace_stitch.stitch_traces(paths)
+    data_events = [
+        e for e in merged["traceEvents"] if e.get("ph") != "M"
+    ]
+    assert all("ts" in e and "pid" in e for e in data_events)
+    assert len({e["pid"] for e in data_events}) >= 2
+    # valid Chrome trace: serializes, events time-ordered
+    json.dumps(merged)
+    ts = [e["ts"] for e in data_events]
+    assert ts == sorted(ts)
+
+    # ---- the acceptance chain: >= 1 sampled request whose spans cross
+    # router -> replica -> batcher -> engine under ONE trace id
+    index = trace_stitch.trace_index(merged)
+    required = {"fleet_request", "serve_request", "serve_device",
+                "engine_run"}
+    complete = [
+        trace_id for trace_id, entry in index.items()
+        if required <= {s["name"] for s in entry["spans"]}
+        and len(entry["processes"]) >= 2
+    ]
+    assert complete, (
+        f"no complete router->replica->batcher->engine chain; saw "
+        f"{ {t: sorted({s['name'] for s in e['spans']}) for t, e in list(index.items())[:4]} }"
+    )
+    # the chain's worker spans all live in ONE replica's file
+    entry = index[complete[0]]
+    worker_processes = {
+        s["process"] for s in entry["spans"] if s["name"] != "fleet_request"
+    }
+    assert len(worker_processes) == 1
+    assert next(iter(worker_processes)).startswith("r")

@@ -940,6 +940,22 @@ def test_bench_serve_arm_reports_latency_and_zero_recompiles(tmp_path):
     assert 0 < detail["pad_efficiency"] <= 1
     assert detail["latency_ms"]["device"]["count"] > 0
     assert len(detail["schedule_provenance"]) == detail["executables"]
+    # PR-15 observability provenance: the mid-load /metrics scrape is
+    # recorded PARSED (a malformed exporter would have died in the
+    # parser), the flight recorder observed the stream, and the SLO
+    # error-budget block carries the verdict the metric line mirrors
+    scrape = detail["metrics_scrape"]
+    assert scrape["series"] > 0
+    # mid-load: exactly the requests submitted before the scrape point
+    assert scrape["samples"]["c2v_serve_requests_total"] == scrape[
+        "at_request"
+    ]
+    assert detail["flight"]["seen"] == 60
+    burn = detail["slo_burn"]
+    assert burn["good"] == 60 and burn["bad"] == 0
+    assert burn["exhausted"] is False
+    assert metric["slo_budget_exhausted"] is False
+    assert metric["slo_burn_rate"] == 0.0
 
 
 # ---------------------------------------------------------------------------

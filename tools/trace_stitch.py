@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome trace files into ONE fleet-wide trace.
+
+Every process in a serving fleet writes its own ``trace-p<i>.json``
+(``obs/trace.py``): the router under ``--trace_dir``, each replica under
+``--trace_dir/r<slot>``. Those files already share one time axis — span
+timestamps are unix-epoch-anchored microseconds (PR 2), aligned across
+hosts up to NTP skew — but they collide on ``pid`` (every single-host
+process exports as process 0) and nothing ties a router span to the
+replica work it caused. This tool fixes both:
+
+- **stitch**: each input file gets a fresh pid; its ``process_name``
+  metadata row is prefixed with the file's source label (``r0:``,
+  ``r1:`` — the directory the fleet CLI wrote it under), so the merged
+  trace shows the router row and every replica row aligned on one
+  timeline, loadable in Perfetto / ``chrome://tracing`` unchanged.
+- **index**: spans tagged with a request trace id (``args.trace_id``, or
+  the coalesce-aware ``args.trace_ids`` list a batched device span
+  carries for the N requests it served) are grouped per trace id — the
+  cross-process request path: ``fleet_request`` (router) ->
+  ``serve_request`` (worker resolver) -> ``serve_pad``/``serve_device``
+  (micro-batcher) -> ``engine_run`` (executable call), one id end to end.
+
+Usage::
+
+    python tools/trace_stitch.py --out merged.json TRACE_DIR [MORE...]
+    python tools/trace_stitch.py --index-out index.json fleet_traces/
+
+Inputs are trace files or directories (searched recursively for
+``trace-p*.json``). A one-line JSON summary lands on stdout: file/event
+counts, distinct trace ids, and how many trace ids cross processes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+__all__ = ["find_trace_files", "stitch_traces", "trace_index"]
+
+
+def find_trace_files(paths: list[str]) -> list[str]:
+    """Expand files/directories into a sorted list of trace-p*.json files
+    (directories searched recursively)."""
+    found: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            found.extend(
+                glob.glob(
+                    os.path.join(path, "**", "trace-p*.json"), recursive=True
+                )
+            )
+        elif os.path.isfile(path):
+            found.append(path)
+        else:
+            raise FileNotFoundError(f"no trace file or directory at {path!r}")
+    # stable order: label (relative path) sorts router before r0 before r1
+    return sorted(dict.fromkeys(os.path.abspath(p) for p in found))
+
+
+def _source_label(path: str, root: str) -> str:
+    """The per-file row label: the file's directory relative to the
+    common root ('' for files directly in the root — typically the
+    router's own trace)."""
+    rel = os.path.relpath(os.path.dirname(path), root)
+    return "" if rel == "." else rel.replace(os.sep, "/")
+
+
+def stitch_traces(paths: list[str]) -> dict:
+    """Merge trace files into one Chrome trace object: per-file pid
+    remapping, source-labeled process rows, events in timestamp order.
+    Timestamps are passed through untouched — the files are already
+    epoch-anchored onto one shared axis."""
+    if not paths:
+        raise ValueError("no trace files to stitch")
+    root = os.path.commonpath([os.path.dirname(p) for p in paths])
+    meta: list[dict] = []
+    events: list[dict] = []
+    sources: list[dict] = []
+    dropped = 0
+    for new_pid, path in enumerate(paths):
+        with open(path, encoding="utf-8") as f:
+            trace = json.load(f)
+        label = _source_label(path, root)
+        dropped += int(trace.get("dropped_events", 0) or 0)
+        n_events = 0
+        named = False
+        for event in trace.get("traceEvents", []):
+            event = dict(event, pid=new_pid)
+            if event.get("ph") == "M":
+                if event.get("name") == "process_name":
+                    named = True
+                    name = (event.get("args") or {}).get("name", "")
+                    event["args"] = {
+                        "name": f"{label}: {name}" if label else name
+                    }
+                meta.append(event)
+            else:
+                events.append(event)
+                n_events += 1
+        if not named:  # a file without naming metadata still gets a row
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": new_pid,
+                "args": {"name": label or os.path.basename(path)},
+            })
+        sources.append({
+            "pid": new_pid, "path": path, "label": label, "events": n_events,
+        })
+    events.sort(key=lambda e: e.get("ts", 0))
+    merged: dict = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "stitch": {"sources": sources},
+    }
+    if dropped:
+        merged["dropped_events"] = dropped
+    return merged
+
+
+def trace_index(trace: dict) -> dict:
+    """Group a (stitched) trace's spans by request trace id.
+
+    Returns ``{trace_id: {"spans": [...], "processes": [...]}}`` where
+    each span entry carries the process label, span name, ts, dur, and
+    whether the link came through a batched span's ``trace_ids`` list
+    (``coalesced: true`` — the device call served N requests at once).
+    """
+    labels = {
+        s["pid"]: (s["label"] or "router")
+        for s in trace.get("stitch", {}).get("sources", [])
+    }
+    index: dict[str, dict] = {}
+
+    def add(trace_id: str, event: dict, coalesced: bool) -> None:
+        entry = index.setdefault(
+            str(trace_id), {"spans": [], "processes": []}
+        )
+        process = labels.get(
+            event.get("pid"), f"p{event.get('pid')}"
+        )
+        entry["spans"].append({
+            "process": process,
+            "name": event.get("name"),
+            "ts": event.get("ts"),
+            "dur": event.get("dur"),
+            "coalesced": coalesced,
+        })
+        if process not in entry["processes"]:
+            entry["processes"].append(process)
+
+    for event in trace.get("traceEvents", []):
+        if event.get("ph") == "M":
+            continue
+        args = event.get("args") or {}
+        trace_id = args.get("trace_id")
+        if isinstance(trace_id, str) and trace_id:
+            add(trace_id, event, coalesced=False)
+        trace_ids = args.get("trace_ids")
+        if isinstance(trace_ids, list):
+            for tid in trace_ids:
+                if isinstance(tid, str) and tid:
+                    add(tid, event, coalesced=True)
+    for entry in index.values():
+        entry["spans"].sort(key=lambda s: (s["ts"] or 0))
+    return index
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="trace_stitch",
+        description="merge per-process Chrome traces into one fleet-wide "
+        "trace and index spans by request trace id",
+    )
+    parser.add_argument("inputs", nargs="+",
+                        help="trace files or directories (searched "
+                        "recursively for trace-p*.json)")
+    parser.add_argument("--out", default=None,
+                        help="write the merged Chrome trace here "
+                        "(viewable in Perfetto / chrome://tracing)")
+    parser.add_argument("--index-out", default=None,
+                        help="write the per-trace-id span index here")
+    args = parser.parse_args(argv)
+
+    paths = find_trace_files(args.inputs)
+    if not paths:
+        raise SystemExit("no trace-p*.json files found under the inputs")
+    merged = stitch_traces(paths)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+    index = trace_index(merged)
+    if args.index_out:
+        with open(args.index_out, "w", encoding="utf-8") as f:
+            json.dump(index, f, indent=1)
+    n_events = sum(
+        1 for e in merged["traceEvents"] if e.get("ph") != "M"
+    )
+    summary = {
+        "files": len(paths),
+        "events": n_events,
+        "traces": len(index),
+        "cross_process_traces": sum(
+            1 for entry in index.values() if len(entry["processes"]) > 1
+        ),
+        "out": args.out,
+        "index_out": args.index_out,
+    }
+    json.dump(summary, sys.stdout)
+    sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    main()
