@@ -2365,6 +2365,22 @@ def _serve_bench() -> None:
         for t in os.environ.get("BENCH_SERVE_BATCH_SIZES", "1,8").split(",")
         if t.strip()
     )
+    # seeded Zipf request mix (the router result-cache's acceptance
+    # traffic): BENCH_SERVE_ZIPF=skew,distinct draws every request from a
+    # fixed population of `distinct` bags with Zipf(skew) popularity, and
+    # every resend PERMUTES its rows — so the cache's order-invariant
+    # canonicalization, not byte equality, is what makes repeats hit.
+    # --cache-ab turns on the cache-on/off ABBA arm and implies the
+    # default mix (1.1 over 64 bags) when the env knob is unset.
+    cache_ab = "--cache-ab" in sys.argv[1:]
+    zipf_spec = os.environ.get("BENCH_SERVE_ZIPF", "")
+    zipf = None
+    if zipf_spec or cache_ab:
+        parts = (zipf_spec or "1.1,64").split(",")
+        zipf = (
+            float(parts[0]),
+            int(parts[1]) if len(parts) > 1 and parts[1].strip() else 64,
+        )
 
     config = TrainConfig(batch_size=max(batch_sizes), max_path_length=bag)
     model_config = Code2VecConfig(
@@ -2394,6 +2410,18 @@ def _serve_bench() -> None:
     counts = np.clip(
         np.rint(rng.lognormal(np.log(bag / 6.0), 0.6, n_requests)), 1, bag
     ).astype(np.int64)
+    distinct_counts = bag_ids = None
+    if zipf is not None:
+        skew, distinct = zipf
+        distinct_counts = np.clip(
+            np.rint(rng.lognormal(np.log(bag / 6.0), 0.6, distinct)), 1, bag
+        ).astype(np.int64)
+        weights = 1.0 / np.arange(1.0, distinct + 1) ** skew
+        weights /= weights.sum()
+        bag_ids = rng.choice(distinct, size=n_requests, p=weights)
+        # the ladder sees the TRAFFIC-weighted width distribution, not
+        # the population's: hot bags dominate bucket occupancy
+        counts = distinct_counts[bag_ids]
     ladder = derive_bucket_ladder(counts, bag)
 
     health = RuntimeHealth()
@@ -2424,7 +2452,25 @@ def _serve_bench() -> None:
             axis=1,
         ).astype(np.int32)
 
-    requests = [request(i) for i in range(n_requests)]
+    if zipf is not None:
+        def make_bag(n: int) -> np.ndarray:
+            return np.stack(
+                [
+                    rng.integers(1, n_terminals, n),
+                    rng.integers(1, n_paths, n),
+                    rng.integers(1, n_terminals, n),
+                ],
+                axis=1,
+            ).astype(np.int32)
+
+        bags = [make_bag(int(c)) for c in distinct_counts]
+        # every resend is a fresh row permutation of its bag: byte-level
+        # dedup would miss, canonical multiset digests hit
+        requests = [
+            bags[b][rng.permutation(len(bags[b]))] for b in bag_ids
+        ]
+    else:
+        requests = [request(i) for i in range(n_requests)]
     # seeded exponential inter-arrival gaps: a Poisson process at the
     # target rate, fixed before the clock starts (open loop)
     gaps = rng.exponential(1.0 / target_qps, n_requests)
@@ -2490,6 +2536,25 @@ def _serve_bench() -> None:
         # before the swap so its v0 embedding is on record
         golden_request = requests[0]
         ref_v0 = batcher.submit(golden_request).result()
+        if cache_ab:
+            # the router result-cache's version lifecycle, mirrored here
+            # against the real swap machinery: warm an entry under v0,
+            # prove commit invalidates (retaining it) and rollback
+            # revalidates it bitwise with zero device calls
+            from code2vec_tpu.serve.fleet.cache import (
+                ResultCache,
+                canonical_bag_digest,
+            )
+
+            lifecycle_cache = ResultCache(8 * 2**20, version="v0")
+            golden_key = ("v0", canonical_bag_digest(golden_request))
+            lifecycle_cache.begin(golden_key)
+            lifecycle_cache.fill(
+                golden_key, ref_v0,
+                nbytes=int(ref_v0.code_vector.nbytes + ref_v0.logits.nbytes),
+            )
+        else:
+            lifecycle_cache = None
 
     futures = []
     submit_times: list[float] = []
@@ -2565,7 +2630,152 @@ def _serve_bench() -> None:
             f"{len(failed)} request(s) failed during the load run "
             f"(first: {failed[:3]})"
         )
+
+    cache_detail = None
+
+    def cache_pass(use_cache: bool):
+        """One open-loop pass over the Zipf stream through the (always
+        v0) batcher, optionally fronted by the result cache — the same
+        admission protocol the fleet router runs: hit resolves inline,
+        join rides the leader's future, lead submits and fills."""
+        from code2vec_tpu.serve.fleet.cache import (
+            ResultCache,
+            canonical_bag_digest,
+        )
+
+        cache = ResultCache(64 * 2**20, version="v0") if use_cache else None
+        hits = []  # (index, ServeResult, latency_ms)
+        pend = []  # (index, "miss"|"join", future, t_submit)
+        done_at: dict = {}
+        t0 = time.perf_counter()
+        for i, arr in enumerate(requests):
+            delay = arrivals[i] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            ts = time.perf_counter()
+            if cache is None:
+                fut = batcher.submit(arr)
+                fut.add_done_callback(
+                    lambda f, i=i: done_at.__setitem__(
+                        i, time.perf_counter()
+                    )
+                )
+                pend.append((i, "miss", fut, ts))
+                continue
+            key = ("v0", canonical_bag_digest(arr))
+            state, held = cache.begin(key)
+            if state == "hit":
+                hits.append((i, held, (time.perf_counter() - ts) * 1e3))
+                continue
+            if state == "join":
+                held.add_done_callback(
+                    lambda f, i=i: done_at.__setitem__(
+                        i, time.perf_counter()
+                    )
+                )
+                pend.append((i, "join", held, ts))
+                continue
+            fut = batcher.submit(arr)
+
+            def on_done(f, i=i, cache=cache, key=key):
+                done_at[i] = time.perf_counter()
+                if f.exception() is None:
+                    r = f.result()
+                    cache.fill(
+                        key, r,
+                        nbytes=int(
+                            r.code_vector.nbytes + r.logits.nbytes
+                        ),
+                    )
+                else:  # pragma: no cover - load run already validated
+                    cache.abandon(key, None)
+
+            fut.add_done_callback(on_done)
+            pend.append((i, "miss", fut, ts))
+        values = {i: fut.result() for i, _, fut, _ in pend}
+        kinds = {i: kind for i, kind, _, _ in pend}
+        kinds.update({i: "hit" for i, _, _ in hits})
+        t_pass = time.perf_counter() - t0
+        # one device call per miss GROUP: each member of a coalesced
+        # device batch carries an equal 1/coalesced share
+        device_calls = sum(
+            1.0 / values[i].coalesced
+            for i, kind, _, _ in pend
+            if kind == "miss"
+        )
+        e2e_hit = [ms for _, _, ms in hits]
+        e2e_miss = [
+            (done_at[i] - ts) * 1e3 for i, _, _, ts in pend if i in done_at
+        ]
+        vectors = {i: v.code_vector for i, v, _ in hits}
+        vectors.update({i: v.code_vector for i, v in values.items()})
+        arm = {
+            "cache": use_cache,
+            "qps": round(n_requests / t_pass, 2) if t_pass > 0 else None,
+            "hit_rate": round(len(hits) / n_requests, 4),
+            "coalesced": (
+                cache.stats()["coalesced"] if cache is not None else 0
+            ),
+            "device_calls": round(device_calls, 2),
+            "device_calls_per_request": round(
+                device_calls / n_requests, 4
+            ),
+            "p50_hit_ms": (
+                round(float(np.percentile(e2e_hit, 50)), 3)
+                if e2e_hit else None
+            ),
+            "p50_miss_ms": (
+                round(float(np.percentile(e2e_miss, 50)), 3)
+                if e2e_miss else None
+            ),
+        }
+        return arm, vectors, kinds
+
+    def run_cache_ab() -> dict:
+        """Cache on/off over the SAME seeded Zipf stream, ABBA order (the
+        kernel-bench discipline: interleaving cancels thermal/allocator
+        drift), best-of per arm; responses must be bitwise-identical
+        cached vs uncached."""
+        passes = [cache_pass(on) for on in (True, False, False, True)]
+        on_arms = [a for a, _, _ in (passes[0], passes[3])]
+        off_arms = [a for a, _, _ in (passes[1], passes[2])]
+        on_best = max(on_arms, key=lambda a: a["qps"] or 0.0)
+        off_best = max(off_arms, key=lambda a: a["qps"] or 0.0)
+        # bitwise contract, per request of the cache-on arm against the
+        # uncached arm: a MISS computed fresh must match the uncached
+        # result for the same byte-identical array; a HIT/JOIN returns
+        # the exact payload of an earlier computation of the SAME
+        # canonical bag (a different row permutation — float pooling is
+        # not order-bitwise-stable, so the match is against the uncached
+        # arm's result for that bag's original submission, not index i's)
+        on_vecs, on_kinds = passes[0][1], passes[0][2]
+        off_vecs = passes[1][1]
+        by_bag_off: dict = {}
+        for j in range(n_requests):
+            by_bag_off.setdefault(int(bag_ids[j]), []).append(off_vecs[j])
+        bitwise = True
+        for i in range(n_requests):
+            if on_kinds.get(i) == "miss":
+                ok = np.array_equal(on_vecs[i], off_vecs[i])
+            else:
+                ok = any(
+                    np.array_equal(on_vecs[i], v)
+                    for v in by_bag_off[int(bag_ids[i])]
+                )
+            if not ok:
+                bitwise = False
+                break
+        return {
+            "zipf": {"skew": zipf[0], "distinct_bags": zipf[1]},
+            "order": "ABBA",
+            "cache_on": on_best,
+            "cache_off": off_best,
+            "bitwise_identical": bitwise,
+        }
+
     if not rolling_swap:
+        if cache_ab:
+            cache_detail = run_cache_ab()
         batcher.close()
 
     completed = len(results)
@@ -2620,14 +2830,51 @@ def _serve_bench() -> None:
         # (not die here on the rollback's own ValueError).
         rollback_bitwise = versions_differ = False
         shadow_post_warmup = 0
+        cache_lifecycle = None
         if last.get("outcome") == "committed":
             v1_result = controller.active.batcher.submit(
                 golden_request
             ).result()
+            if lifecycle_cache is not None:
+                # commit: the active version key flips forward — the v0
+                # entry goes invisible (a resend MISSES and recomputes on
+                # v1) but stays resident for the rollback below
+                from code2vec_tpu.serve.fleet.cache import (
+                    canonical_bag_digest,
+                )
+
+                gk = canonical_bag_digest(golden_request)
+                lifecycle_cache.begin_swap()
+                lifecycle_cache.end_swap(version="v1")
+                state_after_commit, _ = lifecycle_cache.begin(("v1", gk))
+                lifecycle_cache.abandon(("v1", gk), None)
+                cache_lifecycle = {
+                    "invalidated_on_commit": state_after_commit == "lead",
+                    "v0_entries_retained": (
+                        lifecycle_cache.stats()["versions"].get("v0", 0)
+                    ),
+                }
             controller.rollback()
             restored = controller.active.batcher.submit(
                 golden_request
             ).result()
+            if lifecycle_cache is not None:
+                # rollback: the version key flips back and the retained
+                # v0 entry is a HIT again — bitwise-equal to what the
+                # restored generation recomputes, with zero device calls
+                # on the hit path
+                lifecycle_cache.set_version("v0")
+                state_back, held = lifecycle_cache.begin(("v0", gk))
+                cache_lifecycle["revalidated_bitwise"] = bool(
+                    state_back == "hit"
+                    and np.array_equal(
+                        held.code_vector, restored.code_vector
+                    )
+                    and np.array_equal(held.logits, restored.logits)
+                )
+                cache_lifecycle["device_calls_on_revalidate"] = 0
+                if state_back == "lead":  # pragma: no cover - fail path
+                    lifecycle_cache.abandon(("v0", gk), None)
             rollback_bitwise = bool(
                 np.array_equal(ref_v0.code_vector, restored.code_vector)
                 and np.array_equal(ref_v0.logits, restored.logits)
@@ -2657,7 +2904,13 @@ def _serve_bench() -> None:
             "versions_differ": versions_differ,
             "rollback_bitwise": rollback_bitwise,
             "post_warmup_recompiles_shadow": shadow_post_warmup,
+            "cache": cache_lifecycle,
         }
+        if cache_ab:
+            # the A/B arm runs on the (rolled-back, still-resident) v0
+            # batcher AFTER the swap machinery settles, so both arms
+            # measure one stable generation
+            cache_detail = run_cache_ab()
         controller.close()
 
     detail = {
@@ -2698,7 +2951,13 @@ def _serve_bench() -> None:
         "flight": {"recorded": flight.count, "seen": flight.seen},
         "slo_burn": burn.snapshot()["serve"],
         "memory": memory_snapshot(),
+        "zipf": (
+            {"skew": zipf[0], "distinct_bags": zipf[1]}
+            if zipf is not None else None
+        ),
     }
+    if cache_detail is not None:
+        detail["cache_ab"] = cache_detail
     if swap_detail is not None:
         detail["rolling_swap"] = swap_detail
     print(json.dumps({"detail": detail}), file=sys.stderr, flush=True)
@@ -2721,6 +2980,19 @@ def _serve_bench() -> None:
         "flight_recorded": flight.count,
         "backend": backend,
     }
+    if cache_detail is not None:
+        metric["cache_ab"] = {
+            "hit_rate": cache_detail["cache_on"]["hit_rate"],
+            "device_calls_per_request": (
+                cache_detail["cache_on"]["device_calls_per_request"]
+            ),
+            "device_calls_per_request_uncached": (
+                cache_detail["cache_off"]["device_calls_per_request"]
+            ),
+            "p50_hit_ms": cache_detail["cache_on"]["p50_hit_ms"],
+            "p50_miss_ms": cache_detail["cache_on"]["p50_miss_ms"],
+            "bitwise_identical": cache_detail["bitwise_identical"],
+        }
     if swap_detail is not None:
         metric["rolling_swap"] = {
             key: swap_detail[key]
@@ -2770,9 +3042,48 @@ def _serve_bench() -> None:
                     "rollback did NOT restore v0's bitwise-identical "
                     "outputs"
                 )
+            lifecycle = swap_detail.get("cache")
+            if lifecycle is not None:
+                if not lifecycle["invalidated_on_commit"]:
+                    problems.append(
+                        "cache served a stale v0 entry after the commit "
+                        "flipped the active version"
+                    )
+                if not lifecycle["revalidated_bitwise"]:
+                    problems.append(
+                        "rollback did not revalidate the retained v0 "
+                        "cache entry bitwise"
+                    )
         if problems:
             raise RuntimeError(
                 "--rolling-swap verdict failed: " + "; ".join(problems)
+            )
+    if cache_detail is not None:
+        problems = []
+        on, off = cache_detail["cache_on"], cache_detail["cache_off"]
+        if on["device_calls_per_request"] >= 0.5:
+            problems.append(
+                f"device-call rate did not decouple from QPS: "
+                f"{on['device_calls_per_request']} calls/request with the "
+                f"cache on (uncached: "
+                f"{off['device_calls_per_request']}) >= 0.5"
+            )
+        if (
+            on["p50_hit_ms"] is None
+            or on["p50_miss_ms"] is None
+            or on["p50_hit_ms"] >= on["p50_miss_ms"]
+        ):
+            problems.append(
+                f"hit-path p50 ({on['p50_hit_ms']} ms) is not below "
+                f"miss-path p50 ({on['p50_miss_ms']} ms)"
+            )
+        if not cache_detail["bitwise_identical"]:
+            problems.append(
+                "cached responses are not bitwise-identical to uncached"
+            )
+        if problems:
+            raise RuntimeError(
+                "--cache-ab verdict failed: " + "; ".join(problems)
             )
 
 

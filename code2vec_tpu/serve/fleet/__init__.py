@@ -15,6 +15,13 @@ item 2 — heavy traffic from millions of users):
   single global ``max_pending``: tiered load shedding means overload
   degrades the cheap-to-retry tiers first while the control plane stays
   responsive.
+- :mod:`cache` — router-level content-addressed result cache: repeat
+  requests (order-invariant canonical bag digest + op knobs + generation
+  version) are served from router memory in O(1), ahead of SLO admission
+  — S3-FIFO eviction with byte-accounted capacity, concurrent-miss
+  coalescing, and swap-versioned invalidation (a committed rolling swap
+  flips the active version key; ``rollback`` flips it back and the old
+  generation's entries are instantly valid again, bitwise).
 - :mod:`router` — the fan-out: per-class bounded queues feed a dispatcher
   that places each request on the least-loaded healthy replica (bounded
   per-replica in-flight — the micro-batcher backpressure idea, one level
@@ -32,6 +39,11 @@ launches router + replicas; the client-facing transports are the same
 stdio-JSONL/HTTP adapters single-process serving uses.
 """
 
+from code2vec_tpu.serve.fleet.cache import (
+    ResultCache,
+    canonical_bag_digest,
+    canonical_request_key,
+)
 from code2vec_tpu.serve.fleet.replica import ReplicaDied, ReplicaHandle
 from code2vec_tpu.serve.fleet.router import FleetRouter
 from code2vec_tpu.serve.fleet.slo import (
@@ -46,7 +58,10 @@ __all__ = [
     "FleetRouter",
     "ReplicaDied",
     "ReplicaHandle",
+    "ResultCache",
     "SloClass",
+    "canonical_bag_digest",
+    "canonical_request_key",
     "classify_op",
     "parse_slo_spec",
 ]

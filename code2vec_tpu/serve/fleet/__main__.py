@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "error budget over the rolling window)")
     parser.add_argument("--slo_window_s", type=float, default=60.0,
                         help="rolling error-budget window length")
+    parser.add_argument("--result_cache_mb", type=float, default=0.0,
+                        help="router-level content-addressed result cache "
+                        "capacity in MB (0 = off): repeat requests for the "
+                        "same canonical path-context bag are served from "
+                        "router memory in O(1) — no queue budget, no "
+                        "replica, no device call — with S3-FIFO eviction, "
+                        "miss coalescing, and swap-versioned invalidation")
     parser.add_argument("--flight_threshold_ms", type=float, default=0.0,
                         help="capture a full per-request flight record "
                         "for any request slower than this (0 = p99 "
@@ -176,6 +183,14 @@ def build_router(args):
         threshold_ms=threshold if threshold > 0 else None,
         events=events, health=global_health(),
     )
+    cache = None
+    cache_mb = getattr(args, "result_cache_mb", 0.0) or 0.0
+    if cache_mb > 0:
+        from code2vec_tpu.serve.fleet.cache import ResultCache
+
+        cache = ResultCache(
+            int(cache_mb * 2**20), health=global_health()
+        )
     router = FleetRouter(
         factory,
         args.replicas,
@@ -189,6 +204,7 @@ def build_router(args):
         slo_objective=getattr(args, "slo_objective", 0.999),
         slo_window_s=getattr(args, "slo_window_s", 60.0),
         flight=flight,
+        result_cache=cache,
     )
     return router, events
 

@@ -2,6 +2,13 @@
 
 Request life cycle (all jax-free; the router moves dicts, never tensors):
 
+0. **cache** (``--result_cache_mb``, cache.py): data requests first hit
+   the content-addressed result cache — a repeat of a cached request
+   (same canonical bag/source/vector + knobs, same generation version)
+   resolves HERE, ahead of SLO admission: it consumes no queue budget and
+   touches no replica. Concurrent identical misses coalesce onto the
+   first one's future; a committed rolling swap flips the cache's active
+   version (old entries stay resident) and ``rollback`` flips it back.
 1. **admit**: ``handle_async`` classifies the op into its SLO class and
    enqueues into that class's bounded queue — a full queue sheds with a
    retryable ``overloaded`` error (the class's budget IS the admission
@@ -54,6 +61,7 @@ from code2vec_tpu.obs.runtime import (
     global_health,
 )
 from code2vec_tpu.obs.trace import ensure_trace, get_tracer
+from code2vec_tpu.serve.fleet.cache import ResultCache
 from code2vec_tpu.serve.fleet.replica import ReplicaDied
 from code2vec_tpu.serve.fleet.slo import (
     DEFAULT_SLO,
@@ -97,6 +105,12 @@ class _Queued:
     # counts exactly these; worker-relayed errors are already counted in
     # the replica's own registry
     router_error: bool = False
+    # result-cache bookkeeping: the versioned key this item leads or
+    # coalesces on (None = cache off / uncacheable / mid-roll) and its
+    # role — "miss" leads (fills on success), "coalesced" rides the
+    # leader's future without ever touching a queue or replica
+    cache_key: tuple | None = None
+    cache_state: str | None = None
 
     @property
     def age_ms(self) -> float:
@@ -132,6 +146,7 @@ class FleetRouter:
         slo_objective: float = 0.999,
         slo_window_s: float = 60.0,
         flight: FlightRecorder | None = None,
+        result_cache: ResultCache | None = None,
     ) -> None:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -187,6 +202,10 @@ class FleetRouter:
         # slow-request flight recorder: a shed or tail-latency request
         # leaves a concrete per-request timeline, not just a histogram
         self._flight = flight
+        # content-addressed result cache (cache.py): hits resolve at
+        # admission, ahead of SLO queues and replicas; None = disabled
+        self._cache = result_cache
+        self._version_seq = 0
 
         # ---- boot the fleet (parallel: each worker compiles its ladder)
         self._slots: list = [None] * int(n_replicas)
@@ -218,6 +237,19 @@ class FleetRouter:
                 f"replica slot(s) {failed} failed to boot: "
                 f"{[str(errors[i]) for i in failed]}"
             )
+
+        if self._cache is not None:
+            # seed the cache's version from the fleet's actual serving
+            # generation (every replica booted the same checkpoint); a
+            # factory whose readiness payload carries no version keeps
+            # the cache's own default
+            for handle in self._slots:
+                version = (getattr(handle, "last_health", None) or {}).get(
+                    "version"
+                )
+                if version:
+                    self._cache.set_version(version)
+                    break
 
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="c2v-fleet-dispatch", daemon=True
@@ -306,14 +338,70 @@ class FleetRouter:
 
         # data plane: stamp (or honor) the request's trace context FIRST —
         # the same dict crosses the replica pipe, so the worker's spans
-        # inherit the id with no extra wiring — then admit into the class
-        # queue (budget = admission bound)
+        # inherit the id with no extra wiring — then consult the result
+        # cache AHEAD of SLO admission (a hit never consumes queue budget
+        # or touches a replica; sheds cannot starve cacheable traffic) —
+        # then admit into the class queue (budget = admission bound)
+        t0 = time.perf_counter()
         trace = ensure_trace(request)
         self.health.counter(f"serve.op.{op}.requests").inc()
+        cache_key = (
+            self._cache.key_for(request) if self._cache is not None else None
+        )
+        cache_state = None
+        if cache_key is not None:
+            state, held = self._cache.begin(cache_key)
+            if state == "hit":
+                payload = held
+                self.health.counter(f"slo.{cls_name}.cache_hits").inc()
+                self._burn.record(cls_name, good=True)
+                now = time.perf_counter()
+                tracer = get_tracer()
+                if tracer.enabled:
+                    tracer.span_complete(
+                        "fleet_request", category="fleet",
+                        start_s=t0, end_s=now,
+                        trace_id=trace.trace_id, op=op, slo_class=cls_name,
+                        outcome="ok", cache_hit=True,
+                        cache_version=cache_key[0],
+                    )
+                if self._flight is not None:
+                    self._flight.observe((now - t0) * 1e3, {
+                        "kind": "router",
+                        "trace_id": trace.trace_id,
+                        "op": op,
+                        "slo_class": cls_name,
+                        "outcome": "ok",
+                        "cache_hit": True,
+                        "cache_version": cache_key[0],
+                    })
+                return lambda: finish(payload)
+            if state == "join":
+                # coalesced miss: ride the leader's in-flight future —
+                # no queue budget, no replica, one device call for the
+                # whole herd. _finalize still runs for burn accounting
+                # and the span/flight breakdown.
+                item = _Queued(
+                    request=request, future=Future(), cls=cls_name, op=op,
+                    trace_id=trace.trace_id, cache_key=cache_key,
+                    cache_state="coalesced",
+                )
+                item.future.add_done_callback(
+                    lambda fut, item=item: self._finalize(item, fut)
+                )
+                held.add_done_callback(
+                    lambda fut, item=item: (
+                        item.future.set_result(fut.result())
+                        if not item.future.done() else None
+                    )
+                )
+                return lambda: finish(item.future.result())
+            cache_state = "miss"  # this request leads; _finalize fills
         item = _Queued(
             request=request, future=Future(), cls=cls_name, op=op,
             trace_id=trace.trace_id,
             depth=self._queues[cls_name].qsize(),
+            cache_key=cache_key, cache_state=cache_state,
         )
         self.health.counter(f"slo.{cls_name}.submitted").inc()
         try:
@@ -333,6 +421,10 @@ class FleetRouter:
                 "error_kind": "overloaded",
                 "slo_class": cls_name,
             }
+            if item.cache_key is not None:
+                # this item led a coalesced miss: hand joiners the shed
+                # payload (they attached to THIS attempt) without caching
+                self._cache.abandon(item.cache_key, payload)
             tracer = get_tracer()
             if tracer.enabled:
                 tracer.span_complete(
@@ -359,6 +451,17 @@ class FleetRouter:
         payload = fut.result()  # router futures always resolve to a dict
         kind = payload.get("error_kind") if isinstance(payload, dict) else None
         now = time.perf_counter()
+        if item.cache_key is not None and item.cache_state == "miss":
+            # leader exit: cache the exact payload (pre-"id" — every
+            # future hit re-stamps its own correlation id) or, on any
+            # error, resolve joiners without caching so the next
+            # identical request retries cold
+            if kind is None and isinstance(payload, dict) and not payload.get(
+                "error"
+            ):
+                self._cache.fill(item.cache_key, payload)
+            else:
+                self._cache.abandon(item.cache_key, payload)
         if item.router_error:
             # ROUTER-minted outcomes never reached a worker resolver —
             # without this the per-op error counters undercount sheds.
@@ -367,13 +470,23 @@ class FleetRouter:
             # the /metrics aggregation would otherwise show them twice
             self.health.counter(f"serve.op.{item.op}.errors").inc()
         self._burn.record(item.cls, good=kind not in _BUDGET_BURNING_KINDS)
+        cache_tags = {}
+        if self._cache is not None:
+            cache_tags = {
+                "cache_hit": False,
+                "cache_version": (
+                    item.cache_key[0] if item.cache_key is not None else None
+                ),
+            }
+            if item.cache_state == "coalesced":
+                cache_tags["cache_coalesced"] = True
         tracer = get_tracer()
         if tracer.enabled:
             tracer.span_complete(
                 "fleet_request", category="fleet",
                 start_s=item.enqueued, end_s=now,
                 trace_id=item.trace_id, op=item.op, slo_class=item.cls,
-                outcome=kind or "ok", slot=item.slot,
+                outcome=kind or "ok", slot=item.slot, **cache_tags,
             )
         if self._flight is not None:
             dispatch_wait_ms = (
@@ -394,6 +507,7 @@ class FleetRouter:
                 ),
                 "replica_slot": item.slot,
                 "attempts": item.attempts,
+                **cache_tags,
             })
 
     # ---- dispatch -------------------------------------------------------
@@ -666,6 +780,12 @@ class FleetRouter:
                 # slo.<class>.burn_rate / budget_remaining gauges
                 "slo_burn": self._burn.snapshot(),
                 "rolling": self._rolling_status(),
+                # result-cache block: hit/miss/coalesced counters, byte
+                # accounting, and per-version resident entry counts (the
+                # same numbers /metrics exports as c2v_cache_* series)
+                "cache": (
+                    self._cache.stats() if self._cache is not None else None
+                ),
                 "flight_recorded": (
                     self._flight.count if self._flight is not None else None
                 ),
@@ -734,6 +854,10 @@ class FleetRouter:
                 )
             self._rolling = {"state": "running", "target": target,
                              "outcome": None, "replicas": []}
+            if self._cache is not None:
+                # mid-roll the fleet is mixed-version: the cache stands
+                # down (no hits, no fills) until the outcome is known
+                self._cache.begin_swap()
             self._rolling_thread = threading.Thread(
                 target=self._rolling_swap, args=(target,),
                 name="c2v-fleet-rolling-swap", daemon=True,
@@ -815,6 +939,24 @@ class FleetRouter:
         with self._swap_lock:
             self._rolling = {"state": "idle", "target": target,
                              "outcome": outcome, "replicas": per_replica}
+        if self._cache is not None:
+            if outcome == "committed":
+                # flip the active version forward: the old generation's
+                # entries stay resident (rollback revalidates them
+                # bitwise) but stop being visible. A commit whose version
+                # is unreported gets a fresh unique label — serving the
+                # OLD entries against NEW weights would be wrong.
+                versions = [
+                    e.get("version") for e in per_replica if e.get("version")
+                ]
+                self._cache.end_swap(
+                    version=versions[-1] if versions
+                    else self._fresh_version(target)
+                )
+            else:
+                # the roll failed with the incumbent generation intact:
+                # its entries never stopped being true
+                self._cache.end_swap()
         self._emit(
             "fleet_swap_committed" if outcome == "committed"
             else "fleet_swap_failed",
@@ -856,8 +998,33 @@ class FleetRouter:
                         "active_version"
                     ),
                 })
+        if self._cache is not None:
+            versions = {
+                r.get("version")
+                for r in results
+                if r.get("outcome") == "rolled_back"
+            }
+            if ok and len(versions) == 1 and None not in versions:
+                # the whole fleet agreed on the restored generation: flip
+                # the cache back — that generation's entries (retained
+                # across the commit) are instantly valid again, bitwise
+                self._cache.set_version(versions.pop())
+            else:
+                # partial/ambiguous rollback: no version label is
+                # truthful for the whole fleet — go cold under a fresh
+                # unique version rather than risk a wrong hit
+                self._cache.set_version(
+                    self._fresh_version("post_rollback")
+                )
         self._emit("fleet_rollback", replicas=results)
         return {"ok": ok, "replicas": results}
+
+    def _fresh_version(self, hint) -> str:
+        """A unique never-hits-anything version label for states where
+        the fleet's true generation is unknown (unreported commit,
+        partial rollback): correctness over hit rate."""
+        self._version_seq += 1
+        return f"{hint or 'unknown'}@seq{self._version_seq}"
 
     def _fleet_swap_status(self) -> dict:
         per_replica = []

@@ -20,6 +20,12 @@ Request schema (one ``op`` per object; unknown fields ignored)::
     {"op": "predict",    "source": str, "language": "java"|"python",
      "method_name": "*", "top_k": 5, "include_vector": false}
     {"op": "embed",      ... same selectors ...}
+    # predict/embed alternatively take a PRE-MAPPED path-context bag in
+    # place of "source": extraction and vocab mapping are skipped — the
+    # form an indexing pipeline resends, and the form the fleet router's
+    # content-addressed result cache digests order-invariantly (a
+    # permuted resend of the same bag is a cache hit)
+    {"op": "embed",      "contexts": [[start, path, end], ...]}
     {"op": "embed_file", ... same selectors ...}   # one pooled vector for
                                                    # the whole source (the
                                                    # hierarchical head)
@@ -76,6 +82,42 @@ __all__ = [
 # `serve.op.<op>.requests`/`.errors` counters — one schema for dashboards
 # and the fleet router's shedding decisions); unknown ops are excluded so
 # garbage requests cannot grow the registry unboundedly
+def _validate_context_rows(
+    rows, n_terminals: int, n_paths: int
+) -> list[tuple[int, int, int]]:
+    """Validate a pre-mapped ``"contexts"`` field: a non-empty list of
+    ``[start, path, end]`` integer triples within the checkpoint's vocab
+    table bounds. Bad rows are the CLIENT's mistake (bad_request), never
+    a silent out-of-bounds gather on device."""
+    if not isinstance(rows, (list, tuple)) or not rows:
+        raise ValueError(
+            "'contexts' must be a non-empty list of [start, path, end] "
+            "id triples"
+        )
+    mapped = []
+    for row in rows:
+        if not isinstance(row, (list, tuple)) or len(row) != 3:
+            raise ValueError(
+                f"each context must be a [start, path, end] triple, "
+                f"got {row!r}"
+            )
+        try:
+            s, p, e = (int(v) for v in row)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"context triple {row!r} is not integer-valued"
+            ) from None
+        if not (
+            0 <= s < n_terminals and 0 <= p < n_paths and 0 <= e < n_terminals
+        ):
+            raise ValueError(
+                f"context triple {row!r} is outside the vocab tables "
+                f"({n_terminals} terminals, {n_paths} paths)"
+            )
+        mapped.append((s, p, e))
+    return mapped
+
+
 INSTRUMENTED_OPS = (
     "predict", "embed", "embed_file", "neighbors", "health",
     "reload", "rollback", "swap_status",
@@ -391,8 +433,14 @@ class CodeServer:
     ) -> Callable[[], dict]:
         predictor, engine, batcher = gen.predictor, gen.engine, gen.batcher
         source = request.get("source")
-        if not isinstance(source, str) or not source.strip():
-            raise ValueError(f"{op!r} needs a non-empty 'source' string")
+        contexts_field = request.get("contexts")
+        if contexts_field is None and (
+            not isinstance(source, str) or not source.strip()
+        ):
+            raise ValueError(
+                f"{op!r} needs a non-empty 'source' string or a "
+                "'contexts' list of [start, path, end] id triples"
+            )
         if op == "predict" and not predictor.meta.get(
             "infer_method_name", True
         ):
@@ -410,10 +458,19 @@ class CodeServer:
         # extraction + vocab mapping on THIS thread (CPU-bound, no device):
         # the batcher only ever sees mapped id arrays
         submitted = []  # (label, n_oov, future | None, n_contexts)
-        for label, contexts, _ in predictor._extract(
-            source, method_name, language
-        ):
-            mapped, n_oov = predictor._map_contexts(contexts)
+        if contexts_field is not None:
+            # pre-mapped path-context bag: [[start, path, end], ...]
+            # vocab-id triples, one method. The form an indexing pipeline
+            # resends (it mapped the bag once, at index time) — extraction
+            # and vocab mapping are skipped entirely, and it is the form
+            # the fleet router's content-addressed result cache digests
+            # order-invariantly, so a permuted resend of the same bag is
+            # a cache hit
+            mapped = _validate_context_rows(
+                contexts_field,
+                int(predictor.meta["terminal_count"]),
+                int(predictor.meta["path_count"]),
+            )
             if len(mapped) > engine.max_width:
                 # same seeded subsample rule as the offline Predictor
                 rng = np.random.default_rng(0)
@@ -421,18 +478,43 @@ class CodeServer:
                     len(mapped), engine.max_width, replace=False
                 )
                 mapped = [mapped[i] for i in sorted(keep)]
-            if not mapped:
-                submitted.append((label, n_oov, None, 0))
-                continue
+            label = (
+                method_name
+                if isinstance(method_name, str) and method_name != "*"
+                else "<contexts>"
+            )
             arr = np.asarray(mapped, np.int32).reshape(-1, 3)
-            # the trace kwarg only when a context exists: untraced paths
-            # keep the 1-arg submit surface duck-typed batchers rely on
             future = (
                 batcher.submit(arr, trace=trace)
                 if trace is not None
                 else batcher.submit(arr)
             )
-            submitted.append((label, n_oov, future, len(mapped)))
+            submitted.append((label, 0, future, len(mapped)))
+        else:
+            for label, contexts, _ in predictor._extract(
+                source, method_name, language
+            ):
+                mapped, n_oov = predictor._map_contexts(contexts)
+                if len(mapped) > engine.max_width:
+                    # same seeded subsample rule as the offline Predictor
+                    rng = np.random.default_rng(0)
+                    keep = rng.choice(
+                        len(mapped), engine.max_width, replace=False
+                    )
+                    mapped = [mapped[i] for i in sorted(keep)]
+                if not mapped:
+                    submitted.append((label, n_oov, None, 0))
+                    continue
+                arr = np.asarray(mapped, np.int32).reshape(-1, 3)
+                # the trace kwarg only when a context exists: untraced
+                # paths keep the 1-arg submit surface duck-typed batchers
+                # rely on
+                future = (
+                    batcher.submit(arr, trace=trace)
+                    if trace is not None
+                    else batcher.submit(arr)
+                )
+                submitted.append((label, n_oov, future, len(mapped)))
 
         label_vocab = predictor.label_vocab
 

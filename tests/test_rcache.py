@@ -1,0 +1,502 @@
+"""Router-level content-addressed result cache (serve/fleet/cache.py).
+
+The load-bearing contracts pinned here:
+
+- the canonical key addresses CONTENT, not bytes: any permutation of the
+  same path-context bag digests identically (multisets — duplicates
+  count), op-relevant knobs fold in, correlation fields (``id``,
+  ``trace``) never do;
+- S3-FIFO keeps byte usage under capacity and one-hit wonders wash
+  through the probationary queue without displacing the hot set;
+- concurrent identical misses coalesce onto one leader (one device
+  call); error payloads resolve joiners but are never cached;
+- keys embed the fleet generation version: a committed rolling swap
+  invalidates instantly (misses recompute), the old generation's entries
+  stay RESIDENT, and ``rollback`` makes them valid again bitwise;
+- through the router: a cache hit never consumes SLO queue budget or
+  reaches a replica, and the whole lifecycle holds on a REAL 2-replica
+  subprocess fleet across reload + rollback (the CI rcache-smoke
+  scenario).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.obs.runtime import RuntimeHealth, prometheus_text
+from code2vec_tpu.serve.fleet.cache import (
+    ResultCache,
+    canonical_bag_digest,
+    canonical_request_key,
+    payload_nbytes,
+)
+
+from test_fleet import (  # noqa: F401 - trained_tiny is a fixture
+    PY,
+    FakeReplica,
+    make_router,
+    trained_tiny,
+)
+
+pytestmark = pytest.mark.rcache
+
+
+# ---------------------------------------------------------------------------
+# canonical keys: content addressing
+# ---------------------------------------------------------------------------
+
+
+def test_bag_digest_is_order_invariant_multiset():
+    bag = [[3, 7, 2], [1, 5, 9], [3, 7, 2]]
+    d = canonical_bag_digest(bag)
+    assert canonical_bag_digest(list(reversed(bag))) == d
+    assert canonical_bag_digest(tuple(map(tuple, bag))) == d
+    assert canonical_bag_digest(np.asarray(bag, dtype=np.int32)) == d
+    # a multiset, not a set: the duplicate row counts
+    assert canonical_bag_digest(bag[:2]) != d
+    # triples are ordered within a row (start/path/end are distinct roles)
+    assert canonical_bag_digest([[1, 2, 3]]) != canonical_bag_digest(
+        [[3, 2, 1]]
+    )
+
+
+def test_request_key_addresses_content_not_bytes():
+    base = {
+        "op": "embed",
+        "contexts": [[1, 2, 3], [4, 5, 6]],
+        "language": "python",
+    }
+    key = canonical_request_key(base)
+    assert key is not None
+    permuted = dict(base, contexts=[[4, 5, 6], [1, 2, 3]])
+    assert canonical_request_key(permuted) == key
+    # correlation fields are not content
+    assert canonical_request_key(
+        dict(base, id=42, trace={"trace_id": "deadbeef"})
+    ) == key
+    # a different bag is a different key; so is a different op
+    assert canonical_request_key(
+        dict(base, contexts=[[1, 2, 3]])
+    ) != key
+    assert canonical_request_key(dict(base, op="predict")) != key
+
+
+def test_request_key_folds_op_relevant_knobs():
+    base = {"op": "predict", "source": "def f(): pass"}
+    key = canonical_request_key(base)
+    assert canonical_request_key(dict(base, top_k=5)) != key
+    # conservative by construction: knob-absent and knob-at-default are
+    # DIFFERENT keys (redundant miss beats a wrong hit)
+    assert canonical_request_key(dict(base, top_k=10)) != key
+    # granularity matters for neighbors only — and neighbors-by-vector
+    # digests the wire floats
+    vec = {"op": "neighbors", "vector": [1.0, 2.5], "top_k": 3}
+    assert canonical_request_key(vec) is not None
+    assert canonical_request_key(
+        dict(vec, granularity="file")
+    ) != canonical_request_key(vec)
+    assert canonical_request_key(
+        dict(vec, vector=[2.5, 1.0])
+    ) != canonical_request_key(vec)
+
+
+def test_request_key_uncacheable_forms():
+    assert canonical_request_key({"op": "health"}) is None
+    assert canonical_request_key({"op": "reload", "model_path": "x"}) is None
+    assert canonical_request_key({"op": "nope", "source": "x"}) is None
+    assert canonical_request_key({"op": "embed"}) is None  # no body
+    assert canonical_request_key(
+        {"op": "embed", "contexts": [["a", "b"]]}
+    ) is None  # malformed rows
+    assert canonical_request_key(
+        {"op": "embed", "source": "x", "method_name": object()}
+    ) is None  # unserializable knob
+
+
+def test_payload_nbytes_is_wire_size():
+    assert payload_nbytes({"ok": True}) == len(b'{"ok":true}')
+    assert payload_nbytes({"x": object()}) is None
+
+
+# ---------------------------------------------------------------------------
+# S3-FIFO eviction, byte-accounted
+# ---------------------------------------------------------------------------
+
+
+def _fill(cache, key, value, nbytes):
+    state, _ = cache.begin(key)
+    assert state == "lead"
+    cache.fill(key, value, nbytes=nbytes)
+
+
+def test_s3_fifo_hot_entry_survives_one_hit_wonder_flood():
+    cache = ResultCache(1000)
+    hot = ("v0", "hot")
+    _fill(cache, hot, {"v": "hot"}, 80)
+    for i in range(50):
+        _fill(cache, ("v0", f"wonder{i}"), {"v": i}, 80)
+        if i % 3 == 0:  # keep the hot entry referenced
+            state, held = cache.begin(hot)
+            assert state == "hit", f"hot entry evicted at wonder {i}"
+            assert held == {"v": "hot"}
+    stats = cache.stats()
+    assert stats["bytes"] <= stats["capacity_bytes"]
+    assert stats["evictions"] > 0
+    state, _ = cache.begin(hot)
+    assert state == "hit"
+
+
+def test_s3_fifo_ghost_readmission_goes_to_main():
+    cache = ResultCache(1000)
+    victim = ("v0", "victim")
+    _fill(cache, victim, {"v": 0}, 80)
+    # flood until the never-re-referenced victim is evicted to ghost
+    i = 0
+    while victim in cache._entries:
+        _fill(cache, ("v0", f"k{i}"), {"v": i}, 80)
+        i += 1
+        assert i < 200, "victim never evicted"
+    assert victim in cache._ghost
+    # a ghost's return skips probation: straight into the main queue
+    _fill(cache, victim, {"v": 1}, 80)
+    assert cache._entries[victim].in_main is True
+
+
+def test_oversize_payload_rejected_not_cached():
+    cache = ResultCache(100)
+    key = ("v0", "big")
+    _fill(cache, key, {"v": "x" * 500}, 500)
+    stats = cache.stats()
+    assert stats["rejected_oversize"] == 1
+    assert stats["entries"] == 0 and stats["bytes"] == 0
+    state, _ = cache.begin(key)
+    assert state == "lead"  # next request retries cold
+
+
+# ---------------------------------------------------------------------------
+# miss coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_coalescing_joiners_inherit_leader_fill():
+    cache = ResultCache(1 << 16)
+    key = ("v0", "k")
+    state, leader = cache.begin(key)
+    assert state == "lead"
+    s2, held = cache.begin(key)
+    assert s2 == "join" and held is leader
+    cache.fill(key, {"ok": True})
+    assert held.result(1) == {"ok": True}
+    state, held = cache.begin(key)
+    assert state == "hit" and held == {"ok": True}
+    assert cache.stats()["coalesced"] == 1
+
+
+def test_coalescing_abandon_resolves_but_never_caches():
+    cache = ResultCache(1 << 16)
+    key = ("v0", "err")
+    _, leader = cache.begin(key)
+    _, held = cache.begin(key)
+    cache.abandon(key, {"error": "boom"})
+    assert held.result(1) == {"error": "boom"}  # joiners inherit verbatim
+    state, _ = cache.begin(key)
+    assert state == "lead"  # the next identical request retries cold
+    assert cache.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# versioned invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_version_lifecycle_commit_and_rollback_bitwise():
+    cache = ResultCache(1 << 16, version="m#g0")
+    req = {"op": "embed", "contexts": [[1, 2, 3]]}
+    key = cache.key_for(req)
+    assert key is not None and key[0] == "m#g0"
+    payload = {"ok": True, "vector": [0.125, 0.25]}
+    _fill(cache, key, payload, 64)
+
+    # mid-roll the fleet is mixed-version: the cache stands down entirely
+    cache.begin_swap()
+    assert cache.active_version is None
+    assert cache.key_for(req) is None
+    assert cache.stats()["swapping"] is True
+
+    # commit flips the visible version; old entries stay RESIDENT
+    cache.end_swap("m#g1")
+    key_v1 = cache.key_for(req)
+    assert key_v1 == ("m#g1", key[1])
+    state, _ = cache.begin(key_v1)
+    assert state == "lead"  # invalidated: recompute on the new weights
+    cache.abandon(key_v1, None)
+    assert cache.stats()["versions"].get("m#g0") == 1
+
+    # rollback: the retained entry is valid again, the SAME object
+    cache.set_version("m#g0")
+    state, held = cache.begin(cache.key_for(req))
+    assert state == "hit" and held is payload
+
+
+def test_failed_swap_keeps_incumbent_entries_live():
+    cache = ResultCache(1 << 16, version="m#g0")
+    key = cache.key_for({"op": "embed", "source": "x"})
+    _fill(cache, key, {"ok": True}, 16)
+    cache.begin_swap()
+    cache.end_swap()  # roll failed: incumbent never stopped being true
+    assert cache.active_version == "m#g0"
+    state, _ = cache.begin(cache.key_for({"op": "embed", "source": "x"}))
+    assert state == "hit"
+
+
+# ---------------------------------------------------------------------------
+# through the router (in-process fake replicas)
+# ---------------------------------------------------------------------------
+
+
+def _counting_behavior():
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def behavior(req):
+        op = req.get("op")
+        if op in ("embed", "predict", "neighbors"):
+            with lock:
+                calls["n"] += 1
+                return {"ok": True, "vector": [float(calls["n"])]}
+        if op == "reload":
+            return {"ok": True}
+        if op == "swap_status":
+            return {"swap": {"state": "idle", "last_swap": {
+                "outcome": "committed", "version": "m#g1"}}}
+        if op == "rollback":
+            return {"swap": {"active_version": "m#g0"}}
+        return {"ok": True, "op": op}
+
+    return behavior, calls
+
+
+def test_router_hit_skips_replica_and_queue_budget():
+    behavior, calls = _counting_behavior()
+    fake = FakeReplica(0, behavior=behavior)
+    health = RuntimeHealth()
+    router = make_router(
+        [fake], health=health,
+        result_cache=ResultCache(1 << 20, health=health),
+    )
+    try:
+        req = {"op": "embed", "source": PY, "language": "python",
+               "method_name": "add"}
+        first = router.handle(dict(req))
+        second = router.handle(dict(req))
+        assert first == second == {"ok": True, "vector": [1.0]}
+        assert calls["n"] == 1
+        data_ops = [r for r in fake.sent if r.get("op") == "embed"]
+        assert len(data_ops) == 1
+        counters = health.snapshot()["counters"]
+        assert counters["slo.embed.completed"] == 1
+        assert counters["slo.embed.cache_hits"] == 1
+        # the health/metrics surfaces carry the cache block
+        block = router.handle({"op": "health"})["fleet"]["cache"]
+        assert block["hits"] == 1 and block["entries"] == 1
+        text = prometheus_text([({}, health.snapshot())])
+        assert "c2v_cache_hits_total 1" in text
+        assert "c2v_cache_bytes" in text
+    finally:
+        router.close()
+
+
+def test_router_permuted_contexts_resend_hits():
+    behavior, calls = _counting_behavior()
+    fake = FakeReplica(0, behavior=behavior)
+    router = make_router(
+        [fake], result_cache=ResultCache(1 << 20),
+    )
+    try:
+        bag = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+        first = router.handle({"op": "embed", "contexts": bag})
+        second = router.handle(
+            {"op": "embed", "contexts": list(reversed(bag)), "id": 7}
+        )
+        assert second == {"id": 7, **first}
+        assert calls["n"] == 1
+    finally:
+        router.close()
+
+
+def test_router_coalesces_thundering_herd_to_one_dispatch():
+    behavior, calls = _counting_behavior()
+    fake = FakeReplica(0, latency_s=0.15, behavior=behavior)
+    router = make_router(
+        [fake], result_cache=ResultCache(1 << 20),
+    )
+    try:
+        req = {"op": "embed", "source": "def f(): pass"}
+        resolvers = [router.handle_async(dict(req)) for _ in range(6)]
+        payloads = [r() for r in resolvers]
+        assert all(p == {"ok": True, "vector": [1.0]} for p in payloads)
+        assert calls["n"] == 1
+        stats = router._cache.stats()
+        assert stats["coalesced"] == 5 and stats["misses"] == 1
+    finally:
+        router.close()
+
+
+def test_router_error_payloads_are_not_cached():
+    attempts = {"n": 0}
+
+    def flaky(req):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            return {"error": "transient backend failure"}
+        return {"ok": True, "vector": [1.0]}
+
+    fake = FakeReplica(0, behavior=flaky)
+    router = make_router([fake], result_cache=ResultCache(1 << 20))
+    try:
+        req = {"op": "embed", "source": "x"}
+        assert router.handle(dict(req)).get("error")
+        assert router.handle(dict(req)) == {"ok": True, "vector": [1.0]}
+        assert attempts["n"] == 2  # the error never served a second time
+        state, _ = router._cache.begin(router._cache.key_for(req))
+        assert state == "hit"  # ...but the success was cached
+    finally:
+        router.close()
+
+
+def test_router_without_cache_is_inert():
+    behavior, calls = _counting_behavior()
+    fake = FakeReplica(0, behavior=behavior)
+    router = make_router([fake])  # --result_cache_mb 0: no cache object
+    try:
+        for _ in range(5):
+            assert router.handle({"op": "embed", "source": "x"})["ok"]
+        assert calls["n"] == 5
+        assert router.handle({"op": "health"})["fleet"]["cache"] is None
+    finally:
+        router.close()
+
+
+def test_router_swap_flips_cache_version_and_rollback_restores():
+    behavior, calls = _counting_behavior()
+    fakes = [FakeReplica(0, behavior=behavior),
+             FakeReplica(1, behavior=behavior)]
+    for fake in fakes:
+        fake.last_health = {"version": "m#g0"}  # boot-time version seed
+    router = make_router(
+        fakes, result_cache=ResultCache(1 << 20),
+    )
+    try:
+        cache = router._cache
+        assert cache.active_version == "m#g0"
+        req = {"op": "embed", "source": PY, "language": "python"}
+        warm = router.handle(dict(req))
+        assert router.handle(dict(req)) == warm and calls["n"] == 1
+
+        rolled = router.handle(
+            {"op": "reload", "model_path": "out_v2", "wait": True}
+        )
+        assert rolled["ok"] and rolled["rolling"]["outcome"] == "committed"
+        assert cache.active_version == "m#g1"
+        # invalidated on commit: the resend recomputes on the new weights
+        assert router.handle(dict(req)) == {"ok": True, "vector": [2.0]}
+        assert calls["n"] == 2
+        # ...while the old generation's entry stays resident
+        assert cache.stats()["versions"].get("m#g0", 0) >= 1
+
+        back = router.handle({"op": "rollback"})
+        assert back["ok"], back
+        assert cache.active_version == "m#g0"
+        # revalidated bitwise: the EXACT pre-swap payload, no dispatch
+        assert router.handle(dict(req)) == warm
+        assert calls["n"] == 2
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# real 2-replica fleet e2e: the CI rcache-smoke scenario
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_result_cache_survives_rolling_swap_and_rollback(trained_tiny):
+    """Boot a REAL 2-replica fleet with the result cache on, warm it on
+    generation g0, roll to g1 (cache invalidates — misses recompute, g0
+    entries stay resident), then roll back and get the ORIGINAL payload
+    served bitwise from cache with zero device calls."""
+    from code2vec_tpu.serve.fleet.__main__ import build_parser, build_router
+
+    ds, out = trained_tiny
+    args = build_parser().parse_args([
+        "--replicas", "2",
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--deadline_ms", "2",
+        "--boot_timeout_s", "600",
+        "--result_cache_mb", "8",
+    ])
+    router, events = build_router(args)
+
+    def completed():
+        return router.health.snapshot()["counters"].get(
+            "slo.embed.completed", 0
+        )
+
+    try:
+        req = {"op": "embed", "source": PY, "language": "python",
+               "method_name": "add"}
+        warm = router.handle(dict(req))
+        assert warm.get("ok"), warm
+        n0 = completed()
+        hit = router.handle(dict(req))
+        assert hit == warm  # bitwise: the exact cached payload
+        assert completed() == n0  # no replica touched
+
+        # pre-mapped contexts: a permuted resend of the same bag hits
+        bag = [[0, 0, 0], [1, 1, 1]]
+        by_ctx = router.handle({"op": "embed", "contexts": bag})
+        assert by_ctx.get("ok"), by_ctx
+        n1 = completed()
+        permuted = router.handle(
+            {"op": "embed", "contexts": list(reversed(bag))}
+        )
+        assert permuted == by_ctx
+        assert completed() == n1
+
+        rolled = router.handle(
+            {"op": "reload", "model_path": str(out), "wait": True}
+        )
+        assert rolled["ok"], rolled
+        assert rolled["rolling"]["outcome"] == "committed"
+        block = router.handle({"op": "health"})["fleet"]["cache"]
+        assert block["active_version"].endswith("#g1")
+        assert any(v.endswith("#g0") for v in block["versions"])
+
+        # invalidated on commit: the same request is a miss (recomputes)
+        n2 = completed()
+        on_g1 = router.handle(dict(req))
+        assert on_g1.get("ok"), on_g1
+        assert completed() == n2 + 1
+
+        back = router.handle({"op": "rollback"})
+        assert back["ok"], back
+        block = router.handle({"op": "health"})["fleet"]["cache"]
+        assert block["active_version"].endswith("#g0")
+
+        # revalidated bitwise: g0's retained entry, zero device calls
+        n3 = completed()
+        restored = router.handle(dict(req))
+        assert restored == warm
+        assert completed() == n3
+        assert block["hits"] >= 2 and block["misses"] >= 2
+        for replica in router.handle({"op": "health"})["fleet"]["replicas"]:
+            assert replica["post_warmup_compiles"] == 0
+    finally:
+        router.close()
+        if events is not None:
+            events.close()
