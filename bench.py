@@ -2950,6 +2950,10 @@ def _serve_bench() -> None:
         "metrics_scrape": metrics_scrape,
         "flight": {"recorded": flight.count, "seen": flight.seen},
         "slo_burn": burn.snapshot()["serve"],
+        # device-time/MFU accounting: static costs x accumulated fenced
+        # device spans, the block tools/perf_report.py ratios against its
+        # committed baseline
+        "perf": engine.perf_summary(),
         "memory": memory_snapshot(),
         "zipf": (
             {"skew": zipf[0], "distinct_bags": zipf[1]}
@@ -2978,6 +2982,7 @@ def _serve_bench() -> None:
         "slo_burn_rate": detail["slo_burn"]["burn_rate"],
         "slo_budget_exhausted": detail["slo_burn"]["exhausted"],
         "flight_recorded": flight.count,
+        "mfu": (detail["perf"] or {}).get("mfu"),
         "backend": backend,
     }
     if cache_detail is not None:
@@ -3415,6 +3420,33 @@ def main() -> None:
 
     memory = memory_snapshot()
 
+    # headline perf block: analytic fwd+bwd FLOPs at the measured shape
+    # over the measured window — achieved FLOP/s and MFU against the
+    # per-device-kind peak table (obs/costs.py). The window includes host
+    # row-gen between dispatches, so this is a LOWER bound on device MFU.
+    from code2vec_tpu.obs import costs as obs_costs
+
+    device_kind = obs_costs.detect_device_kind()
+    peak = obs_costs.peak_flops(device_kind)
+    step_cost = obs_costs.train_step_cost(
+        obs_costs.analytic_forward_cost(
+            batch_size, bag,
+            terminal_embed=model_config.terminal_embed_size,
+            path_embed=model_config.path_embed_size,
+            encode=model_config.encode_size,
+            labels=model_config.padded(model_config.label_count),
+        )
+    )
+    achieved_flops = step_cost["flops"] * steps / elapsed / n_chips
+    perf = {
+        "device_kind": device_kind,
+        "peak_flops_per_s": peak,
+        "flops_per_step": step_cost["flops"],
+        "cost_source": step_cost["cost_source"],
+        "achieved_flops_per_s_per_chip": round(achieved_flops, 1),
+        "mfu": round(achieved_flops / peak, 9),
+    }
+
     # The driver captures the merged stdout/stderr stream and parses the LAST
     # JSON line into BENCH_rN.json's `parsed` field — so the detail line goes
     # first (stderr) and the headline metric is the final thing printed.
@@ -3464,6 +3496,7 @@ def main() -> None:
                         "host_pipeline": False,
                     },
                     "attribution": attribution,
+                    "perf": perf,
                     "memory": memory,
                 }
             }
@@ -3478,6 +3511,7 @@ def main() -> None:
                 "value": round(contexts_per_sec, 1),
                 "unit": "contexts/sec",
                 "vs_baseline": round(vs_baseline, 4),
+                "mfu": perf["mfu"],
                 "backend": backend,
             }
         ),

@@ -34,6 +34,8 @@ __all__ = [
     "NamespacedHealth",
     "RuntimeHealth",
     "RecompileDetector",
+    "build_info",
+    "build_info_text",
     "global_health",
     "host_cpu_fingerprint",
     "host_rss_bytes",
@@ -368,6 +370,52 @@ def prometheus_text(
         for labels, value in entry["samples"]:
             lines.append(f"{name}{_prom_label_str(labels)} {_prom_number(value)}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def build_info(extra: dict | None = None) -> dict:
+    """Build/runtime identity labels for the ``c2v_build_info`` gauge.
+
+    jax's version comes from package metadata (no import), so a jax-free
+    process — the fleet router — can report it without dragging in the
+    backend; ``backend``/``device_kind`` appear only when the caller's
+    process already initialized jax (workers, the train loop).
+    """
+    import platform
+
+    info = {"python_version": platform.python_version()}
+    try:
+        import code2vec_tpu
+
+        info["package_version"] = getattr(code2vec_tpu, "__version__", "unknown")
+    except Exception:  # pragma: no cover - package always importable in-tree
+        info["package_version"] = "unknown"
+    try:
+        from importlib import metadata as _im
+
+        info["jax_version"] = _im.version("jax")
+    except Exception:
+        info["jax_version"] = "absent"
+    import sys as _sys
+
+    jax = _sys.modules.get("jax")
+    if jax is not None:
+        try:
+            info["backend"] = str(jax.default_backend())
+            info["device_kind"] = str(jax.devices()[0].device_kind)
+        except Exception:  # pragma: no cover - backend init races
+            pass
+    if extra:
+        info.update({k: str(v) for k, v in extra.items()})
+    return info
+
+
+def build_info_text(extra: dict | None = None, prefix: str = "c2v_") -> str:
+    """The conventional Prometheus info-gauge: constant 1, identity in
+    labels. Prepend to an exposition body (workers and the router both
+    do) so every scrape carries version/backend provenance."""
+    name = prometheus_metric_name("build_info", prefix)
+    labels = _prom_label_str(build_info(extra))
+    return f"# TYPE {name} gauge\n{name}{labels} 1\n"
 
 
 _PROM_SAMPLE = re.compile(

@@ -295,6 +295,12 @@ class MicroBatcher:
             vectors = np.asarray(vectors)
             attention = np.asarray(attention)
             device_ms = (time.perf_counter() - t0) * 1e3
+        # perf accounting rides the span we already timed — O(1) counter
+        # math in the engine's accountant, guarded so duck-typed engines
+        # without the hook keep working
+        record_perf = getattr(engine, "record_device_time", None)
+        if record_perf is not None:
+            record_perf(batch, width, device_ms, requests=len(group))
         t_device_end = time.perf_counter()
         with tracer.span("serve_postprocess", category="serve", **span_trace):
             for i, pending in enumerate(group):

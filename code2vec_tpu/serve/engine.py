@@ -126,6 +126,8 @@ class ServingEngine:
         self._warmed = False  # True once the ladder's executables exist
         self.provenance: list[dict] = []
         self._jit = None
+        self._costs = None  # CostAccountant, created at first compile
+        self._n_labels = None  # label head width, derived lazily
 
         # per-engine tallies (the health counters are process-global and
         # would alias across engines); mirrored into the registry below
@@ -299,6 +301,7 @@ class ServingEngine:
                     "schedule": schedules.get((b, w), {}).get("schedule"),
                     "schedule_cached": schedules.get((b, w), {}).get("cached"),
                 }
+                record["cost"] = self._executable_cost(b, w)
                 self.provenance.append(record)
                 if self._events is not None:
                     self._events.emit("serve_executable", **record)
@@ -331,6 +334,63 @@ class ServingEngine:
                 "sizes do not cover the traffic", b, w,
             )
         return round((time.perf_counter() - t0) * 1e3, 3)
+
+    # ---- cost accounting ------------------------------------------------
+    def _label_width(self) -> int | None:
+        """Label-head width via ``jax.eval_shape`` on the jitted forward —
+        abstract evaluation only, no compile, no device work."""
+        if self._n_labels is None:
+            try:
+                import jax
+
+                struct = jax.ShapeDtypeStruct((1, 1), np.int32)
+                out = jax.eval_shape(
+                    self._forward_fn(), self._state, struct, struct, struct
+                )
+                self._n_labels = int(out[0].shape[-1])
+            except Exception:  # pragma: no cover - exotic head shapes
+                self._n_labels = 0
+        return self._n_labels or None
+
+    def _executable_cost(self, b: int, w: int) -> dict:
+        """Static cost record for one compiled shape (XLA ``cost_analysis``
+        with analytic fallback), registered with the accountant so later
+        device-time records fold into MFU."""
+        from code2vec_tpu.obs import costs as obs_costs
+
+        if self._costs is None:
+            self._costs = obs_costs.CostAccountant(
+                device_kind=obs_costs.detect_device_kind(),
+                health=self._health,
+            )
+        analytic = None
+        if self._model_dims is not None:
+            te, pe, enc = self._model_dims
+            labels = self._label_width()
+            if labels:
+                analytic = obs_costs.analytic_forward_cost(
+                    b, w,
+                    terminal_embed=te, path_embed=pe, encode=enc,
+                    labels=labels, table_dtype=self.table_dtype,
+                )
+        cost = obs_costs.executable_cost(self._compiled.get((b, w)), analytic)
+        self._costs.register((b, w), cost)
+        return cost
+
+    def record_device_time(
+        self, batch: int, width: int, device_ms: float, requests: int = 1
+    ) -> None:
+        """Fold one fenced device span into the perf accounting (called by
+        the batcher with its existing ``device_ms`` measurement — O(1),
+        no new timers or syncs on the hot path)."""
+        if self._costs is not None:
+            self._costs.record((batch, width), device_ms, requests=requests)
+
+    def perf_summary(self) -> dict | None:
+        """The perf block (device time, achieved FLOP/s, MFU, per-exec
+        breakdown) for health payloads and bench detail; None before the
+        first compile."""
+        return self._costs.snapshot() if self._costs is not None else None
 
     # ---- hot path -------------------------------------------------------
     def width_for(self, count: int) -> int:
@@ -369,6 +429,7 @@ class ServingEngine:
                     "schedule_cached": None,
                     "post_warmup": was_warmed,
                 }
+                record["cost"] = self._executable_cost(*key)
                 self.provenance.append(record)
                 if self._events is not None:
                     self._events.emit("serve_executable", **record)

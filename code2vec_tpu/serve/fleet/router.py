@@ -335,6 +335,9 @@ class FleetRouter:
         if op == "swap_status":
             payload = self._fleet_swap_status()
             return lambda: finish(payload)
+        if op == "flights":
+            payload = self._fleet_flights()
+            return lambda: finish(payload)
 
         # data plane: stamp (or honor) the request's trace context FIRST —
         # the same dict crosses the replica pipe, so the worker's spans
@@ -762,6 +765,9 @@ class FleetRouter:
                 "version": last.get("version"),
                 "post_warmup_compiles": last.get("post_warmup_compiles"),
                 "executables": last.get("executables"),
+                # device-time/MFU block (engine.perf_summary, cached by
+                # the prober) — the per-replica truth behind fleet.capacity
+                "perf": last.get("perf"),
             })
         return {
             "ok": all(r.get("alive") for r in replicas),
@@ -789,9 +795,40 @@ class FleetRouter:
                 "flight_recorded": (
                     self._flight.count if self._flight is not None else None
                 ),
+                # max-sustainable-QPS model from the replicas' perf blocks
+                # (device-ms/request × observed mix) — the autoscaling
+                # control signal; None until device time has been observed
+                "capacity": self._capacity_block(),
             },
             **self.health.snapshot(),
         }
+
+    def _capacity_block(self) -> dict | None:
+        """Fleet capacity from the prober's cached per-replica ``perf``
+        blocks — never crosses a pipe. Mirrored into router gauges so
+        /metrics carries ``c2v_fleet_capacity_qps`` alongside health."""
+        from code2vec_tpu.obs.costs import fleet_capacity
+
+        perfs = []
+        alive = 0
+        for handle in self._slots:
+            if handle is None or not handle.alive:
+                continue
+            alive += 1
+            last = handle.last_health
+            perfs.append(last.get("perf") if isinstance(last, dict) else None)
+        capacity = fleet_capacity(perfs, alive=alive)
+        if capacity is not None:
+            self.health.gauge("fleet.capacity_qps").set(
+                capacity["max_qps_fleet"]
+            )
+            self.health.gauge("fleet.capacity_qps_per_replica").set(
+                capacity["max_qps_per_replica"]
+            )
+            self.health.gauge("fleet.capacity_device_ms_per_request").set(
+                capacity["device_ms_per_request"]
+            )
+        return capacity
 
     def metrics_text(self) -> str:
         """Prometheus text exposition for ``GET /metrics`` on the router:
@@ -803,8 +840,11 @@ class FleetRouter:
         Each replica block carries its own ``started_unix`` /
         ``snapshot_seq``, so scrapers can detect counter resets across
         respawns."""
-        from code2vec_tpu.obs.runtime import prometheus_text
+        from code2vec_tpu.obs.runtime import build_info_text, prometheus_text
 
+        # refresh the capacity gauges from the cached perf blocks so a
+        # metrics-only consumer sees the same signal as /health
+        self._capacity_block()
         sources = [({}, self.health.snapshot())]
         for slot, handle in enumerate(self._slots):
             if handle is None:
@@ -829,7 +869,7 @@ class FleetRouter:
                     "replica_last_health_unix": captured_unix,
                 }
             sources.append(({"replica": f"r{slot}"}, snap))
-        return prometheus_text(sources)
+        return build_info_text({"role": "router"}) + prometheus_text(sources)
 
     def _rolling_status(self) -> dict:
         with self._swap_lock:
@@ -1043,6 +1083,41 @@ class FleetRouter:
         return {
             "ok": True,
             "rolling": self._rolling_status(),
+            "replicas": per_replica,
+        }
+
+    def _fleet_flights(self) -> dict:
+        """Live flight-recorder fan-out: the router's own captured
+        records plus each alive replica's, fetched over the control pipe
+        (same per-replica error isolation as ``swap_status``)."""
+        per_replica = []
+        for slot, handle in enumerate(self._slots):
+            if handle is None or not handle.alive:
+                per_replica.append({"slot": slot, "alive": False})
+                continue
+            try:
+                payload = handle.send({"op": "flights"}).result(
+                    self._probe_timeout_s
+                )
+                per_replica.append({
+                    "slot": slot,
+                    "recorded": payload.get("recorded"),
+                    "seen": payload.get("seen"),
+                    "flights": payload.get("flights") or [],
+                })
+            except Exception as exc:  # noqa: BLE001 - per-replica report
+                per_replica.append({"slot": slot, "error": str(exc)})
+        router_flights = (
+            self._flight.snapshot() if self._flight is not None else []
+        )
+        return {
+            "ok": True,
+            "router": {
+                "recorded": (
+                    self._flight.count if self._flight is not None else 0
+                ),
+                "flights": router_flights,
+            },
             "replicas": per_replica,
         }
 

@@ -99,6 +99,7 @@ _OP_CLASS = {
     "reload": "health",
     "rollback": "health",
     "shutdown": "health",
+    "flights": "health",
 }
 
 

@@ -120,7 +120,7 @@ def _validate_context_rows(
 
 INSTRUMENTED_OPS = (
     "predict", "embed", "embed_file", "neighbors", "health",
-    "reload", "rollback", "swap_status",
+    "reload", "rollback", "swap_status", "flights",
 )
 
 
@@ -285,6 +285,9 @@ class CodeServer:
             elif op == "swap_status":
                 status = self.swap.status()
                 resolver = lambda: {"ok": True, "swap": status}  # noqa: E731
+            elif op == "flights":
+                payload = self._flights_payload()
+                resolver = lambda: payload  # noqa: E731
             else:
                 payload = {
                     "error": f"unknown op {op!r}",
@@ -394,11 +397,30 @@ class CodeServer:
         """Prometheus text exposition (0.0.4) of the health registry —
         what ``GET /metrics`` serves. A lock-light snapshot serialize:
         never touches the engine, the batcher queue, or device state."""
-        from code2vec_tpu.obs.runtime import prometheus_text
+        from code2vec_tpu.obs.runtime import build_info_text, prometheus_text
 
-        return prometheus_text([({}, self.health.snapshot())])
+        return build_info_text() + prometheus_text(
+            [({}, self.health.snapshot())]
+        )
 
     # ---- ops ------------------------------------------------------------
+    def _flights_payload(self) -> dict:
+        """Live flight-recorder contents — the mid-incident view the
+        exit-time ``flight_*.json`` dumps cannot give. JSON-sanitized so
+        numpy scalars inside captured span breakdowns survive the wire."""
+        from code2vec_tpu.obs.events import sanitize
+
+        flight = self.flight
+        if flight is None:
+            return {"ok": True, "recorded": 0, "seen": 0, "flights": []}
+        return {
+            "ok": True,
+            "recorded": flight.count,
+            "seen": flight.seen,
+            "threshold_ms": flight.threshold_ms,
+            "flights": [sanitize(r) for r in flight.snapshot()],
+        }
+
     def _health_payload(self) -> dict:
         gen = self.swap.active
         engine = gen.engine
@@ -423,6 +445,14 @@ class CodeServer:
             # captured per-request timeline (None = recorder not wired)
             "flight_recorded": (
                 self.flight.count if self.flight is not None else None
+            ),
+            # static costs × accumulated device time: per-executable
+            # device-ms, achieved FLOP/s, MFU — what the router's capacity
+            # model reads off each replica (guarded: duck-typed engines)
+            "perf": (
+                engine.perf_summary()
+                if hasattr(engine, "perf_summary")
+                else None
             ),
             **self.health.snapshot(),
         }

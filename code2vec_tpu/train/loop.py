@@ -383,6 +383,34 @@ def class_weights_from(config: TrainConfig, data: CorpusData) -> jnp.ndarray:
 
 
 
+def _manifest_costs(config, model_config, bucket_ladder) -> dict:
+    """Static cost block for the run manifest: one analytic fwd+bwd
+    record per train-step variant (ladder rung at the configured batch
+    size), plus the device peak MFU is measured against."""
+    from code2vec_tpu.obs import costs as obs_costs
+
+    kind = obs_costs.detect_device_kind()
+    widths = (
+        list(bucket_ladder) if bucket_ladder else [config.max_path_length]
+    )
+    per_width = {}
+    for width in widths:
+        fwd = obs_costs.analytic_forward_cost(
+            config.batch_size, width,
+            terminal_embed=model_config.terminal_embed_size,
+            path_embed=model_config.path_embed_size,
+            encode=model_config.encode_size,
+            labels=model_config.padded(model_config.label_count),
+        )
+        per_width[str(width)] = obs_costs.train_step_cost(fwd)
+    return {
+        "device_kind": kind,
+        "peak_flops_per_s": obs_costs.peak_flops(kind),
+        "cost_source": "analytic",
+        "train_step": per_width,
+    }
+
+
 def _train_pass(
     config: TrainConfig,
     state,
@@ -394,6 +422,7 @@ def _train_pass(
     epoch: int | None = None,
     step_hook: _EpochCursorHook | None = None,
     loss_offset: float = 0.0,
+    step_flops=None,
 ):
     """One epoch of train steps over the host pipeline; returns
     ``(state, train_loss)``.
@@ -444,8 +473,19 @@ def _train_pass(
                         # async dispatch would otherwise hide
                         jax.block_until_ready(loss)
                 if sampled:
+                    # the analytic step cost at this batch's exact shape —
+                    # host-side arithmetic on a sampled step only, feeding
+                    # the profiler's mfu column
+                    flops = (
+                        step_flops(
+                            int(host_batch["paths"].shape[0]),
+                            int(host_batch["paths"].shape[1]),
+                        )
+                        if step_flops is not None
+                        else None
+                    )
                     profiler.record_compute(
-                        step, (time.perf_counter() - t0) * 1e3
+                        step, (time.perf_counter() - t0) * 1e3, flops=flops
                     )
                 losses.append(loss)
                 if step >= _LOSS_SYNC_WINDOW:
@@ -730,6 +770,10 @@ def train(
                 "label_vocab": len(data.label_vocab),
                 "shard": data.shard,
             },
+            # static cost model for this run's step variants: analytic
+            # fwd+bwd FLOPs per ladder rung at the configured batch size,
+            # and the peak the mfu column is measured against
+            costs=_manifest_costs(config, model_config, bucket_ladder),
         )
     if mesh is not None:
         from code2vec_tpu.parallel.shardings import shard_state
@@ -1165,6 +1209,7 @@ def train(
     # dispatch whole chunks, so the per-step host/H2D/compute split does
     # not apply there
     profiler = None
+    step_flops = None
     if config.profile_steps > 0:
         if use_device_epoch:
             logger.warning(
@@ -1174,6 +1219,23 @@ def train(
             )
         else:
             profiler = StepProfiler(config.profile_steps)
+            # MFU on the sampled steps: analytic fwd+bwd FLOPs at each
+            # sampled batch's exact shape over the per-device-kind peak
+            from code2vec_tpu.obs import costs as obs_costs
+
+            profiler.peak_flops = obs_costs.peak_flops(
+                obs_costs.detect_device_kind()
+            )
+
+            def step_flops(batch, width, _mc=model_config):
+                fwd = obs_costs.analytic_forward_cost(
+                    batch, width,
+                    terminal_embed=_mc.terminal_embed_size,
+                    path_embed=_mc.path_embed_size,
+                    encode=_mc.encode_size,
+                    labels=_mc.padded(_mc.label_count),
+                )
+                return obs_costs.train_step_cost(fwd)["flops"]
 
     if config.checkpoint_every_steps:
         if sharded_feed:
@@ -1466,6 +1528,7 @@ def train(
                     config, state, train_step, train_batches, to_device,
                     profiler, tracer=tracer, epoch=epoch,
                     step_hook=step_hook, loss_offset=loss_offset,
+                    step_flops=step_flops,
                 )
                 # pad accounting comes from the source — exact corpus
                 # geometry for the in-RAM/mmap variants, stream-tallied
@@ -1530,13 +1593,18 @@ def train(
                     logger.info(
                         "step-time attribution (%d sampled train steps, "
                         "stride %d): host_build %.2f ms | h2d %.2f ms | "
-                        "feed_wait %.2f ms | compute %.2f ms",
+                        "feed_wait %.2f ms | compute %.2f ms%s",
                         attribution["profiled_steps"],
                         profiler.stride,
                         attribution["host_build_ms"],
                         attribution["h2d_ms"],
                         attribution["feed_wait_ms"],
                         attribution["compute_ms"],
+                        (
+                            " | mfu %.4f" % attribution["mfu"]
+                            if "mfu" in attribution
+                            else ""
+                        ),
                     )
                 for rec in profiler.per_step():
                     events.emit("step_sample", epoch=epoch, **rec)

@@ -96,12 +96,15 @@ class StepProfiler:
     plain dicts under the GIL suffice.
     """
 
-    def __init__(self, sample_steps: int = 0):
+    def __init__(self, sample_steps: int = 0, peak_flops: float | None = None):
         self.sample_steps = int(sample_steps)
         self.stride = 1
         self._next_stride = 1
         self._host: dict[int, tuple[float, float, float]] = {}
-        self._compute: dict[int, float] = {}
+        self._compute: dict[int, tuple[float, float | None]] = {}
+        # per-device peak FLOP/s (obs.costs.peak_flops); with it set and
+        # per-step flops recorded, sampled steps gain an mfu column
+        self.peak_flops = peak_flops
 
     def sampled(self, step: int) -> bool:
         """Whether ``step`` gets block_until_ready fencing."""
@@ -130,23 +133,30 @@ class StepProfiler:
         pool keeps the consumer fed."""
         self._host[step] = (host_build_ms, h2d_ms, feed_wait_ms)
 
-    def record_compute(self, step: int, compute_ms: float) -> None:
-        self._compute[step] = compute_ms
+    def record_compute(
+        self, step: int, compute_ms: float, flops: float | None = None
+    ) -> None:
+        """``flops``: the step's analytic FLOP cost (fwd+bwd), when the
+        loop knows the batch shape — enables the mfu column."""
+        self._compute[step] = (compute_ms, flops)
 
     def per_step(self) -> list[dict[str, float]]:
         """Attribution dicts for the fenced steps, in step order."""
         out = []
         for step in sorted(self._compute):
             build, h2d, feed_wait = self._host.get(step, (0.0, 0.0, 0.0))
-            out.append(
-                {
-                    "step": step,
-                    "host_build_ms": round(build, 3),
-                    "h2d_ms": round(h2d, 3),
-                    "feed_wait_ms": round(feed_wait, 3),
-                    "compute_ms": round(self._compute[step], 3),
-                }
-            )
+            compute_ms, flops = self._compute[step]
+            rec = {
+                "step": step,
+                "host_build_ms": round(build, 3),
+                "h2d_ms": round(h2d, 3),
+                "feed_wait_ms": round(feed_wait, 3),
+                "compute_ms": round(compute_ms, 3),
+            }
+            if flops and self.peak_flops and compute_ms > 0:
+                achieved = flops / (compute_ms / 1e3)
+                rec["mfu"] = round(achieved / self.peak_flops, 9)
+            out.append(rec)
         return out
 
     def summary(self) -> dict[str, float] | None:
@@ -155,13 +165,17 @@ class StepProfiler:
         if not steps:
             return None
         n = len(steps)
-        return {
+        out = {
             "host_build_ms": round(sum(s["host_build_ms"] for s in steps) / n, 3),
             "h2d_ms": round(sum(s["h2d_ms"] for s in steps) / n, 3),
             "feed_wait_ms": round(sum(s["feed_wait_ms"] for s in steps) / n, 3),
             "compute_ms": round(sum(s["compute_ms"] for s in steps) / n, 3),
             "profiled_steps": n,
         }
+        with_mfu = [s["mfu"] for s in steps if "mfu" in s]
+        if with_mfu:
+            out["mfu"] = round(sum(with_mfu) / len(with_mfu), 9)
+        return out
 
     def reset(self) -> None:
         self._host.clear()

@@ -428,6 +428,7 @@ class TestMeshKnobSmoke:
     (BENCH_ATTN_IMPL). Subprocess: bench must force its own platform/mesh
     from env, as the driver invokes it."""
 
+    @pytest.mark.slow
     def test_ctx_axis_and_streaming_attn(self):
         env = dict(
             # scrub ambient BENCH_* knobs: an outer BENCH_MODEL_AXIS (or a

@@ -840,6 +840,74 @@ def test_fleet_trace_stitch_metrics_and_burn_end_to_end(
             }
             assert {"r0", "r1"} <= start_labels
 
+            # ---- perf accounting (PR 17): every replica that served
+            # traffic exports MFU from its compiled-cost + device-time
+            # accountant, and the invariant achieved <= peak holds
+            mfu_rows = {
+                row["labels"].get("replica"): row["value"]
+                for row in parsed["c2v_perf_mfu"]
+            }
+            assert {"r0", "r1"} <= set(mfu_rows), mfu_rows
+            for replica, mfu in mfu_rows.items():
+                assert 0.0 < mfu <= 1.0, (replica, mfu)
+            peak_rows = {
+                row["labels"].get("replica"): row["value"]
+                for row in parsed["c2v_perf_peak_flops_per_s"]
+            }
+            for replica in ("r0", "r1"):
+                achieved = [
+                    row["value"]
+                    for row in parsed["c2v_perf_achieved_flops_per_s"]
+                    if row["labels"].get("replica") == replica
+                ]
+                assert achieved and achieved[0] <= peak_rows[replica]
+            # build-info gauge on the router exposition (role=router),
+            # jax-version label present without dragging jax into the
+            # router process
+            assert parsed["# types"]["c2v_build_info"] == "gauge"
+            build_rows = parsed["c2v_build_info"]
+            assert any(
+                row["labels"].get("role") == "router" for row in build_rows
+            )
+            assert all(
+                row["labels"].get("jax_version") for row in build_rows
+            )
+
+            # ---- fleet capacity block: per-rung device-ms/request rolled
+            # into the max-QPS headroom signal (ROADMAP item 3)
+            health_payload = router.handle({"op": "health"})
+            capacity = health_payload["fleet"]["capacity"]
+            assert capacity is not None, health_payload["fleet"]
+            assert capacity["alive_replicas"] == 2
+            assert capacity["requests_observed"] >= n_requests
+            assert capacity["max_qps_fleet"] > 0
+            assert capacity["max_qps_fleet"] == pytest.approx(
+                capacity["max_qps_per_replica"] * 2, rel=1e-4
+            )
+            assert capacity["per_rung"], capacity
+            for rung in capacity["per_rung"]:
+                assert rung["device_ms_per_request"] > 0
+                assert 0.0 < rung["share"] <= 1.0
+            # replica health carries the full perf block the capacity
+            # figure was derived from
+            for replica_row in health_payload["fleet"]["replicas"]:
+                perf = replica_row["perf"]
+                assert perf["device_calls"] > 0
+                assert perf["mfu"] == mfu_rows[f"r{replica_row['slot']}"]
+
+            # ---- flights control op: live per-request breakdowns from
+            # every replica plus the router's own recorder, no dump needed
+            flights_payload = router.handle({"op": "flights"})
+            assert flights_payload["ok"] is True
+            assert len(flights_payload["replicas"]) == 2
+            live_flights = [
+                f for row in flights_payload["replicas"]
+                for f in row.get("flights", [])
+            ]
+            assert live_flights, flights_payload["replicas"]
+            assert all("device_ms" in f for f in live_flights)
+            json.dumps(flights_payload)  # wire-safe end to end
+
             # ---- burn accounting: a clean burst leaves the budget alone
             burn = health_payload["fleet"]["slo_burn"]["embed"]
             assert burn["good"] == n_requests and burn["bad"] == 0

@@ -1,0 +1,685 @@
+"""Device-time/MFU/capacity accounting + perf-regression sentinel (PR 17).
+
+Covers obs/costs.py (static cost extraction with its full degradation
+matrix, the CostAccountant's O(1) dynamic accounting, fleet capacity
+math), the serve integration (provenance cost records on a REAL compiled
+ladder, XLA-vs-analytic FLOP agreement, batcher-fed device time), the
+StepProfiler mfu column, the build_info gauge, the live ``flights`` op,
+``trace_stitch --trace-id``, and ``tools/perf_report.py``'s exit codes
+(clean run -> 0, injected regression -> nonzero).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.obs import costs as obs_costs
+from code2vec_tpu.obs.costs import (
+    CostAccountant,
+    analytic_forward_cost,
+    executable_cost,
+    extract_cost,
+    fleet_capacity,
+    peak_flops,
+    train_step_cost,
+)
+from code2vec_tpu.obs.runtime import (
+    RuntimeHealth,
+    build_info,
+    build_info_text,
+    parse_prometheus_text,
+)
+
+pytestmark = pytest.mark.perfobs
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import perf_report  # noqa: E402
+import trace_stitch  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# static cost model: extraction + degradation matrix
+
+
+class TestExtractCost:
+    def test_none_returns_none(self):
+        assert extract_cost(None) is None
+
+    def test_missing_flops_key_returns_none(self):
+        assert extract_cost({"bytes accessed": 100.0}) is None
+        assert extract_cost([{"transcendentals": 3.0}]) is None
+
+    def test_empty_containers_return_none(self):
+        assert extract_cost([]) is None
+        assert extract_cost({}) is None
+        assert extract_cost("not a dict") is None
+
+    def test_bare_dict(self):
+        got = extract_cost({"flops": 100.0, "bytes accessed": 400.0})
+        assert got == {"flops": 100.0, "bytes_accessed": 400.0}
+
+    def test_cpu_style_list_of_one_dict(self):
+        # what jax CPU actually returns: a list holding one properties dict
+        got = extract_cost([{"flops": 88035.0, "bytes accessed": 280876.0,
+                             "transcendentals": 128.0}])
+        assert got["flops"] == 88035.0
+        assert got["bytes_accessed"] == 280876.0
+
+    def test_per_primitive_dicts_are_summed(self):
+        got = extract_cost([
+            {"flops": 60.0, "bytes accessed": 10.0},
+            {"flops": 40.0},
+            {"not_a_cost": 1.0},
+        ])
+        assert got["flops"] == 100.0
+        assert got["bytes_accessed"] == 10.0
+
+    def test_garbage_values_rejected(self):
+        assert extract_cost({"flops": float("nan")}) is None
+        assert extract_cost({"flops": -1.0}) is None
+        assert extract_cost({"flops": "huge"}) is None
+        assert extract_cost({"flops": float("inf")}) is None
+
+
+class _Compiled:
+    """Fake compiled executable with a configurable cost_analysis()."""
+
+    def __init__(self, result=None, raises=False):
+        self._result = result
+        self._raises = raises
+
+    def cost_analysis(self):
+        if self._raises:
+            raise NotImplementedError("backend has no cost model")
+        return self._result
+
+
+ANALYTIC = analytic_forward_cost(
+    8, 32, terminal_embed=16, path_embed=16, encode=24, labels=100
+)
+
+
+class TestExecutableCost:
+    def test_xla_source_when_backend_reports(self):
+        got = executable_cost(
+            _Compiled([{"flops": 704280.0, "bytes accessed": 1000.0}]),
+            ANALYTIC,
+        )
+        assert got["cost_source"] == "xla"
+        assert got["flops"] == 704280.0
+        assert got["arithmetic_intensity"] == pytest.approx(704.28)
+
+    def test_analytic_fallback_when_backend_returns_none(self):
+        got = executable_cost(_Compiled(None), ANALYTIC)
+        assert got["cost_source"] == "analytic"
+        assert got["flops"] == ANALYTIC["flops"]
+
+    def test_analytic_fallback_when_backend_raises(self):
+        got = executable_cost(_Compiled(raises=True), ANALYTIC)
+        assert got["cost_source"] == "analytic"
+
+    def test_analytic_fallback_on_missing_keys(self):
+        got = executable_cost(_Compiled([{"transcendentals": 5.0}]), ANALYTIC)
+        assert got["cost_source"] == "analytic"
+
+    def test_no_compiled_no_analytic_is_explicitly_unknown(self):
+        got = executable_cost(None, None)
+        assert got == {"flops": None, "bytes_accessed": None,
+                       "arithmetic_intensity": None, "cost_source": None}
+
+    def test_object_without_cost_analysis_degrades(self):
+        got = executable_cost(object(), ANALYTIC)
+        assert got["cost_source"] == "analytic"
+
+    def test_xla_flops_with_analytic_bytes_backfill(self):
+        got = executable_cost(_Compiled({"flops": 500.0}), ANALYTIC)
+        assert got["cost_source"] == "xla"
+        assert got["flops"] == 500.0
+        assert got["bytes_accessed"] == ANALYTIC["bytes_accessed"]
+
+
+def test_train_step_cost_is_three_forwards():
+    step = train_step_cost(ANALYTIC)
+    assert step["flops"] == pytest.approx(3.0 * ANALYTIC["flops"])
+    assert step["cost_source"] == "analytic"
+
+
+class TestPeakFlops:
+    def test_known_kinds(self):
+        assert peak_flops("TPU v4") == 275e12
+        assert peak_flops("NVIDIA A100-SXM4-80GB") == 312e12
+        assert peak_flops("TPU v5 lite") == 197e12  # v5e before v5
+
+    def test_unknown_kind_uses_cpu_formula(self):
+        expected = 256e9 * (os.cpu_count() or 1)
+        assert peak_flops("cpu") == expected
+        assert peak_flops(None) == expected
+
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("C2V_PEAK_FLOPS", "123456.0")
+        assert peak_flops("TPU v4") == 123456.0
+        monkeypatch.setenv("C2V_PEAK_FLOPS", "not a number")
+        assert peak_flops("TPU v4") == 275e12
+
+
+# ---------------------------------------------------------------------------
+# dynamic accounting
+
+
+class TestCostAccountant:
+    def test_record_accumulates_and_derives_mfu(self):
+        health = RuntimeHealth()
+        acct = CostAccountant("cpu", peak=1e9, health=health)
+        acct.register((8, 32), {"flops": 1e6, "bytes_accessed": 2e6,
+                                "arithmetic_intensity": 0.5,
+                                "cost_source": "xla"})
+        acct.record((8, 32), device_ms=10.0, requests=8)
+        acct.record((8, 32), device_ms=10.0, requests=8)
+        snap = acct.snapshot()
+        assert snap["device_ms"] == 20.0
+        assert snap["device_calls"] == 2
+        assert snap["requests"] == 16
+        # 2 calls x 1e6 flops over 20ms of device time = 1e8 FLOP/s
+        assert snap["achieved_flops_per_s"] == pytest.approx(1e8)
+        assert snap["mfu"] == pytest.approx(0.1)
+        exec_rec = snap["per_executable"]["b8w32"]
+        assert exec_rec["cost_source"] == "xla"
+        assert exec_rec["device_ms_per_request"] == pytest.approx(1.25)
+        assert exec_rec["mfu"] == pytest.approx(0.1)
+        gauges = health.snapshot()["gauges"]
+        assert gauges["perf.mfu"] == pytest.approx(0.1)
+        assert gauges["perf.peak_flops_per_s"] == 1e9
+        assert gauges["perf.device_ms_total"] == 20.0
+        assert 0.0 < gauges["perf.busy_fraction"] <= 1.0
+
+    def test_unregistered_key_gets_time_but_no_flops(self):
+        acct = CostAccountant("cpu", peak=1e9)
+        acct.record((1, 8), device_ms=5.0)
+        snap = acct.snapshot()
+        assert snap["per_executable"]["b1w8"]["device_ms"] == 5.0
+        assert snap["mfu"] is None  # no static cost -> no MFU claim
+
+    def test_busy_fraction_and_mfu_bounded(self):
+        # a fake clock that advances slower than recorded device time
+        # would push busy over 1 — it must clamp
+        t = [0.0]
+        acct = CostAccountant("cpu", peak=1e9, clock=lambda: t[0])
+        acct.register("k", {"flops": 10.0, "cost_source": "analytic"})
+        t[0] = 0.001
+        acct.record("k", device_ms=5.0)
+        snap = acct.snapshot()
+        assert snap["busy_fraction"] == 1.0
+
+    def test_negative_device_ms_ignored(self):
+        acct = CostAccountant("cpu")
+        acct.record("k", device_ms=-1.0)
+        assert acct.snapshot()["device_calls"] == 0
+
+
+class TestFleetCapacity:
+    def test_none_without_data(self):
+        assert fleet_capacity([]) is None
+        assert fleet_capacity([None, {}]) is None
+        assert fleet_capacity([{"per_executable": {
+            "b1w8": {"requests": 0, "device_ms": 0.0}}}]) is None
+
+    def test_single_rung_math(self):
+        perf = {"per_executable": {
+            "b1w8": {"requests": 100, "device_ms": 200.0}}}
+        cap = fleet_capacity([perf, perf])
+        # 2ms/request -> 500 qps/replica, 2 alive -> 1000 fleet
+        assert cap["alive_replicas"] == 2
+        assert cap["device_ms_per_request"] == pytest.approx(2.0)
+        assert cap["max_qps_per_replica"] == pytest.approx(500.0)
+        assert cap["max_qps_fleet"] == pytest.approx(1000.0)
+        (rung,) = cap["per_rung"]
+        assert rung["rung"] == "b1w8"
+        assert rung["share"] == 1.0
+
+    def test_mix_weighted_harmonic(self):
+        perf = {"per_executable": {
+            # 75% of traffic at 1ms/req, 25% at 3ms/req
+            "b1w8": {"requests": 75, "device_ms": 75.0},
+            "b8w32": {"requests": 25, "device_ms": 75.0},
+        }}
+        cap = fleet_capacity([perf])
+        # weighted: 0.75*1ms + 0.25*3ms = 1.5ms -> 666.67 qps
+        assert cap["device_ms_per_request"] == pytest.approx(1.5)
+        assert cap["max_qps_per_replica"] == pytest.approx(666.67, rel=1e-3)
+        assert cap["max_qps_fleet"] == cap["max_qps_per_replica"]
+
+    def test_dead_replicas_reduce_fleet_bound(self):
+        perf = {"per_executable": {
+            "b1w8": {"requests": 10, "device_ms": 10.0}}}
+        cap = fleet_capacity([perf], alive=3)
+        assert cap["max_qps_fleet"] == pytest.approx(3 * 1000.0)
+
+    def test_garbage_entries_skipped(self):
+        cap = fleet_capacity([{"per_executable": {
+            "bad": {"requests": "x", "device_ms": "y"},
+            "ok": {"requests": 4, "device_ms": 8.0},
+        }}])
+        assert cap["requests_observed"] == 4
+
+
+# ---------------------------------------------------------------------------
+# serve integration: a REAL compiled ladder
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax
+
+    from code2vec_tpu.models.code2vec import Code2VecConfig
+    from code2vec_tpu.serve.engine import ServingEngine
+    from code2vec_tpu.train.config import TrainConfig
+    from code2vec_tpu.train.step import create_train_state
+
+    bag, embed, encode, labels = 16, 16, 24, 100
+    config = TrainConfig(batch_size=4, max_path_length=bag)
+    model_config = Code2VecConfig(
+        terminal_count=200, path_count=200, label_count=labels,
+        terminal_embed_size=embed, path_embed_size=embed,
+        encode_size=encode, dropout_prob=0.0,
+    )
+    example = {
+        "starts": np.zeros((1, bag), np.int32),
+        "paths": np.zeros((1, bag), np.int32),
+        "ends": np.zeros((1, bag), np.int32),
+        "labels": np.zeros(1, np.int32),
+        "example_mask": np.ones(1, np.float32),
+    }
+    state = create_train_state(
+        config, model_config, jax.random.PRNGKey(0), example
+    )
+    health = RuntimeHealth()
+    engine = ServingEngine(
+        state, max_width=bag, model_dims=(embed, embed, encode),
+        ladder=(8, 16), batch_sizes=(1, 4), health=health,
+    )
+    engine.prepare()
+    return engine, health, model_config
+
+
+class TestEngineCosts:
+    def test_provenance_carries_cost_records(self, tiny_engine):
+        engine, _, _ = tiny_engine
+        assert engine.provenance  # (1,8),(4,8),(1,16),(4,16)
+        for record in engine.provenance:
+            cost = record["cost"]
+            assert cost["cost_source"] in ("xla", "analytic")
+            assert cost["flops"] > 0
+            assert cost["arithmetic_intensity"] is None or (
+                cost["arithmetic_intensity"] > 0
+            )
+
+    def test_xla_agrees_with_analytic_within_10pct(self, tiny_engine):
+        # the tentpole acceptance bound, on a REAL compiled shape
+        engine, _, mc = tiny_engine
+        xla_seen = 0
+        for record in engine.provenance:
+            cost = record["cost"]
+            if cost["cost_source"] != "xla":
+                continue
+            xla_seen += 1
+            analytic = analytic_forward_cost(
+                record["batch"], record["width"],
+                terminal_embed=mc.terminal_embed_size,
+                path_embed=mc.path_embed_size,
+                encode=mc.encode_size,
+                labels=mc.padded(mc.label_count),
+            )
+            assert cost["flops"] == pytest.approx(
+                analytic["flops"], rel=0.10
+            ), f"shape ({record['batch']}, {record['width']})"
+        # CPU implements cost_analysis(); if this ever stops holding the
+        # analytic fallback takes over and this test should be revisited
+        assert xla_seen >= 1
+
+    def test_device_time_folds_into_perf_summary(self, tiny_engine):
+        engine, health, _ = tiny_engine
+        before = (engine.perf_summary() or {}).get("device_calls", 0)
+        starts = np.zeros((1, 8), np.int32)
+        engine.run(starts, starts, starts)
+        engine.record_device_time(1, 8, 2.5, requests=1)
+        perf = engine.perf_summary()
+        assert perf["device_calls"] == before + 1
+        assert perf["per_executable"]["b1w8"]["device_ms"] >= 2.5
+        # the acceptance invariant: achieved never exceeds peak
+        assert perf["achieved_flops_per_s"] <= perf["peak_flops_per_s"]
+        assert 0.0 < perf["mfu"] <= 1.0
+        gauges = health.snapshot()["gauges"]
+        assert gauges["perf.mfu"] == perf["mfu"]
+
+    def test_batcher_feeds_device_time(self, tiny_engine):
+        from code2vec_tpu.serve.batcher import MicroBatcher
+
+        engine, _, _ = tiny_engine
+        before = engine.perf_summary()["device_calls"]
+        batcher = MicroBatcher(engine, deadline_ms=0.0)
+        try:
+            contexts = np.ones((5, 3), np.int32)
+            batcher.submit(contexts).result(timeout=30.0)
+        finally:
+            batcher.close()
+        perf = engine.perf_summary()
+        assert perf["device_calls"] > before
+        assert perf["per_executable"]["b1w8"]["requests"] >= 1
+
+    def test_shape_miss_compile_also_gets_cost(self, tiny_engine):
+        engine, _, _ = tiny_engine
+        starts = np.zeros((2, 8), np.int32)  # batch 2 not in (1, 4)
+        engine.run(starts, starts, starts)
+        record = engine.provenance[-1]
+        assert (record["batch"], record["width"]) == (2, 8)
+        assert record["cost"]["cost_source"] in ("xla", "analytic")
+
+
+# ---------------------------------------------------------------------------
+# StepProfiler mfu column
+
+
+class TestStepProfilerMfu:
+    def test_mfu_column_when_flops_known(self):
+        from code2vec_tpu.train.prefetch import StepProfiler
+
+        prof = StepProfiler(sample_steps=4, peak_flops=1e9)
+        prof.record_host(0, 1.0, 0.5)
+        prof.record_compute(0, 10.0, flops=1e6)  # 1e8 FLOP/s -> mfu 0.1
+        prof.record_compute(1, 10.0)  # no flops -> no mfu key
+        steps = prof.per_step()
+        assert steps[0]["mfu"] == pytest.approx(0.1)
+        assert "mfu" not in steps[1]
+        summary = prof.summary()
+        assert summary["mfu"] == pytest.approx(0.1)
+        assert summary["profiled_steps"] == 2
+
+    def test_no_mfu_without_peak(self):
+        from code2vec_tpu.train.prefetch import StepProfiler
+
+        prof = StepProfiler(sample_steps=2)
+        prof.record_compute(0, 10.0, flops=1e6)
+        assert "mfu" not in prof.per_step()[0]
+        assert "mfu" not in prof.summary()
+
+
+# ---------------------------------------------------------------------------
+# build_info gauge
+
+
+class TestBuildInfo:
+    def test_labels(self):
+        info = build_info()
+        assert info["package_version"]
+        assert info["jax_version"] not in ("", None)
+        assert info["python_version"].count(".") == 2
+
+    def test_exposition_parses(self):
+        text = build_info_text({"role": "router"})
+        assert text.startswith("# TYPE c2v_build_info gauge\n")
+        parsed = parse_prometheus_text(text)
+        assert parsed["# types"]["c2v_build_info"] == "gauge"
+        (sample,) = parsed["c2v_build_info"]
+        assert sample["value"] == 1.0
+        assert sample["labels"]["role"] == "router"
+        assert "jax_version" in sample["labels"]
+
+
+# ---------------------------------------------------------------------------
+# the flights op (worker side; the router passthrough rides test_obsfleet)
+
+
+def test_flights_op_returns_live_recorder_contents():
+    from code2vec_tpu.obs.runtime import FlightRecorder
+    from code2vec_tpu.serve.protocol import CodeServer
+
+    flight = FlightRecorder(capacity=8, threshold_ms=0.0)
+    flight.observe(12.5, {"kind": "serve", "op": "embed",
+                          "e2e_ms": np.float64(12.5)})
+
+    class _Batcher:
+        def close(self, timeout=0.0):
+            pass
+
+    server = CodeServer(None, None, _Batcher(), flight=flight)
+    resolver = server.handle_async({"op": "flights", "id": 7})
+    payload = resolver()
+    assert payload["id"] == 7
+    assert payload["ok"] is True
+    assert payload["recorded"] == 1
+    assert payload["seen"] == 1
+    (rec,) = payload["flights"]
+    assert rec["op"] == "embed"
+    json.dumps(payload)  # numpy scalars sanitized for the wire
+
+
+def test_flights_op_without_recorder():
+    from code2vec_tpu.serve.protocol import CodeServer
+
+    class _Batcher:
+        def close(self, timeout=0.0):
+            pass
+
+    server = CodeServer(None, None, _Batcher())
+    payload = server.handle_async({"op": "flights"})()
+    assert payload == {"ok": True, "recorded": 0, "seen": 0, "flights": []}
+
+
+def test_flights_classified_as_health_slo_class():
+    from code2vec_tpu.serve.fleet.slo import classify_op
+
+    assert classify_op("flights") == "health"
+
+
+# ---------------------------------------------------------------------------
+# trace_stitch --trace-id
+
+
+@pytest.fixture()
+def stitched_trace_dir(tmp_path):
+    router = tmp_path / "trace-p0.json"
+    replica_dir = tmp_path / "r0"
+    replica_dir.mkdir()
+    replica = replica_dir / "trace-p0.json"
+    router.write_text(json.dumps({"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "router"}},
+        {"name": "fleet_request", "ph": "X", "pid": 0, "tid": 1,
+         "ts": 1_000_000, "dur": 5000, "args": {"trace_id": "tid-1"}},
+    ]}))
+    replica.write_text(json.dumps({"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "worker"}},
+        {"name": "serve_request", "ph": "X", "pid": 0, "tid": 1,
+         "ts": 1_001_000, "dur": 3000, "args": {"trace_id": "tid-1"}},
+        {"name": "serve_device", "ph": "X", "pid": 0, "tid": 2,
+         "ts": 1_002_000, "dur": 1500,
+         "args": {"trace_ids": ["tid-1", "tid-2"]}},
+    ]}))
+    return tmp_path
+
+
+def test_critical_path_table_renders_per_hop_ms(stitched_trace_dir):
+    paths = trace_stitch.find_trace_files([str(stitched_trace_dir)])
+    index = trace_stitch.trace_index(trace_stitch.stitch_traces(paths))
+    table = trace_stitch.critical_path_table("tid-1", index["tid-1"])
+    lines = table.splitlines()
+    assert "3 spans across 2 processes" in lines[0]
+    assert "critical path 5.000 ms" in lines[0]
+    body = "\n".join(lines)
+    assert "fleet_request" in body
+    assert "serve_device" in body
+    assert "coalesced" in body
+    assert "+1.000" in body  # serve_request starts 1ms after admission
+    assert "5.000" in body  # fleet_request dur in ms
+
+
+def test_trace_id_cli_prints_table_and_rejects_unknown(
+    stitched_trace_dir, capsys
+):
+    trace_stitch.main([str(stitched_trace_dir), "--trace-id", "tid-1"])
+    out = capsys.readouterr().out
+    assert "trace tid-1" in out
+    assert "serve_device" in out
+    with pytest.raises(SystemExit, match="not found"):
+        trace_stitch.main([str(stitched_trace_dir), "--trace-id", "nope"])
+
+
+# ---------------------------------------------------------------------------
+# perf_report sentinel exit codes
+
+
+CLEAN = {
+    "pad_efficiency": 0.26, "device_calls_per_request": 0.75,
+    "post_warmup_recompiles": 0, "mfu": 0.001, "coalesce_mean": 1.6,
+    "qps": 140.0,
+}
+
+
+def _bench_stream(tmp_path, name, **overrides):
+    metrics = dict(CLEAN, **overrides)
+    detail = {
+        "mode": "serve",
+        "pad_efficiency": metrics["pad_efficiency"],
+        "post_warmup_recompiles": metrics["post_warmup_recompiles"],
+        "coalesce_mean": metrics["coalesce_mean"],
+        "completed": 100,
+        "counters": {
+            "serve_batches": int(metrics["device_calls_per_request"] * 100)
+        },
+        "qps": metrics["qps"],
+        "latency_ms": {"e2e": {"p50_ms": 2.7, "p99_ms": 5.2}},
+        "perf": {"mfu": metrics["mfu"], "busy_fraction": 0.02,
+                 "device_kind": "cpu"},
+    }
+    path = tmp_path / name
+    path.write_text(
+        "some non-json log line\n"
+        + json.dumps({"detail": detail}) + "\n"
+        + json.dumps({"metric": "serve_requests_per_sec", "value": 140.0,
+                      "mfu": metrics["mfu"]}) + "\n"
+    )
+    return str(path)
+
+
+@pytest.fixture()
+def baseline_file(tmp_path):
+    current = _bench_stream(tmp_path, "base_stream.json")
+    baseline = tmp_path / "baseline.json"
+    rc = perf_report.main([
+        "--update-baseline", "--baseline", str(baseline),
+        "--current", current,
+    ])
+    assert rc == 0
+    return str(baseline)
+
+
+class TestPerfReportCheck:
+    def test_clean_run_exits_zero(self, tmp_path, baseline_file, capsys):
+        current = _bench_stream(tmp_path, "clean.json")
+        rc = perf_report.main([
+            "--check", "--baseline", baseline_file, "--current", current,
+        ])
+        assert rc == 0
+        assert "perf sentinel: OK" in capsys.readouterr().out
+
+    def test_small_noise_within_tolerance(self, tmp_path, baseline_file):
+        current = _bench_stream(
+            tmp_path, "noisy.json",
+            pad_efficiency=CLEAN["pad_efficiency"] * 0.95,
+            mfu=CLEAN["mfu"] * 0.5,  # hosts vary; only 10x decay fails
+            coalesce_mean=CLEAN["coalesce_mean"] * 0.8,
+        )
+        assert perf_report.main([
+            "--check", "--baseline", baseline_file, "--current", current,
+        ]) == 0
+
+    @pytest.mark.parametrize("regression", [
+        {"pad_efficiency": 0.10},           # padding efficiency collapsed
+        {"device_calls_per_request": 1.5},  # coalescing stopped working
+        {"post_warmup_recompiles": 2},      # hot path recompiling
+        {"mfu": 0.00005},                   # >10x MFU decay
+        {"coalesce_mean": 0.5},             # batches fell apart
+    ])
+    def test_injected_regression_exits_nonzero(
+        self, tmp_path, baseline_file, regression, capsys
+    ):
+        current = _bench_stream(tmp_path, "bad.json", **regression)
+        rc = perf_report.main([
+            "--check", "--baseline", baseline_file, "--current", current,
+        ])
+        assert rc == 1
+        assert "PERF REGRESSION" in capsys.readouterr().err
+
+    def test_mfu_above_one_violates_invariant(
+        self, tmp_path, baseline_file, capsys
+    ):
+        current = _bench_stream(tmp_path, "impossible.json", mfu=1.5)
+        rc = perf_report.main([
+            "--check", "--baseline", baseline_file, "--current", current,
+        ])
+        assert rc == 1
+        assert "invariant" in capsys.readouterr().err
+
+    def test_metric_vanishing_fails_loudly(self, tmp_path, baseline_file):
+        current = _bench_stream(tmp_path, "partial.json")
+        data = [json.loads(l) for l in open(current) if l.startswith("{")]
+        del data[0]["detail"]["pad_efficiency"]
+        with open(current, "w") as f:
+            for obj in data:
+                f.write(json.dumps(obj) + "\n")
+        assert perf_report.main([
+            "--check", "--baseline", baseline_file, "--current", current,
+        ]) == 1
+
+    def test_empty_current_exits_2(self, tmp_path, baseline_file):
+        empty = tmp_path / "empty.json"
+        empty.write_text("no json here\n")
+        assert perf_report.main([
+            "--check", "--baseline", baseline_file,
+            "--current", str(empty),
+        ]) == 2
+
+    def test_missing_baseline_exits_2(self, tmp_path):
+        current = _bench_stream(tmp_path, "c.json")
+        assert perf_report.main([
+            "--check", "--baseline", str(tmp_path / "nope.json"),
+            "--current", current,
+        ]) == 2
+
+
+def test_committed_baseline_is_loadable_and_gated():
+    """The baseline the CI job checks against must stay well-formed."""
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "perf_baseline.json")
+    with open(path, encoding="utf-8") as f:
+        baseline = json.load(f)
+    for gate in perf_report.GATES:
+        assert gate in baseline, f"baseline lost gated metric {gate!r}"
+    assert 0.0 < baseline["mfu"] <= 1.0
+    assert baseline["post_warmup_recompiles"] == 0
+
+
+def test_serve_metrics_reads_bench_stamp_format(tmp_path):
+    """BENCH_rN.json stamps wrap the stream in {"raw": ..., "parsed": ...}."""
+    inner = (
+        json.dumps({"detail": {"mode": "serve", "pad_efficiency": 0.5,
+                               "completed": 10,
+                               "counters": {"serve_batches": 5},
+                               "post_warmup_recompiles": 0,
+                               "coalesce_mean": 2.0,
+                               "perf": {"mfu": 0.01}}})
+        + "\n" + json.dumps({"metric": "serve_requests_per_sec"})
+    )
+    stamp = tmp_path / "BENCH_r9.json"
+    stamp.write_text(json.dumps({"raw": inner, "parsed": {"metric": "x"}}))
+    metrics = perf_report.serve_metrics(perf_report.load_records(str(stamp)))
+    assert metrics["pad_efficiency"] == 0.5
+    assert metrics["device_calls_per_request"] == 0.5
+    assert metrics["mfu"] == 0.01

@@ -23,6 +23,7 @@ def test_tool_compiles(script):
     py_compile.compile(os.path.join(TOOLS, script), doraise=True)
 
 
+@pytest.mark.slow
 def test_rehearse_java_large_tiny_end_to_end(tmp_path):
     """The java-large rehearsal (round-4 evidence for BASELINE config 3)
     must keep running end-to-end: all phases (gen, int32 guard, host

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Perf report + CI regression sentinel over bench serve output.
+
+Two modes:
+
+**Report** — pretty-print the sentinel metrics extracted from one or more
+bench output files (the merged stdout/stderr stream of ``bench.py
+--serve``, or a ``BENCH_rN.json`` stamp whose ``raw`` field carries it)::
+
+    python tools/perf_report.py /tmp/serve_bench.json
+
+**Check** — compare a fresh run against the committed baseline and exit
+nonzero on regression (the CI ``perf-sentinel`` job)::
+
+    env JAX_PLATFORMS=cpu BENCH_SUPERVISED=1 \\
+        python bench.py --serve > /tmp/m.json 2> /tmp/d.json
+    cat /tmp/d.json /tmp/m.json > /tmp/serve_bench.json
+    python tools/perf_report.py --check \\
+        --baseline tools/perf_baseline.json --current /tmp/serve_bench.json
+
+Every gate is a RATIO against the baseline (or a structural invariant),
+never an absolute wall-clock number — shared CI runners make absolute
+latency/QPS gating pure noise. The gated metrics:
+
+- ``pad_efficiency``        may not drop more than ``--tol-pad`` (15%)
+- ``device_calls_per_request`` may not grow more than ``--tol-calls`` (25%)
+- ``post_warmup_recompiles``   may not exceed the baseline (normally 0)
+- ``mfu``                   must stay within (0, 1] and above
+                            ``--mfu-floor`` (10%) of the baseline — the
+                            loose floor absorbs host-speed variance while
+                            still catching order-of-magnitude decay
+- ``coalesce_mean``         may not drop more than ``--tol-coalesce`` (50%;
+                            coalescing is arrival-timing sensitive)
+
+``--update-baseline`` rewrites the baseline file from the current run
+(commit the result when a perf change is intentional).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# metric -> (direction, default tolerance); direction "min" = current may
+# not drop below baseline*(1-tol), "max" = may not exceed baseline*(1+tol)
+GATES = {
+    "pad_efficiency": ("min", 0.15),
+    "device_calls_per_request": ("max", 0.25),
+    "post_warmup_recompiles": ("max", 0.0),
+    "mfu": ("min", 0.90),  # i.e. floor = 10% of baseline; see --mfu-floor
+    "coalesce_mean": ("min", 0.50),
+}
+
+INFO_METRICS = ("qps", "p50_ms", "p99_ms", "busy_fraction")
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse a bench output file: JSON-lines (logging noise skipped), a
+    single JSON object, or a BENCH_rN.json stamp with a ``raw`` stream."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    records: list[dict] = []
+    try:
+        whole = json.loads(text)
+    except ValueError:
+        whole = None
+    if isinstance(whole, dict):
+        records.append(whole)
+        raw = whole.get("raw")
+        if isinstance(raw, str):
+            records.extend(_parse_lines(raw))
+        parsed = whole.get("parsed")
+        if isinstance(parsed, dict):
+            records.append(parsed)
+        return records
+    if isinstance(whole, list):
+        return [r for r in whole if isinstance(r, dict)]
+    return _parse_lines(text)
+
+
+def _parse_lines(text: str) -> list[dict]:
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            records.append(obj)
+    return records
+
+
+def serve_metrics(records: list[dict]) -> dict:
+    """Sentinel metrics from the LAST serve-mode detail + metric line."""
+    detail = None
+    metric = None
+    for obj in records:
+        d = obj.get("detail")
+        if isinstance(d, dict) and d.get("mode") == "serve":
+            detail = d
+        if obj.get("metric") == "serve_requests_per_sec":
+            metric = obj
+    out: dict = {}
+    if detail is not None:
+        counters = detail.get("counters") or {}
+        completed = detail.get("completed") or 0
+        batches = counters.get("serve_batches")
+        out["pad_efficiency"] = detail.get("pad_efficiency")
+        if batches is not None and completed:
+            out["device_calls_per_request"] = round(batches / completed, 4)
+        out["post_warmup_recompiles"] = detail.get("post_warmup_recompiles")
+        out["coalesce_mean"] = detail.get("coalesce_mean")
+        out["qps"] = detail.get("qps")
+        lat = (detail.get("latency_ms") or {}).get("e2e") or {}
+        out["p50_ms"] = lat.get("p50_ms")
+        out["p99_ms"] = lat.get("p99_ms")
+        perf = detail.get("perf") or {}
+        out["mfu"] = perf.get("mfu")
+        out["busy_fraction"] = perf.get("busy_fraction")
+        out["device_kind"] = perf.get("device_kind")
+    if metric is not None:
+        out.setdefault("mfu", metric.get("mfu"))
+        out.setdefault("post_warmup_recompiles",
+                       metric.get("post_warmup_recompiles"))
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def compare(baseline: dict, current: dict, tolerances: dict) -> list[str]:
+    """Ratio gates; returns human-readable failure strings (empty = OK)."""
+    failures = []
+    mfu = current.get("mfu")
+    if mfu is not None and not (0.0 < mfu <= 1.0):
+        failures.append(
+            f"mfu={mfu} violates the 0 < mfu <= 1 invariant "
+            "(achieved FLOP/s exceeded the device peak — the cost model "
+            "or the peak table is wrong)"
+        )
+    for name, (direction, _default) in GATES.items():
+        tol = tolerances[name]
+        base = baseline.get(name)
+        cur = current.get(name)
+        if base is None:
+            continue  # baseline never recorded it — nothing to gate
+        if cur is None:
+            failures.append(
+                f"{name}: present in baseline ({base}) but missing from "
+                "the current run — the bench stopped reporting it"
+            )
+            continue
+        if direction == "min":
+            floor = base * (1.0 - tol)
+            if cur < floor:
+                failures.append(
+                    f"{name}: {cur} < {floor:.4g} "
+                    f"(baseline {base} - {tol:.0%} tolerance)"
+                )
+        else:
+            ceiling = base * (1.0 + tol) if base else tol
+            if cur > ceiling:
+                failures.append(
+                    f"{name}: {cur} > {ceiling:.4g} "
+                    f"(baseline {base} + {tol:.0%} tolerance)"
+                )
+    return failures
+
+
+def _print_table(rows: list[tuple[str, dict]]) -> None:
+    keys = list(GATES) + [k for k in INFO_METRICS]
+    width = max(len(k) for k in keys) + 2
+    header = "metric".ljust(width) + "  ".join(
+        name.rjust(18) for name, _ in rows
+    )
+    print(header)
+    print("-" * len(header))
+    for key in keys:
+        cells = []
+        for _, metrics in rows:
+            value = metrics.get(key)
+            cells.append(("-" if value is None else str(value)).rjust(18))
+        gate = "*" if key in GATES else " "
+        print(f"{key.ljust(width - 2)}{gate} " + "  ".join(cells))
+    print("(* = gated by --check; others informational)")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("files", nargs="*", help="bench output files to report")
+    parser.add_argument("--check", action="store_true",
+                        help="gate --current against --baseline; exit "
+                        "nonzero on regression")
+    parser.add_argument("--baseline", default="tools/perf_baseline.json")
+    parser.add_argument("--current",
+                        help="fresh bench output to check/update from")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite --baseline from --current")
+    parser.add_argument("--tol-pad", type=float, default=GATES["pad_efficiency"][1])
+    parser.add_argument("--tol-calls", type=float,
+                        default=GATES["device_calls_per_request"][1])
+    parser.add_argument("--tol-recompiles", type=float,
+                        default=GATES["post_warmup_recompiles"][1])
+    parser.add_argument("--mfu-floor", type=float, default=GATES["mfu"][1],
+                        help="mfu may drop this fraction below baseline "
+                        "(default 0.9: fail only below 10%% of baseline)")
+    parser.add_argument("--tol-coalesce", type=float,
+                        default=GATES["coalesce_mean"][1])
+    args = parser.parse_args(argv)
+    tolerances = {
+        "pad_efficiency": args.tol_pad,
+        "device_calls_per_request": args.tol_calls,
+        "post_warmup_recompiles": args.tol_recompiles,
+        "mfu": args.mfu_floor,
+        "coalesce_mean": args.tol_coalesce,
+    }
+
+    if args.update_baseline:
+        if not args.current:
+            parser.error("--update-baseline needs --current")
+        metrics = serve_metrics(load_records(args.current))
+        if not metrics:
+            print(f"no serve metrics found in {args.current}", file=sys.stderr)
+            return 2
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(metrics, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"baseline {args.baseline} updated: "
+              f"{json.dumps(metrics, sort_keys=True)}")
+        return 0
+
+    if args.check:
+        if not args.current:
+            parser.error("--check needs --current")
+        try:
+            with open(args.baseline, encoding="utf-8") as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"cannot load baseline {args.baseline}: {exc}",
+                  file=sys.stderr)
+            return 2
+        current = serve_metrics(load_records(args.current))
+        if not current:
+            print(f"no serve metrics found in {args.current}",
+                  file=sys.stderr)
+            return 2
+        _print_table([("baseline", baseline), ("current", current)])
+        failures = compare(baseline, current, tolerances)
+        if failures:
+            print("\nPERF REGRESSION:", file=sys.stderr)
+            for failure in failures:
+                print(f"  - {failure}", file=sys.stderr)
+            return 1
+        print("\nperf sentinel: OK (all ratio gates within tolerance)")
+        return 0
+
+    if not args.files:
+        parser.error("give bench output files, or --check/--update-baseline")
+    rows = []
+    for path in args.files:
+        metrics = serve_metrics(load_records(path))
+        rows.append((path.rsplit("/", 1)[-1], metrics))
+    _print_table(rows)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

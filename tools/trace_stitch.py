@@ -25,10 +25,15 @@ Usage::
 
     python tools/trace_stitch.py --out merged.json TRACE_DIR [MORE...]
     python tools/trace_stitch.py --index-out index.json fleet_traces/
+    python tools/trace_stitch.py --trace-id 8f2a... fleet_traces/
 
 Inputs are trace files or directories (searched recursively for
 ``trace-p*.json``). A one-line JSON summary lands on stdout: file/event
 counts, distinct trace ids, and how many trace ids cross processes.
+With ``--trace-id`` the tool instead prints that ONE request's
+critical path as a per-hop ms table (offset from the request's first
+span, duration, process, coalesce flag) — the mid-incident view that
+otherwise needs a Chrome-trace load.
 """
 
 from __future__ import annotations
@@ -39,7 +44,12 @@ import json
 import os
 import sys
 
-__all__ = ["find_trace_files", "stitch_traces", "trace_index"]
+__all__ = [
+    "critical_path_table",
+    "find_trace_files",
+    "stitch_traces",
+    "trace_index",
+]
 
 
 def find_trace_files(paths: list[str]) -> list[str]:
@@ -168,6 +178,52 @@ def trace_index(trace: dict) -> dict:
     return index
 
 
+def critical_path_table(trace_id: str, entry: dict) -> str:
+    """Render one indexed request as a per-hop ms table.
+
+    Spans are already ts-sorted (``trace_index``); offsets are relative
+    to the request's first span, so the table reads top-to-bottom as the
+    request's life: router admission -> worker resolver -> pad -> device
+    -> postprocess. ``ts``/``dur`` are Chrome-trace microseconds.
+    """
+    spans = entry.get("spans") or []
+    if not spans:
+        return f"trace {trace_id}: no spans"
+    t0 = min(s["ts"] for s in spans if s.get("ts") is not None)
+    end = max(
+        (s["ts"] or 0) + (s["dur"] or 0)
+        for s in spans
+        if s.get("ts") is not None
+    )
+    rows = []
+    for span in spans:
+        ts, dur = span.get("ts"), span.get("dur")
+        rows.append((
+            span.get("process") or "?",
+            span.get("name") or "?",
+            f"{(ts - t0) / 1e3:+.3f}" if ts is not None else "?",
+            f"{dur / 1e3:.3f}" if dur is not None else "?",
+            "coalesced" if span.get("coalesced") else "",
+        ))
+    headers = ("process", "span", "start_ms", "dur_ms", "")
+    widths = [
+        max(len(headers[i]), max(len(r[i]) for r in rows))
+        for i in range(len(headers))
+    ]
+    lines = [
+        f"trace {trace_id}: {len(spans)} spans across "
+        f"{len(entry.get('processes') or [])} processes, "
+        f"critical path {(end - t0) / 1e3:.3f} ms",
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip(),
+    ]
+    lines.append("-" * len(lines[-1]))
+    for row in rows:
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+        )
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(
         prog="trace_stitch",
@@ -182,6 +238,9 @@ def main(argv: list[str] | None = None) -> None:
                         "(viewable in Perfetto / chrome://tracing)")
     parser.add_argument("--index-out", default=None,
                         help="write the per-trace-id span index here")
+    parser.add_argument("--trace-id", default=None,
+                        help="print ONE request's critical path as a "
+                        "per-hop ms table and exit")
     args = parser.parse_args(argv)
 
     paths = find_trace_files(args.inputs)
@@ -192,6 +251,15 @@ def main(argv: list[str] | None = None) -> None:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(merged, f)
     index = trace_index(merged)
+    if args.trace_id is not None:
+        entry = index.get(args.trace_id)
+        if entry is None:
+            raise SystemExit(
+                f"trace id {args.trace_id!r} not found "
+                f"({len(index)} trace ids indexed)"
+            )
+        print(critical_path_table(args.trace_id, entry))
+        return
     if args.index_out:
         with open(args.index_out, "w", encoding="utf-8") as f:
             json.dump(index, f, indent=1)
