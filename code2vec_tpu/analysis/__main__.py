@@ -21,13 +21,44 @@ import subprocess
 import sys
 from pathlib import Path
 
-from code2vec_tpu.analysis import jaxlint
+from code2vec_tpu.analysis import concurrency, jaxlint
 from code2vec_tpu.analysis.sharding_check import check_source, declared_axes
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 DEFAULT_PATHS = ("code2vec_tpu", "tools", "bench.py", "main.py")
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 DEFAULT_MESH = "code2vec_tpu/parallel/mesh.py"
+SYNC_MODULE = "code2vec_tpu/obs/sync.py"
+# textual markers of a lock-factory call site / raw lock construction: a
+# change to any such module can add or remove acquisition-graph edges whose
+# cycles close through UNCHANGED files, so the diff-restricted scan widens
+_LOCK_SITE_MARKERS = (
+    "make_lock(",
+    "make_rlock(",
+    "make_condition(",
+    "threading.Lock(",
+    "threading.RLock(",
+    "threading.Condition(",
+)
+
+
+def _touches_lock_graph(root: Path, changed: list[Path]) -> Path | None:
+    """The first changed file that can perturb the repo-wide lock
+    acquisition graph (the sync module itself, or any module constructing
+    locks / calling the lock factory); None when the diff is graph-inert."""
+    for rel in changed:
+        if rel.as_posix() == SYNC_MODULE:
+            return rel
+        path = root / rel
+        if not path.exists():  # a deleted lock-site module also perturbs
+            continue
+        try:
+            text = path.read_text()
+        except OSError:  # pragma: no cover - unreadable working tree file
+            continue
+        if any(marker in text for marker in _LOCK_SITE_MARKERS):
+            return rel
+    return None
 
 
 def _git(root: Path, *args: str) -> str:
@@ -80,6 +111,7 @@ def run(
         declared_axes(mesh_file.read_text()) if mesh_file is not None else None
     )
     findings: list[jaxlint.Finding] = []
+    fragments: list[concurrency.ModuleFragment] = []
     for file in jaxlint.iter_py_files(paths):
         try:
             rel = file.resolve().relative_to(root.resolve()).as_posix()
@@ -93,6 +125,15 @@ def run(
         findings += jaxlint.lint_source(source, rel, tree=tree)
         if axis_decls is not None and tree is not None:
             findings += check_source(source, rel, axis_decls, tree=tree)
+        if tree is not None:
+            cx_findings, fragment = concurrency.check_source(
+                source, rel, tree=tree
+            )
+            findings += cx_findings
+            fragments.append(fragment)
+    # CX002 is repo-wide: the acquisition graph joins every scanned file's
+    # fragments, so cross-class cycles surface wherever their edges live
+    findings += concurrency.finalize(fragments)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     jaxlint.apply_baseline(findings, jaxlint.load_baseline(baseline_path))
     return findings
@@ -183,6 +224,15 @@ def main(argv: list[str] | None = None) -> int:
             # and break the full scan on main
             print(
                 "jaxlint: mesh declarations changed; running the full scan",
+                file=sys.stderr,
+            )
+        elif (lock_site := _touches_lock_graph(root, changed)) is not None:
+            # same widening logic as the mesh rule, for CX002: the lock
+            # acquisition graph is repo-wide, so an edge added in this
+            # diff can close a cycle through unchanged files
+            print(
+                f"jaxlint: lock construction changed ({lock_site.as_posix()})"
+                "; running the full scan",
                 file=sys.stderr,
             )
         else:

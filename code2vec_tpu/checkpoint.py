@@ -41,6 +41,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from code2vec_tpu import faultinject
+from code2vec_tpu.obs.sync import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -452,7 +453,7 @@ class CheckpointWriter:
         self.async_save = async_save
         self._thread: threading.Thread | None = None
         self._failure: BaseException | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("checkpoint.writer")
         sweep_staging_dirs(out_dir)
 
     # ---- failure propagation -------------------------------------------

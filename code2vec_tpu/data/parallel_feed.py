@@ -56,6 +56,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from code2vec_tpu.obs.sync import guard_fork_safety
+
 from code2vec_tpu.data.pipeline import (
     BatchSource,
     execute_plan,
@@ -255,6 +257,12 @@ class FeedPool:
         self._events = events
         self._health = health
         self._tracer = tracer
+        # runtime twin of the static CX005 rule: a forked child inherits
+        # any lock a live non-daemon thread holds, permanently frozen —
+        # warn (error event + log) before requesting the fork context so
+        # a coordinator that already started serving/training threads
+        # hears about it instead of deadlocking a worker later
+        guard_fork_safety("FeedPool", events=self._events)
         self._ctx = multiprocessing.get_context("fork")
         self._shms = [
             shared_memory.SharedMemory(

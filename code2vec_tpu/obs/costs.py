@@ -45,6 +45,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from code2vec_tpu.obs.sync import make_lock
+
 __all__ = [
     "PEAK_FLOPS",
     "CostAccountant",
@@ -323,7 +325,7 @@ class CostAccountant:
         self._health = health
         self._clock = clock
         self._t0 = clock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.costs")
         self._execs: dict[str, dict[str, Any]] = {}
         self._device_ms = 0.0
         self._flops_done = 0.0

@@ -230,7 +230,9 @@ class EventLog:
         self._events_dir = events_dir
         self._file = None
         self._closed = False
-        # RLock: a consumer may emit follow-up events from inside dispatch
+        # RLock: a consumer may emit follow-up events from inside dispatch.
+        # Plain on purpose: the sanitizer reports violations THROUGH event
+        # logs, so a traced lock here would re-enter the reporter
         self._lock = threading.RLock()
         self._consumers: list[Callable[[dict], None]] = []
         self._seq = 0

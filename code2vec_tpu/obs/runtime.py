@@ -24,6 +24,10 @@ import re
 import threading
 import time
 
+# no cycle: obs.sync reaches back into this module only lazily (inside its
+# metric-recording path), so the factory import is safe at module top
+from code2vec_tpu.obs.sync import make_lock
+
 logger = logging.getLogger(__name__)
 
 __all__ = [
@@ -52,6 +56,8 @@ class Counter:
 
     def __init__(self) -> None:
         self._value = 0
+        # plain on purpose: metric primitives are the lock sanitizer's own
+        # recording substrate — tracing them would recurse
         self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
@@ -97,7 +103,7 @@ class LatencyHistogram:
         self._count = 0
         self._sum = 0.0  # over ALL samples ever (Prometheus summary _sum)
         self._max = int(max_samples)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # plain on purpose: sanitizer substrate
 
     def record(self, value_ms: float) -> None:
         with self._lock:
@@ -153,6 +159,7 @@ class RuntimeHealth:
     snapshot on demand."""
 
     def __init__(self) -> None:
+        # plain on purpose: the registry hands out the sanitizer's metrics
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
@@ -535,7 +542,7 @@ class FlightRecorder:
         self._captured = (
             health.counter("flight.recorded") if health is not None else Counter()
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.flight_recorder")
 
     @property
     def count(self) -> int:
@@ -613,7 +620,7 @@ class FlightRecorder:
 
 
 _global_health: RuntimeHealth | None = None
-_global_health_lock = threading.Lock()
+_global_health_lock = threading.Lock()  # plain on purpose: sanitizer substrate
 
 
 def global_health() -> RuntimeHealth:

@@ -177,6 +177,8 @@ class Tracer:
         # (ident, name) gives every distinctly-named occupant its own row
         self._tids: dict[tuple[int, str], int] = {}
         self._dropped = 0
+        # plain on purpose: hottest leaf lock in the process (every span);
+        # never held across another acquire, so tracing it buys nothing
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         # wall-clock anchor for the monotonic span clock: exported ts are

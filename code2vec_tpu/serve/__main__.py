@@ -110,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "slower than this many ms (0 = p99 sampling "
                         "only); records land as `flight` events and as "
                         "flight_*.json dumps under <events_dir>/flight")
+    parser.add_argument("--sync_debug", action="store_true", default=False,
+                        help="lock sanitizer: trace every factory-built "
+                        "lock (acquisition-order cycle detection, "
+                        "hold/wait/contention metrics); equivalent to "
+                        "C2V_SYNC_DEBUG=1. Off by default — the factory "
+                        "then returns plain threading primitives")
     return parser
 
 
@@ -227,6 +233,14 @@ def build_server(args):
     from code2vec_tpu.serve.protocol import CodeServer
     from code2vec_tpu.serve.swap import GoldenSet
 
+    # the sanitizer switch must flip BEFORE any lock is constructed below
+    # (batcher, engine, swap controller all build their locks here);
+    # make_lock reads the env at call time, so this is the whole wiring
+    if getattr(args, "sync_debug", False):
+        from code2vec_tpu.obs.sync import SYNC_DEBUG_ENV
+
+        os.environ[SYNC_DEBUG_ENV] = "1"
+
     # pin the schedule cache BEFORE the first trace, exactly like train()
     # and export_from_checkpoint do
     if args.autotune_cache:
@@ -239,6 +253,11 @@ def build_server(args):
         from code2vec_tpu.obs.events import EventLog
 
         events = EventLog(args.events_dir)
+        from code2vec_tpu.obs.sync import register_event_log, sync_debug_enabled
+
+        if sync_debug_enabled():
+            # lock_order_violation events land in this worker's own log
+            register_event_log(events)
 
     # slow-request flight recorder: one per process, shared by every
     # generation's batcher (constructed without the event log for the

@@ -52,6 +52,7 @@ from code2vec_tpu.obs.runtime import (
     RuntimeHealth,
     global_health,
 )
+from code2vec_tpu.obs.sync import make_lock
 from code2vec_tpu.obs.trace import TraceContext, get_tracer, trace_scope
 
 __all__ = ["MicroBatcher", "ServeOverloaded", "ServerClosed", "ServeResult"]
@@ -130,7 +131,7 @@ class MicroBatcher:
         # flag-set: without it a submit could pass the check, lose the
         # CPU, and enqueue after close() already swept the queue —
         # leaving its future pending forever
-        self._submit_lock = threading.Lock()
+        self._submit_lock = make_lock("batcher.submit")
         self._requests = self._health.counter("serve_requests")
         self._batches = self._health.counter("serve_batches")
         self._coalesced = self._health.counter("serve_coalesced")

@@ -46,6 +46,7 @@ from code2vec_tpu.data.pipeline import (
     nearest_bucket_width,
 )
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.sync import make_rlock
 from code2vec_tpu.obs.trace import current_trace_scope, get_tracer
 
 logger = logging.getLogger(__name__)
@@ -120,7 +121,7 @@ class ServingEngine:
         self.warmup_requests = int(warmup_requests)
         self._health = health or global_health()
         self._events = events
-        self._lock = threading.RLock()
+        self._lock = make_rlock("engine")
         self._compiled: dict[tuple[int, int], object] = {}
         self._width_histogram: dict[int, int] = {}
         self._warmed = False  # True once the ladder's executables exist

@@ -107,6 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--ann_n_probe", type=int, default=None)
     parser.add_argument("--ann_shortlist", type=int, default=None)
     parser.add_argument("--accelerator", action="store_true", default=False)
+    parser.add_argument("--sync_debug", action="store_true", default=False,
+                        help="lock sanitizer on the router AND every "
+                        "worker (the flag is forwarded down the replica "
+                        "command line); equivalent to C2V_SYNC_DEBUG=1")
     return parser
 
 
@@ -146,6 +150,8 @@ def worker_argv(args, slot: int) -> list[str]:
     threshold = getattr(args, "flight_threshold_ms", 0.0)
     if threshold:
         argv += ["--flight_threshold_ms", str(threshold)]
+    if getattr(args, "sync_debug", False):
+        argv += ["--sync_debug"]
     return argv
 
 
@@ -155,6 +161,13 @@ def build_router(args):
     from code2vec_tpu.serve.fleet.replica import ReplicaHandle
     from code2vec_tpu.serve.fleet.router import FleetRouter
     from code2vec_tpu.serve.fleet.slo import parse_slo_spec
+
+    # flip the sanitizer BEFORE the router/cache/SLO locks are built; the
+    # replica subprocesses inherit the env AND get the explicit flag
+    if getattr(args, "sync_debug", False):
+        from code2vec_tpu.obs.sync import SYNC_DEBUG_ENV
+
+        os.environ[SYNC_DEBUG_ENV] = "1"
 
     events = None
     if args.events_dir:
@@ -170,6 +183,11 @@ def build_router(args):
                 "per_replica_inflight": args.per_replica_inflight,
             }
         )
+        from code2vec_tpu.obs.sync import register_event_log, sync_debug_enabled
+
+        if sync_debug_enabled():
+            # router-side lock_order_violation events land in the fleet log
+            register_event_log(events)
 
     def factory(slot: int, incarnation: int) -> ReplicaHandle:
         return ReplicaHandle(

@@ -61,6 +61,8 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 
+from code2vec_tpu.obs.sync import make_lock
+
 __all__ = [
     "ResultCache",
     "canonical_bag_digest",
@@ -208,7 +210,7 @@ class ResultCache:
         self._capacity = int(capacity_bytes)
         self._small_target = max(1, int(self._capacity * small_fraction))
         self._ghost_cap = int(ghost_entries)
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.cache")
         self._entries: dict[tuple, _Entry] = {}
         self._small: deque[tuple] = deque()
         self._main: deque[tuple] = deque()

@@ -47,6 +47,7 @@ import time
 from concurrent.futures import Future
 
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.sync import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -77,8 +78,8 @@ class ReplicaHandle:
             f"fleet.r{self.slot}"
         )
         self._pending: collections.deque[Future] = collections.deque()
-        self._plock = threading.Lock()
-        self._wlock = threading.Lock()
+        self._plock = make_lock(f"replica.r{self.slot}.pending")
+        self._wlock = make_lock(f"replica.r{self.slot}.write")
         self._dead = threading.Event()
         self.death_reason: str | None = None
         # prober bookkeeping (owned by the router's probe thread)
@@ -138,8 +139,13 @@ class ReplicaHandle:
             with self._plock:
                 self._pending.append(future)
             try:
-                self._proc.stdin.write(line + "\n")
-                self._proc.stdin.flush()
+                # pipe write under _wlock is the point of _wlock: it exists
+                # to serialize writers so request lines interleave whole.
+                # Blocking is bounded by the pipe buffer and the worker's
+                # reader, which drains continuously; nothing that resolves
+                # this write ever needs _wlock.
+                self._proc.stdin.write(line + "\n")  # jaxlint: disable=CX003
+                self._proc.stdin.flush()  # jaxlint: disable=CX003
             except (BrokenPipeError, OSError, ValueError) as exc:
                 # nothing was (fully) written for THIS request — it is the
                 # newest pending entry; remove it before failing the rest

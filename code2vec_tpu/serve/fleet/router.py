@@ -60,6 +60,7 @@ from code2vec_tpu.obs.runtime import (
     RuntimeHealth,
     global_health,
 )
+from code2vec_tpu.obs.sync import make_lock, sync_snapshot
 from code2vec_tpu.obs.trace import ensure_trace, get_tracer
 from code2vec_tpu.serve.fleet.cache import ResultCache
 from code2vec_tpu.serve.fleet.replica import ReplicaDied
@@ -180,7 +181,7 @@ class FleetRouter:
         self._shutdown = threading.Event()
         self._stop_probe = threading.Event()
 
-        self._swap_lock = threading.Lock()
+        self._swap_lock = make_lock("router.swap")
         self._rolling: dict = {"state": "idle", "target": None,
                                "outcome": None, "replicas": []}
         self._rolling_thread: threading.Thread | None = None
@@ -768,6 +769,9 @@ class FleetRouter:
                 # device-time/MFU block (engine.perf_summary, cached by
                 # the prober) — the per-replica truth behind fleet.capacity
                 "perf": last.get("perf"),
+                # lock-sanitizer block from the worker's own health
+                # payload: enabled flag + order-violation count
+                "sync": last.get("sync"),
             })
         return {
             "ok": all(r.get("alive") for r in replicas),
@@ -799,6 +803,10 @@ class FleetRouter:
                 # (device-ms/request × observed mix) — the autoscaling
                 # control signal; None until device time has been observed
                 "capacity": self._capacity_block(),
+                # the ROUTER's own lock-sanitizer snapshot (router.swap /
+                # fleet.cache / fleet.slo locks); each replica row above
+                # carries the worker-side block
+                "sync": sync_snapshot(),
             },
             **self.health.snapshot(),
         }
@@ -1151,7 +1159,9 @@ class FleetRouter:
         # the micro-batcher's close fix covers one level down)
         leftovers = list(self._retries)
         self._retries.clear()
-        for cls, head in self._heads.items():
+        # lockless by design: the dispatcher (the only other _heads writer)
+        # was joined above, so this sweep runs single-threaded
+        for cls, head in self._heads.items():  # jaxlint: disable=CX001
             if head is not None:
                 leftovers.append(head)
                 self._heads[cls] = None

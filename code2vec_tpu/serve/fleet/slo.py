@@ -54,6 +54,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from code2vec_tpu.obs.sync import make_lock
+
 __all__ = [
     "DEFAULT_SLO",
     "PRIORITY",
@@ -218,7 +220,7 @@ class SloBurnTracker:
         self._health = health
         self._events = events
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.slo")
         n_buckets = int(window_s) + 1
         self._windows: dict[str, _BurnWindow] = {
             name: _BurnWindow(n_buckets) for name in classes

@@ -65,6 +65,7 @@ from typing import Callable
 
 import numpy as np
 
+from code2vec_tpu.obs.sync import sync_snapshot
 from code2vec_tpu.obs.trace import TraceContext, get_tracer, new_trace_id
 from code2vec_tpu.serve.swap import Generation, SwapController
 
@@ -454,6 +455,9 @@ class CodeServer:
                 if hasattr(engine, "perf_summary")
                 else None
             ),
+            # lock sanitizer: enabled flag + order-violation count + graph
+            # size — zero violations under load is the health criterion
+            "sync": sync_snapshot(),
             **self.health.snapshot(),
         }
 

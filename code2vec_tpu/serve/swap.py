@@ -56,6 +56,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.sync import make_rlock
 
 logger = logging.getLogger(__name__)
 
@@ -287,7 +288,7 @@ class SwapController:
         self._health = health or global_health()
         self._events = events
         self._close_timeout = close_timeout
-        self._lock = threading.RLock()
+        self._lock = make_rlock("swap.controller")
         self._state = "idle"  # idle | building | validating
         self._target: str | None = None
         self._last: dict | None = None
@@ -300,7 +301,8 @@ class SwapController:
     # ---- status ---------------------------------------------------------
     @property
     def state(self) -> str:
-        return self._state
+        with self._lock:
+            return self._state
 
     def status(self) -> dict:
         with self._lock:
@@ -444,12 +446,14 @@ class SwapController:
                 "outcome": "rolled_back",
                 "version": self.active.version,
             }
+            # snapshot under the lock: a reload racing this rollback could
+            # repoint active/previous between release and the log/emit below
+            restored, demoted = self.active.version, self.previous.version
         self._rollbacks.inc()
-        self._health.gauge("serve_active_version").set(self.active.version)
+        self._health.gauge("serve_active_version").set(restored)
         logger.info("rolled back to %s (%s stays resident)",
-                    self.active.version, self.previous.version)
-        self._emit("rollback", version=self.active.version,
-                   demoted_version=self.previous.version)
+                    restored, demoted)
+        self._emit("rollback", version=restored, demoted_version=demoted)
         return self.status()
 
     # ---- lifecycle ------------------------------------------------------
@@ -459,7 +463,9 @@ class SwapController:
         thread = self._thread
         if thread is not None and thread.is_alive():
             thread.join(self._close_timeout)
-        for gen in (self.active, self.previous):
+        with self._lock:
+            generations = (self.active, self.previous)
+        for gen in generations:
             if gen is not None:
                 try:
                     gen.close(self._close_timeout)

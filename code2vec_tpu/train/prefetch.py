@@ -237,6 +237,10 @@ class HostPrefetcher:
         # only: a per-process early stream end desynchronizes the
         # lockstep collectives of a multi-process epoch
         self._drain = drain_on_preemption and jax.process_count() == 1
+        # deliberately lock-free (nothing for obs.sync.make_lock to
+        # route): the producer/consumer handoff is entirely the Queue's
+        # own internal condition plus a stop Event — this class never
+        # holds one lock while acquiring another
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exhausted = False

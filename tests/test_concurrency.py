@@ -1,0 +1,907 @@
+"""Tests for the concurrency sanitizer: the CX static rule family
+(paired positive/negative AST fixtures per rule, suppression handling,
+the repo-wide CX002 graph), the traced-lock runtime (order-cycle
+detection, RLock reentrancy, contention metrics, the plain-primitives
+default pinned to the exact ``threading`` types), the fork-safety guard
+(message pinned; ``parallel_feed`` proven guarded by the static rule),
+and schedule-stressing runs of the REAL batcher / router / swap
+controller / result cache with the sanitizer on — zero violations.
+
+The fleet-level end-to-end (2 subprocess replicas, rolling swap under
+load, sanitizer on in router AND workers) lives in
+``tests/test_fleet.py::test_fleet_rolling_swap_with_lock_sanitizer``
+next to the fleet it exercises.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+import time
+from concurrent.futures import Future
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.analysis.concurrency import lint_concurrency
+from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+from code2vec_tpu.obs.sync import (
+    SYNC_DEBUG_ENV,
+    TracedCondition,
+    TracedLock,
+    TracedRLock,
+    guard_fork_safety,
+    make_condition,
+    make_lock,
+    make_rlock,
+    register_event_log,
+    reset_sync_state,
+    sync_debug_enabled,
+    sync_snapshot,
+    violations,
+)
+
+pytestmark = pytest.mark.sync
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def lint(src: str):
+    return lint_concurrency(textwrap.dedent(src), "mod.py")
+
+
+def rule_ids(findings, *, include_suppressed=False):
+    return {
+        f.rule
+        for f in findings
+        if include_suppressed or not f.suppressed
+    }
+
+
+# ---------------------------------------------------------------------------
+# CX001 unguarded shared state
+# ---------------------------------------------------------------------------
+
+
+class TestCX001UnguardedSharedState:
+    def test_thread_written_attr_read_unguarded_flags(self):
+        findings = lint(
+            """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self._count += 1
+
+                def progress(self):
+                    return self._count
+            """
+        )
+        assert "CX001" in rule_ids(findings)
+        (finding,) = [f for f in findings if f.rule == "CX001"]
+        assert "_count" in finding.message
+
+    def test_guarded_public_access_is_clean(self):
+        findings = lint(
+            """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self._count += 1
+
+                def progress(self):
+                    with self._lock:
+                        return self._count
+            """
+        )
+        assert "CX001" not in rule_ids(findings)
+
+    def test_no_thread_entry_no_finding(self):
+        # same attr pattern but single-threaded by construction
+        findings = lint(
+            """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+
+                def bump(self):
+                    self._count += 1
+
+                def progress(self):
+                    return self._count
+            """
+        )
+        assert "CX001" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# CX002 lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+class TestCX002LockOrderCycle:
+    def test_inverted_nesting_in_one_class_flags(self):
+        findings = lint(
+            """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """
+        )
+        assert "CX002" in rule_ids(findings)
+
+    def test_consistent_order_is_clean(self):
+        findings = lint(
+            """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """
+        )
+        assert "CX002" not in rule_ids(findings)
+
+    def test_cross_class_cycle_through_attr_calls_flags(self):
+        # A holds its lock and calls into B; B holds its lock and calls
+        # back into A — the cycle only exists in the JOINED graph
+        findings = lint(
+            """
+            import threading
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._lock = threading.Lock()
+                    self._b = b
+
+                def touch(self):
+                    with self._lock:
+                        pass
+
+                def kick(self):
+                    with self._lock:
+                        self._b.poke()
+
+            class B:
+                def __init__(self, a: "A"):
+                    self._lock = threading.Lock()
+                    self._a = a
+
+                def poke(self):
+                    with self._lock:
+                        self._a.touch()
+            """
+        )
+        assert "CX002" in rule_ids(findings)
+
+    def test_rlock_reentry_through_self_call_is_clean(self):
+        # engine.observe_width holds the RLock and calls prepare(), which
+        # re-acquires the SAME RLock — reentrancy, not an inversion
+        findings = lint(
+            """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def prepare(self):
+                    with self._lock:
+                        pass
+
+                def observe(self):
+                    with self._lock:
+                        self.prepare()
+            """
+        )
+        assert "CX002" not in rule_ids(findings)
+
+    def test_plain_lock_self_deadlock_flags(self):
+        # the same shape with a NON-reentrant lock IS a self-deadlock
+        findings = lint(
+            """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def prepare(self):
+                    with self._lock:
+                        pass
+
+                def observe(self):
+                    with self._lock:
+                        self.prepare()
+            """
+        )
+        assert "CX002" in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# CX003 blocking call under lock
+# ---------------------------------------------------------------------------
+
+
+class TestCX003BlockingUnderLock:
+    def test_sleep_under_lock_flags(self):
+        findings = lint(
+            """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """
+        )
+        assert "CX003" in rule_ids(findings)
+
+    def test_future_result_under_lock_flags(self):
+        findings = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def wait_on(self, future):
+                    with self._lock:
+                        return future.result()
+            """
+        )
+        assert "CX003" in rule_ids(findings)
+
+    def test_sleep_outside_lock_is_clean(self):
+        findings = lint(
+            """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        pass
+                    time.sleep(1.0)
+            """
+        )
+        assert "CX003" not in rule_ids(findings)
+
+    def test_inline_suppression_is_honored_and_counted(self):
+        findings = lint(
+            """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        time.sleep(1.0)  # jaxlint: disable=CX003
+            """
+        )
+        assert "CX003" not in rule_ids(findings)
+        assert "CX003" in rule_ids(findings, include_suppressed=True)
+
+
+# ---------------------------------------------------------------------------
+# CX004 condition wait without predicate loop
+# ---------------------------------------------------------------------------
+
+
+class TestCX004ConditionWait:
+    def test_bare_wait_flags(self):
+        findings = lint(
+            """
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def take(self):
+                    with self._cond:
+                        self._cond.wait()
+            """
+        )
+        assert "CX004" in rule_ids(findings)
+
+    def test_predicate_loop_is_clean(self):
+        findings = lint(
+            """
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._items = []
+
+                def take(self):
+                    with self._cond:
+                        while not self._items:
+                            self._cond.wait()
+                        return self._items.pop()
+            """
+        )
+        assert "CX004" not in rule_ids(findings)
+
+    def test_timeout_wait_is_clean(self):
+        findings = lint(
+            """
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def take(self):
+                    with self._cond:
+                        self._cond.wait(1.0)
+            """
+        )
+        assert "CX004" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# CX005 fork after threads
+# ---------------------------------------------------------------------------
+
+
+class TestCX005ForkAfterThreads:
+    def test_unguarded_fork_context_flags(self):
+        findings = lint(
+            """
+            import multiprocessing
+
+            def boot():
+                return multiprocessing.get_context("fork")
+            """
+        )
+        assert "CX005" in rule_ids(findings)
+
+    def test_guarded_fork_context_is_clean(self):
+        findings = lint(
+            """
+            import multiprocessing
+
+            from code2vec_tpu.obs.sync import guard_fork_safety
+
+            def boot():
+                guard_fork_safety("boot")
+                return multiprocessing.get_context("fork")
+            """
+        )
+        assert "CX005" not in rule_ids(findings)
+
+    def test_spawn_context_is_clean(self):
+        findings = lint(
+            """
+            import multiprocessing
+
+            def boot():
+                return multiprocessing.get_context("spawn")
+            """
+        )
+        assert "CX005" not in rule_ids(findings)
+
+    def test_parallel_feed_is_guarded(self):
+        # the real FeedPool must carry its runtime guard — the static rule
+        # and the runtime guard pin each other
+        path = REPO / "code2vec_tpu" / "data" / "parallel_feed.py"
+        findings = lint_concurrency(
+            path.read_text(), "code2vec_tpu/data/parallel_feed.py"
+        )
+        assert "CX005" not in rule_ids(findings)
+        assert "guard_fork_safety" in path.read_text()
+
+
+# ---------------------------------------------------------------------------
+# traced-lock runtime
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sync_debug(monkeypatch):
+    monkeypatch.setenv(SYNC_DEBUG_ENV, "1")
+    reset_sync_state()
+    yield
+    reset_sync_state()
+
+
+class TestFactoryDefaults:
+    def test_disabled_returns_plain_primitives(self, monkeypatch):
+        monkeypatch.delenv(SYNC_DEBUG_ENV, raising=False)
+        assert not sync_debug_enabled()
+        # EXACT plain types, zero attributes added: production serving
+        # never pays for the sanitizer
+        assert type(make_lock("x")) is type(threading.Lock())
+        assert type(make_rlock("x")) is type(threading.RLock())
+        assert type(make_condition("x")) is threading.Condition
+        assert dir(make_lock("x")) == dir(threading.Lock())
+
+    def test_falsy_env_values_stay_disabled(self, monkeypatch):
+        for value in ("0", "false", "no", "off", ""):
+            monkeypatch.setenv(SYNC_DEBUG_ENV, value)
+            assert not sync_debug_enabled()
+        monkeypatch.setenv(SYNC_DEBUG_ENV, "1")
+        assert sync_debug_enabled()
+
+    def test_enabled_returns_traced(self, sync_debug):
+        assert isinstance(make_lock("a"), TracedLock)
+        assert isinstance(make_rlock("a"), TracedRLock)
+        assert isinstance(make_condition("a"), TracedCondition)
+
+
+class TestOrderCycleDetection:
+    def test_two_lock_inversion_fires_once(self, sync_debug):
+        a, b = make_lock("a"), make_lock("b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # inversion: b -> a after a -> b is on record
+                pass
+        recorded = violations()
+        assert len(recorded) == 1
+        v = recorded[0]
+        assert v["lock"] == "a" and v["held"] == ["b"]
+        assert v["other_thread"]  # provenance of the recorded a -> b edge
+        # dedup: repeating the same inversion adds nothing
+        with b:
+            with a:
+                pass
+        assert len(violations()) == 1
+
+    def test_three_thread_cycle_fires(self, sync_debug):
+        a, b, c = make_lock("a"), make_lock("b"), make_lock("c")
+
+        def nested(outer, inner):
+            with outer:
+                with inner:
+                    pass
+
+        # each leg on its own thread, joined sequentially: the graph is
+        # a -> b -> c, and the third leg closes the cycle c -> a
+        for outer, inner in ((a, b), (b, c), (c, a)):
+            t = threading.Thread(target=nested, args=(outer, inner))
+            t.start()
+            t.join()
+        recorded = violations()
+        assert len(recorded) == 1
+        assert recorded[0]["lock"] == "a" and recorded[0]["held"] == ["c"]
+        snap = sync_snapshot()
+        assert snap["enabled"] and snap["order_violations"] == 1
+        assert snap["locks_tracked"] == 3
+
+    def test_rlock_reentrancy_is_not_an_inversion(self, sync_debug):
+        r, other = make_rlock("r"), make_lock("other")
+        with r:
+            with other:
+                with r:  # reentrant re-acquire: no other -> r edge
+                    pass
+        with r:
+            pass
+        assert violations() == []
+
+    def test_violation_emits_event_and_counter(self, sync_debug):
+        emitted = []
+
+        class _Log:
+            def emit(self, kind, **fields):
+                emitted.append((kind, fields))
+
+        register_event_log(_Log())
+        counter = global_health().counter("lock.order_violations")
+        before = counter.value
+        a, b = make_lock("ev.a"), make_lock("ev.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert counter.value == before + 1
+        (kind, fields), = emitted
+        assert kind == "lock_order_violation"
+        assert fields["lock"] == "ev.a" and fields["held"] == ["ev.b"]
+        assert fields["stack"] and fields["other_stack"]
+
+
+class TestContentionAndCondition:
+    def test_contention_metrics_recorded(self, sync_debug):
+        lock = make_lock("contended")
+        counter = global_health().counter("lock.contended")
+        before = counter.value
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                entered.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert entered.wait(5)
+        waiter_done = threading.Event()
+
+        def waiter():
+            with lock:
+                pass
+            waiter_done.set()
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        time.sleep(0.05)  # let the waiter actually block
+        release.set()
+        assert waiter_done.wait(5)
+        t.join(5)
+        w.join(5)
+        assert counter.value >= before + 1
+        summary = global_health().latency("lock.wait_ms").summary()
+        assert summary is not None and summary["count"] >= 1
+        assert global_health().latency("lock.hold_ms").summary() is not None
+        assert violations() == []
+
+    def test_traced_condition_handoff(self, sync_debug):
+        cond = make_condition("handoff")
+        items: list[int] = []
+        got: list[int] = []
+
+        def consumer():
+            with cond:
+                while not items:
+                    cond.wait(5)
+                got.append(items.pop())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            items.append(7)
+            cond.notify_all()
+        t.join(5)
+        assert got == [7]
+        assert violations() == []
+
+
+# ---------------------------------------------------------------------------
+# fork-safety guard
+# ---------------------------------------------------------------------------
+
+
+class TestForkGuard:
+    def test_quiet_with_only_daemon_threads(self):
+        assert guard_fork_safety("test") == []
+
+    def test_offender_named_and_event_pinned(self):
+        emitted = []
+
+        class _Log:
+            # first positional is the event name; "kind" arrives as a field
+            def emit(self, event, **fields):
+                emitted.append((event, fields))
+
+        stop = threading.Event()
+        t = threading.Thread(
+            target=stop.wait, args=(10,), name="lingering-feeder",
+            daemon=False,
+        )
+        t.start()
+        try:
+            offenders = guard_fork_safety("FeedPool", events=_Log())
+        finally:
+            stop.set()
+            t.join(5)
+        assert "lingering-feeder" in offenders
+        (kind, fields), = emitted
+        assert kind == "error"
+        assert fields["where"] == "FeedPool"
+        assert fields["kind"] == "fork_after_threads"
+        assert "lingering-feeder" in fields["threads"]
+        # the message is operator-facing: pin its load-bearing clauses
+        assert "fork start-method requested while non-daemon threads" in (
+            fields["message"]
+        )
+        assert "permanently frozen" in fields["message"]
+        assert "start worker pools before serving/training threads" in (
+            fields["message"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# schedule stress: real components, sanitizer on, zero violations
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    """Duck-typed engine for the batcher: instant numpy 'device' calls."""
+
+    batch_sizes = (1, 4)
+    max_width = 16
+
+    def observe_width(self, width):
+        pass
+
+    def pad_requests(self, requests):
+        batch = len(requests)
+        width = max(len(r) for r in requests)
+        starts = np.zeros((batch, width), np.int32)
+        paths = np.zeros((batch, width), np.int32)
+        ends = np.zeros((batch, width), np.int32)
+        for i, contexts in enumerate(requests):
+            n = len(contexts)
+            starts[i, :n] = contexts[:, 0]
+            paths[i, :n] = contexts[:, 1]
+            ends[i, :n] = contexts[:, 2]
+        return starts, paths, ends, batch, width
+
+    def run(self, starts, paths, ends):
+        batch, width = starts.shape
+        logits = np.zeros((batch, 4), np.float32)
+        vectors = np.ones((batch, 8), np.float32)
+        attention = np.full((batch, width), 1.0 / max(width, 1), np.float32)
+        return logits, vectors, attention
+
+
+def _requests(rng, n):
+    return [
+        np.stack(
+            [
+                rng.integers(1, 50, w),
+                rng.integers(1, 40, w),
+                rng.integers(1, 50, w),
+            ],
+            axis=1,
+        ).astype(np.int32)
+        for w in rng.integers(1, 16, n)
+    ]
+
+
+class TestSanitizerStress:
+    def test_batcher_under_concurrent_submitters(self, sync_debug):
+        from code2vec_tpu.serve.batcher import MicroBatcher
+
+        rng = np.random.default_rng(0)
+        reqs = [_requests(rng, 40) for _ in range(4)]
+        results: list[list] = [[] for _ in range(4)]
+        with MicroBatcher(
+            _StubEngine(), deadline_ms=1.0, health=RuntimeHealth()
+        ) as batcher:
+
+            def submitter(i):
+                for contexts in reqs[i]:
+                    results[i].append(
+                        batcher.submit(contexts).result(timeout=30)
+                    )
+
+            threads = [
+                threading.Thread(target=submitter, args=(i,))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        assert all(len(r) == 40 for r in results)
+        assert violations() == []
+
+    def test_result_cache_under_concurrent_leaders(self, sync_debug):
+        from code2vec_tpu.serve.fleet.cache import ResultCache
+
+        cache = ResultCache(1 << 16, health=RuntimeHealth())
+        cache.set_version("v0")
+        errors: list[BaseException] = []
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for i in range(200):
+                    key = ("k", int(rng.integers(0, 8)), "v0")
+                    state, payload = cache.begin(key)
+                    if state == "lead":
+                        cache.fill(key, {"ok": True, "i": i})
+                    elif state == "join":
+                        payload.result(timeout=10)
+                    else:
+                        assert payload["ok"]
+                    if rng.integers(0, 20) == 0:
+                        cache.begin_swap()
+                        cache.end_swap("v0")
+            except BaseException as exc:  # noqa: BLE001 - surfaced below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(seed,)) for seed in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:2]
+        assert violations() == []
+
+    def test_swap_controller_reload_rollback_under_readers(self, sync_debug):
+        from code2vec_tpu.serve.swap import Generation, SwapController
+
+        class _StubBatcher:
+            def __init__(self):
+                self.closed = threading.Event()
+
+            def close(self, timeout=None):
+                self.closed.set()
+
+        def gen(version):
+            return Generation(
+                version=version, engine=_StubEngine(),
+                batcher=_StubBatcher(),
+            )
+
+        controller = SwapController(
+            gen("v0"), build=lambda target: gen(str(target)),
+            golden=None, health=RuntimeHealth(),
+        )
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                controller.status()
+                _ = controller.state
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for cycle in range(5):
+                status = controller.reload(f"v{cycle + 1}", wait=True)
+                assert status["last_swap"]["outcome"] == "committed"
+                controller.rollback()
+                controller.rollback()  # swap back and forth
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(10)
+            controller.close()
+        assert violations() == []
+
+    def test_router_fleet_under_concurrent_clients(self, sync_debug):
+        from code2vec_tpu.obs.runtime import FlightRecorder
+        from code2vec_tpu.serve.fleet.cache import ResultCache
+        from code2vec_tpu.serve.fleet.router import FleetRouter
+
+        class _Fake:
+            def __init__(self, slot, incarnation=0):
+                self.slot = slot
+                self.incarnation = incarnation
+                self._alive = True
+                self._inflight = 0
+                self._lock = threading.Lock()
+                self.probe_failures = 0
+                self.last_health = None
+                self.last_health_unix = None
+                self.death_reason = None
+                self.pid = 41000 + slot
+
+            @property
+            def alive(self):
+                return self._alive
+
+            @property
+            def in_flight(self):
+                return self._inflight
+
+            def send(self, request):
+                future: Future = Future()
+                with self._lock:
+                    self._inflight += 1
+
+                def run():
+                    time.sleep(0.002)
+                    with self._lock:
+                        self._inflight -= 1
+                    future.set_result(
+                        {"ok": True, "op": request.get("op"),
+                         "slot": self.slot}
+                    )
+
+                threading.Thread(target=run, daemon=True).start()
+                return future
+
+            def wait_ready(self, timeout):
+                return {"ok": True}
+
+            def stop(self, timeout=10.0):
+                self._alive = False
+
+            def kill(self, timeout=10.0):
+                self._alive = False
+
+        health = RuntimeHealth()
+        cache = ResultCache(1 << 16, health=health)
+        router = FleetRouter(
+            lambda slot, incarnation: _Fake(slot, incarnation),
+            2,
+            health=health,
+            probe_interval_s=0.05,  # prober thread in the mix
+            flight=FlightRecorder(health=health),
+            result_cache=cache,
+        )
+        failures: list = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            for i in range(60):
+                op = ("embed", "neighbors", "health")[int(rng.integers(0, 3))]
+                payload = router.handle(
+                    {"op": op, "source": f"s{int(rng.integers(0, 6))}",
+                     "language": "python", "method_name": "m"}
+                )
+                if payload.get("error"):
+                    failures.append(payload)
+
+        try:
+            threads = [
+                threading.Thread(target=client, args=(seed,))
+                for seed in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        finally:
+            router.close()
+        assert not failures, failures[:3]
+        assert violations() == []
