@@ -1246,13 +1246,18 @@ def _longbag_ab() -> None:
     chunked_rps = real_full / min(c_times)
     truncated_rps = real_truncated / min(t_times)
 
+    from code2vec_tpu.ops.backend import resolve as resolve_backend
+
+    kernel_backend = resolve_backend()
+
     print(
         json.dumps(
             {
                 "detail": {
                     "backend": backend,
                     "mode": "longbag_ab",
-                    "interpret": backend != "tpu",
+                    "strategy": kernel_backend.label,
+                    "interpret": kernel_backend.interpret,
                     "batch": batch_size,
                     "bag": bag,
                     "base_ladder": list(base_ladder),
@@ -1936,6 +1941,7 @@ def _ann_ab() -> None:
                 "probed_row_fraction": round(
                     ann.probed_fraction(queries), 4
                 ),
+                "kernel_backend": ann.searcher._backend_label(),
             }
         )
 
@@ -1994,6 +2000,7 @@ def _ann_ab() -> None:
                         for k in ("recall@1", "recall@10", "recall@100")
                     },
                     "ann_schedule": ann.searcher.schedule.to_dict(),
+                    "kernel_backend": ann.searcher._backend_label(),
                     "exact_per_query_ms": round(
                         1e3 * min(exact_times) / n_queries, 3
                     ),
@@ -2034,9 +2041,16 @@ def _kernel_provenance(model_config) -> dict:
     """Kernel impl + schedule provenance for a detail block: the stamp must
     say which lowering produced the number, and — for autotuned runs — how
     much schedule search the process paid (the obs/ counters)."""
+    from code2vec_tpu.ops.backend import resolve as resolve_backend
+
+    configured = model_config.pallas_backend
     out = {
         "use_pallas": model_config.use_pallas,
         "impl": model_config.pallas_impl if model_config.use_pallas else "xla",
+        "backend": configured,
+        "strategy": resolve_backend(
+            backend=None if configured == "auto" else configured
+        ).label,
         "block_b": model_config.pallas_block_b,
         "dma_depth": model_config.pallas_dma_depth,
         "chunk_l": model_config.pallas_chunk_l,
@@ -2069,10 +2083,14 @@ def _kernel_ab() -> None:
     from the persisted cache with zero timing runs (the counters in the
     detail block prove it). ``--dry`` makes that pass serialize-only.
 
-    On a non-TPU backend the kernels execute in Pallas interpret mode:
-    the record is still produced, flagged ``"interpret": true`` — an
-    honest statement that the numbers characterize the interpreter, not
-    the hardware.
+    Off TPU the resolved lowering strategy (ops/backend.py) decides what
+    actually runs: the default is the compiled CPU strategy (plain XLA
+    with the kernels' exact semantics — ``"interpret": false``), and two
+    extra ``*_interp`` arms pin the legacy Pallas-interpreter path so the
+    record quantifies compiled-vs-interpret at equal real-context work.
+    Under ``C2V_KERNEL_BACKEND=interpret`` every arm runs the interpreter
+    and the record is flagged ``"interpret": true`` with the honest note
+    that the numbers characterize the interpreter, not the hardware.
     """
     jax, backend, fell_back = _init_backend()
     _bench_tracer(jax)
@@ -2085,8 +2103,9 @@ def _kernel_ab() -> None:
         generate_corpus_data,
     )
     from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
-    from code2vec_tpu.obs.runtime import memory_snapshot
+    from code2vec_tpu.obs.runtime import RecompileDetector, memory_snapshot
     from code2vec_tpu.ops import autotune as at
+    from code2vec_tpu.ops.backend import resolve as resolve_backend
     from code2vec_tpu.ops.quant import quantize_table
 
     jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
@@ -2095,7 +2114,8 @@ def _kernel_ab() -> None:
     def knob(name: str, device_default: int, cpu_default: int) -> int:
         return _recipe_knob(name, device_default, cpu_default, fell_back, backend)
 
-    interpret = jax.default_backend() != "tpu"
+    kernel_backend = resolve_backend()
+    interpret = kernel_backend.interpret
     batch_size = knob("BENCH_BATCH", 1024, 16)
     bag = knob("BENCH_BAG", 200, 24)
     steps = knob("BENCH_AB_STEPS", 30, 4)  # batches per timed pass
@@ -2202,6 +2222,25 @@ def _kernel_ab() -> None:
         arms.append(
             ("auto_f32", cfg(use_pallas=True, pallas_impl="auto"), None)
         )
+    if kernel_backend.strategy != "pallas_tpu" and not interpret:
+        # the compiled-vs-interpret comparison arms: same params, same
+        # batches, same real-context work — only the lowering differs.
+        # Skipped when every arm already runs the interpreter (the env
+        # pinned it) or on real TPU (nothing interprets there).
+        arms += [
+            (
+                "pool_only_f32_interp",
+                cfg(use_pallas=True, pallas_impl="pool_only",
+                    pallas_backend="interpret"),
+                None,
+            ),
+            (
+                "fused_f32_interp",
+                cfg(use_pallas=True, pallas_impl="fused",
+                    pallas_backend="interpret"),
+                None,
+            ),
+        ]
 
     def make_forward(model_config: Code2VecConfig, quant_tables):
         model = Code2Vec(model_config)
@@ -2219,6 +2258,13 @@ def _kernel_ab() -> None:
     fns = {name: make_forward(mc, qt) for name, mc, qt in arms}
     for name in fns:  # compile + warm, untimed
         jax.block_until_ready(fns[name](params, device_batches[0]))
+    # every arm serves ONE static shape: any jit-cache growth during the
+    # timed window is a silent recompile — the verdict the acceptance
+    # demands ("zero post-warmup recompiles")
+    detector = RecompileDetector()
+    for name in fns:
+        detector.track(name, fns[name])
+    detector.check()
 
     def one_pass(fn) -> float:
         t0 = time.perf_counter()
@@ -2235,12 +2281,14 @@ def _kernel_ab() -> None:
         for name in order + order[::-1]:
             best[name] = min(best[name], one_pass(fns[name]))
 
+    post_warmup = detector.check()
     rates = {name: real_slots / best[name] for name in best}
     speedup = best["xla_f32"] / best["fused_f32"]
 
     detail = {
         "backend": backend,
         "mode": "kernel_ab",
+        "strategy": kernel_backend.label,
         "interpret": interpret,
         "batch": batch_size,
         "bag": bag,
@@ -2259,9 +2307,21 @@ def _kernel_ab() -> None:
             for name, mc, _ in arms
         },
         "speedup_fused_vs_xla_f32": round(speedup, 4),
+        "post_warmup_recompiles": post_warmup,
         "autotune": autotune_info,
         "memory": memory_snapshot(),
     }
+    if "fused_f32_interp" in best:
+        # equal real-context work, only the lowering differs: this is the
+        # compiled-CPU-beats-interpreter number
+        detail["speedup_compiled_vs_interpret"] = {
+            "pool_only_f32": round(
+                best["pool_only_f32_interp"] / best["pool_only_f32"], 4
+            ),
+            "fused_f32": round(
+                best["fused_f32_interp"] / best["fused_f32"], 4
+            ),
+        }
     if interpret:
         detail["note"] = (
             "Pallas interpret mode (no TPU backend): rates characterize "

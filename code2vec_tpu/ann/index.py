@@ -409,11 +409,23 @@ class AnnSearcher:
             "capacity": int(self.capacity),
             "schedule": self.schedule.to_dict(),
             "impl_effective": self._impl_effective(),
+            "kernel_backend": self._backend_label(),
             "search_executables": self._cache_size(),
         }
 
     def _impl_effective(self) -> str:
         return "xla" if self._mesh is not None else self.schedule.impl
+
+    def _backend_label(self) -> str:
+        """Resolved lowering-strategy label (ops/backend.py) the score
+        kernel will actually use — provenance for serving describe()."""
+        from code2vec_tpu.ops.backend import resolve as resolve_backend
+
+        sched = self.schedule
+        return resolve_backend(
+            backend=None if sched.backend == "auto" else sched.backend,
+            interpret=self._interpret,
+        ).label
 
     # ---- query ----------------------------------------------------------
     def _fn(self, qb: int):
@@ -442,6 +454,9 @@ class AnnSearcher:
                     lut, probed.astype(jnp.int32), codes, scales, bias,
                     impl=impl, chunk_c=sched.chunk_c,
                     dma_depth=sched.dma_depth, interpret=interpret,
+                    backend=(
+                        None if sched.backend == "auto" else sched.backend
+                    ),
                 )
                 scores = adc + coarse[:, :, None]  # + q . centroid term
                 flat = scores.reshape(qb, n_probe * cap)

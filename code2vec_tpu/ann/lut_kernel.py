@@ -19,6 +19,14 @@ Two implementations with pinned parity (tests/test_ann.py):
   fast vector gather, but ``[chunk_c, 256] x [256]`` compare-and-reduce is
   pure VPU work. ``interpret=True`` runs the same kernel on CPU.
 
+A third formulation, ``gpu_lut_score_cells``, lowers through Pallas's
+Triton backend: XLA pre-gathers the probed cells' slabs and a portable
+kernel body (no DMA/scratch/TPU memory spaces) runs the same one-hot
+contraction per (query, cell). ``lut_score_cells`` picks between the
+three via the shared backend resolver (``ops/backend.py``) — on the
+resolved ``cpu`` strategy every impl serves the compiled ``xla``
+formulation, so CPU serving never enters the Pallas interpreter.
+
 Pad rows (beyond a cell's real count) carry scale 0 and bias ``-inf``, so
 they score ``-inf`` and can never surface in the shortlist.
 
@@ -34,6 +42,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from code2vec_tpu.analysis.contracts import shape_contract, spec
+from code2vec_tpu.ops.backend import resolve as resolve_backend
 
 LUT_IMPLS = ("xla", "pallas")
 _LANE = 128
@@ -127,10 +136,64 @@ def _make_kernel(m: int, entries: int, cap: int, cc: int, depth: int):
     return _kernel
 
 
+def _make_gpu_kernel(m: int, entries: int):
+    """The GPU (Triton-lowered) formulation: XLA pre-gathers the probed
+    cells' codes/scales/bias, one kernel program per (query, probed cell)
+    runs the same one-hot LUT contraction as the TPU kernel's
+    ``compute_chunk`` over the whole cell — no DMA, no scratch, no TPU
+    memory spaces, so the body lowers through Pallas's Triton backend
+    (and runs under the interpreter for off-GPU validation)."""
+
+    def _kernel(lut_ref, codes_ref, scales_ref, bias_ref, out_ref):
+        codes_c = codes_ref[0, 0].astype(jnp.int32)  # [C, M]
+        cap = codes_c.shape[0]
+        col = jax.lax.broadcasted_iota(jnp.int32, (cap, entries), 1)
+        acc = jnp.zeros((cap,), jnp.float32)
+        for sub in range(m):
+            onehot = (codes_c[:, sub][:, None] == col).astype(jnp.float32)
+            acc = acc + jnp.sum(onehot * lut_ref[0, sub][None, :], axis=1)
+        out_ref[0, 0] = acc * scales_ref[0, 0] + bias_ref[0, 0]
+
+    return _kernel
+
+
+def gpu_lut_score_cells(
+    lut, probed, codes, scales, bias, *, interpret: bool = False
+):
+    """Score probed cells with the GPU kernel formulation (see
+    ``_make_gpu_kernel``). Same output contract as the other impls."""
+    q, m, entries = lut.shape
+    p = probed.shape[1]
+    cap = codes.shape[1]
+    g_codes = codes[probed]  # [Q, P, C, M] — XLA-side gather
+    g_scales = scales[probed]
+    g_bias = bias[probed]
+    return pl.pallas_call(
+        _make_gpu_kernel(m, entries),
+        grid=(q, p),
+        in_specs=[
+            pl.BlockSpec((1, m, entries), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, cap, m), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, cap), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, cap), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((q, p, cap), jnp.float32),
+        interpret=interpret,
+    )(lut, g_codes, g_scales, g_bias)
+
+
 def pallas_lut_score_cells(
     lut, probed, codes, scales, bias, *, chunk_c: int = _LANE,
-    dma_depth: int = 2, interpret: bool = True,
+    dma_depth: int = 2, interpret: bool | None = None,
 ):
+    if interpret is None:
+        # route through the shared resolver (ops/backend.py) — this TPU
+        # formulation compiles only on TPU, so any other resolution means
+        # the interpreter (callers wanting compiled-off-TPU use
+        # lut_score_cells, which picks a non-TPU strategy instead)
+        bs = resolve_backend()
+        interpret = True if bs.strategy != "pallas_tpu" else bs.interpret
     q, m, entries = lut.shape
     p = probed.shape[1]
     n_list, cap, _ = codes.shape
@@ -193,6 +256,7 @@ def lut_score_cells(
     chunk_c: int = _LANE,
     dma_depth: int = 2,
     interpret: bool | None = None,
+    backend: str | None = None,
 ) -> jnp.ndarray:
     """Score every row of every probed cell; returns f32 ``[Q, P, C]``.
 
@@ -200,16 +264,24 @@ def lut_score_cells(
     harness) jit the enclosing computation, and the impl knobs are plain
     Python — compile-time by construction.
 
-    ``interpret=None`` auto-selects: compiled on TPU, interpreter
-    elsewhere (the repo-wide Pallas convention)."""
+    ``backend``/``interpret`` route through the shared resolver
+    (``ops/backend.py``). ``impl="pallas"`` under the resolved ``cpu``
+    strategy runs the compiled ``xla`` formulation (the reference
+    semantics — there is no CPU Pallas lowering, and the serving path
+    must never pay the interpreter); under ``pallas_gpu`` it runs the
+    Triton-shaped kernel; an explicit ``interpret=True`` keeps its
+    legacy meaning and pins the TPU formulation under the interpreter."""
     if impl not in LUT_IMPLS:
         raise ValueError(f"impl must be one of {LUT_IMPLS}, got {impl!r}")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    bs = resolve_backend(backend=backend, interpret=interpret)
     _check_contract(lut, probed, codes, scales, bias)
-    if impl == "xla":
+    if impl == "xla" or bs.strategy == "cpu":
         return xla_lut_score_cells(lut, probed, codes, scales, bias)
+    if bs.strategy == "pallas_gpu":
+        return gpu_lut_score_cells(
+            lut, probed, codes, scales, bias, interpret=bs.interpret
+        )
     return pallas_lut_score_cells(
         lut, probed, codes, scales, bias, chunk_c=int(chunk_c),
-        dma_depth=int(dma_depth), interpret=bool(interpret),
+        dma_depth=int(dma_depth), interpret=bs.interpret,
     )

@@ -58,6 +58,12 @@ class Code2VecConfig:
     #                also pick plain "xla". Param tree is IDENTICAL across
     #                impls, so checkpoints interchange freely.
     pallas_impl: str = "pool_only"
+    # which lowering family serves the kernels (ops/backend.py): "auto"
+    # resolves per C2V_KERNEL_BACKEND env then the actual device; "tpu" /
+    # "gpu" pin the Pallas formulations (interpreted off-device); "cpu"
+    # pins the compiled XLA strategy (never interprets); "interpret" pins
+    # the TPU formulation under the Pallas interpreter (parity-test mode)
+    pallas_backend: str = "auto"
     pallas_dma_depth: int = 2  # fused-impl gather double-buffer slots
     pallas_chunk_l: int = 128  # fused-impl bag-chunk lane tile
     # bag-softmax numerics of the fused kernel (ops/fused_encode_pool.py):
@@ -224,6 +230,7 @@ class Code2Vec(nn.Module):
             dma_depth=c.pallas_dma_depth,
             chunk_l=c.pallas_chunk_l,
             softmax=configured_softmax,
+            backend=c.pallas_backend,
             source="config",
         )
         if c.pallas_impl == "auto":
@@ -376,6 +383,7 @@ class Code2Vec(nn.Module):
                 dma_depth=sched.dma_depth, chunk_l=sched.chunk_l,
                 softmax_mode=sched.softmax,
                 compute_dtype=c.dtype,
+                backend=None if sched.backend == "auto" else sched.backend,
             )
         else:
             code_vector_f32, attention = self._unfused_forward(
@@ -453,6 +461,7 @@ class Code2Vec(nn.Module):
             code_vector, attention = pallas_attention_pool(
                 contexts, mask, attention_param.astype(c.dtype),
                 block_b=sched.block_b,
+                backend=None if sched.backend == "auto" else sched.backend,
             )
         elif c.attn_impl == "streaming":
             code_vector, attention = streaming_attention_pool(

@@ -21,6 +21,14 @@ Accounting is observable: ``obs.runtime.global_health()`` counters
 / ``autotune_schedule_stored`` let callers (tests, ``bench.py
 --kernel-ab``) assert exactly how much search a run paid.
 
+Schedules carry a ``backend`` axis (``ops/backend.py``): variant spaces,
+miss-fallback defaults, and per-entry interpret provenance all follow
+the resolved lowering strategy (TPU Pallas / GPU Triton / compiled CPU /
+interpreter), so one cache file holds per-backend winners side by side —
+``ShapeKey.device_kind`` already keys entries per device. Old entries
+deserialize with ``backend="auto"`` (resolve-at-call-time), no version
+bump.
+
 ``--dry`` writes default schedules without timing — the serialization
 smoke CI runs on every PR::
 
@@ -56,6 +64,12 @@ class KernelSchedule:
     # Pre-PR-13 cache entries deserialize with the default — unchanged
     # behavior, no cache version bump.
     softmax: str = "materialize"
+    # the backend axis (ops/backend.py): "auto" resolves at call time
+    # (env/device — the pre-existing behavior, so old cache entries
+    # deserialize unchanged); "tpu"/"gpu"/"cpu"/"interpret" pin the
+    # lowering this variant was timed under. Same no-version-bump
+    # tolerant-from_dict contract as the softmax field.
+    backend: str = "auto"
     source: str = "default"  # "default" | "dry" | "autotune" | "cache"
 
     def to_dict(self) -> dict:
@@ -100,6 +114,7 @@ class LutSchedule:
     impl: str = "xla"  # "xla" | "pallas"
     chunk_c: int = 128  # cell rows DMA'd per chunk (pallas impl only)
     dma_depth: int = 2  # double-buffer slots (pallas impl only)
+    backend: str = "auto"  # lowering axis, same contract as KernelSchedule
     source: str = "default"  # "default" | "dry" | "autotune" | "cache"
 
     def to_dict(self) -> dict:
@@ -262,6 +277,30 @@ def reset_cache() -> None:
     _cache_singleton = None
 
 
+def _resolve_backend(backend: str | None = None):
+    from code2vec_tpu.ops import backend as _backend
+
+    return _backend.resolve(backend=backend)
+
+
+def default_schedule() -> KernelSchedule:
+    """The configured fallback on a cache miss, per resolved backend: the
+    pool-only kernel where the Pallas lowerings run (TPU, and the
+    interpret test mode — the pre-existing default), the compiled
+    gather_split chain under the cpu/gpu strategies (off-TPU the
+    interpreter is exactly what ``auto`` must avoid)."""
+    bs = _resolve_backend()
+    if bs.strategy == "cpu":
+        return KernelSchedule(
+            impl="gather_split", backend="cpu", source="default"
+        )
+    if bs.strategy == "pallas_gpu":
+        return KernelSchedule(
+            impl="gather_split", backend="gpu", source="default"
+        )
+    return KernelSchedule(impl="pool_only", source="default")
+
+
 def lookup_schedule(
     batch: int,
     width: int,
@@ -274,9 +313,10 @@ def lookup_schedule(
     cache: ScheduleCache | None = None,
 ) -> KernelSchedule:
     """Trace-time schedule lookup (``pallas_impl="auto"``). A cache hit
-    returns the persisted winner; a miss falls back to ``default`` (the
-    pool-only kernel unless overridden) WITHOUT timing anything — search
-    happens only in :func:`autotune`, never on the training hot path."""
+    returns the persisted winner; a miss falls back to ``default``
+    (:func:`default_schedule` unless overridden) WITHOUT timing anything —
+    search happens only in :func:`autotune`, never on the training hot
+    path."""
     key = ShapeKey(
         device_kind=device_kind(), batch=int(batch), width=int(width),
         terminal_embed=int(terminal_embed), path_embed=int(path_embed),
@@ -289,7 +329,7 @@ def lookup_schedule(
         c["hit"].inc()
         return found
     c["miss"].inc()
-    return default or KernelSchedule(impl="pool_only", source="default")
+    return default or default_schedule()
 
 
 def consult_schedules(
@@ -312,7 +352,7 @@ def consult_schedules(
             schedule = found
         else:
             c["miss"].inc()
-            schedule = KernelSchedule(impl="pool_only", source="default")
+            schedule = default_schedule()
         out.append(
             {
                 "key": key.cache_key(),
@@ -324,13 +364,18 @@ def consult_schedules(
 
 
 def default_lut_schedule() -> LutSchedule:
-    """The configured fallback on a cache miss: the take-based XLA
-    formulation off-TPU (XLA's gather lowering is the right tool there),
-    the Pallas DMA kernel on TPU."""
-    import jax
-
-    impl = "pallas" if jax.default_backend() == "tpu" else "xla"
-    return LutSchedule(impl=impl, source="default")
+    """The configured fallback on a cache miss: the Pallas kernels where
+    they compile (TPU DMA kernel, GPU Triton kernel), the take-based XLA
+    formulation everywhere else — including the interpret test mode,
+    where ``xla`` was already the pre-existing CPU default."""
+    bs = _resolve_backend()
+    if not bs.interpret and bs.strategy == "pallas_tpu":
+        return LutSchedule(impl="pallas", backend="tpu", source="default")
+    if not bs.interpret and bs.strategy == "pallas_gpu":
+        return LutSchedule(impl="pallas", backend="gpu", source="default")
+    if bs.strategy == "cpu":
+        return LutSchedule(impl="xla", backend="cpu", source="default")
+    return LutSchedule(impl="xla", source="default")
 
 
 def lookup_lut_schedule(
@@ -360,11 +405,25 @@ def lookup_lut_schedule(
     return default or default_lut_schedule()
 
 
-def enumerate_lut_variants(capacity: int) -> list[LutSchedule]:
-    """The LUT kernel's search space: the XLA gather formulation plus the
-    Pallas DMA kernel across chunk size x pipeline depth. Chunks that do
-    not divide the padded cell capacity are pruned (the kernel would
-    silently clamp them to one lane)."""
+def enumerate_lut_variants(
+    capacity: int, backend: str | None = None
+) -> list[LutSchedule]:
+    """The LUT kernel's search space, per resolved backend. TPU (and the
+    interpret test mode, which must exercise the same kernel bodies): the
+    XLA gather formulation plus the Pallas DMA kernel across chunk size x
+    pipeline depth — chunks that do not divide the padded cell capacity
+    are pruned (the kernel would silently clamp them to one lane). CPU:
+    the compiled take-based formulation only (no interpreter in a timing
+    run). GPU: XLA plus the Triton-shaped kernel (no chunk/depth axis —
+    it has no DMA pipeline)."""
+    bs = _resolve_backend(backend)
+    if bs.strategy == "cpu":
+        return [LutSchedule(impl="xla", backend="cpu")]
+    if bs.strategy == "pallas_gpu":
+        return [
+            LutSchedule(impl="xla", backend="gpu"),
+            LutSchedule(impl="pallas", backend="gpu"),
+        ]
     cap = max(int(capacity), 1)
     chunks = sorted({c for c in (128, 256, 512) if c <= cap and cap % c == 0})
     if not chunks:
@@ -414,6 +473,7 @@ def time_lut_variant(
         return lut_score_cells(
             *inputs, impl=schedule.impl, chunk_c=schedule.chunk_c,
             dma_depth=schedule.dma_depth,
+            backend=None if schedule.backend == "auto" else schedule.backend,
         )
 
     jax.block_until_ready(fn())
@@ -428,9 +488,10 @@ def time_lut_variant(
 
 
 def _lut_variant_label(s: LutSchedule) -> str:
-    if s.impl == "xla":
-        return "xla"
-    return f"pallas/c{s.chunk_c}/d{s.dma_depth}"
+    label = "xla" if s.impl == "xla" else f"pallas/c{s.chunk_c}/d{s.dma_depth}"
+    if s.backend != "auto":
+        label += f"@{s.backend}"
+    return label
 
 
 def autotune_lut(
@@ -446,11 +507,9 @@ def autotune_lut(
 ) -> dict[str, LutSchedule]:
     """Search (or dry-stamp) a LUT-kernel schedule per missing key and
     persist — the :func:`autotune` contract on the LUT variant axis."""
-    import jax
-
     cache = cache or get_cache()
     c = _counters()
-    interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_backend().interpret
     out: dict[str, LutSchedule] = {}
     dirty = False
     for key in keys:
@@ -469,7 +528,7 @@ def autotune_lut(
         inputs = _synth_lut_inputs(key, min(n_probe, key.n_list), q_batch)
         timings: dict[str, float] = {}
         best_sched, best_t = None, float("inf")
-        for variant in enumerate_lut_variants(key.capacity):
+        for variant in enumerate_lut_variants(key.capacity):  # env-resolved
             c["timing"].inc()
             try:
                 t = time_lut_variant(variant, inputs, iters=iters,
@@ -500,20 +559,47 @@ def autotune_lut(
     return out
 
 
-def enumerate_variants(batch: int, width: int, table_dtype: str) -> list[KernelSchedule]:
-    """The search space for one shape: plain XLA, pool-only, gather-split,
-    and fully-fused — the fused impl additionally across the chunked-
-    softmax axis (``chunk_l`` × ``dma_depth`` × two-pass-vs-online, PR 13)
-    — across batch tiling / DMA pipeline depth / lane chunk. Tile sizes
-    larger than the (padded) batch are pruned — they would all alias the
-    same single-program grid. Variants that fail to lower on a shape
-    (e.g. ``materialize`` blowing VMEM at a longbag width) are skipped by
-    the tuner's try/except, so the space can stay uniform across widths.
-    """
+def enumerate_variants(
+    batch: int, width: int, table_dtype: str, backend: str | None = None
+) -> list[KernelSchedule]:
+    """The search space for one shape, per resolved backend.
+
+    TPU (and the interpret test mode): plain XLA, pool-only,
+    gather-split, and fully-fused — the fused impl additionally across
+    the chunked-softmax axis (``chunk_l`` × ``dma_depth`` ×
+    two-pass-vs-online, PR 13) — across batch tiling / DMA pipeline
+    depth / lane chunk. Tile sizes larger than the (padded) batch are
+    pruned — they would all alias the same single-program grid. Variants
+    that fail to lower on a shape (e.g. ``materialize`` blowing VMEM at a
+    longbag width) are skipped by the tuner's try/except, so the space
+    can stay uniform across widths.
+
+    CPU: plain XLA vs the compiled gather_split chain across ``block_b``
+    (the ``lax.map`` tile size — the only tiling economics left). GPU:
+    XLA, pool-only, and gather_split across ``block_b`` (warp-friendly
+    tile candidates; the DMA axes do not exist off-TPU)."""
     bp = max(batch, 1)
     blocks = [b for b in (8, 16, 32) if b <= max(bp, 8)]
     if not blocks:
         blocks = [8]
+    bs = _resolve_backend(backend)
+    if bs.strategy == "cpu":
+        variants = [KernelSchedule(impl="xla", backend="cpu")]
+        for b in blocks:
+            variants.append(
+                KernelSchedule(impl="gather_split", block_b=b, backend="cpu")
+            )
+        return variants
+    if bs.strategy == "pallas_gpu":
+        variants = [KernelSchedule(impl="xla", backend="gpu")]
+        for b in blocks:
+            variants.append(
+                KernelSchedule(impl="pool_only", block_b=b, backend="gpu")
+            )
+            variants.append(
+                KernelSchedule(impl="gather_split", block_b=b, backend="gpu")
+            )
+        return variants
     lane_pad = -(-max(width, 1) // 128) * 128
     chunks = sorted({c for c in (128, 256) if c <= lane_pad and lane_pad % c == 0})
     variants = [KernelSchedule(impl="xla")]
@@ -616,6 +702,9 @@ def _build_forward(schedule: KernelSchedule, t_table, p_table, data):
             return pallas_attention_pool(
                 enc, data["mask"], data["attn_param"],
                 block_b=schedule.block_b,
+                backend=(
+                    None if schedule.backend == "auto" else schedule.backend
+                ),
             )[0]
 
     elif schedule.impl in ("gather_split", "fused"):
@@ -628,6 +717,9 @@ def _build_forward(schedule: KernelSchedule, t_table, p_table, data):
                 impl=schedule.impl, block_b=schedule.block_b,
                 dma_depth=schedule.dma_depth, chunk_l=schedule.chunk_l,
                 softmax_mode=schedule.softmax,
+                backend=(
+                    None if schedule.backend == "auto" else schedule.backend
+                ),
             )[0]
 
     else:
@@ -673,11 +765,9 @@ def autotune(
     cheaply (the CI smoke) and so a tuner can pre-create entries to edit
     by hand. Timed entries record per-variant ms for provenance.
     """
-    import jax
-
     cache = cache or get_cache()
     c = _counters()
-    interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_backend().interpret
     vocab = vocab or int(os.environ.get("C2V_AUTOTUNE_VOCAB", 20_000))
     out: dict[str, KernelSchedule] = {}
     dirty = False
@@ -689,7 +779,7 @@ def autotune(
             continue
         c["miss"].inc()
         if dry:
-            sched = KernelSchedule(source="dry")
+            sched = dataclasses.replace(default_schedule(), source="dry")
             cache.put(key, sched, timings_ms=None, interpret=interpret)
             out[key.cache_key()] = sched
             dirty = True
@@ -732,14 +822,17 @@ def autotune(
 
 def _variant_label(s: KernelSchedule) -> str:
     if s.impl == "xla":
-        return "xla"
-    if s.impl == "pool_only":
-        return f"pool_only/b{s.block_b}"
-    if s.impl == "gather_split":
-        return f"gather_split/b{s.block_b}"
-    label = f"fused/b{s.block_b}/d{s.dma_depth}/c{s.chunk_l}"
-    if s.softmax != "materialize":
-        label += f"/{s.softmax}"
+        label = "xla"
+    elif s.impl == "pool_only":
+        label = f"pool_only/b{s.block_b}"
+    elif s.impl == "gather_split":
+        label = f"gather_split/b{s.block_b}"
+    else:
+        label = f"fused/b{s.block_b}/d{s.dma_depth}/c{s.chunk_l}"
+        if s.softmax != "materialize":
+            label += f"/{s.softmax}"
+    if s.backend != "auto":
+        label += f"@{s.backend}"
     return label
 
 
@@ -789,6 +882,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--vocab", type=int, default=None)
     parser.add_argument("--force", action="store_true",
                         help="re-tune even for cached shapes")
+    parser.add_argument("--backend", type=str, default=None,
+                        choices=("auto", "tpu", "gpu", "cpu", "interpret"),
+                        help="pin the kernel lowering backend for this run "
+                             "(sets C2V_KERNEL_BACKEND for the shared "
+                             "resolver, ops/backend.py)")
     parser.add_argument("--expect-cached", action="store_true",
                         help="exit 2 if any shape missed the cache (the "
                              "round-trip assertion: a second identical run "
@@ -802,6 +900,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--lut-capacity", type=int, default=256)
     parser.add_argument("--lut-shortlist", type=int, default=128)
     args = parser.parse_args(argv)
+
+    if args.backend:
+        from code2vec_tpu.ops.backend import ENV_VAR
+
+        os.environ[ENV_VAR] = args.backend
 
     cache = ScheduleCache(args.cache or default_cache_path())
     before = counters_snapshot()
@@ -834,6 +937,7 @@ def main(argv: list[str] | None = None) -> int:
         json.dumps(
             {
                 "device_kind": device_kind(),
+                "backend": _resolve_backend().label,
                 "cache": cache.path,
                 "dry": args.dry,
                 "schedules": {k: s.to_dict() for k, s in schedules.items()},

@@ -1,0 +1,114 @@
+"""Kernel backend resolution: device kind → lowering strategy.
+
+Every Pallas kernel in the repo used to carry its own copy of the same
+heuristic — ``interpret = jax.default_backend() != "tpu"`` — which meant
+off-TPU callers always paid the Pallas *interpreter* (correct, slow) and
+no caller could ask for a genuinely compiled non-TPU lowering. This
+module is the single replacement: one resolver maps the requested
+backend (explicit argument > ``C2V_KERNEL_BACKEND`` env > device auto)
+to a :class:`BackendStrategy`, and every kernel wrapper consumes that.
+
+Strategies (``BackendStrategy.strategy``):
+
+- ``"pallas_tpu"`` — the TPU kernel formulation (DMA gathers, VMEM
+  scratch, semaphores). Compiled on TPU; anywhere else it runs under the
+  Pallas interpreter (``interpret=True``) — the pre-existing test mode,
+  kept bit-for-bit so parity suites still validate the TPU kernel bodies
+  on CPU.
+- ``"pallas_gpu"`` — the GPU (Triton-lowered) kernel formulation:
+  XLA-side gathers feed portable kernel bodies (no TPU memory spaces,
+  no DMA/semaphores) behind warp-friendly block specs. Compiled on GPU;
+  elsewhere it runs under the interpreter so the GPU formulation is
+  validated even on CPU-only CI.
+- ``"cpu"`` — the compiled CPU strategy: plain XLA formulations with the
+  kernels' exact masking/softmax semantics. NEVER enters the Pallas
+  interpreter (``interpret`` is always False) — this is what serving and
+  bench paths get on CPU by default.
+
+Resolution precedence (``resolve``):
+
+1. An explicit ``interpret`` bool with no explicit backend — the legacy
+   per-call flag. ``True`` pins the TPU formulation under the
+   interpreter; ``False`` compiles for the device we are actually on.
+2. An explicit ``backend`` argument (``models.Code2VecConfig
+   .pallas_backend``, autotune's per-variant backend axis).
+3. ``C2V_KERNEL_BACKEND`` env — ``auto`` | ``tpu`` | ``gpu`` | ``cpu``
+   | ``interpret``. The test suite pins ``interpret`` (tests/conftest.py)
+   so existing suites exercise the kernel bodies unchanged; the CI
+   kernel-portability job pins ``cpu`` to run the same suites compiled.
+4. Device auto: tpu→pallas_tpu, gpu→pallas_gpu, cpu→cpu.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+ENV_VAR = "C2V_KERNEL_BACKEND"
+BACKENDS = ("auto", "tpu", "gpu", "cpu", "interpret")
+STRATEGIES = ("pallas_tpu", "pallas_gpu", "cpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendStrategy:
+    """One resolved lowering decision (hashable — goes into jit statics
+    and provenance records)."""
+
+    backend: str  # device family the lowering targets: "tpu"|"gpu"|"cpu"
+    strategy: str  # "pallas_tpu" | "pallas_gpu" | "cpu"
+    interpret: bool  # Pallas interpreter? (always False for "cpu")
+
+    @property
+    def label(self) -> str:
+        """Compact provenance form: ``cpu``, ``pallas_tpu``,
+        ``pallas_tpu:interpret``, ``pallas_gpu`` …"""
+        return self.strategy + (":interpret" if self.interpret else "")
+
+
+def device_backend() -> str:
+    """The platform jax actually runs on, folded to {tpu, gpu, cpu}."""
+    b = jax.default_backend()
+    return b if b in ("tpu", "gpu") else "cpu"
+
+
+def _for_family(family: str, interpret: bool | None) -> BackendStrategy:
+    dev = device_backend()
+    if family == "tpu":
+        itp = (dev != "tpu") if interpret is None else bool(interpret)
+        return BackendStrategy("tpu", "pallas_tpu", itp)
+    if family == "gpu":
+        itp = (dev != "gpu") if interpret is None else bool(interpret)
+        return BackendStrategy("gpu", "pallas_gpu", itp)
+    # the compiled CPU strategy is plain XLA by construction — there is
+    # no interpreter to fall into
+    return BackendStrategy("cpu", "cpu", False)
+
+
+def resolve(
+    backend: str | None = None, interpret: bool | None = None
+) -> BackendStrategy:
+    """Resolve the lowering strategy for one kernel call site.
+
+    ``backend`` is one of :data:`BACKENDS` (or None = consult the env /
+    device). ``interpret`` is the legacy per-call flag: an explicit bool
+    with no explicit backend wins over everything (True pins the TPU
+    formulation under the interpreter — what parity tests pass); combined
+    with an explicit tpu/gpu backend it overrides that family's
+    compiled-vs-interpret default.
+    """
+    req = (backend or "").strip().lower() or None
+    if req is None:
+        if interpret is not None:
+            if interpret:
+                return BackendStrategy(device_backend(), "pallas_tpu", True)
+            return _for_family(device_backend(), False)
+        req = os.environ.get(ENV_VAR, "").strip().lower() or "auto"
+    if req not in BACKENDS:
+        raise ValueError(f"backend must be one of {BACKENDS}, got {req!r}")
+    if req == "interpret":
+        return BackendStrategy(device_backend(), "pallas_tpu", True)
+    if req == "auto":
+        req = device_backend()
+    return _for_family(req, interpret)

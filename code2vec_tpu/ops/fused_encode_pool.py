@@ -54,6 +54,15 @@ reuse the same DMA double-buffer machinery and reproduce the exact
 masking semantics above (the running max starts at ``-inf`` and column 0
 is always a real lane, so no NaN path exists). ``fused`` impl only —
 the other impls materialize O(L·E) inputs by construction.
+
+Multi-backend lowering (``ops/backend.py``): the TPU kernels above are
+the ``pallas_tpu`` strategy. ``pallas_gpu`` lowers the portable
+``gather_split`` kernel body through Pallas's Triton backend (no TPU
+memory spaces or DMA — XLA gathers feed the same encode→attend→pool
+tile). ``cpu`` is a compiled XLA strategy: ``_compiled_chain_forward``
+sweeps ``_encode_f32``/``_pool_f32`` over the exact tiles the
+interpret-mode grid would visit, so it is bitwise-equal to the
+interpreter without ever entering it.
 """
 
 from __future__ import annotations
@@ -68,6 +77,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from code2vec_tpu.analysis.contracts import shape_contract, spec
 from code2vec_tpu.ops.attention import NINF, attention_pool
+from code2vec_tpu.ops.backend import resolve as resolve_backend
 from code2vec_tpu.ops.quant import QuantTable
 
 _LANE = 128
@@ -96,6 +106,12 @@ class FusedStatic:
     has_off: bool
     interpret: bool
     softmax: str = "materialize"  # "materialize" | "online" | "two_pass"
+    # lowering strategy (ops/backend.py): "pallas_tpu" keeps the original
+    # TPU kernel (compiled on TPU, interpreter elsewhere); "pallas_gpu"
+    # lowers the portable gather_split kernel body via Triton with
+    # GPU-friendly block specs; "cpu" runs the compiled XLA tile sweep
+    # (_compiled_chain_forward) — never the Pallas interpreter
+    strategy: str = "pallas_tpu"
 
 
 # full primal layout of the custom_vjp op (entries may be None per static)
@@ -428,10 +444,62 @@ def _make_fused_kernel(
     return _kernel
 
 
+def _compiled_chain_forward(static: FusedStatic, args: dict):
+    """The compiled CPU strategy: the gather_split tile computation as
+    plain XLA, swept (``lax.map``) over the identical ``[block_b, lp, ·]``
+    tiles the interpret-mode kernel grid would visit — same padding, same
+    per-tile arithmetic (``_encode_f32``/``_pool_f32``), so the outputs
+    are bitwise-equal to the interpreter at compiled-XLA cost. No
+    ``pallas_call`` anywhere on this path."""
+    starts = args["starts"]
+    b, l = starts.shape
+    h = args["dense_kernel"].shape[-1]
+    block_b = static.block_b
+    bp = _round_up(max(b, 1), block_b)
+    lp = _round_up(max(l, 1), _LANE)
+
+    mask_p = _pad_dim(_pad_dim(args["mask"].astype(jnp.float32), 0, bp), 1, lp)
+    kern = args["dense_kernel"].astype(jnp.float32)
+    lns = args["ln_scale"].reshape(1, h).astype(jnp.float32)
+    lnb = args["ln_bias"].reshape(1, h).astype(jnp.float32)
+    attn = args["attn_param"].reshape(1, h).astype(jnp.float32)
+    gs = _pad_dim(_pad_dim(args["g_start"], 0, bp), 1, lp)
+    gp = _pad_dim(_pad_dim(args["g_path"], 0, bp), 1, lp)
+    ge = _pad_dim(_pad_dim(args["g_end"], 0, bp), 1, lp)
+    drop = args.get("drop_mask")
+    if drop is not None:
+        drop = _pad_dim(_pad_dim(drop.astype(jnp.float32), 0, bp), 1, lp)
+
+    n_tiles = bp // block_b
+
+    def tile(x):
+        return x.reshape((n_tiles, block_b) + x.shape[1:])
+
+    tiles = [tile(gs), tile(gp), tile(ge), tile(mask_p)]
+    if drop is not None:
+        tiles.append(tile(drop))
+
+    def one_tile(t):
+        enc = _encode_f32(
+            t[0].astype(jnp.float32), t[1].astype(jnp.float32),
+            t[2].astype(jnp.float32), kern, lns, lnb,
+        )
+        if drop is not None:
+            enc = enc * t[4]
+        return _pool_f32(enc, t[3], attn, l)
+
+    cv, weights = jax.lax.map(one_tile, tuple(tiles))
+    return cv.reshape(bp, h)[:b], weights.reshape(bp, lp)[:b, :l]
+
+
 def _kernel_forward(static: FusedStatic, args: dict):
-    """Pad, tile, and run the selected Pallas kernel. ``args`` holds the
+    """Pad, tile, and run the selected lowering. ``args`` holds the
     kernel-relevant arrays (tables/scales or pre-gathered rows, ids, mask,
-    encoder params, optional drop mask)."""
+    encoder params, optional drop mask). ``strategy="cpu"`` short-circuits
+    to the compiled XLA tile sweep; the Pallas strategies differ only in
+    memory-space annotations (TPU: VMEM/ANY; GPU: compiler-chosen)."""
+    if static.strategy == "cpu":
+        return _compiled_chain_forward(static, args)
     starts, paths, ends = args["starts"], args["paths"], args["ends"]
     mask = args["mask"]
     b, l = starts.shape
@@ -445,15 +513,18 @@ def _kernel_forward(static: FusedStatic, args: dict):
 
     mask_p = _pad_dim(_pad_dim(mask.astype(jnp.float32), 0, bp), 1, lp)
     grid = (bp // block_b,)
+    # GPU (Triton) lowering rejects TPU memory spaces — let the compiler
+    # place blocks there; the TPU strategy pins VMEM as before
+    ms = pltpu.VMEM if static.strategy != "pallas_gpu" else None
 
     def tile2(x):  # [B, L] → blocked (block_b, lp)
         return pl.BlockSpec(
-            (block_b, x.shape[-1]), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (block_b, x.shape[-1]), lambda i: (i, 0), memory_space=ms
         )
 
     def vec_spec(x):  # params broadcast to every tile
         return pl.BlockSpec(
-            x.shape, lambda i: (0,) * x.ndim, memory_space=pltpu.VMEM
+            x.shape, lambda i: (0,) * x.ndim, memory_space=ms
         )
 
     kern = args["dense_kernel"].astype(jnp.float32)
@@ -469,8 +540,8 @@ def _kernel_forward(static: FusedStatic, args: dict):
         jax.ShapeDtypeStruct((bp, lp), jnp.float32),
     ]
     out_specs = [
-        pl.BlockSpec((block_b, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, h), lambda i: (i, 0), memory_space=ms),
+        pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=ms),
     ]
 
     if static.impl == "gather_split":
@@ -482,15 +553,15 @@ def _kernel_forward(static: FusedStatic, args: dict):
         in_specs = [
             pl.BlockSpec(
                 (block_b, lp, gs.shape[-1]), lambda i: (i, 0, 0),
-                memory_space=pltpu.VMEM,
+                memory_space=ms,
             ),
             pl.BlockSpec(
                 (block_b, lp, gp.shape[-1]), lambda i: (i, 0, 0),
-                memory_space=pltpu.VMEM,
+                memory_space=ms,
             ),
             pl.BlockSpec(
                 (block_b, lp, ge.shape[-1]), lambda i: (i, 0, 0),
-                memory_space=pltpu.VMEM,
+                memory_space=ms,
             ),
             tile2(mask_p), vec_spec(kern), vec_spec(lns), vec_spec(lnb),
             vec_spec(attn),
@@ -500,12 +571,18 @@ def _kernel_forward(static: FusedStatic, args: dict):
             in_specs.append(
                 pl.BlockSpec(
                     (block_b, lp, h), lambda i: (i, 0, 0),
-                    memory_space=pltpu.VMEM,
+                    memory_space=ms,
                 )
             )
         kernel = _make_split_kernel(l, drop is not None)
         scratch_shapes: list = []
     elif static.impl == "fused":
+        if static.strategy == "pallas_gpu":
+            raise ValueError(
+                "impl='fused' (in-kernel DMA gather) is a TPU-only "
+                "formulation; the gpu strategy lowers 'gather_split' "
+                "(the public wrapper rewrites this automatically)"
+            )
         t_vals, p_vals = args["t_vals"], args["p_vals"]
         quant = static.table_dtype == "int8"
         ids = [
@@ -827,6 +904,7 @@ def fused_encode_attend_pool(
     softmax_mode: str = "materialize",
     compute_dtype=jnp.float32,
     interpret: bool | None = None,
+    backend: str | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused forward for the whole code2vec aggregation chain.
 
@@ -846,8 +924,14 @@ def fused_encode_attend_pool(
     arbitrary bag length; ``impl="fused"`` only — the other impls
     materialize O(L·E) inputs by construction).
 
-    ``interpret=None`` auto-selects: compiled on TPU, interpreter
-    elsewhere (tests and the CPU mesh run the same code path).
+    ``backend``/``interpret`` route through the shared resolver
+    (``ops/backend.py``): explicit ``interpret`` keeps its legacy meaning
+    (True pins the TPU formulation under the Pallas interpreter); with
+    both None the ``C2V_KERNEL_BACKEND`` env or the device decides. Under
+    the ``cpu`` and ``pallas_gpu`` strategies ``impl="fused"`` lowers as
+    ``gather_split`` (the in-kernel DMA gather is TPU-only) and chunked
+    softmax modes compute the materialized formulation — same semantics,
+    host/GPU memory is not VMEM-bounded.
     """
     if impl not in FUSED_IMPLS:
         raise ValueError(f"impl must be one of {FUSED_IMPLS}, got {impl!r}")
@@ -862,8 +946,12 @@ def fused_encode_attend_pool(
             f"{impl!r} materializes the full bag before the kernel runs, "
             "so streaming the softmax would not bound VMEM"
         )
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    bs = resolve_backend(backend=backend, interpret=interpret)
+    if bs.strategy != "pallas_tpu":
+        if impl == "fused":
+            impl = "gather_split"
+        if softmax_mode != "materialize":
+            softmax_mode = "materialize"
     t_vals, t_scale, table_dtype = _split_table(t_table)
     p_vals, p_scale, p_dtype = _split_table(p_table)
     if table_dtype != p_dtype:
@@ -884,8 +972,9 @@ def fused_encode_attend_pool(
         compute=jnp.dtype(compute_dtype).name,
         has_drop=drop_mask is not None,
         has_off=off_se is not None,
-        interpret=bool(interpret),
+        interpret=bs.interpret,
         softmax=softmax_mode,
+        strategy=bs.strategy,
     )
     args = (
         t_vals, t_scale, p_vals, p_scale,

@@ -28,38 +28,46 @@ from jax.experimental.pallas import tpu as pltpu
 
 from code2vec_tpu.analysis.contracts import shape_contract
 from code2vec_tpu.ops.attention import NINF, POOL_CONTRACT
+from code2vec_tpu.ops.backend import BackendStrategy
+from code2vec_tpu.ops.backend import resolve as resolve_backend
 
 _BLOCK_B = 8
 _LANE = 128
 
 
-def _make_kernel(real_l: int):
-    """Kernel closure over the un-padded bag length.
+def _tile_pool(ctx, mask, attn, real_l: int):
+    """The per-tile pool arithmetic — shared verbatim by the Pallas kernel
+    and the compiled CPU strategy so their outputs are bitwise-equal.
 
     Lane-padding columns (l >= real_l) get a hard -inf — distinct from the
     finite NINF that *user*-masked positions get (parity with
     model/model.py:93) — so that a fully-masked row degenerates to uniform
     over the real bag length exactly like the XLA path, instead of leaking
     mass into the padding."""
+    # VPU form throughout: Mosaic cannot lower batched dot_general, and
+    # at these shapes (E <= a few hundred) the reductions are
+    # bandwidth-bound anyway
+    ctx32 = ctx.astype(jnp.float32)
+    scores = jnp.sum(ctx32 * attn[0][None, None, :], axis=2)  # [TB, Lp]
+    masked = scores * mask + (1.0 - mask) * NINF
+    tb, lp = masked.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (tb, lp), 1)
+    masked = jnp.where(col < real_l, masked, -jnp.inf)
+    masked = masked - jnp.max(masked, axis=-1, keepdims=True)
+    e = jnp.exp(masked)
+    weights = e / jnp.sum(e, axis=-1, keepdims=True)
+    cv = jnp.sum(ctx32 * weights[:, :, None], axis=1)  # [TB, E]
+    return cv, weights
+
+
+def _make_kernel(real_l: int):
+    """Kernel closure over the un-padded bag length (see ``_tile_pool``
+    for the masking semantics)."""
 
     def _kernel(ctx_ref, mask_ref, attn_ref, cv_ref, w_ref):
-        ctx = ctx_ref[:]  # [TB, Lp, E]
-        mask = mask_ref[:].astype(jnp.float32)  # [TB, Lp]
-        attn = attn_ref[:]  # [1, E]
-
-        # VPU form throughout: Mosaic cannot lower batched dot_general, and
-        # at these shapes (E <= a few hundred) the reductions are
-        # bandwidth-bound anyway
-        ctx32 = ctx.astype(jnp.float32)
-        scores = jnp.sum(ctx32 * attn[0][None, None, :], axis=2)  # [TB, Lp]
-        masked = scores * mask + (1.0 - mask) * NINF
-        tb, lp = masked.shape
-        col = jax.lax.broadcasted_iota(jnp.int32, (tb, lp), 1)
-        masked = jnp.where(col < real_l, masked, -jnp.inf)
-        masked = masked - jnp.max(masked, axis=-1, keepdims=True)
-        e = jnp.exp(masked)
-        weights = e / jnp.sum(e, axis=-1, keepdims=True)
-        cv = jnp.sum(ctx32 * weights[:, :, None], axis=1)  # [TB, E]
+        cv, weights = _tile_pool(
+            ctx_ref[:], mask_ref[:].astype(jnp.float32), attn_ref[:], real_l
+        )
         cv_ref[:] = cv.astype(cv_ref.dtype)
         w_ref[:] = weights
 
@@ -76,33 +84,51 @@ def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
     return jnp.pad(x, pad)
 
 
-def _forward(contexts, mask, attn_param, *, block_b: int, interpret: bool):
+def _forward(contexts, mask, attn_param, *, block_b: int,
+             strategy: BackendStrategy):
     b, bag, enc = contexts.shape
     ctx_p = _pad_to(_pad_to(contexts, 0, block_b), 1, _LANE)
     mask_p = _pad_to(_pad_to(mask.astype(jnp.float32), 0, block_b), 1, _LANE)
     bp, lp = ctx_p.shape[0], ctx_p.shape[1]
+    attn = attn_param.reshape(1, enc).astype(jnp.float32)
 
+    if strategy.strategy == "cpu":
+        # compiled CPU strategy: sweep the identical tile arithmetic over
+        # the same blocks in plain XLA — bitwise-equal to the interpreter
+        # without entering it
+        n_tiles = bp // block_b
+        cv, weights = jax.lax.map(
+            lambda t: _tile_pool(t[0], t[1], attn, bag),
+            (
+                ctx_p.reshape(n_tiles, block_b, lp, enc),
+                mask_p.reshape(n_tiles, block_b, lp),
+            ),
+        )
+        return (
+            cv.reshape(bp, enc).astype(jnp.float32)[:b],
+            weights.reshape(bp, lp)[:b, :bag],
+        )
+
+    ms = pltpu.VMEM if strategy.strategy != "pallas_gpu" else None
     grid = (bp // block_b,)
     cv, weights = pl.pallas_call(
         _make_kernel(bag),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(
-                (block_b, lp, enc), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, enc), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, lp, enc), lambda i: (i, 0, 0), memory_space=ms),
+            pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=ms),
+            pl.BlockSpec((1, enc), lambda i: (0, 0), memory_space=ms),
         ],
         out_specs=[
-            pl.BlockSpec((block_b, enc), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, enc), lambda i: (i, 0), memory_space=ms),
+            pl.BlockSpec((block_b, lp), lambda i: (i, 0), memory_space=ms),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bp, enc), jnp.float32),
             jax.ShapeDtypeStruct((bp, lp), jnp.float32),
         ],
-        interpret=interpret,
-    )(ctx_p, mask_p, attn_param.reshape(1, enc).astype(jnp.float32))
+        interpret=strategy.interpret,
+    )(ctx_p, mask_p, attn)
     return cv[:b], weights[:b, :bag]
 
 
@@ -129,21 +155,21 @@ def compat_def_partition(p, *, partition, infer_sharding_from_operands,
 _partitioned_forward_cache: dict = {}
 
 
-def _get_partitioned_forward(block_b: int, interpret: bool):
+def _get_partitioned_forward(block_b: int, strategy: BackendStrategy):
     """The pallas forward wrapped in ``custom_partitioning`` so GSPMD can
     shard it batch-wise over a mesh instead of replicating the Mosaic
     custom call behind a full all-gather. The rule: batch follows the
     operand sharding, bag/encode dims are forced replicated per shard (the
     kernel's softmax needs the whole bag) — GSPMD inserts the resharding
     if an upstream op sharded them."""
-    key = (block_b, interpret)
+    key = (block_b, strategy)
     if key not in _partitioned_forward_cache:
         from jax.experimental.custom_partitioning import custom_partitioning
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def fwd(contexts, mask, attn_param):
             return _forward(
-                contexts, mask, attn_param, block_b=block_b, interpret=interpret
+                contexts, mask, attn_param, block_b=block_b, strategy=strategy
             )
 
         def _batch_spec(arg_shapes):
@@ -182,20 +208,20 @@ def _get_partitioned_forward(block_b: int, interpret: bool):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _pool(contexts, mask, attn_param, block_b, interpret):
-    return _get_partitioned_forward(block_b, interpret)(
+def _pool(contexts, mask, attn_param, block_b, strategy):
+    return _get_partitioned_forward(block_b, strategy)(
         contexts, mask, attn_param
     )
 
 
-def _pool_fwd(contexts, mask, attn_param, block_b, interpret):
-    cv, weights = _get_partitioned_forward(block_b, interpret)(
+def _pool_fwd(contexts, mask, attn_param, block_b, strategy):
+    cv, weights = _get_partitioned_forward(block_b, strategy)(
         contexts, mask, attn_param
     )
     return (cv, weights), (contexts, mask, attn_param, weights)
 
 
-def _pool_bwd(block_b, interpret, residuals, grads):
+def _pool_bwd(block_b, strategy, residuals, grads):
     contexts, mask, attn_param, weights = residuals
     g_cv, g_w = grads
     ctx32 = contexts.astype(jnp.float32)
@@ -234,12 +260,15 @@ def pallas_attention_pool(
     attn_param: jnp.ndarray,  # [E]
     block_b: int = _BLOCK_B,
     interpret: bool | None = None,
+    backend: str | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in replacement for ops.attention.attention_pool.
 
-    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
-    (so tests and the CPU mesh exercise the same code path).
+    ``backend``/``interpret`` route through the shared resolver
+    (``ops/backend.py``): the resolved strategy picks the TPU kernel, the
+    GPU (Triton) lowering, or the compiled CPU tile sweep — an explicit
+    ``interpret=True`` keeps its legacy meaning (TPU formulation under
+    the Pallas interpreter, the parity-test mode).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _pool(contexts, mask, attn_param, block_b, interpret)
+    bs = resolve_backend(backend=backend, interpret=interpret)
+    return _pool(contexts, mask, attn_param, block_b, bs)

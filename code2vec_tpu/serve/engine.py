@@ -290,6 +290,7 @@ class ServingEngine:
                 (b, w) for w in self.active_ladder for b in self.batch_sizes
             ]
             schedules = self._consult(shapes)
+            kernel_backend = self._kernel_backend_label()
             for b, w in shapes:
                 if (b, w) in self._compiled:
                     continue
@@ -298,6 +299,7 @@ class ServingEngine:
                     "width": w,
                     "version": self.version,
                     "table_dtype": self.table_dtype,
+                    "kernel_backend": kernel_backend,
                     "compile_ms": self._compile(b, w),
                     "schedule": schedules.get((b, w), {}).get("schedule"),
                     "schedule_cached": schedules.get((b, w), {}).get("cached"),
@@ -309,6 +311,15 @@ class ServingEngine:
             self._warmed = True
             self._health.gauge("serve_executables").set(len(self._compiled))
             return list(self.provenance)
+
+    def _kernel_backend_label(self) -> str:
+        """Resolved default lowering-strategy label (ops/backend.py) for
+        this process — what a schedule with ``backend="auto"`` lowers to.
+        Provenance only: per-schedule overrides ride in the schedule dict
+        itself (its ``backend`` field)."""
+        from code2vec_tpu.ops.backend import resolve as resolve_backend
+
+        return resolve_backend().label
 
     def _compile(self, b: int, w: int) -> float:
         """AOT-compile one (batch, width) executable; returns compile ms."""
@@ -425,6 +436,7 @@ class ServingEngine:
                     "width": key[1],
                     "version": self.version,
                     "table_dtype": self.table_dtype,
+                    "kernel_backend": self._kernel_backend_label(),
                     "compile_ms": self._compile(*key),
                     "schedule": None,
                     "schedule_cached": None,

@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the suite's kernel calls default to the Pallas INTERPRETER: the parity
+# tests exist to validate the TPU kernel bodies on CPU, and the pre-backend
+# suites were written against that behavior. The CI kernel-portability job
+# (and any caller) overrides with C2V_KERNEL_BACKEND=cpu to run the same
+# suites through the compiled CPU strategy instead (ops/backend.py);
+# setdefault keeps that override — and per-test monkeypatching — working.
+os.environ.setdefault("C2V_KERNEL_BACKEND", "interpret")
 # subprocess-spawning tests (multiprocess workers, tool drives) inherit the
 # compile cache through the env var form of the same knob. Per-user suffix:
 # a fixed /tmp path collides across users on shared machines (permission

@@ -1,0 +1,544 @@
+"""Multi-backend kernel portability (ops/backend.py — ISSUE 19).
+
+Covers the shared resolver (precedence: explicit interpret > explicit
+backend > C2V_KERNEL_BACKEND env > device auto), the compiled CPU
+strategy's bitwise parity against the interpret-mode Pallas reference
+for both hot kernels (fused encode-pool and the ANN LUT), golden-request
+parity at the model level under the PR-12 GoldenSet tolerance rules
+(embeddings bitwise, logits within reduction-order tolerance), mesh-path
+parity on the 8-device harness, and the autotune cache's backend axis
+(round-trip + pre-backend entry deserialization).
+
+The suite runs with NO reliance on the conftest interpret pin: every
+test that cares about the env sets it explicitly via monkeypatch.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.ops import backend as kb
+
+ON_GPU = jax.default_backend() == "gpu"
+
+
+# ---------------------------------------------------------------------------
+# resolver units
+# ---------------------------------------------------------------------------
+class TestResolver:
+    def test_device_auto_on_cpu(self, monkeypatch):
+        monkeypatch.delenv(kb.ENV_VAR, raising=False)
+        bs = kb.resolve()
+        assert (bs.backend, bs.strategy, bs.interpret) == ("cpu", "cpu", False)
+        assert bs.label == "cpu"
+
+    @pytest.mark.parametrize(
+        "env,expect",
+        [
+            ("cpu", ("cpu", "cpu", False)),
+            ("gpu", ("gpu", "pallas_gpu", True)),  # off-GPU -> interpreter
+            ("tpu", ("tpu", "pallas_tpu", True)),  # off-TPU -> interpreter
+            ("interpret", ("cpu", "pallas_tpu", True)),
+        ],
+    )
+    def test_env_resolution(self, monkeypatch, env, expect):
+        monkeypatch.setenv(kb.ENV_VAR, env)
+        bs = kb.resolve()
+        assert (bs.backend, bs.strategy, bs.interpret) == expect
+
+    def test_explicit_backend_beats_env(self, monkeypatch):
+        monkeypatch.setenv(kb.ENV_VAR, "cpu")
+        assert kb.resolve(backend="interpret").interpret is True
+        assert kb.resolve(backend="gpu").strategy == "pallas_gpu"
+
+    def test_legacy_interpret_flag_beats_env(self, monkeypatch):
+        monkeypatch.setenv(kb.ENV_VAR, "cpu")
+        bs = kb.resolve(interpret=True)
+        assert bs.strategy == "pallas_tpu" and bs.interpret is True
+        # interpret=False compiles for the device actually present
+        bs = kb.resolve(interpret=False)
+        assert bs.interpret is False
+        assert bs.strategy == ("pallas_gpu" if ON_GPU else "cpu")
+
+    def test_explicit_backend_with_interpret_override(self, monkeypatch):
+        monkeypatch.delenv(kb.ENV_VAR, raising=False)
+        bs = kb.resolve(backend="gpu", interpret=True)
+        assert (bs.strategy, bs.interpret) == ("pallas_gpu", True)
+        bs = kb.resolve(backend="gpu", interpret=False)
+        assert (bs.strategy, bs.interpret) == ("pallas_gpu", False)
+        # the cpu strategy never interprets, whatever the flag says
+        assert kb.resolve(backend="cpu", interpret=True).interpret is False
+
+    def test_unknown_backend_rejected(self, monkeypatch):
+        with pytest.raises(ValueError, match="backend must be one of"):
+            kb.resolve(backend="mps")
+        monkeypatch.setenv(kb.ENV_VAR, "bogus")
+        with pytest.raises(ValueError, match="backend must be one of"):
+            kb.resolve()
+
+    def test_label_forms(self):
+        assert kb.BackendStrategy("tpu", "pallas_tpu", True).label == (
+            "pallas_tpu:interpret"
+        )
+        assert kb.BackendStrategy("cpu", "cpu", False).label == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# compiled CPU strategy: bitwise parity with the interpret-mode reference
+# ---------------------------------------------------------------------------
+def _fused_inputs(b=5, l=9, et=4, ep=6, h=8, seed=0):
+    rng = np.random.default_rng(seed)
+    t_table = jnp.asarray(rng.normal(size=(30, et)).astype(np.float32))
+    p_table = jnp.asarray(rng.normal(size=(25, ep)).astype(np.float32))
+    starts = jnp.asarray(rng.integers(1, 30, (b, l)).astype(np.int32))
+    paths = jnp.asarray(rng.integers(1, 25, (b, l)).astype(np.int32))
+    ends = jnp.asarray(rng.integers(1, 30, (b, l)).astype(np.int32))
+    mask = jnp.asarray((rng.random((b, l)) > 0.3).astype(np.float32))
+    mask = mask.at[0].set(0.0)  # a fully-masked row rides along
+    kern = jnp.asarray(
+        rng.normal(size=(2 * et + ep, h)).astype(np.float32) * 0.2
+    )
+    ln_s = jnp.asarray(rng.normal(size=h).astype(np.float32) * 0.1 + 1.0)
+    ln_b = jnp.asarray(rng.normal(size=h).astype(np.float32) * 0.1)
+    attn = jnp.asarray(rng.normal(size=h).astype(np.float32))
+    return t_table, p_table, starts, paths, ends, mask, kern, ln_s, ln_b, attn
+
+
+class TestCompiledCpuParity:
+    def test_gather_split_bitwise_vs_interpreter(self):
+        from code2vec_tpu.ops.fused_encode_pool import fused_encode_attend_pool
+
+        args = _fused_inputs()
+        cv_c, w_c = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="cpu"
+        )
+        cv_i, w_i = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="interpret"
+        )
+        assert np.array_equal(np.asarray(cv_c), np.asarray(cv_i))
+        assert np.array_equal(np.asarray(w_c), np.asarray(w_i))
+
+    def test_fused_impl_rewrites_to_gather_split_on_cpu(self):
+        from code2vec_tpu.ops.fused_encode_pool import fused_encode_attend_pool
+
+        args = _fused_inputs()
+        cv_f, w_f = fused_encode_attend_pool(
+            *args, impl="fused", block_b=2, backend="cpu"
+        )
+        cv_g, w_g = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="cpu"
+        )
+        assert np.array_equal(np.asarray(cv_f), np.asarray(cv_g))
+        assert np.array_equal(np.asarray(w_f), np.asarray(w_g))
+
+    def test_cpu_strategy_matches_xla_reference(self):
+        from code2vec_tpu.ops.fused_encode_pool import (
+            fused_encode_attend_pool,
+            xla_reference_forward,
+        )
+
+        args = _fused_inputs()
+        cv_c, w_c = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="cpu"
+        )
+        cv_r, w_r = xla_reference_forward(*args)
+        np.testing.assert_allclose(
+            np.asarray(cv_c), np.asarray(cv_r), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(w_c), np.asarray(w_r), rtol=1e-5, atol=1e-6
+        )
+
+    def test_cpu_strategy_never_enters_interpreter(self, monkeypatch):
+        # the proof the serving path needs: with the interpreter made to
+        # explode, the compiled CPU strategy still runs both kernels
+        import jax.experimental.pallas as pl
+
+        def boom(*a, **kw):
+            if kw.get("interpret"):
+                raise AssertionError("Pallas interpreter entered")
+            return orig(*a, **kw)
+
+        orig = pl.pallas_call
+        from code2vec_tpu.ann import lut_kernel
+        from code2vec_tpu.ops import fused_encode_pool, pallas_attention
+
+        for mod in (fused_encode_pool, pallas_attention, lut_kernel):
+            monkeypatch.setattr(mod.pl, "pallas_call", boom)
+        args = _fused_inputs()
+        fused_encode_pool.fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="cpu"
+        )
+        pallas_attention.pallas_attention_pool(
+            jnp.ones((4, 8, 8)), jnp.ones((4, 8)), jnp.ones(8),
+            block_b=2, backend="cpu",
+        )
+        lut, probed, codes, scales, bias = _lut_inputs()
+        lut_kernel.lut_score_cells(
+            lut, probed, codes, scales, bias, impl="pallas", backend="cpu"
+        )
+
+    def test_pool_only_bitwise_vs_interpreter(self):
+        from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+        rng = np.random.default_rng(1)
+        ctx = jnp.asarray(rng.normal(size=(6, 10, 8)).astype(np.float32))
+        mask = jnp.asarray((rng.random((6, 10)) > 0.4).astype(np.float32))
+        attn = jnp.asarray(rng.normal(size=8).astype(np.float32))
+        cv_c, w_c = pallas_attention_pool(
+            ctx, mask, attn, block_b=2, backend="cpu"
+        )
+        cv_i, w_i = pallas_attention_pool(
+            ctx, mask, attn, block_b=2, backend="interpret"
+        )
+        assert np.array_equal(np.asarray(cv_c), np.asarray(cv_i))
+        assert np.array_equal(np.asarray(w_c), np.asarray(w_i))
+
+    def test_grad_through_cpu_strategy(self):
+        from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+        rng = np.random.default_rng(2)
+        ctx = jnp.asarray(rng.normal(size=(4, 8, 8)).astype(np.float32))
+        mask = jnp.asarray((rng.random((4, 8)) > 0.4).astype(np.float32))
+        attn = jnp.asarray(rng.normal(size=8).astype(np.float32))
+
+        def loss(c, a, backend):
+            cv, _ = pallas_attention_pool(
+                c, mask, a, block_b=2, backend=backend
+            )
+            return jnp.sum(cv**2)
+
+        g_ctx_c, g_attn_c = jax.grad(loss, argnums=(0, 1))(ctx, attn, "cpu")
+        g_ctx_i, g_attn_i = jax.grad(loss, argnums=(0, 1))(
+            ctx, attn, "interpret"
+        )
+        assert np.all(np.isfinite(np.asarray(g_ctx_c)))
+        # the backward is shared closed-form XLA: identical across strategies
+        np.testing.assert_allclose(
+            np.asarray(g_ctx_c), np.asarray(g_ctx_i), rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_attn_c), np.asarray(g_attn_i), rtol=1e-6, atol=1e-7
+        )
+
+
+# ---------------------------------------------------------------------------
+# golden request set: compiled CPU strategy vs interpret-mode reference at
+# the model level, judged by the PR-12 GoldenSet rules (swap.py:
+# embeddings bitwise, logits rtol=1e-5 atol=1e-6)
+# ---------------------------------------------------------------------------
+class TestGoldenRequests:
+    def test_model_forward_golden_parity(self):
+        from code2vec_tpu.models.code2vec import Code2Vec, Code2VecConfig
+
+        base = dict(
+            terminal_count=40, path_count=35, label_count=9,
+            terminal_embed_size=4, path_embed_size=6, encode_size=8,
+            dropout_prob=0.0, use_pallas=True, pallas_impl="gather_split",
+            pallas_block_b=2,
+        )
+        compiled = Code2Vec(Code2VecConfig(**base, pallas_backend="cpu"))
+        reference = Code2Vec(
+            Code2VecConfig(**base, pallas_backend="interpret")
+        )
+        rng = np.random.default_rng(3)
+        key = jax.random.PRNGKey(0)
+        # n_per_width requests at and just under each ladder rung — the
+        # GoldenSet sweep shape (serve/swap.py)
+        widths = (8, 16)
+        init_s = jnp.asarray(rng.integers(1, 40, (2, 8)).astype(np.int32))
+        init_p = jnp.asarray(rng.integers(1, 35, (2, 8)).astype(np.int32))
+        params = compiled.init(key, init_s, init_p, init_s)
+        for w in widths:
+            s = rng.integers(1, 40, (4, w)).astype(np.int32)
+            p = rng.integers(1, 35, (4, w)).astype(np.int32)
+            e = rng.integers(1, 40, (4, w)).astype(np.int32)
+            s[:, w - 2:] = 0  # requests "just under" the rung
+            logits_c, cv_c, _ = compiled.apply(params, s, p, e)
+            logits_r, cv_r, _ = reference.apply(params, s, p, e)
+            assert np.array_equal(np.asarray(cv_c), np.asarray(cv_r)), (
+                f"embeddings diverge bitwise from the interpret-mode "
+                f"reference at width {w}"
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits_c), np.asarray(logits_r),
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+# ---------------------------------------------------------------------------
+# mesh-path parity on the 8-device harness (SNIPPETS.md [2] pattern:
+# Mesh + PartitionSpec + shard_map under jit)
+# ---------------------------------------------------------------------------
+class TestMeshParity:
+    def _inputs(self):
+        rng = np.random.default_rng(4)
+        ctx = jnp.asarray(rng.normal(size=(16, 12, 8)).astype(np.float32))
+        mask = jnp.asarray((rng.random((16, 12)) > 0.3).astype(np.float32))
+        attn = jnp.asarray(rng.normal(size=8).astype(np.float32))
+        return ctx, mask, attn
+
+    def test_shard_map_bitwise(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+        ctx, mask, attn = self._inputs()
+        ref_cv, ref_w = pallas_attention_pool(
+            ctx, mask, attn, block_b=2, backend="cpu"
+        )
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        fn = lambda c, m, a: pallas_attention_pool(  # noqa: E731
+            c, m, a, block_b=2, backend="cpu"
+        )
+        sharded = shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("data"), P("data"), P()),
+            out_specs=(P("data"), P("data")),
+            check_rep=False,  # custom_partitioning has no replication rule
+        )
+        with mesh:
+            cv, w = jax.jit(sharded)(ctx, mask, attn)
+        assert np.array_equal(np.asarray(cv), np.asarray(ref_cv))
+        assert np.array_equal(np.asarray(w), np.asarray(ref_w))
+
+    def test_custom_partitioning_bitwise(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+        ctx, mask, attn = self._inputs()
+        ref_cv, ref_w = pallas_attention_pool(
+            ctx, mask, attn, block_b=2, backend="cpu"
+        )
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        cs = jax.device_put(ctx, NamedSharding(mesh, P("data")))
+        ms = jax.device_put(mask, NamedSharding(mesh, P("data")))
+        As = jax.device_put(attn, NamedSharding(mesh, P()))
+        cv, w = jax.jit(
+            lambda c, m, a: pallas_attention_pool(
+                c, m, a, block_b=2, backend="cpu"
+            )
+        )(cs, ms, As)
+        assert np.array_equal(np.asarray(cv), np.asarray(ref_cv))
+        assert np.array_equal(np.asarray(w), np.asarray(ref_w))
+
+
+# ---------------------------------------------------------------------------
+# ANN LUT kernel: strategy routing + GPU formulation validation
+# ---------------------------------------------------------------------------
+def _lut_inputs(q=3, m=4, entries=16, n_list=6, cap=8, p=2, seed=5):
+    rng = np.random.default_rng(seed)
+    lut = jnp.asarray(rng.normal(size=(q, m, entries)).astype(np.float32))
+    probed = jnp.asarray(rng.integers(0, n_list, (q, p)).astype(np.int32))
+    codes = jnp.asarray(
+        rng.integers(0, entries, (n_list, cap, m)).astype(np.uint8)
+    )
+    scales = jnp.asarray(
+        rng.random((n_list, cap)).astype(np.float32) + 0.5
+    )
+    bias = np.zeros((n_list, cap), np.float32)
+    bias[:, cap - 1] = -np.inf  # a pad slot per cell
+    return lut, probed, codes, scales, jnp.asarray(bias)
+
+
+class TestLutBackends:
+    def test_cpu_backend_routes_to_xla(self):
+        from code2vec_tpu.ann.lut_kernel import (
+            lut_score_cells,
+            xla_lut_score_cells,
+        )
+
+        lut, probed, codes, scales, bias = _lut_inputs()
+        got = lut_score_cells(
+            lut, probed, codes, scales, bias, impl="pallas", backend="cpu"
+        )
+        ref = xla_lut_score_cells(lut, probed, codes, scales, bias)
+        assert np.array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_gpu_formulation_validates_under_interpreter(self):
+        from code2vec_tpu.ann.lut_kernel import (
+            gpu_lut_score_cells,
+            xla_lut_score_cells,
+        )
+
+        lut, probed, codes, scales, bias = _lut_inputs()
+        got = gpu_lut_score_cells(
+            lut, probed, codes, scales, bias, interpret=True
+        )
+        ref = xla_lut_score_cells(lut, probed, codes, scales, bias)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+    def test_gpu_backend_resolution_runs_gpu_formulation(self):
+        # backend="gpu" off-GPU resolves to the GPU formulation under the
+        # interpreter — CPU-only CI still validates the Triton body
+        from code2vec_tpu.ann.lut_kernel import (
+            lut_score_cells,
+            xla_lut_score_cells,
+        )
+
+        lut, probed, codes, scales, bias = _lut_inputs()
+        got = lut_score_cells(
+            lut, probed, codes, scales, bias, impl="pallas", backend="gpu"
+        )
+        ref = xla_lut_score_cells(lut, probed, codes, scales, bias)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestGpuFormulationFused:
+    def test_gather_split_gpu_formulation_under_interpreter(self):
+        # the pallas_gpu lowering of gather_split (no TPU memory spaces)
+        # is bitwise-identical arithmetic: validate it on CPU via the
+        # interpreter against the TPU formulation
+        from code2vec_tpu.ops.fused_encode_pool import fused_encode_attend_pool
+
+        args = _fused_inputs(seed=6)
+        cv_g, w_g = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="gpu"
+        )
+        cv_t, w_t = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="interpret"
+        )
+        assert np.array_equal(np.asarray(cv_g), np.asarray(cv_t))
+        assert np.array_equal(np.asarray(w_g), np.asarray(w_t))
+
+    def test_pool_gpu_formulation_under_interpreter(self):
+        from code2vec_tpu.ops.pallas_attention import pallas_attention_pool
+
+        rng = np.random.default_rng(7)
+        ctx = jnp.asarray(rng.normal(size=(4, 8, 8)).astype(np.float32))
+        mask = jnp.asarray((rng.random((4, 8)) > 0.4).astype(np.float32))
+        attn = jnp.asarray(rng.normal(size=8).astype(np.float32))
+        cv_g, w_g = pallas_attention_pool(
+            ctx, mask, attn, block_b=2, backend="gpu"
+        )
+        cv_t, w_t = pallas_attention_pool(
+            ctx, mask, attn, block_b=2, backend="interpret"
+        )
+        assert np.array_equal(np.asarray(cv_g), np.asarray(cv_t))
+        assert np.array_equal(np.asarray(w_g), np.asarray(w_t))
+
+    @pytest.mark.skipif(not ON_GPU, reason="needs a real GPU backend")
+    def test_compiled_gpu_lowering(self):
+        # on actual GPU hardware the pallas_gpu strategy compiles via
+        # Triton; parity against the XLA reference is the contract
+        from code2vec_tpu.ops.fused_encode_pool import (
+            fused_encode_attend_pool,
+            xla_reference_forward,
+        )
+
+        args = _fused_inputs(seed=8)
+        cv_g, w_g = fused_encode_attend_pool(
+            *args, impl="gather_split", block_b=2, backend="gpu"
+        )
+        cv_r, w_r = xla_reference_forward(*args)
+        np.testing.assert_allclose(
+            np.asarray(cv_g), np.asarray(cv_r), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(w_g), np.asarray(w_r), rtol=1e-4, atol=1e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# autotune: the backend axis on the schedule cache
+# ---------------------------------------------------------------------------
+class TestAutotuneBackendAxis:
+    def test_kernel_schedule_roundtrip(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        cache = at.ScheduleCache(str(tmp_path / "sched.json"))
+        key = at.ShapeKey("cpu", 8, 16, 4, 6, 8, "f32")
+        sched = at.KernelSchedule(
+            impl="gather_split", backend="cpu", source="autotune"
+        )
+        cache.put(key, sched, interpret=False)
+        cache.save()
+        reloaded = at.ScheduleCache(str(tmp_path / "sched.json")).get(key)
+        assert reloaded.backend == "cpu"
+        assert reloaded.impl == "gather_split"
+
+    def test_lut_schedule_roundtrip(self, tmp_path):
+        from code2vec_tpu.ops import autotune as at
+
+        cache = at.ScheduleCache(str(tmp_path / "sched.json"))
+        key = at.LutShapeKey("cpu", 4, 16, 8, 32)
+        cache.put(key, at.LutSchedule(impl="xla", backend="cpu"))
+        cache.save()
+        reloaded = at.ScheduleCache(str(tmp_path / "sched.json")).get_lut(key)
+        assert reloaded.backend == "cpu"
+
+    def test_pre_backend_entries_deserialize(self, tmp_path):
+        # old cache files have no "backend" key: they must load with the
+        # "auto" default — no version bump, no migration
+        from code2vec_tpu.ops import autotune as at
+
+        key = at.ShapeKey("cpu", 8, 16, 4, 6, 8, "f32")
+        old_entry = at.KernelSchedule(impl="fused").to_dict()
+        del old_entry["backend"]
+        path = tmp_path / "old.json"
+        path.write_text(json.dumps({
+            "version": 1,
+            "entries": {key.cache_key(): {"schedule": old_entry}},
+        }))
+        sched = at.ScheduleCache(str(path)).get(key)
+        assert sched.backend == "auto"
+        assert sched.impl == "fused"
+
+    def test_default_schedule_per_backend(self, monkeypatch):
+        from code2vec_tpu.ops import autotune as at
+
+        monkeypatch.setenv(kb.ENV_VAR, "cpu")
+        sched = at.default_schedule()
+        assert (sched.impl, sched.backend) == ("gather_split", "cpu")
+        assert at.default_lut_schedule().backend == "cpu"
+        monkeypatch.setenv(kb.ENV_VAR, "interpret")
+        sched = at.default_schedule()
+        # the interpret pin keeps the legacy default (pool_only, auto) so
+        # pre-backend suites see unchanged miss-fallback behavior
+        assert (sched.impl, sched.backend) == ("pool_only", "auto")
+        assert at.default_lut_schedule().backend == "auto"
+
+    def test_variant_labels_carry_backend(self):
+        from code2vec_tpu.ops import autotune as at
+
+        s = at.KernelSchedule(impl="gather_split", block_b=8, backend="cpu")
+        assert at._variant_label(s).endswith("@cpu")
+        assert "@" not in at._variant_label(
+            at.KernelSchedule(impl="xla", backend="auto")
+        )
+
+    def test_enumerate_variants_backend_axis(self):
+        from code2vec_tpu.ops import autotune as at
+
+        cpu_variants = at.enumerate_variants(8, 16, "f32", backend="cpu")
+        assert all(v.backend == "cpu" for v in cpu_variants)
+        assert {v.impl for v in cpu_variants} == {"xla", "gather_split"}
+        gpu_variants = at.enumerate_variants(8, 16, "f32", backend="gpu")
+        assert all(v.backend == "gpu" for v in gpu_variants)
+        lut_cpu = at.enumerate_lut_variants(128, backend="cpu")
+        assert [v.impl for v in lut_cpu] == ["xla"]
+
+    def test_timed_autotune_under_cpu_backend(self, tmp_path, monkeypatch):
+        # a full (non-dry) search under the compiled CPU strategy stores a
+        # backend-tagged winner with interpret=False in the entry
+        from code2vec_tpu.ops import autotune as at
+
+        monkeypatch.setenv(kb.ENV_VAR, "cpu")
+        cache = at.ScheduleCache(str(tmp_path / "t.json"))
+        keys = at.keys_for(4, [8], 4, 4, 8, ["f32"])
+        schedules = at.autotune(keys, cache=cache, iters=1)
+        (sched,) = schedules.values()
+        assert sched.backend == "cpu"
+        assert sched.source == "autotune"
+        entry = cache.entries[keys[0].cache_key()]
+        assert entry["interpret"] is False
+        assert any("@cpu" in lbl for lbl in entry["timings_ms"])

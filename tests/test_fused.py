@@ -493,7 +493,10 @@ class TestAutotune:
         before = at.counters_snapshot()
         sched = at.lookup_schedule(4, 99, 6, 5, 12, cache=cache)
         after = at.counters_snapshot()
-        assert sched.impl == "pool_only" and sched.source == "default"
+        # the fallback is whatever default_schedule() resolves to under the
+        # ambient kernel backend (pool_only@auto in interpret mode,
+        # gather_split@cpu under the compiled CPU strategy)
+        assert sched == at.default_schedule() and sched.source == "default"
         assert after["autotune_cache_miss"] == before["autotune_cache_miss"] + 1
         assert after["autotune_timing_run"] == before["autotune_timing_run"]
 
@@ -661,6 +664,10 @@ class TestBenchKernelAB:
         env = dict(
             os.environ,
             JAX_PLATFORMS="cpu",
+            # this test pins the LEGACY interpret-mode record regardless of
+            # the ambient backend (the CI kernel-portability job runs the
+            # suite with C2V_KERNEL_BACKEND=cpu)
+            C2V_KERNEL_BACKEND="interpret",
             BENCH_SUPERVISED="1",
             BENCH_BATCH="8",
             BENCH_BAG="16",
@@ -703,3 +710,50 @@ class TestBenchKernelAB:
         assert delta["autotune_timing_run"] == 0
         assert delta["autotune_cache_miss"] == 0
         assert delta["autotune_cache_hit"] == 2
+
+    def test_end_to_end_compiled_cpu_record(self):
+        # --kernel-ab with the compiled CPU strategy pinned: no Pallas
+        # interpreter anywhere in the main arms (interpret false, no
+        # apologetic note), the resolved strategy in the record, the two
+        # *_interp comparison arms quantifying compiled-vs-interpret at
+        # equal real-context work, and zero post-warmup recompiles.
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            C2V_KERNEL_BACKEND="cpu",
+            BENCH_SUPERVISED="1",
+            BENCH_BATCH="8",
+            BENCH_BAG="16",
+            BENCH_AB_STEPS="2",
+            BENCH_EMBED="4",
+            BENCH_ENCODE="8",
+            BENCH_AB_TERMINALS="200",
+            BENCH_AB_PATHS="150",
+            BENCH_AB_LABELS="20",
+            BENCH_AB_REPEATS="1",
+        )
+        bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        proc = subprocess.run(
+            [sys.executable, bench_path, "--kernel-ab"],
+            env=env, capture_output=True, text=True, timeout=540,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        metric = json.loads(proc.stdout.strip().splitlines()[-1])
+        detail = None
+        for line in proc.stderr.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"detail"' in line:
+                detail = json.loads(line)["detail"]
+        assert metric["value"] and metric["value"] > 0
+        assert detail["strategy"] == "cpu"
+        assert detail["interpret"] is False and "note" not in detail
+        assert detail["post_warmup_recompiles"] == 0
+        fused = detail["arms"]["fused_f32"]["kernel"]
+        assert fused["backend"] == "auto" and fused["strategy"] == "cpu"
+        interp = detail["arms"]["fused_f32_interp"]["kernel"]
+        assert interp["strategy"] == "pallas_tpu:interpret"
+        cvi = detail["speedup_compiled_vs_interpret"]
+        # equal work, different lowering: the compiled strategy must win
+        # (the fused arm's interpreter penalty is large even at toy shapes)
+        assert cvi["fused_f32"] > 1.0
+        assert cvi["pool_only_f32"] > 0

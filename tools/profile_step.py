@@ -153,11 +153,16 @@ bench("forward", fwd, state.params, batch)
 # --- kernel variants (ops/fused_encode_pool.py) ---------------------------
 # Pallas forward rows: pool-only vs gather-split vs fully-fused (+ int8
 # fused), with the autotuned schedule consulted/recorded for provenance.
-# On non-TPU backends the kernels run in the Pallas INTERPRETER — numbers
-# characterize the interpreter, so the rows are opt-in there.
+# The rows run whenever the resolved lowering strategy (ops/backend.py)
+# compiles — TPU kernels on TPU, the compiled CPU strategy elsewhere; only
+# an interpreting resolution (e.g. C2V_KERNEL_BACKEND=interpret) makes
+# them opt-in, because interpreter numbers characterize the interpreter.
 _kern_env = os.environ.get("PROF_KERNEL_VARIANTS", "auto").strip().lower()
+from code2vec_tpu.ops.backend import resolve as _resolve_kernel_backend
+
+_kern_strategy = _resolve_kernel_backend()
 if _kern_env in ("1", "true", "yes", "on") or (
-    _kern_env == "auto" and jax.default_backend() == "tpu"
+    _kern_env == "auto" and not _kern_strategy.interpret
 ):
     from code2vec_tpu.ops.autotune import counters_snapshot, lookup_schedule
     from code2vec_tpu.ops.quant import quantize_table
@@ -165,6 +170,7 @@ if _kern_env in ("1", "true", "yes", "on") or (
     sched = lookup_schedule(B, L, mc.terminal_embed_size, mc.path_embed_size,
                             mc.encode_size, "f32")
     print(json.dumps({"kernel_schedule": sched.to_dict(),
+                      "kernel_strategy": _kern_strategy.label,
                       "autotune_counters": counters_snapshot()}), flush=True)
 
     def _variant_fwd(impl, table_dtype="f32", quant_tables=None):
