@@ -55,10 +55,12 @@ blocks carry ``pad_efficiency`` (real/padded) and ``padded_slots_per_sec``
 
 from __future__ import annotations
 
+import atexit
 import glob
 import json
 import os
 import re
+import shutil
 import sys
 import time
 
@@ -1371,6 +1373,9 @@ def _ooc_ab() -> None:
         seed=0,
     )
     tmp = tempfile.mkdtemp(prefix="c2v_ooc_ab_")
+    # the CSR mmap stays open for the whole arm, so the synthetic corpus
+    # (GBs at the default spec) is reclaimed at exit, not inline
+    atexit.register(shutil.rmtree, tmp, ignore_errors=True)
     paths = generate_corpus_files(tmp, spec)
     csr_path = os.path.join(tmp, "corpus.csr")
     from tools.corpus_convert import text_to_csr
@@ -1585,6 +1590,7 @@ def _feed_ab() -> None:
         seed=0,
     )
     tmp = tempfile.mkdtemp(prefix="c2v_feed_ab_")
+    atexit.register(shutil.rmtree, tmp, ignore_errors=True)
     paths = generate_corpus_files(tmp, spec)
     csr_path = os.path.join(tmp, "corpus.csr")
     from tools.corpus_convert import text_to_csr

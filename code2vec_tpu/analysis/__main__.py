@@ -21,7 +21,7 @@ import subprocess
 import sys
 from pathlib import Path
 
-from code2vec_tpu.analysis import concurrency, jaxlint
+from code2vec_tpu.analysis import concurrency, jaxlint, lifecycle
 from code2vec_tpu.analysis.sharding_check import check_source, declared_axes
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
@@ -29,6 +29,7 @@ DEFAULT_PATHS = ("code2vec_tpu", "tools", "bench.py", "main.py")
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 DEFAULT_MESH = "code2vec_tpu/parallel/mesh.py"
 SYNC_MODULE = "code2vec_tpu/obs/sync.py"
+HANDLES_MODULE = "code2vec_tpu/obs/handles.py"
 # textual markers of a lock-factory call site / raw lock construction: a
 # change to any such module can add or remove acquisition-graph edges whose
 # cycles close through UNCHANGED files, so the diff-restricted scan widens
@@ -39,6 +40,22 @@ _LOCK_SITE_MARKERS = (
     "threading.Lock(",
     "threading.RLock(",
     "threading.Condition(",
+)
+# textual markers of resource construction: RS005's repo-wide finalize
+# joins per-file class fragments, so a diff adding a resource ctor (or
+# touching the ledger module) can change verdicts on UNCHANGED owner
+# classes — same rationale as the CX002 widening above
+_RESOURCE_SITE_MARKERS = (
+    "subprocess.Popen(",
+    "SharedMemory(",
+    "np.memmap(",
+    "open_memmap(",
+    "mmap.mmap(",
+    "mkdtemp(",
+    "NamedTemporaryFile(",
+    "threading.Thread(",
+    "ThreadPoolExecutor(",
+    "ProcessPoolExecutor(",
 )
 
 
@@ -57,6 +74,25 @@ def _touches_lock_graph(root: Path, changed: list[Path]) -> Path | None:
         except OSError:  # pragma: no cover - unreadable working tree file
             continue
         if any(marker in text for marker in _LOCK_SITE_MARKERS):
+            return rel
+    return None
+
+
+def _touches_resource_graph(root: Path, changed: list[Path]) -> Path | None:
+    """The first changed file that can perturb the repo-wide resource
+    ownership table (the handle-ledger module itself, or any module
+    constructing tracked resources); None when the diff is inert."""
+    for rel in changed:
+        if rel.as_posix() == HANDLES_MODULE:
+            return rel
+        path = root / rel
+        if not path.exists():  # a deleted owner module also perturbs
+            continue
+        try:
+            text = path.read_text()
+        except OSError:  # pragma: no cover - unreadable working tree file
+            continue
+        if any(marker in text for marker in _RESOURCE_SITE_MARKERS):
             return rel
     return None
 
@@ -112,6 +148,7 @@ def run(
     )
     findings: list[jaxlint.Finding] = []
     fragments: list[concurrency.ModuleFragment] = []
+    rs_fragments: list[lifecycle.LifecycleFragment] = []
     for file in jaxlint.iter_py_files(paths):
         try:
             rel = file.resolve().relative_to(root.resolve()).as_posix()
@@ -131,9 +168,17 @@ def run(
             )
             findings += cx_findings
             fragments.append(fragment)
+            rs_findings, rs_fragment = lifecycle.check_source(
+                source, rel, tree=tree
+            )
+            findings += rs_findings
+            rs_fragments.append(rs_fragment)
     # CX002 is repo-wide: the acquisition graph joins every scanned file's
     # fragments, so cross-class cycles surface wherever their edges live
     findings += concurrency.finalize(fragments)
+    # RS005 likewise: owned-class attributes resolve against every class
+    # seen anywhere in the scan
+    findings += lifecycle.finalize(rs_fragments)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     jaxlint.apply_baseline(findings, jaxlint.load_baseline(baseline_path))
     return findings
@@ -233,6 +278,14 @@ def main(argv: list[str] | None = None) -> int:
             print(
                 f"jaxlint: lock construction changed ({lock_site.as_posix()})"
                 "; running the full scan",
+                file=sys.stderr,
+            )
+        elif (res_site := _touches_resource_graph(root, changed)) is not None:
+            # RS005's ownership table is repo-wide: a resource ctor added
+            # in this diff can change verdicts on unchanged owner classes
+            print(
+                f"jaxlint: resource construction changed "
+                f"({res_site.as_posix()}); running the full scan",
                 file=sys.stderr,
             )
         else:

@@ -41,6 +41,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from code2vec_tpu import faultinject
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.sync import make_lock
 
 logger = logging.getLogger(__name__)
@@ -455,6 +456,7 @@ class CheckpointWriter:
         self._failure: BaseException | None = None
         self._lock = make_lock("checkpoint.writer")
         sweep_staging_dirs(out_dir)
+        handles.track(self, "checkpoint_writer", name=out_dir)
 
     # ---- failure propagation -------------------------------------------
     def check(self) -> None:
@@ -485,6 +487,7 @@ class CheckpointWriter:
             failure, self._failure = self._failure, None
         if failure is not None:
             logger.error("async checkpoint persist failed", exc_info=failure)
+        handles.untrack(self)
 
     # ---- saving ---------------------------------------------------------
     def save(self, state, meta: TrainMeta, slot: str, **event_fields) -> str:

@@ -432,6 +432,8 @@ def main(argv: list[str] | None = None) -> None:
 
     config = config_from_args(args)
     if args.synthetic is not None:
+        import atexit
+        import shutil
         import tempfile
 
         from code2vec_tpu.data.synth import SPECS, generate_corpus_files
@@ -441,6 +443,9 @@ def main(argv: list[str] | None = None) -> None:
                 f"--synthetic must be one of {sorted(SPECS)}, "
                 f"got {args.synthetic!r}")
         synth_dir = tempfile.mkdtemp(prefix="c2v_synth_")
+        # the corpus must outlive this function (training reads it for the
+        # whole run), so reclaim the temp dir at process exit
+        atexit.register(shutil.rmtree, synth_dir, ignore_errors=True)
         logger.info("generating %r synthetic corpus in %s", args.synthetic,
                     synth_dir)
         paths = generate_corpus_files(synth_dir, SPECS[args.synthetic])

@@ -56,6 +56,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.sync import guard_fork_safety
 
 from code2vec_tpu.data.pipeline import (
@@ -310,6 +311,7 @@ class FeedPool:
         self._finalizer = weakref.finalize(
             self, _release_pool_resources, self._procs, self._shms
         )
+        handles.track(self, "feed_pool", name=f"workers={self.slots}")
 
     # ---- delivery mode -------------------------------------------------
     def deliver_mode(self) -> str:
@@ -399,6 +401,7 @@ class FeedPool:
             except Exception:
                 pass
         self._finalizer.detach()
+        handles.untrack(self)
 
 
 def _release_pool_resources(procs, shms) -> None:

@@ -363,6 +363,9 @@ def load_corpus_csr(
     logger.info(
         "corpus (csr mmap): %d items, %d contexts", data.n_items, data.n_contexts
     )
+    # the reader handle is done: the context views handed into CorpusData
+    # hold their own reference to the mapping (CsrCorpus.close contract)
+    corpus.close()
     return data
 
 

@@ -29,6 +29,8 @@ from typing import IO, Iterator
 
 import numpy as np
 
+from code2vec_tpu.obs import handles
+
 
 @dataclass
 class CorpusRecord:
@@ -398,6 +400,19 @@ class CsrCorpus:
     def doc(self, i: int) -> str | None:
         return self._string("doc", i) if self.flags[i] & FLAG_DOC else None
 
+    def close(self) -> None:
+        """Retire this reader from the handle ledger (idempotent). The OS
+        releases the mapping when the last array view dies; views already
+        handed out stay valid — they hold their own reference to the
+        underlying mmap buffer."""
+        handles.untrack(self)
+
+    def __enter__(self) -> "CsrCorpus":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
     def aliases(self, i: int) -> list[tuple[str, str]]:
         out = []
         for line in self._string("var", i).splitlines():
@@ -451,7 +466,7 @@ def open_corpus_csr(path: str | os.PathLike) -> CsrCorpus:
         prefix: (np.array(view(f"{prefix}_offsets")), view(f"{prefix}_blob"))
         for prefix in ("label", "source", "doc", "var")
     }
-    return CsrCorpus(
+    return handles.track(CsrCorpus(
         path=path,
         n_items=int(header["n_items"]),
         n_contexts=int(header["n_contexts"]),
@@ -466,7 +481,7 @@ def open_corpus_csr(path: str | os.PathLike) -> CsrCorpus:
         hist_counts=np.array(view("hist_counts")),
         _mm=mm,
         _strings=strings,
-    )
+    ), "mmap_corpus", name=path)
 
 
 def read_csr_histogram(
@@ -474,5 +489,6 @@ def read_csr_histogram(
 ) -> tuple[np.ndarray, np.ndarray]:
     """(lengths, counts) context-count histogram from the container footer —
     no context scan; the O(1) input to ``derive_bucket_ladder_hist``."""
-    corpus = open_corpus_csr(path)
-    return corpus.hist_lengths, corpus.hist_counts
+    with open_corpus_csr(path) as corpus:
+        # in-RAM copies (O(k)); the mmap itself is released with the reader
+        return corpus.hist_lengths, corpus.hist_counts

@@ -43,6 +43,12 @@ The fleet observability plane (PR 15) adds two more:
   edge-triggered once per exhaustion episode: ``slo_class``,
   ``burn_rate``, ``objective``, ``window_s``, window ``good``/``bad``.
 
+The opt-in debug planes add ``lock_order_violation`` (obs.sync, under
+``C2V_SYNC_DEBUG``) and ``handle_leak`` (obs.handles, under
+``C2V_HANDLE_DEBUG``) — one per handle still open at the shutdown leak
+report: ``where``, ``kind``, ``name``, ``age_s``, and the creation-site
+``site`` stack captured when the handle was tracked.
+
 Health snapshots embedded in ``epoch``/``health`` payloads additionally
 carry ``started_unix`` + ``snapshot_seq`` (obs.runtime.RuntimeHealth),
 so consumers can compute rates and detect counter resets across replica
@@ -67,6 +73,8 @@ import threading
 import time
 import uuid
 from typing import Callable
+
+from code2vec_tpu.obs import handles
 
 __all__ = ["EventLog", "metric_record", "run_manifest", "sink_consumer"]
 
@@ -250,6 +258,7 @@ class EventLog:
             self._events_dir, f"events-p{self.process_index}.jsonl"
         )
         self._file = open(self.path, "a", encoding="utf-8")
+        handles.track(self, "event_log", name=self.path)
         return self._file
 
     @property
@@ -314,6 +323,7 @@ class EventLog:
             if self._file is not None:
                 self._file.close()
                 self._file = None
+        handles.untrack(self)
 
     def __enter__(self) -> "EventLog":
         return self

@@ -26,6 +26,7 @@ import time
 
 # no cycle: obs.sync reaches back into this module only lazily (inside its
 # metric-recording path), so the factory import is safe at module top
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.sync import make_lock
 
 logger = logging.getLogger(__name__)
@@ -543,6 +544,7 @@ class FlightRecorder:
             health.counter("flight.recorded") if health is not None else Counter()
         )
         self._lock = make_lock("obs.flight_recorder")
+        handles.track(self, "flight_recorder")
 
     @property
     def count(self) -> int:
@@ -617,6 +619,12 @@ class FlightRecorder:
                 json.dump(sanitize(record), f, indent=1)
             paths.append(path)
         return paths
+
+    def close(self) -> None:
+        """Retire the recorder from the handle ledger. Resident records
+        stay readable (``dump`` after close is fine — the teardown paths
+        dump last); idempotent."""
+        handles.untrack(self)
 
 
 _global_health: RuntimeHealth | None = None

@@ -116,6 +116,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "hold/wait/contention metrics); equivalent to "
                         "C2V_SYNC_DEBUG=1. Off by default — the factory "
                         "then returns plain threading primitives")
+    parser.add_argument("--handle_debug", action="store_true", default=False,
+                        help="handle ledger: track every lifecycle object "
+                        "(batchers, generations, mmap readers, event "
+                        "logs) with creation-site stacks; per-kind "
+                        "c2v_handles_open gauges, a handles health "
+                        "block, and a handle_leak shutdown report. "
+                        "Equivalent to C2V_HANDLE_DEBUG=1; off by "
+                        "default — track() is then a no-op")
     return parser
 
 
@@ -240,6 +248,12 @@ def build_server(args):
         from code2vec_tpu.obs.sync import SYNC_DEBUG_ENV
 
         os.environ[SYNC_DEBUG_ENV] = "1"
+    # likewise the ledger switch, BEFORE any lifecycle owner (flight
+    # recorder, batcher, generation 0) is constructed below
+    if getattr(args, "handle_debug", False):
+        from code2vec_tpu.obs.handles import HANDLE_DEBUG_ENV
+
+        os.environ[HANDLE_DEBUG_ENV] = "1"
 
     # pin the schedule cache BEFORE the first trace, exactly like train()
     # and export_from_checkpoint do
@@ -258,6 +272,14 @@ def build_server(args):
         if sync_debug_enabled():
             # lock_order_violation events land in this worker's own log
             register_event_log(events)
+        from code2vec_tpu.obs.handles import handle_debug_enabled
+        from code2vec_tpu.obs.handles import (
+            register_event_log as register_handle_log,
+        )
+
+        if handle_debug_enabled():
+            # handle_leak events from the shutdown report land here too
+            register_handle_log(events)
 
     # slow-request flight recorder: one per process, shared by every
     # generation's batcher (constructed without the event log for the
@@ -312,7 +334,7 @@ def build_server(args):
         gen0.predictor, engine, gen0.batcher, retrieval=retrieval,
         version=gen0.version, factory=factory,
         golden=GoldenSet(min_recall=args.golden_min_recall),
-        events=events, flight=flight,
+        events=events, flight=flight, generation=gen0,
     )
     health = global_health()
     health.gauge("serve_transport").set(args.transport)
@@ -366,6 +388,15 @@ def main(argv: list[str] | None = None) -> None:
                 server.flight.dump(os.path.join(args.events_dir, "flight"))
             except Exception:
                 logger.warning("could not dump flight records", exc_info=True)
+        # shutdown leak report: run_transport already closed the server
+        # (generations, batchers, flight recorder all untracked), so any
+        # handle still open here is a leak — named by its creation site.
+        # The event log itself is legitimately open until the line below.
+        from code2vec_tpu.obs.handles import handle_debug_enabled, report_leaks
+
+        if handle_debug_enabled():
+            exclude = (events,) if events is not None else ()
+            report_leaks("serve.shutdown", events=events, exclude=exclude)
         if events is not None:
             try:
                 events.close()

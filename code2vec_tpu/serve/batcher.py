@@ -52,6 +52,7 @@ from code2vec_tpu.obs.runtime import (
     RuntimeHealth,
     global_health,
 )
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.sync import make_lock
 from code2vec_tpu.obs.trace import TraceContext, get_tracer, trace_scope
 
@@ -145,6 +146,7 @@ class MicroBatcher:
             target=self._loop, name="c2v-micro-batcher", daemon=True
         )
         self._thread.start()
+        handles.track(self, "batcher")
 
     # ---- caller side ----------------------------------------------------
     def submit(self, contexts, trace: TraceContext | None = None) -> Future:
@@ -206,6 +208,7 @@ class MicroBatcher:
                 leftover.future.set_exception(
                     ServerClosed("micro-batcher closed before dispatch")
                 )
+        handles.untrack(self)
 
     def __enter__(self) -> "MicroBatcher":
         return self

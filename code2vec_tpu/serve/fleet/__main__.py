@@ -111,6 +111,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="lock sanitizer on the router AND every "
                         "worker (the flag is forwarded down the replica "
                         "command line); equivalent to C2V_SYNC_DEBUG=1")
+    parser.add_argument("--handle_debug", action="store_true", default=False,
+                        help="handle ledger on the router AND every "
+                        "worker (forwarded like --sync_debug): per-kind "
+                        "open-handle gauges, per-replica handles health "
+                        "blocks, open-handle counts on eviction events, "
+                        "and a handle_leak shutdown report; equivalent "
+                        "to C2V_HANDLE_DEBUG=1")
     return parser
 
 
@@ -152,6 +159,8 @@ def worker_argv(args, slot: int) -> list[str]:
         argv += ["--flight_threshold_ms", str(threshold)]
     if getattr(args, "sync_debug", False):
         argv += ["--sync_debug"]
+    if getattr(args, "handle_debug", False):
+        argv += ["--handle_debug"]
     return argv
 
 
@@ -168,6 +177,12 @@ def build_router(args):
         from code2vec_tpu.obs.sync import SYNC_DEBUG_ENV
 
         os.environ[SYNC_DEBUG_ENV] = "1"
+    # same ordering rule for the handle ledger: the env must be live before
+    # the first lifecycle owner (event log, flight recorder, replicas)
+    if getattr(args, "handle_debug", False):
+        from code2vec_tpu.obs.handles import HANDLE_DEBUG_ENV
+
+        os.environ[HANDLE_DEBUG_ENV] = "1"
 
     events = None
     if args.events_dir:
@@ -188,6 +203,12 @@ def build_router(args):
         if sync_debug_enabled():
             # router-side lock_order_violation events land in the fleet log
             register_event_log(events)
+        from code2vec_tpu.obs.handles import handle_debug_enabled
+        from code2vec_tpu.obs.handles import register_event_log as register_handle_log
+
+        if handle_debug_enabled():
+            # router-side handle_leak events land in the fleet log too
+            register_handle_log(events)
 
     def factory(slot: int, incarnation: int) -> ReplicaHandle:
         return ReplicaHandle(
@@ -267,6 +288,11 @@ def main(argv: list[str] | None = None) -> None:
                 router._flight.dump(os.path.join(args.events_dir, "flight"))
             except Exception:
                 logger.warning("could not dump flight records", exc_info=True)
+        from code2vec_tpu.obs.handles import handle_debug_enabled, report_leaks
+
+        if handle_debug_enabled():
+            exclude = (events,) if events is not None else ()
+            report_leaks("fleet.shutdown", events=events, exclude=exclude)
         if events is not None:
             try:
                 events.close()

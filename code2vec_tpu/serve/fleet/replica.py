@@ -46,6 +46,7 @@ import threading
 import time
 from concurrent.futures import Future
 
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
 from code2vec_tpu.obs.sync import make_lock
 
@@ -107,6 +108,9 @@ class ReplicaHandle:
             daemon=True,
         )
         self._reader.start()
+        handles.track(
+            self, "replica", name=f"r{self.slot}#i{self.incarnation}"
+        )
 
     # ---- state ----------------------------------------------------------
     @property
@@ -207,6 +211,9 @@ class ReplicaHandle:
         self._dead.set()
         self.death_reason = reason
         self._deaths.inc()
+        # every path out of a replica's life funnels through here exactly
+        # once (stop/kill/crash all set _dead) — the ledger close point
+        handles.untrack(self)
         with self._plock:
             stranded = list(self._pending)
             self._pending.clear()

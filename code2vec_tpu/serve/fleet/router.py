@@ -60,6 +60,7 @@ from code2vec_tpu.obs.runtime import (
     RuntimeHealth,
     global_health,
 )
+from code2vec_tpu.obs.handles import handles_snapshot
 from code2vec_tpu.obs.sync import make_lock, sync_snapshot
 from code2vec_tpu.obs.trace import ensure_trace, get_tracer
 from code2vec_tpu.serve.fleet.cache import ResultCache
@@ -723,10 +724,22 @@ class FleetRouter:
     def _evict(self, slot: int, reason: str) -> None:
         handle = self._slots[slot]
         self._evictions.inc()
+        # leak-on-crash preflight: the dead incarnation's last prober-cached
+        # handle-ledger block rides the eviction event, so a replica that
+        # died leaking shows its open-handle count without a log dive
+        last = getattr(handle, "last_health", None) or {}
+        dead_handles = last.get("handles") or {}
+        if dead_handles.get("open_total"):
+            logger.warning(
+                "replica r%d died with %d ledger-open handle(s): %s",
+                slot, dead_handles["open_total"], dead_handles.get("open"),
+            )
         logger.warning("evicting replica r%d: %s", slot, reason)
         self._emit(
             "fleet_replica_evicted", slot=slot,
             incarnation=getattr(handle, "incarnation", None), reason=reason,
+            open_handles=dead_handles.get("open_total"),
+            open_handles_by_kind=dead_handles.get("open"),
         )
         try:
             handle.kill()  # SIGTERM first: the worker drains, then exits
@@ -772,6 +785,9 @@ class FleetRouter:
                 # lock-sanitizer block from the worker's own health
                 # payload: enabled flag + order-violation count
                 "sync": last.get("sync"),
+                # handle-ledger block from the worker: per-kind open
+                # counts — a count climbing across swaps is a leak
+                "handles": last.get("handles"),
             })
         return {
             "ok": all(r.get("alive") for r in replicas),
@@ -807,6 +823,9 @@ class FleetRouter:
                 # fleet.cache / fleet.slo locks); each replica row above
                 # carries the worker-side block
                 "sync": sync_snapshot(),
+                # the ROUTER's own handle ledger (replica handles, the
+                # flight recorder, the event log)
+                "handles": handles_snapshot(),
             },
             **self.health.snapshot(),
         }
@@ -1175,6 +1194,8 @@ class FleetRouter:
             self._fail_item(
                 item, "fleet router closed before dispatch", kind="closed"
             )
+        if self._flight is not None:
+            self._flight.close()
 
     def __enter__(self) -> "FleetRouter":
         return self

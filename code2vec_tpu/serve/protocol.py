@@ -65,6 +65,7 @@ from typing import Callable
 
 import numpy as np
 
+from code2vec_tpu.obs.handles import handles_snapshot
 from code2vec_tpu.obs.sync import sync_snapshot
 from code2vec_tpu.obs.trace import TraceContext, get_tracer, new_trace_id
 from code2vec_tpu.serve.swap import Generation, SwapController
@@ -153,7 +154,7 @@ class CodeServer:
     def __init__(
         self, predictor, engine, batcher, retrieval=None, health=None,
         *, version: str = "v0", factory=None, golden=None, events=None,
-        flight=None,
+        flight=None, generation=None,
     ) -> None:
         from code2vec_tpu.obs.runtime import global_health
 
@@ -162,11 +163,17 @@ class CodeServer:
         # batcher feeds it per-request breakdowns; kept on the server so
         # the health payload and the CLI's exit-time dump can reach it
         self.flight = flight
-        self.swap = SwapController(
-            Generation(
+        # adopt the caller's Generation when it already built one (the
+        # CLI's gen0): wrapping the same pieces in a second Generation
+        # here would orphan the first on the handle ledger — only one of
+        # the two wrappers would ever be closed
+        if generation is None:
+            generation = Generation(
                 version=version, predictor=predictor, engine=engine,
                 batcher=batcher, retrieval=retrieval,
-            ),
+            )
+        self.swap = SwapController(
+            generation,
             build=factory, golden=golden, health=self.health, events=events,
         )
         self._shutdown = threading.Event()
@@ -219,6 +226,8 @@ class CodeServer:
     def close(self) -> None:
         """Drain in-flight requests and stop every resident generation."""
         self.swap.close()
+        if self.flight is not None:
+            self.flight.close()
 
     # ---- request handling ----------------------------------------------
     def handle(self, request: dict) -> dict:
@@ -458,6 +467,10 @@ class CodeServer:
             # lock sanitizer: enabled flag + order-violation count + graph
             # size — zero violations under load is the health criterion
             "sync": sync_snapshot(),
+            # handle ledger: per-kind open-handle counts — the router
+            # relays this per replica, so a slow leak shows as a count
+            # climbing across swaps before the replica dies of it
+            "handles": handles_snapshot(),
             **self.health.snapshot(),
         }
 

@@ -41,6 +41,8 @@ import logging
 
 import numpy as np
 
+from code2vec_tpu.obs import handles
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["RetrievalIndex", "AnnRetrievalIndex", "load_retrieval_index"]
@@ -257,10 +259,20 @@ class AnnRetrievalIndex:
             index.meta["n"], index.meta["dim"], path, index.meta["n_list"],
             index.meta["m"], resolved_probe, resolved_short,
         )
-        return cls(
-            labels, rows, index, n_probe=resolved_probe,
-            shortlist=resolved_short, mesh=mesh, source=path,
+        return handles.track(
+            cls(
+                labels, rows, index, n_probe=resolved_probe,
+                shortlist=resolved_short, mesh=mesh, source=path,
+            ),
+            "mmap_ann",
+            name=path,
         )
+
+    def close(self) -> None:
+        """Retire this index from the handle ledger (idempotent). The
+        container's mmap pages are released when the last row view dies
+        with the owning generation; nothing to flush."""
+        handles.untrack(self)
 
     def _cache_size(self) -> int:
         return self.searcher._cache_size()

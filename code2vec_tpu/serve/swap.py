@@ -55,6 +55,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
 from code2vec_tpu.obs.sync import make_rlock
 
@@ -85,13 +86,21 @@ class Generation:
     provenance: list = field(default_factory=list)
     created_unix: float = field(default_factory=time.time)
 
+    def __post_init__(self) -> None:
+        handles.track(self, "generation", name=str(self.version))
+
     def close(self, timeout: float | None = None) -> None:
-        """Drain and stop this generation's batcher (idempotent).
-        Argument-free call keeps duck-typed batcher stands-ins (tests, CI
-        smokes) working; MicroBatcher's own default drain timeout applies.
+        """Drain and stop this generation's batcher and release its
+        retrieval backend (idempotent). Argument-free call keeps
+        duck-typed batcher stand-ins (tests, CI smokes) working;
+        MicroBatcher's own default drain timeout applies.
         """
         del timeout
         self.batcher.close()
+        close_retrieval = getattr(self.retrieval, "close", None)
+        if close_retrieval is not None:
+            close_retrieval()
+        handles.untrack(self)
 
 
 @dataclass

@@ -45,6 +45,7 @@ from typing import Callable, Iterable, Iterator
 import jax
 
 from code2vec_tpu import faultinject
+from code2vec_tpu.obs import handles
 from code2vec_tpu.obs.trace import get_tracer
 from code2vec_tpu.train.preempt import preemption_guard
 
@@ -248,6 +249,7 @@ class HostPrefetcher:
             target=self._produce, name="c2v-host-prefetch", daemon=True
         )
         self._thread.start()
+        handles.track(self, "prefetcher")
 
     # ---- producer side -------------------------------------------------
     def _put(self, item) -> bool:
@@ -362,6 +364,7 @@ class HostPrefetcher:
                 break
         self._thread.join(timeout=10.0)
         self._exhausted = True
+        handles.untrack(self)
 
     def __enter__(self) -> "HostPrefetcher":
         return self

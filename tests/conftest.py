@@ -69,6 +69,39 @@ def pytest_report_header(config):
     return f"jax devices: {jax.device_count()} ({jax.default_backend()})"
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def zero_leaked_handles():
+    """Assert every handle the test opened was closed again by its end.
+
+    Inert when the handle ledger is off (the default): production runs
+    pay nothing, and the plain suite behaves exactly as before. With
+    ``C2V_HANDLE_DEBUG=1`` (the lifecycle CI job, or a local repro run)
+    it diffs the ledger's monotone open tokens across the test — any
+    token opened during the test and still open at the end fails with
+    the handle's kind, name, and creation site.
+    """
+    from code2vec_tpu.obs import handles
+
+    if not handles.handle_debug_enabled():
+        yield
+        return
+    before = {r["token"] for r in handles.open_handles()}
+    yield
+    leaked = [
+        r for r in handles.open_handles() if r["token"] not in before
+    ]
+    assert not leaked, (
+        f"{len(leaked)} handle(s) leaked by this test: "
+        + "; ".join(
+            f"{r['kind']} '{r['name']}' created at\n{r['site']}"
+            for r in leaked
+        )
+    )
+
+
 def make_reference_corpus(
     tmp_path,
     rng,

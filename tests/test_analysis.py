@@ -809,6 +809,46 @@ class TestRunnerCLI:
         assert "SC001" in captured.out and "shardings.py" in captured.out
         assert "full scan" in captured.err
 
+    def test_diff_only_resource_site_change_triggers_full_scan(
+        self, tmp_path, capsys
+    ):
+        # adding a resource construction can change RS005's repo-wide
+        # ownership verdicts on UNCHANGED files — --diff-only must widen
+        # to the full scan (same rationale as the lock-graph widening)
+        stale = tmp_path / "leaky.py"
+        stale.write_text(
+            "def read(p):\n"
+            "    f = open(p)\n"
+            "    return f.read()\n"
+        )
+        worker = tmp_path / "worker.py"
+        worker.write_text("import threading\n")
+
+        def git(*a):
+            subprocess.run(
+                ["git", "-C", str(tmp_path), *a],
+                check=True,
+                capture_output=True,
+            )
+
+        git("init", "-q")
+        git("add", "-A")
+        git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+        worker.write_text(  # leaky.py left untouched
+            "import threading\n"
+            "\n"
+            "def spawn(fn):\n"
+            "    t = threading.Thread(target=fn, daemon=True)\n"
+            "    t.start()\n"
+            "    return t\n"
+        )
+        rc = self._run(tmp_path, "--diff-only", "HEAD")
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "RS001" in captured.out and "leaky.py" in captured.out
+        assert "resource construction" in captured.err
+        assert "full scan" in captured.err
+
     def test_tools_wrapper_smoke(self):
         res = subprocess.run(
             [sys.executable, str(REPO / "tools" / "jaxlint.py"),

@@ -917,6 +917,7 @@ main(sys.argv[1:])
 """
 
 
+@pytest.mark.usefixtures("zero_leaked_handles")
 def test_sigkill_mid_epoch_then_cli_resume(tiny, tmp_path):
     """The unceremonious preemption: SIGKILL mid-epoch through the real
     CLI (no finally blocks, no atexit — recovery works from what reached

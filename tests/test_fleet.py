@@ -736,6 +736,7 @@ def trained_tiny(tmp_path_factory):
     return ds, out
 
 
+@pytest.mark.usefixtures("zero_leaked_handles")
 def test_fleet_two_replicas_rolling_swap_under_trickle(trained_tiny):
     """Boot a REAL 2-replica fleet (subprocess workers), keep a trickle of
     requests flowing, perform one rolling hot-swap and a rollback, and
@@ -818,6 +819,7 @@ def test_fleet_two_replicas_rolling_swap_under_trickle(trained_tiny):
 
 
 @pytest.mark.sync
+@pytest.mark.usefixtures("zero_leaked_handles")
 def test_fleet_rolling_swap_with_lock_sanitizer(trained_tiny, monkeypatch):
     """The sanitizer-on acceptance run: a REAL 2-replica fleet with the
     lock sanitizer enabled in the router AND (via inherited env) both
@@ -893,3 +895,101 @@ def test_fleet_rolling_swap_with_lock_sanitizer(trained_tiny, monkeypatch):
         if events is not None:
             events.close()
         syncmod.reset_sync_state()
+
+
+@pytest.mark.lifecycle
+def test_fleet_rolling_swap_zero_leaked_handles(
+    trained_tiny, monkeypatch, tmp_path
+):
+    """The ledger-on acceptance run: a REAL 2-replica fleet with the
+    handle ledger enabled in the router AND (via the forwarded flag)
+    both subprocess workers, one rolling hot-swap + rollback under a
+    request trickle — ZERO leaked handles anywhere (router ledger drains
+    to empty, no worker emits a ``handle_leak`` shutdown event), zero
+    failed requests, zero post-warmup recompiles."""
+    from code2vec_tpu.obs import handles as handlesmod
+    from code2vec_tpu.serve.fleet.__main__ import build_parser, build_router
+
+    monkeypatch.setenv(handlesmod.HANDLE_DEBUG_ENV, "1")
+    handlesmod.reset_handle_state()
+    ds, out = trained_tiny
+    events_dir = tmp_path / "events"
+    args = build_parser().parse_args([
+        "--replicas", "2",
+        "--model_path", str(out),
+        "--terminal_idx_path", str(ds / "terminal_idxs.txt"),
+        "--path_idx_path", str(ds / "path_idxs.txt"),
+        "--deadline_ms", "2",
+        "--probe_interval_s", "0.5",
+        "--boot_timeout_s", "600",
+        "--events_dir", str(events_dir),
+        "--handle_debug",
+    ])
+    before = {r["token"] for r in handlesmod.open_handles()}
+    router, events = build_router(args)
+    failures: list = []
+    stop = threading.Event()
+
+    def trickle():
+        while not stop.is_set():
+            payload = router.handle({
+                "op": "embed", "source": PY, "language": "python",
+                "method_name": "add",
+            })
+            if payload.get("error"):
+                failures.append(payload)
+                return
+            time.sleep(0.05)
+
+    thread = threading.Thread(target=trickle, daemon=True)
+    thread.start()
+    try:
+        time.sleep(0.5)
+        rolled = router.handle(
+            {"op": "reload", "model_path": str(out), "wait": True}
+        )
+        assert rolled["ok"], rolled
+        assert rolled["rolling"]["outcome"] == "committed"
+        time.sleep(0.5)
+        back = router.handle({"op": "rollback"})
+        assert back["ok"], back
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        thread.join(30)
+    try:
+        assert not failures, failures[:3]
+        # mid-flight visibility: the router ledger sees its own handles
+        # and each replica's health payload carries its worker-side block
+        health = router.handle({"op": "health"})
+        assert health["ok"], health
+        fleet_handles = health["fleet"]["handles"]
+        assert fleet_handles["enabled"] is True
+        assert fleet_handles["open"].get("replica") == 2
+        for replica in health["fleet"]["replicas"]:
+            assert replica["alive"]
+            assert replica["post_warmup_compiles"] == 0
+            worker_handles = replica["handles"]
+            assert worker_handles["enabled"] is True
+            assert worker_handles["leaked"] == 0
+            # the worker owns at least its batcher + active generation
+            assert worker_handles["open_total"] >= 2
+    finally:
+        router.close()
+        if events is not None:
+            events.close()
+    try:
+        # router-side: everything opened since `before` was closed again
+        open_now = {r["token"] for r in handlesmod.open_handles()}
+        assert open_now <= before, handlesmod.open_handles()
+        # worker-side: each replica ran its serve.shutdown leak report
+        # into its own event log on the graceful stop — no handle_leak
+        # event anywhere means both workers drained their ledgers too
+        leak_lines = []
+        for log_path in events_dir.rglob("events-*.jsonl"):
+            for line in log_path.read_text().splitlines():
+                if '"handle_leak"' in line:
+                    leak_lines.append((log_path.name, line))
+        assert leak_lines == []
+    finally:
+        handlesmod.reset_handle_state()

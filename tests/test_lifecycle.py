@@ -1,0 +1,754 @@
+"""Resource-lifecycle analyzer tests: the RS static rule family
+(analysis/lifecycle.py) and the runtime handle ledger (obs/handles.py).
+
+Static side: paired positive/negative fixtures per RS rule through
+``lint_lifecycle`` (per-file pass + single-fragment finalize), the
+cross-file RS005 finalize join, and ``# jaxlint: disable=`` suppression.
+
+Runtime side: the off-by-default zero-cost contract (``track(x, k) is
+x``, no attributes added, module state untouched — the plain-primitive
+analogue of obs/sync.py's default contract), the debug-mode ledger
+(gauges, snapshot, creation-site leak events, reported-once idempotence,
+exclude), and open/close round trips through real owners (prefetcher,
+micro-batcher, checkpoint writer).
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.analysis.lifecycle import (
+    check_source,
+    finalize,
+    lint_lifecycle,
+)
+from code2vec_tpu.obs import handles
+from code2vec_tpu.obs.runtime import RuntimeHealth, global_health
+
+pytestmark = pytest.mark.lifecycle
+
+
+def _lint(source):
+    return lint_lifecycle(textwrap.dedent(source))
+
+
+def _rules(findings, *, include_suppressed=False):
+    return sorted(
+        f.rule
+        for f in findings
+        if include_suppressed or not f.suppressed
+    )
+
+
+# ---------------------------------------------------------------------------
+# RS001 — unmanaged file/mmap/socket/SharedMemory
+# ---------------------------------------------------------------------------
+
+
+class TestRS001:
+    def test_open_without_close_flagged(self):
+        findings = _lint(
+            """
+            def read(p):
+                f = open(p)
+                data = f.read()
+                return data
+            """
+        )
+        assert _rules(findings) == ["RS001"]
+        assert findings[0].snippet == "f = open(p)"
+
+    def test_with_statement_clean(self):
+        findings = _lint(
+            """
+            def read(p):
+                with open(p) as f:
+                    return f.read()
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_try_finally_close_clean(self):
+        findings = _lint(
+            """
+            def read(p):
+                f = open(p)
+                try:
+                    return f.read()
+                finally:
+                    f.close()
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_returned_handle_is_callers_problem(self):
+        findings = _lint(
+            """
+            def make(p):
+                f = open(p)
+                return f
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_handed_off_handle_not_flagged(self):
+        # passing the bare name transfers ownership — over-approximate
+        # toward silence
+        findings = _lint(
+            """
+            def make(p, sink):
+                f = open(p)
+                sink(f)
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_contextlib_closing_adopts(self):
+        findings = _lint(
+            """
+            import contextlib
+            import socket
+
+            def probe(addr):
+                s = socket.socket()
+                with contextlib.closing(s):
+                    s.connect(addr)
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_socket_without_close_flagged(self):
+        findings = _lint(
+            """
+            import socket
+
+            def probe(addr):
+                s = socket.socket()
+                s.connect(addr)
+            """
+        )
+        assert _rules(findings) == ["RS001"]
+
+
+# ---------------------------------------------------------------------------
+# RS002 — non-daemon thread with no join on the close path
+# ---------------------------------------------------------------------------
+
+
+class TestRS002:
+    def test_started_thread_without_join_flagged(self):
+        findings = _lint(
+            """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    pass
+            """
+        )
+        assert "RS002" in _rules(findings)
+
+    def test_join_reachable_from_close_clean(self):
+        findings = _lint(
+            """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    self._stop()
+
+                def _stop(self):
+                    self._t.join()
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_daemon_thread_exempt(self):
+        findings = _lint(
+            """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run, daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    pass
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_post_ctor_daemonization_exempt(self):
+        findings = _lint(
+            """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.daemon = True
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    pass
+            """
+        )
+        assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# RS003 — Popen without a reap on every exit path
+# ---------------------------------------------------------------------------
+
+
+class TestRS003:
+    def test_popen_without_reap_flagged(self):
+        findings = _lint(
+            """
+            import subprocess
+
+            def run(cmd):
+                proc = subprocess.Popen(cmd)
+                print(proc.pid)
+            """
+        )
+        assert _rules(findings) == ["RS003"]
+
+    def test_popen_with_wait_clean(self):
+        findings = _lint(
+            """
+            import subprocess
+
+            def run(cmd):
+                proc = subprocess.Popen(cmd)
+                try:
+                    print(proc.pid)
+                finally:
+                    proc.wait()
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_popen_attr_without_reap_flagged(self):
+        findings = _lint(
+            """
+            import subprocess
+
+            class Replica:
+                def __init__(self, cmd):
+                    self._proc = subprocess.Popen(cmd)
+
+                def close(self):
+                    pass
+            """
+        )
+        assert "RS003" in _rules(findings)
+
+    def test_popen_attr_with_terminate_clean(self):
+        findings = _lint(
+            """
+            import subprocess
+
+            class Replica:
+                def __init__(self, cmd):
+                    self._proc = subprocess.Popen(cmd)
+
+                def close(self):
+                    self._proc.terminate()
+                    self._proc.wait()
+            """
+        )
+        assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# RS004 — temp dir/file without recorded cleanup
+# ---------------------------------------------------------------------------
+
+
+class TestRS004:
+    def test_mkdtemp_without_cleanup_flagged(self):
+        findings = _lint(
+            """
+            import tempfile
+
+            def scratch():
+                d = tempfile.mkdtemp()
+                print(d)
+            """
+        )
+        assert _rules(findings) == ["RS004"]
+
+    def test_mkdtemp_with_atexit_register_clean(self):
+        findings = _lint(
+            """
+            import atexit
+            import shutil
+            import tempfile
+
+            def scratch():
+                d = tempfile.mkdtemp()
+                atexit.register(shutil.rmtree, d, ignore_errors=True)
+                print(d)
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_mkdtemp_with_rmtree_clean(self):
+        findings = _lint(
+            """
+            import shutil
+            import tempfile
+
+            def scratch(fn):
+                d = tempfile.mkdtemp()
+                try:
+                    fn(d)
+                finally:
+                    shutil.rmtree(d)
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_returned_tempdir_is_callers_problem(self):
+        findings = _lint(
+            """
+            import tempfile
+
+            def scratch():
+                d = tempfile.mkdtemp()
+                return d
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_delete_false_tempfile_without_cleanup_flagged(self):
+        findings = _lint(
+            """
+            import tempfile
+
+            def spill(data):
+                tmp = tempfile.NamedTemporaryFile(delete=False)
+                tmp.write(data)
+                tmp.close()
+                print(tmp.name)
+            """
+        )
+        assert _rules(findings) == ["RS004"]
+
+    def test_delete_true_tempfile_clean(self):
+        findings = _lint(
+            """
+            import tempfile
+
+            def spill(data):
+                with tempfile.NamedTemporaryFile() as tmp:
+                    tmp.write(data)
+            """
+        )
+        assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# RS005 — resource-owning class without (complete) close
+# ---------------------------------------------------------------------------
+
+
+class TestRS005:
+    def test_owner_without_close_flagged(self):
+        findings = _lint(
+            """
+            class Holder:
+                def __init__(self, p):
+                    self.f = open(p)
+            """
+        )
+        assert _rules(findings) == ["RS005"]
+        assert "Holder" in findings[0].message
+
+    def test_owner_with_close_clean(self):
+        findings = _lint(
+            """
+            class Holder:
+                def __init__(self, p):
+                    self.f = open(p)
+
+                def close(self):
+                    self.f.close()
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_close_missing_tracked_attr_flagged(self):
+        findings = _lint(
+            """
+            class Holder:
+                def __init__(self, p, q):
+                    self.f = open(p)
+                    self.g = open(q)
+
+                def close(self):
+                    self.f.close()
+            """
+        )
+        assert _rules(findings) == ["RS005"]
+        assert "g" in findings[0].message
+
+    def test_exit_counts_as_close(self):
+        findings = _lint(
+            """
+            class Holder:
+                def __init__(self, p):
+                    self.f = open(p)
+
+                def __exit__(self, *exc):
+                    self.f.close()
+            """
+        )
+        assert _rules(findings) == []
+
+    def test_cross_file_finalize_tracks_closeable_ctor(self):
+        # a.py: Reader has close(); b.py: Owner stores a Reader in
+        # __init__ but never closes it — only the repo-wide finalize
+        # (joining both fragments) can see that Reader is closeable
+        fa, frag_a = check_source(
+            textwrap.dedent(
+                """
+                class Reader:
+                    def __init__(self, p):
+                        self.f = open(p)
+
+                    def close(self):
+                        self.f.close()
+                """
+            ),
+            "a.py",
+        )
+        fb, frag_b = check_source(
+            textwrap.dedent(
+                """
+                from a import Reader
+
+                class Owner:
+                    def __init__(self, p):
+                        self.r = Reader(p)
+                """
+            ),
+            "b.py",
+        )
+        assert _rules(fa) == [] and _rules(fb) == []
+        joined = finalize([frag_a, frag_b])
+        assert _rules(joined) == ["RS005"]
+        assert joined[0].path == "b.py"
+
+    def test_cross_file_close_closes_ctor_attr(self):
+        _, frag_a = check_source(
+            textwrap.dedent(
+                """
+                class Reader:
+                    def __init__(self, p):
+                        self.f = open(p)
+
+                    def close(self):
+                        self.f.close()
+                """
+            ),
+            "a.py",
+        )
+        _, frag_b = check_source(
+            textwrap.dedent(
+                """
+                from a import Reader
+
+                class Owner:
+                    def __init__(self, p):
+                        self.r = Reader(p)
+
+                    def close(self):
+                        self.r.close()
+                """
+            ),
+            "b.py",
+        )
+        assert _rules(finalize([frag_a, frag_b])) == []
+
+
+# ---------------------------------------------------------------------------
+# RS006 — executor/pool/queue without shutdown
+# ---------------------------------------------------------------------------
+
+
+class TestRS006:
+    def test_executor_without_shutdown_flagged(self):
+        findings = _lint(
+            """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class Pool:
+                def __init__(self):
+                    self._ex = ThreadPoolExecutor(max_workers=2)
+
+                def close(self):
+                    pass
+            """
+        )
+        assert "RS006" in _rules(findings)
+
+    def test_executor_with_shutdown_clean(self):
+        findings = _lint(
+            """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class Pool:
+                def __init__(self):
+                    self._ex = ThreadPoolExecutor(max_workers=2)
+
+                def close(self):
+                    self._ex.shutdown(wait=True)
+            """
+        )
+        assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression / engine integration
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    def test_disable_comment_suppresses(self):
+        findings = _lint(
+            """
+            def read(p):
+                f = open(p)  # jaxlint: disable=RS001
+                return f.read()
+            """
+        )
+        assert _rules(findings) == []
+        assert _rules(findings, include_suppressed=True) == ["RS001"]
+        assert findings[0].suppressed
+
+    def test_rules_registered_with_engine(self):
+        from code2vec_tpu.analysis import jaxlint
+
+        for rid in ("RS001", "RS002", "RS003", "RS004", "RS005", "RS006"):
+            assert rid in jaxlint.RULES
+            assert jaxlint.RULES[rid].severity == "warning"
+
+    def test_syntax_error_is_silent(self):
+        findings, fragment = check_source("def broken(:\n", "bad.py")
+        assert findings == [] and not fragment.classes
+
+
+# ---------------------------------------------------------------------------
+# runtime ledger: off-by-default zero-cost contract
+# ---------------------------------------------------------------------------
+
+
+class _Probe:
+    pass
+
+
+class TestLedgerOff:
+    def test_track_is_identity_and_stateless(self, monkeypatch):
+        monkeypatch.delenv(handles.HANDLE_DEBUG_ENV, raising=False)
+        handles.reset_handle_state()
+        obj = _Probe()
+        before = dict(vars(obj))
+        assert handles.track(obj, "probe") is obj
+        # bitwise-plain: no attributes added, no wrapper returned
+        assert vars(obj) == before
+        assert handles.untrack(obj) is False
+        assert handles.open_handles() == []
+        assert handles.handles_snapshot() == {"enabled": False}
+        assert handles.report_leaks("off") == []
+
+    def test_falsy_values_stay_off(self, monkeypatch):
+        for value in ("", "0", "false", "no", "off", " OFF "):
+            monkeypatch.setenv(handles.HANDLE_DEBUG_ENV, value)
+            assert not handles.handle_debug_enabled()
+        monkeypatch.setenv(handles.HANDLE_DEBUG_ENV, "1")
+        assert handles.handle_debug_enabled()
+
+
+# ---------------------------------------------------------------------------
+# runtime ledger: debug mode
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def handle_debug(monkeypatch):
+    monkeypatch.setenv(handles.HANDLE_DEBUG_ENV, "1")
+    handles.reset_handle_state()
+    yield
+    handles.reset_handle_state()
+
+
+class _Log:
+    """EventLog stand-in collecting (kind, fields) pairs."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append((event, fields))
+
+
+class TestLedgerOn:
+    def test_track_untrack_round_trip(self, handle_debug):
+        health = global_health()
+        gauge = health.gauge("handles.open.probe")
+        base = gauge.value or 0
+        obj = _Probe()
+        assert handles.track(obj, "probe", name="p0") is obj
+        records = handles.open_handles("probe")
+        assert [r["name"] for r in records] == ["p0"]
+        # the creation site names THIS file — what the leak report prints
+        assert "test_lifecycle" in records[0]["site"]
+        assert gauge.value == base + 1
+        snap = handles.handles_snapshot()
+        assert snap["enabled"] and snap["open"]["probe"] == 1
+        assert handles.untrack(obj) is True
+        assert handles.untrack(obj) is False  # idempotent close paths
+        assert gauge.value == base
+        assert handles.open_handles("probe") == []
+
+    def test_tokens_are_monotone(self, handle_debug):
+        a, b = _Probe(), _Probe()
+        handles.track(a, "probe")
+        handles.track(b, "probe")
+        tokens = [r["token"] for r in handles.open_handles()]
+        assert tokens == sorted(tokens) and len(set(tokens)) == 2
+        handles.untrack(a)
+        handles.untrack(b)
+
+    def test_report_leaks_emits_event_with_site(self, handle_debug):
+        log = _Log()
+        obj = _Probe()
+        handles.track(obj, "probe", name="leaky")
+        leaks = handles.report_leaks("test.shutdown", events=log)
+        assert len(leaks) == 1
+        assert [k for k, _ in log.events] == ["handle_leak"]
+        _, fields = log.events[0]
+        assert fields["where"] == "test.shutdown"
+        assert fields["kind"] == "probe" and fields["name"] == "leaky"
+        assert "test_lifecycle" in fields["site"]
+        # the ledger is NOT cleared — post-report assertions still see it
+        assert handles.open_handles("probe")
+        assert handles.handles_snapshot()["leaked"] == 1
+
+    def test_report_leaks_is_reported_once(self, handle_debug):
+        log = _Log()
+        handles.register_event_log(log)
+        obj = _Probe()
+        handles.track(obj, "probe")
+        assert len(handles.report_leaks("first")) == 1
+        # two teardown paths racing: the second report is silent
+        assert handles.report_leaks("second") == []
+        assert len(log.events) == 1
+
+    def test_report_leaks_exclude(self, handle_debug):
+        log = _Log()
+        keep, leak = _Probe(), _Probe()
+        handles.track(keep, "event_log")
+        handles.track(leak, "probe")
+        leaks = handles.report_leaks("x", events=log, exclude=(keep,))
+        assert [r["kind"] for r in leaks] == ["probe"]
+
+    def test_prefetcher_round_trip(self, handle_debug):
+        from code2vec_tpu.train.prefetch import HostPrefetcher
+
+        before = {r["token"] for r in handles.open_handles()}
+        with HostPrefetcher(
+            iter([{"x": np.zeros(2)}]), lambda b: b, depth=1
+        ) as pf:
+            assert handles.open_handles("prefetcher")
+            list(pf)
+        after = {r["token"] for r in handles.open_handles()}
+        assert after <= before
+
+    def test_batcher_round_trip(self, handle_debug):
+        from code2vec_tpu.serve.batcher import MicroBatcher
+
+        class _Engine:
+            batch_sizes = (1, 4)
+            max_width = 16
+
+            def observe_width(self, width):
+                pass
+
+            def pad_requests(self, requests):
+                batch = len(requests)
+                width = max(len(r) for r in requests)
+                zeros = np.zeros((batch, width), np.int32)
+                return zeros, zeros, zeros, batch, width
+
+            def run(self, starts, paths, ends):
+                batch, width = starts.shape
+                return (
+                    np.zeros((batch, 4), np.float32),
+                    np.ones((batch, 8), np.float32),
+                    np.full((batch, width), 0.5, np.float32),
+                )
+
+        with MicroBatcher(
+            _Engine(), deadline_ms=0.0, health=RuntimeHealth()
+        ) as batcher:
+            assert handles.open_handles("batcher")
+            contexts = np.ones((3, 3), np.int32)
+            batcher.submit(contexts).result(timeout=30)
+        assert handles.open_handles("batcher") == []
+
+    def test_checkpoint_writer_round_trip(self, handle_debug, tmp_path):
+        from code2vec_tpu.checkpoint import CheckpointWriter
+
+        writer = CheckpointWriter(str(tmp_path))
+        assert handles.open_handles("checkpoint_writer")
+        writer.close()
+        assert handles.open_handles("checkpoint_writer") == []
+
+    def test_event_log_round_trip(self, handle_debug, tmp_path):
+        from code2vec_tpu.obs.events import EventLog
+
+        log = EventLog(str(tmp_path))
+        log.emit("x")  # lazy-open: tracking happens at first write
+        assert handles.open_handles("event_log")
+        log.close()
+        assert handles.open_handles("event_log") == []
+
+    def test_corpus_reader_round_trip(self, handle_debug, tmp_path):
+        from code2vec_tpu.formats.corpus_io import (
+            CorpusRecord,
+            CsrCorpusWriter,
+            open_corpus_csr,
+        )
+
+        path = str(tmp_path / "c.csr")
+        with CsrCorpusWriter(path) as writer:
+            writer.add(CorpusRecord(label="m", path_contexts=[(1, 2, 3)]))
+        with open_corpus_csr(path) as corpus:
+            assert handles.open_handles("mmap_corpus")
+            assert corpus.n_items == 1
+        assert handles.open_handles("mmap_corpus") == []

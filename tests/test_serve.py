@@ -281,6 +281,7 @@ def test_backpressure_rejects_when_pending_full(engine):
         b.close()
 
 
+@pytest.mark.usefixtures("zero_leaked_handles")
 def test_graceful_shutdown_drains_in_flight(engine):
     gated = _GatedEngine(engine)
     b = MicroBatcher(gated, deadline_ms=0.0, max_pending=16,

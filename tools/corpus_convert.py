@@ -54,11 +54,11 @@ def text_to_csr(src: str, dst: str, shift: int = QUESTION_TOKEN_INDEX) -> int:
 
 def csr_to_text(src: str, dst: str) -> int:
     """Stream ``src`` (CSR container) back to the canonical text form."""
-    corpus = open_corpus_csr(src)
-    with open(dst, "w", encoding="utf-8") as f:
-        for record in corpus.iter_records():
-            write_corpus_record(f, record)
-    return corpus.n_items
+    with open_corpus_csr(src) as corpus:
+        with open(dst, "w", encoding="utf-8") as f:
+            for record in corpus.iter_records():
+                write_corpus_record(f, record)
+        return corpus.n_items
 
 
 def main(argv: list[str] | None = None) -> None:
